@@ -1,0 +1,25 @@
+"""cluster_tools_tpu: a TPU-native framework for distributed blockwise
+processing of very large 3-D volumetric images.
+
+A ground-up re-design of the capabilities of ``cluster_tools`` (the
+luigi/slurm-based blockwise segmentation framework; see SURVEY.md) for TPU
+hardware: per-block compute kernels are JAX/Pallas functions batched over a
+``jax.sharding.Mesh``; halo exchange and the two-pass label union-find merge
+run as ICI collectives (``shard_map`` + ``ppermute``/``all_gather``); chunked
+N5/zarr IO streams from host into HBM via tensorstore.
+
+Layer map (bottom-up, mirroring SURVEY.md §1 but TPU-first):
+
+- L0' ``ops/``       device kernels: CCL, EDT, watershed, union-find, segment ops
+- L1' ``io/`` +
+       ``utils/``    tensorstore/h5py volume IO, block-grid math, halo/bb math
+- L2' ``runtime/``   task DAG + execution targets (local CPU mesh / TPU mesh),
+                     idempotent success-manifest resume (replaces luigi+slurm)
+- L3' ``tasks/``     the op/task library (connected_components, watershed,
+                     graph, features, multicut, ...)
+- L4' ``workflows``  end-to-end segmentation workflow compositions
+- ``parallel/``      mesh construction, spatial sharding, halo exchange
+- ``models/``        flax models for the inference task (boundary/affinity CNNs)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .containers import open_container, ZarrContainer, H5Container, MemoryContainer

@@ -1,0 +1,426 @@
+"""Chunked-array containers: the framework's inter-stage data plane.
+
+The reference used z5py (C++ N5/zarr bindings) plus h5py as its entire
+inter-job data plane (SURVEY.md §2d).  Here the same role is played by
+**tensorstore** (Google's C++ chunked-array library, zarr + N5 drivers) with
+h5py for HDF5 inputs, behind one small uniform API:
+
+    f = open_container("/data/seg.n5")          # or .zarr / .h5
+    ds = f.create_dataset("labels", shape=..., chunks=..., dtype="uint64")
+    ds[bb] = block          # numpy in / numpy out
+    arr = ds[bb]
+
+Datasets are addressed by key (group paths like ``volumes/raw`` work).  All
+reads/writes are synchronous numpy round-trips at this layer; the async
+host->HBM streaming pipeline lives in :mod:`cluster_tools_tpu.io.prefetch`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import tensorstore as ts
+except ImportError:  # pragma: no cover - tensorstore is expected in this image
+    ts = None
+
+try:
+    import h5py
+except ImportError:  # pragma: no cover
+    h5py = None
+
+
+_ZARR_EXTS = (".zarr", ".zr", ".n5")
+_H5_EXTS = (".h5", ".hdf5", ".hdf")
+
+# numpy dtype -> zarr v2 dtype string
+def _zarr_dtype(dtype) -> str:
+    return np.dtype(dtype).newbyteorder("<").str
+
+
+def _n5_dtype(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+class Dataset:
+    """A chunked dataset backed by tensorstore."""
+
+    def __init__(self, store, attrs_path: Optional[str] = None):
+        self._store = store
+        self._attrs_path = attrs_path
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._store.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._store.dtype.numpy_dtype)
+
+    @property
+    def chunks(self) -> Tuple[int, ...]:
+        return tuple(self._store.chunk_layout.read_chunk.shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __getitem__(self, bb) -> np.ndarray:
+        return np.asarray(self._store[bb].read().result())
+
+    def __setitem__(self, bb, value) -> None:
+        value = np.asarray(value, dtype=self.dtype)
+        self._store[bb].write(value).result()
+
+    def read_async(self, bb):
+        """Start an async read; returns a future with ``.result()`` -> numpy."""
+        return self._store[bb].read()
+
+    def write_async(self, bb, value):
+        value = np.asarray(value, dtype=self.dtype)
+        return self._store[bb].write(value)
+
+    # -- attributes (json sidecar, mirroring z5py/zarr .zattrs) -------------
+    @property
+    def attrs(self) -> Dict:
+        if self._attrs_path is None or not os.path.exists(self._attrs_path):
+            return {}
+        with open(self._attrs_path) as f:
+            return json.load(f)
+
+    def update_attrs(self, **kwargs) -> None:
+        if self._attrs_path is None:
+            raise RuntimeError("dataset has no attribute store")
+        attrs = self.attrs
+        attrs.update(kwargs)
+        with open(self._attrs_path, "w") as f:
+            json.dump(attrs, f, indent=2, default=_json_default)
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not json-serializable: {type(o)}")
+
+
+class _ImmediateFuture:
+    """Future-shim for backends whose reads/writes complete synchronously."""
+
+    def __init__(self, v):
+        self._v = v
+
+    def result(self):
+        return self._v
+
+
+
+class ZarrContainer:
+    """A zarr (v2) or N5 container on the local filesystem, via tensorstore."""
+
+    def __init__(self, path: str, mode: str = "a"):
+        if ts is None:
+            raise ImportError("tensorstore is required for zarr/n5 containers")
+        self.path = os.path.abspath(path)
+        self.mode = mode
+        self.is_n5 = self.path.endswith(".n5")
+        self._cache: Dict[str, Dataset] = {}
+        self._lock = threading.Lock()
+        if mode != "r":
+            os.makedirs(self.path, exist_ok=True)
+            marker = os.path.join(
+                self.path, "attributes.json" if self.is_n5 else ".zgroup"
+            )
+            if not os.path.exists(marker):
+                with open(marker, "w") as f:
+                    json.dump(
+                        {"n5": "2.0.0"} if self.is_n5 else {"zarr_format": 2}, f
+                    )
+
+    # -- internal ----------------------------------------------------------
+    def _spec(self, key: str, metadata: Optional[dict] = None, create: bool = False):
+        spec = {
+            "driver": "n5" if self.is_n5 else "zarr",
+            "kvstore": {"driver": "file", "path": os.path.join(self.path, key)},
+            "recheck_cached_data": "open",
+        }
+        if metadata is not None:
+            spec["metadata"] = metadata
+        if create:
+            spec["create"] = True
+            spec["open"] = True
+        return spec
+
+    def _attrs_path(self, key: str) -> str:
+        fname = "attributes.json" if self.is_n5 else ".zattrs"
+        return os.path.join(self.path, key, fname)
+
+    # -- public api --------------------------------------------------------
+    def create_dataset(
+        self,
+        key: str,
+        shape: Sequence[int],
+        chunks: Sequence[int],
+        dtype,
+        compression: Optional[str] = "gzip",
+        exist_ok: bool = True,
+        fill_value: int = 0,
+    ) -> Dataset:
+        if self.mode == "r":
+            raise PermissionError(f"container {self.path} opened read-only")
+        shape = [int(s) for s in shape]
+        chunks = [int(min(c, s)) for c, s in zip(chunks, shape)]
+        if self.is_n5:
+            comp = {"type": compression if compression else "raw"}
+            # the N5 spec stores dimensions fastest-varying-first (F-order);
+            # we write spec-compliant metadata and present C-order through a
+            # tensorstore transpose in _open_store, so z5py/Java-N5 readers
+            # see the same axis order as our numpy API
+            metadata = {
+                "dimensions": shape[::-1],
+                "blockSize": chunks[::-1],
+                "dataType": _n5_dtype(dtype),
+                "compression": comp,
+            }
+        else:
+            comp = (
+                {"id": "zlib", "level": 1}
+                if compression == "gzip"
+                else None
+            )
+            metadata = {
+                "shape": shape,
+                "chunks": chunks,
+                "dtype": _zarr_dtype(dtype),
+                "compressor": comp,
+                "fill_value": fill_value,
+            }
+        try:
+            store = self._open_store(key, metadata, create=True)
+        except ValueError:
+            if not exist_ok:
+                raise
+            store = self._open_store(key)
+            if tuple(store.shape) != tuple(shape) or (
+                np.dtype(store.dtype.numpy_dtype) != np.dtype(dtype)
+            ):
+                raise ValueError(
+                    f"dataset {key!r} exists with shape {tuple(store.shape)} / "
+                    f"dtype {store.dtype.numpy_dtype}, requested {tuple(shape)} / "
+                    f"{np.dtype(dtype)}"
+                )
+        ds = Dataset(store, self._attrs_path(key))
+        with self._lock:
+            self._cache[key] = ds
+        return ds
+
+    def _open_store(self, key, metadata=None, create=False):
+        store = ts.open(self._spec(key, metadata, create=create)).result()
+        if self.is_n5:
+            # present C-order over the spec-mandated F-order on-disk layout
+            store = store.T
+        return store
+
+    def require_dataset(self, key: str, **kwargs) -> Dataset:
+        if key in self:
+            return self[key]
+        return self.create_dataset(key, **kwargs)
+
+    def __getitem__(self, key: str) -> Dataset:
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        store = self._open_store(key)
+        ds = Dataset(store, self._attrs_path(key))
+        with self._lock:
+            self._cache[key] = ds
+        return ds
+
+    def __contains__(self, key: str) -> bool:
+        d = os.path.join(self.path, key)
+        if self.is_n5:
+            return os.path.exists(os.path.join(d, "attributes.json"))
+        return os.path.exists(os.path.join(d, ".zarray"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def close(self):
+        pass
+
+
+class _H5Dataset:
+    """Adapter giving h5py datasets the same surface as :class:`Dataset`."""
+
+    def __init__(self, ds):
+        self._ds = ds
+
+    shape = property(lambda self: tuple(self._ds.shape))
+    dtype = property(lambda self: self._ds.dtype)
+    ndim = property(lambda self: self._ds.ndim)
+
+    @property
+    def chunks(self):
+        return tuple(self._ds.chunks) if self._ds.chunks else tuple(self._ds.shape)
+
+    def __getitem__(self, bb):
+        return self._ds[bb]
+
+    def __setitem__(self, bb, value):
+        self._ds[bb] = value
+
+    def read_async(self, bb):
+        return _ImmediateFuture(self._ds[bb])
+
+    def write_async(self, bb, value):
+        self._ds[bb] = value
+        return _ImmediateFuture(None)
+
+    @property
+    def attrs(self):
+        return dict(self._ds.attrs)
+
+    def update_attrs(self, **kwargs):
+        self._ds.attrs.update(kwargs)
+
+
+class H5Container:
+    def __init__(self, path: str, mode: str = "a"):
+        if h5py is None:
+            raise ImportError("h5py is required for hdf5 containers")
+        self.path = path
+        self._f = h5py.File(path, mode)
+
+    def create_dataset(self, key, shape, chunks, dtype, compression="gzip", exist_ok=True, fill_value=0):
+        if exist_ok and key in self._f:
+            return _H5Dataset(self._f[key])
+        ds = self._f.create_dataset(
+            key,
+            shape=tuple(shape),
+            chunks=tuple(int(min(c, s)) for c, s in zip(chunks, shape)),
+            dtype=dtype,
+            compression=compression,
+            fillvalue=fill_value,
+        )
+        return _H5Dataset(ds)
+
+    def require_dataset(self, key, **kwargs):
+        return self.create_dataset(key, exist_ok=True, **kwargs)
+
+    def __getitem__(self, key):
+        return _H5Dataset(self._f[key])
+
+    def __contains__(self, key):
+        return key in self._f
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+    def close(self):
+        self._f.close()
+
+
+class MemoryContainer:
+    """In-memory container (tests and tiny pipelines)."""
+
+    _registry: Dict[str, "MemoryContainer"] = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self, path: str = "", mode: str = "a"):
+        self.path = path
+        self._data: Dict[str, "_MemDataset"] = {}
+
+    @classmethod
+    def open(cls, path: str, mode: str = "a") -> "MemoryContainer":
+        with cls._registry_lock:
+            if path not in cls._registry:
+                cls._registry[path] = cls(path)
+            return cls._registry[path]
+
+    def create_dataset(self, key, shape, chunks, dtype, compression=None, exist_ok=True, fill_value=0):
+        if key in self._data:
+            if not exist_ok:
+                raise ValueError(f"dataset {key} exists")
+            return self._data[key]
+        ds = _MemDataset(np.full(tuple(shape), fill_value, dtype=dtype), tuple(chunks))
+        self._data[key] = ds
+        return ds
+
+    def require_dataset(self, key, **kwargs):
+        return self.create_dataset(key, exist_ok=True, **kwargs)
+
+    def __getitem__(self, key):
+        return self._data[key]
+
+    def __contains__(self, key):
+        return key in self._data
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def close(self):
+        pass
+
+
+class _MemDataset:
+    def __init__(self, arr: np.ndarray, chunks: Tuple[int, ...]):
+        self._arr = arr
+        self.chunks = chunks
+        self._attrs: Dict = {}
+
+    shape = property(lambda self: self._arr.shape)
+    dtype = property(lambda self: self._arr.dtype)
+    ndim = property(lambda self: self._arr.ndim)
+
+    def __getitem__(self, bb):
+        return self._arr[bb].copy()
+
+    def __setitem__(self, bb, value):
+        self._arr[bb] = value
+
+    def read_async(self, bb):
+        return _ImmediateFuture(self._arr[bb].copy())
+
+    def write_async(self, bb, value):
+        self._arr[bb] = value
+        return _ImmediateFuture(None)
+
+    @property
+    def attrs(self):
+        return dict(self._attrs)
+
+    def update_attrs(self, **kwargs):
+        self._attrs.update(kwargs)
+
+
+def open_container(path: str, mode: str = "a"):
+    """Open a container by extension (SURVEY.md: ``vu.file_reader``)."""
+    if path.startswith("memory://"):
+        return MemoryContainer.open(path, mode)
+    lower = path.lower()
+    if lower.endswith(_ZARR_EXTS):
+        return ZarrContainer(path, mode)
+    if lower.endswith(_H5_EXTS):
+        return H5Container(path, mode)
+    raise ValueError(
+        f"cannot infer container format from {path!r} "
+        f"(expected one of {_ZARR_EXTS + _H5_EXTS} or memory://)"
+    )

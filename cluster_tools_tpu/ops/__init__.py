@@ -1,0 +1,4 @@
+from . import ccl
+from . import unionfind
+from . import edt
+from . import watershed

@@ -1,0 +1,177 @@
+"""Connected-components labeling as a dense, XLA-friendly device kernel.
+
+The reference delegated per-block CCL to ``vigra.labelVolumeWithBackground``
+(C++, serial two-pass union-find; SURVEY.md §2b).  A serial union-find is the
+wrong shape for a TPU's dense SIMD model, so this is a ground-up redesign: the
+*label-equivalence* algorithm (Playne & Hawick style), which is a fixpoint
+iteration of three dense steps —
+
+1. **propagate**: every foreground voxel takes the min label over its
+   neighborhood (background holds a +inf sentinel, so no masking logic),
+2. **hook**: scatter-min the improved label onto the voxel's current root
+   (union-by-min), which lets label information jump across whole trees
+   instead of one voxel per step,
+3. **compress**: pointer-jumping ``lab = lab[lab]`` to full path compression.
+
+Each step is a dense shift/gather/scatter over the block, so XLA can fuse and
+tile it; the data-dependent iteration count lives in ``lax.while_loop``
+(compiled once, static shapes).  Convergence is O(log d) hook rounds in
+practice.  Labels are ``flat_index(min voxel of component) + 1``; background
+is 0 after :func:`finalize_labels`.
+
+The kernel is pure ``(block) -> labels`` and vmap/shard_map-compatible, so a
+batch of blocks runs as one device program across the mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _shift(x: jnp.ndarray, offset: int, axis: int, fill) -> jnp.ndarray:
+    """y[i] = x[i - offset] along ``axis``, with ``fill`` shifted in."""
+    n = x.shape[axis]
+    pad_shape = list(x.shape)
+    pad_shape[axis] = abs(offset)
+    pad = jnp.full(pad_shape, fill, dtype=x.dtype)
+    if offset > 0:
+        body = lax.slice_in_dim(x, 0, n - offset, axis=axis)
+        return jnp.concatenate([pad, body], axis=axis)
+    else:
+        body = lax.slice_in_dim(x, -offset, n, axis=axis)
+        return jnp.concatenate([body, pad], axis=axis)
+
+
+def _neighbor_offsets(ndim: int, connectivity: int) -> Sequence[Tuple[int, ...]]:
+    """Half of the symmetric neighborhood (each unordered pair once)."""
+    offsets = []
+    for off in np.ndindex(*([3] * ndim)):
+        off = tuple(o - 1 for o in off)
+        if all(o == 0 for o in off):
+            continue
+        if sum(abs(o) for o in off) > connectivity:
+            continue
+        # keep only the lexicographically-positive half
+        if off > tuple([0] * ndim):
+            offsets.append(off)
+    return offsets
+
+
+def _shift_nd(x: jnp.ndarray, offset: Tuple[int, ...], fill) -> jnp.ndarray:
+    for axis, o in enumerate(offset):
+        if o != 0:
+            x = _shift(x, o, axis, fill)
+    return x
+
+
+def _compress(flat: jnp.ndarray, sentinel) -> jnp.ndarray:
+    """Pointer-jump ``flat = flat[flat]`` to fixpoint (full path compression)."""
+    n = flat.shape[0]
+
+    def gather(f):
+        g = f[jnp.clip(f, 0, n - 1)]
+        return jnp.where(f == sentinel, sentinel, g)
+
+    def cond(state):
+        f, changed = state
+        return changed
+
+    def body(state):
+        f, _ = state
+        f2 = gather(f)
+        return f2, jnp.any(f2 != f)
+
+    flat, _ = lax.while_loop(cond, body, (flat, jnp.bool_(True)))
+    return flat
+
+
+@partial(jax.jit, static_argnames=("connectivity",))
+def label_components(mask: jnp.ndarray, connectivity: int = 1) -> jnp.ndarray:
+    """Label connected components of a boolean mask (any rank >= 1).
+
+    Returns int32 labels with the same shape as ``mask``: for foreground
+    voxels, ``flat_index_of_component_minimum`` (a stable, globally
+    offsettable representative); background voxels hold ``N`` (the sentinel).
+    Use :func:`finalize_labels` to convert to 1-based labels with 0 background.
+
+    ``connectivity`` as in scipy: 1 = faces only, ``ndim`` = full neighborhood.
+    """
+    shape = mask.shape
+    n = int(np.prod(shape))
+    sentinel = jnp.int32(n)
+    mask = mask.astype(bool)
+    idx = jnp.arange(n, dtype=jnp.int32).reshape(shape)
+    lab = jnp.where(mask, idx, sentinel)
+    offsets = _neighbor_offsets(len(shape), connectivity)
+
+    def neighbor_min(lab3):
+        m = lab3
+        for off in offsets:
+            m = jnp.minimum(m, _shift_nd(lab3, off, sentinel))
+            m = jnp.minimum(m, _shift_nd(lab3, tuple(-o for o in off), sentinel))
+        return jnp.where(mask, m, sentinel)
+
+    def cond(state):
+        flat, changed = state
+        return changed
+
+    def body(state):
+        flat, _ = state
+        lab3 = flat.reshape(shape)
+        nmin = neighbor_min(lab3).ravel()
+        improved = nmin < flat
+        # hook: push the improved label onto the current root (flat is fully
+        # compressed, so flat[i] is i's root)
+        root = jnp.clip(flat, 0, n - 1)
+        upd = jnp.where(improved, nmin, sentinel)
+        hooked = flat.at[root].min(upd, mode="drop")
+        hooked = jnp.where(flat == sentinel, sentinel, hooked)
+        new = _compress(jnp.minimum(hooked, jnp.minimum(flat, nmin)), sentinel)
+        return new, jnp.any(new != flat)
+
+    flat, _ = lax.while_loop(cond, body, (lab.ravel(), jnp.bool_(True)))
+    return flat.reshape(shape)
+
+
+def finalize_labels(raw: jnp.ndarray) -> jnp.ndarray:
+    """Convert sentinel/flat-index labels to (flat_index + 1, background=0)."""
+    n = int(np.prod(raw.shape))
+    return jnp.where(raw == n, 0, raw + 1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("max_labels",))
+def relabel_consecutive(
+    labels: jnp.ndarray, max_labels: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Map arbitrary non-negative labels (0 = background) to dense 1..K.
+
+    ``max_labels`` is a static upper bound on the number of distinct
+    foreground labels (XLA needs a static size for ``unique``).  Returns
+    ``(dense_labels, n_labels)``.
+    """
+    big = jnp.int32(np.iinfo(np.int32).max)
+    # force 0 into the set so background stays id 0, and pad with int32-max so
+    # the padded array stays sorted for searchsorted
+    flat = jnp.concatenate([jnp.zeros((1,), labels.dtype), labels.ravel()])
+    uniq = jnp.unique(flat, size=max_labels + 2, fill_value=big)
+    dense = jnp.searchsorted(uniq, flat[1:])
+    # exact distinct-foreground count (independent of the static bound), so
+    # callers can detect max_labels overflow: n > max_labels => dense invalid
+    srt = jnp.sort(flat)
+    n_distinct = jnp.sum(srt[1:] != srt[:-1]) + 1  # includes background 0
+    n_fg = (n_distinct - 1).astype(jnp.int32)
+    return dense.reshape(labels.shape).astype(jnp.int32), n_fg
+
+
+def label_components_batch(
+    masks: jnp.ndarray, connectivity: int = 1
+) -> jnp.ndarray:
+    """vmapped :func:`label_components` over a leading block-batch axis."""
+    return jax.vmap(partial(label_components, connectivity=connectivity))(masks)

@@ -1,0 +1,79 @@
+"""Euclidean distance transform as a separable, dense device kernel.
+
+The reference used ``vigra.filters.distanceTransform`` (C++ Felzenszwalb-style
+lower-envelope scan; SURVEY.md §2b).  The envelope scan is inherently
+sequential per line, which is hostile to a vector unit, so this redesign uses
+the *brute-force separable* formulation instead: exact squared EDT decomposes
+per axis as
+
+    g[i] = min_j ( f[j] + w * (i - j)^2 )
+
+— a min-plus product of each line with a fixed (n, n) parabola matrix.  The
+broadcast-add + min-reduce fuses in XLA into a single tiled loop (no (n, n)
+intermediate in HBM), and all lines process in parallel on the VPU.  O(n) more
+FLOPs than Felzenszwalb per line, but FLOPs are what a TPU has; block
+extents are <= a few hundred voxels so n^2 per line is small.
+
+Supports anisotropic ``sampling`` (e.g. CREMI's (40, 4, 4) nm voxels).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BIG = jnp.float32(1e12)
+
+
+def _edt_1d_axis(f: jnp.ndarray, axis: int, w: float) -> jnp.ndarray:
+    """One separable pass: g[..., i] = min_j f[..., j] + w*(i-j)^2 along axis."""
+    n = f.shape[axis]
+    f = jnp.moveaxis(f, axis, -1)
+    i = jnp.arange(n, dtype=jnp.float32)
+    dist = (i[:, None] - i[None, :]) ** 2 * jnp.float32(w)  # [j, i]
+    g = jnp.min(f[..., :, None] + dist, axis=-2)
+    return jnp.moveaxis(g, -1, axis)
+
+
+@partial(jax.jit, static_argnames=("sampling",))
+def _dt_squared_impl(mask: jnp.ndarray, sampling: Tuple[float, ...]) -> jnp.ndarray:
+    f = jnp.where(mask, _BIG, jnp.float32(0.0))
+    for axis in range(mask.ndim):
+        f = _edt_1d_axis(f, axis, float(sampling[axis]) ** 2)
+    return jnp.minimum(f, _BIG)
+
+
+def _norm_sampling(ndim: int, sampling) -> Tuple[float, ...]:
+    if sampling is None:
+        return (1.0,) * ndim
+    sampling = tuple(float(s) for s in np.atleast_1d(sampling))
+    if len(sampling) == 1:
+        sampling = sampling * ndim
+    if len(sampling) != ndim:
+        raise ValueError(f"sampling {sampling} has wrong rank for ndim {ndim}")
+    return sampling
+
+
+def distance_transform_squared(
+    mask: jnp.ndarray, sampling: Optional[Sequence[float]] = None
+) -> jnp.ndarray:
+    """Squared EDT of a boolean mask: distance to the nearest background voxel.
+
+    Foreground voxels get the squared distance to the nearest ``False`` voxel;
+    background voxels get 0.  If the block contains no background, foreground
+    saturates at a large constant (callers clip or don't care — matches the
+    halo-read semantics where blocks always see some context).  ``sampling``
+    may be a scalar, list, tuple, or array of per-axis voxel sizes.
+    """
+    return _dt_squared_impl(mask, _norm_sampling(mask.ndim, sampling))
+
+
+def distance_transform(
+    mask: jnp.ndarray, sampling: Optional[Sequence[float]] = None
+) -> jnp.ndarray:
+    """Exact Euclidean distance transform (sqrt of the squared EDT)."""
+    return jnp.sqrt(distance_transform_squared(mask, sampling=sampling))

@@ -1,0 +1,111 @@
+"""Union-find over label-equivalence pairs, as a device fixpoint iteration.
+
+The reference's global label merge ran ``nifty.ufd`` (serial C++ union-find)
+in a single merge job — its named scalability cliff (SURVEY.md §3.2).  On TPU
+the same merge is a dense pointer-jumping iteration over the whole label
+table, so it parallelizes over the vector unit and, across hosts, the
+equivalence pairs are all-gathered over ICI before one replicated solve:
+
+  repeat until stable:
+    parent <- path-compress(parent)              (pointer jumping)
+    for each pair (u, v): parent[max-root] min= min-root   (scatter-min hook)
+
+Everything is static-shape; the data-dependent iteration count lives in
+``lax.while_loop``.  A numpy/scipy host implementation is provided for the
+driver path and as the test oracle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("n_labels",))
+def union_find(pairs: jnp.ndarray, n_labels: int) -> jnp.ndarray:
+    """Resolve equivalence ``pairs`` (int32 [m, 2]) over labels [0, n_labels).
+
+    Returns ``parent`` of shape [n_labels] mapping every label to its
+    component representative (the component's minimum label).  Invalid pairs
+    may be encoded as ``(i, i)`` self-loops (no-ops) — useful for padding to
+    static shapes.
+    """
+    n = int(n_labels)
+    parent = jnp.arange(n, dtype=jnp.int32)
+    # out-of-range endpoints (e.g. -1 padding) turn the whole pair into a
+    # (0, 0) self-loop no-op rather than being clipped into a real label
+    u, v = pairs[:, 0], pairs[:, 1]
+    valid = (u >= 0) & (u < n) & (v >= 0) & (v < n)
+    u = jnp.where(valid, u, 0)
+    v = jnp.where(valid, v, 0)
+
+    def compress(p):
+        def cond(s):
+            f, changed = s
+            return changed
+
+        def body(s):
+            f, _ = s
+            f2 = f[f]
+            return f2, jnp.any(f2 != f)
+
+        p, _ = lax.while_loop(cond, body, (p, jnp.bool_(True)))
+        return p
+
+    def cond(state):
+        p, changed = state
+        return changed
+
+    def body(state):
+        p, _ = state
+        ru = p[u]
+        rv = p[v]
+        lo = jnp.minimum(ru, rv)
+        hi = jnp.maximum(ru, rv)
+        p2 = p.at[hi].min(lo)
+        p2 = compress(p2)
+        return p2, jnp.any(p2 != p)
+
+    parent, _ = lax.while_loop(cond, body, (parent, jnp.bool_(True)))
+    return parent
+
+
+def union_find_host(pairs: np.ndarray, n_labels: int) -> np.ndarray:
+    """Host-side oracle/driver path via scipy sparse connected components.
+
+    Returns the same contract as :func:`union_find`: each label mapped to the
+    minimum label of its component.
+    """
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components
+
+    pairs = np.asarray(pairs)
+    if pairs.size == 0:
+        return np.arange(n_labels, dtype=np.int64)
+    data = np.ones(len(pairs), dtype=np.uint8)
+    g = coo_matrix(
+        (data, (pairs[:, 0], pairs[:, 1])), shape=(n_labels, n_labels)
+    )
+    _, comp = connected_components(g, directed=False)
+    # map each component id -> min label in it
+    order = np.argsort(comp, kind="stable")
+    comp_sorted = comp[order]
+    first = np.ones(len(order), dtype=bool)
+    first[1:] = comp_sorted[1:] != comp_sorted[:-1]
+    comp_min = np.zeros(comp.max() + 1, dtype=np.int64)
+    comp_min[comp_sorted[first]] = order[first]
+    # order is sorted by comp then label index ascending, so first occurrence
+    # per component is its minimum label
+    return comp_min[comp]
+
+
+@partial(jax.jit, static_argnames=("n_labels",))
+def apply_assignment(labels: jnp.ndarray, assignment: jnp.ndarray, n_labels: int):
+    """Relabel a block through an assignment table (reference: ``write`` task)."""
+    flat = jnp.clip(labels, 0, n_labels - 1)
+    return assignment[flat].astype(labels.dtype)

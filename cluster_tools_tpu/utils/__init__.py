@@ -1,0 +1,3 @@
+from . import volume_utils
+from . import function_utils
+from . import task_utils

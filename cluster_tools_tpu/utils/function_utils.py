@@ -1,0 +1,94 @@
+"""Logging and success markers.
+
+The reference coordinated completion through log files: workers wrote
+``log_block_success`` / ``log_job_success`` lines that the driver's
+``check_jobs`` grepped (SURVEY.md §2d, §5.5).  We keep the same two-level
+success-marker contract (it is the resume mechanism), but markers are JSON
+manifests rather than grep-able log lines.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import sys
+import threading
+from typing import Iterable, List, Optional
+
+_LOGGERS = {}
+_LOCK = threading.Lock()
+
+
+def get_logger(name: str = "cluster_tools_tpu", log_file: Optional[str] = None):
+    with _LOCK:
+        key = (name, log_file)
+        if key in _LOGGERS:
+            return _LOGGERS[key]
+        logger = logging.getLogger(name if log_file is None else f"{name}:{log_file}")
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+        fmt = logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        handler = (
+            logging.FileHandler(log_file)
+            if log_file
+            else logging.StreamHandler(sys.stderr)
+        )
+        handler.setFormatter(fmt)
+        logger.addHandler(handler)
+        _LOGGERS[key] = logger
+        return logger
+
+
+def log(msg: str, log_file: Optional[str] = None):
+    get_logger(log_file=log_file).info(msg)
+
+
+def _marker_dir(tmp_folder: str, task_name: str) -> str:
+    d = os.path.join(tmp_folder, "markers", task_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def log_block_success(tmp_folder: str, task_name: str, block_id: int):
+    """Record that one block of a task finished (block-level resume grain)."""
+    path = os.path.join(_marker_dir(tmp_folder, task_name), f"block_{block_id}.json")
+    with open(path, "w") as f:
+        json.dump({"block_id": block_id, "time": _now()}, f)
+
+
+def log_job_success(tmp_folder: str, task_name: str, job_id: int):
+    path = os.path.join(_marker_dir(tmp_folder, task_name), f"job_{job_id}.json")
+    with open(path, "w") as f:
+        json.dump({"job_id": job_id, "time": _now()}, f)
+
+
+def blocks_done(tmp_folder: str, task_name: str) -> List[int]:
+    d = _marker_dir(tmp_folder, task_name)
+    out = []
+    for fname in os.listdir(d):
+        if fname.startswith("block_") and fname.endswith(".json"):
+            out.append(int(fname[len("block_"):-len(".json")]))
+    return sorted(out)
+
+
+def jobs_done(tmp_folder: str, task_name: str) -> List[int]:
+    d = _marker_dir(tmp_folder, task_name)
+    return sorted(
+        int(f[len("job_"):-len(".json")])
+        for f in os.listdir(d)
+        if f.startswith("job_") and f.endswith(".json")
+    )
+
+
+def clean_up_for_retry(tmp_folder: str, task_name: str):
+    """Drop job-level markers so a failed task re-checks its blocks."""
+    d = _marker_dir(tmp_folder, task_name)
+    for fname in os.listdir(d):
+        if fname.startswith("job_"):
+            os.remove(os.path.join(d, fname))
+
+
+def _now() -> str:
+    return datetime.datetime.now().isoformat()

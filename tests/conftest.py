@@ -1,0 +1,25 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference's
+``target='local'`` doubled as the fake cluster backend; here the fake mesh is
+JAX's forced host-platform device count, so multi-device sharding/collective
+code paths are exercised on CPU without TPU hardware.
+"""
+
+import os
+
+# force CPU even when the session env points JAX at the TPU (JAX_PLATFORMS=axon)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

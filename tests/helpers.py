@@ -1,0 +1,34 @@
+"""Shared test helpers."""
+
+import numpy as np
+
+
+def assert_labels_equivalent(a: np.ndarray, b: np.ndarray):
+    """Assert two labelings are equal up to a bijection of label values.
+
+    Background (0) must match exactly.  This is the reference's oracle
+    comparison for blockwise-vs-single-shot labelings (SURVEY.md §4).
+    """
+    a = np.asarray(a)
+    b = np.asarray(b)
+    assert a.shape == b.shape
+    np.testing.assert_array_equal(a == 0, b == 0, err_msg="background differs")
+    fg = a != 0
+    if not fg.any():
+        return
+    pairs = np.stack([a[fg].ravel(), b[fg].ravel()], axis=1)
+    uniq = np.unique(pairs, axis=0)
+    # bijection: each a-label maps to exactly one b-label and vice versa
+    ua, ca = np.unique(uniq[:, 0], return_counts=True)
+    ub, cb = np.unique(uniq[:, 1], return_counts=True)
+    assert (ca == 1).all(), f"non-injective a->b for labels {ua[ca > 1][:10]}"
+    assert (cb == 1).all(), f"non-injective b->a for labels {ub[cb > 1][:10]}"
+
+
+def random_blobs(rng, shape, p=0.5, smooth=1):
+    """Random binary mask with some spatial correlation."""
+    x = rng.random(shape)
+    from scipy.ndimage import gaussian_filter
+
+    x = gaussian_filter(x, smooth)
+    return x > np.quantile(x, 1 - p)

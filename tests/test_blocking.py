@@ -1,0 +1,62 @@
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.utils.volume_utils import Blocking, blocks_in_volume, pad_block_to
+
+
+def test_blocking_grid():
+    b = Blocking((100, 64, 37), (32, 32, 32))
+    assert b.grid_shape == (4, 2, 2)
+    assert b.n_blocks == 16
+    blk = b.get_block(0)
+    assert blk.begin == (0, 0, 0)
+    assert blk.shape == (32, 32, 32)
+    # last block along each axis is clipped
+    last = b.get_block(b.n_blocks - 1)
+    assert last.end == (100, 64, 37)
+    assert last.shape == (4, 32, 5)
+
+
+def test_blocking_roundtrip_ids():
+    b = Blocking((64, 64, 64), (16, 32, 32))
+    for bid in range(b.n_blocks):
+        pos = b.block_grid_position(bid)
+        assert b.grid_position_to_id(pos) == bid
+
+
+def test_halo_clipping():
+    b = Blocking((64, 64, 64), (32, 32, 32))
+    blk = b.get_block(0, halo=(8, 8, 8))
+    assert blk.outer_begin == (0, 0, 0)
+    assert blk.outer_end == (40, 40, 40)
+    assert blk.inner_in_outer_bb == (slice(0, 32),) * 3
+    # interior block of a finer grid has full halo on all sides
+    b2 = Blocking((96, 96, 96), (32, 32, 32))
+    mid = b2.grid_position_to_id((1, 1, 1))
+    blk2 = b2.get_block(mid, halo=(8, 8, 8))
+    assert blk2.outer_shape == (48, 48, 48)
+    assert blk2.inner_in_outer_bb == (slice(8, 40),) * 3
+
+
+def test_neighbors():
+    b = Blocking((64, 64, 64), (32, 32, 32))
+    assert b.neighbor_id(0, 0, 1) == b.grid_position_to_id((1, 0, 0))
+    assert b.neighbor_id(0, 0, -1) is None
+    assert b.neighbor_id(0, 2, 1) == b.grid_position_to_id((0, 0, 1))
+
+
+def test_blocks_in_volume_roi():
+    ids = blocks_in_volume((64, 64, 64), (32, 32, 32))
+    assert ids == list(range(8))
+    ids = blocks_in_volume((64, 64, 64), (32, 32, 32), (0, 0, 0), (32, 64, 64))
+    assert len(ids) == 4
+    ids = blocks_in_volume((64, 64, 64), (32, 32, 32), (33, 33, 33), (64, 64, 64))
+    assert len(ids) == 1
+
+
+def test_pad_block_to():
+    x = np.ones((5, 7), np.float32)
+    y = pad_block_to(x, (8, 8))
+    assert y.shape == (8, 8)
+    assert y[:5, :7].sum() == 35
+    assert y.sum() == 35

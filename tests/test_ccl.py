@@ -1,0 +1,68 @@
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.ccl import (
+    label_components,
+    label_components_batch,
+    finalize_labels,
+    relabel_consecutive,
+)
+from .helpers import assert_labels_equivalent, random_blobs
+
+
+def _scipy_structure(ndim, connectivity):
+    return ndi.generate_binary_structure(ndim, connectivity)
+
+
+@pytest.mark.parametrize("connectivity", [1, 3])
+def test_ccl_3d_vs_scipy(rng, connectivity):
+    mask = random_blobs(rng, (40, 40, 40), p=0.4)
+    ours = np.asarray(finalize_labels(label_components(jnp.asarray(mask), connectivity)))
+    ref, _ = ndi.label(mask, structure=_scipy_structure(3, connectivity))
+    assert_labels_equivalent(ours, ref)
+
+
+@pytest.mark.parametrize("connectivity", [1, 2])
+def test_ccl_2d_vs_scipy(rng, connectivity):
+    mask = random_blobs(rng, (80, 80), p=0.45)
+    ours = np.asarray(finalize_labels(label_components(jnp.asarray(mask), connectivity)))
+    ref, _ = ndi.label(mask, structure=_scipy_structure(2, connectivity))
+    assert_labels_equivalent(ours, ref)
+
+
+def test_ccl_empty_and_full():
+    empty = jnp.zeros((8, 8, 8), bool)
+    assert np.asarray(finalize_labels(label_components(empty))).sum() == 0
+    full = jnp.ones((8, 8, 8), bool)
+    lab = np.asarray(finalize_labels(label_components(full)))
+    assert (lab == 1).all()
+
+
+def test_ccl_sparse_noise(rng):
+    # worst case for propagation: independent random voxels
+    mask = rng.random((32, 32, 32)) < 0.1
+    ours = np.asarray(finalize_labels(label_components(jnp.asarray(mask))))
+    ref, _ = ndi.label(mask, structure=_scipy_structure(3, 1))
+    assert_labels_equivalent(ours, ref)
+
+
+def test_ccl_batch(rng):
+    masks = np.stack([random_blobs(rng, (24, 24, 24), p=0.4) for _ in range(4)])
+    out = np.asarray(label_components_batch(jnp.asarray(masks)))
+    for i in range(4):
+        ref, _ = ndi.label(masks[i], structure=_scipy_structure(3, 1))
+        assert_labels_equivalent(np.asarray(finalize_labels(jnp.asarray(out[i]))), ref)
+
+
+def test_relabel_consecutive():
+    labels = jnp.asarray(np.array([[0, 5, 5], [9, 0, 123], [9, 5, 0]], np.int32))
+    dense, n = relabel_consecutive(labels, max_labels=10)
+    dense = np.asarray(dense)
+    assert int(n) == 3
+    assert set(np.unique(dense)) == {0, 1, 2, 3}
+    assert (dense == 0).sum() == 3
+    # order-preserving
+    assert dense[0, 1] == 1 and dense[1, 0] == 2 and dense[1, 2] == 3

@@ -1,0 +1,33 @@
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.edt import distance_transform, distance_transform_squared
+
+
+@pytest.mark.parametrize("shape", [(32, 32), (24, 24, 24)])
+def test_edt_vs_scipy(rng, shape):
+    mask = rng.random(shape) > 0.3
+    got = np.asarray(distance_transform(jnp.asarray(mask)))
+    want = ndi.distance_transform_edt(mask)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_edt_anisotropic(rng):
+    mask = rng.random((20, 24, 28)) > 0.3
+    sampling = (4.0, 1.0, 1.0)
+    got = np.asarray(distance_transform(jnp.asarray(mask), sampling=sampling))
+    want = ndi.distance_transform_edt(mask, sampling=sampling)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-3)
+
+
+def test_edt_all_foreground_saturates():
+    mask = jnp.ones((8, 8), bool)
+    got = np.asarray(distance_transform_squared(mask))
+    assert (got >= 1e11).all()
+
+
+def test_edt_all_background():
+    mask = jnp.zeros((8, 8), bool)
+    assert np.asarray(distance_transform(mask)).sum() == 0

@@ -1,0 +1,59 @@
+import numpy as np
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.unionfind import union_find, union_find_host, apply_assignment
+
+
+def _oracle(pairs, n):
+    parent = list(range(n))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for u, v in pairs:
+        ru, rv = find(u), find(v)
+        if ru != rv:
+            parent[max(ru, rv)] = min(ru, rv)
+    return np.array([find(i) for i in range(n)])
+
+
+def test_union_find_device_vs_oracle(rng):
+    n = 500
+    pairs = rng.integers(0, n, size=(300, 2)).astype(np.int32)
+    got = np.asarray(union_find(jnp.asarray(pairs), n))
+    want = _oracle(pairs.tolist(), n)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_union_find_host_vs_oracle(rng):
+    n = 500
+    pairs = rng.integers(0, n, size=(300, 2)).astype(np.int64)
+    got = union_find_host(pairs, n)
+    want = _oracle(pairs.tolist(), n)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_union_find_empty():
+    got = np.asarray(union_find(jnp.zeros((0, 2), jnp.int32), 10))
+    np.testing.assert_array_equal(got, np.arange(10))
+    np.testing.assert_array_equal(union_find_host(np.zeros((0, 2)), 10), np.arange(10))
+
+
+def test_union_find_self_loop_padding(rng):
+    n = 100
+    real = rng.integers(0, n, size=(20, 2)).astype(np.int32)
+    pad = np.stack([np.arange(30, dtype=np.int32)] * 2, axis=1)
+    pairs = np.concatenate([real, pad])
+    got = np.asarray(union_find(jnp.asarray(pairs), n))
+    want = _oracle(real.tolist(), n)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_apply_assignment():
+    labels = jnp.asarray(np.array([0, 1, 2, 3, 2], np.int32))
+    assignment = jnp.asarray(np.array([0, 1, 1, 3], np.int32))
+    out = np.asarray(apply_assignment(labels, assignment, 4))
+    np.testing.assert_array_equal(out, [0, 1, 1, 3, 1])

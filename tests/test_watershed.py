@@ -1,0 +1,163 @@
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.watershed import (
+    seeded_watershed,
+    local_maxima,
+    dt_seeds,
+)
+from cluster_tools_tpu.ops.edt import distance_transform
+from .helpers import assert_labels_equivalent
+
+
+def _descent_oracle(height, seeds, connectivity=1):
+    """Serial steepest-descent watershed with the same (h, idx) tiebreak."""
+    shape = height.shape
+    n = height.size
+    h = height.ravel().astype(np.float64)
+    idx = np.arange(n)
+    offsets = []
+    for off in np.ndindex(*([3] * height.ndim)):
+        off = tuple(o - 1 for o in off)
+        if all(o == 0 for o in off) or sum(map(abs, off)) > connectivity:
+            continue
+        offsets.append(off)
+    coords = np.stack(np.unravel_index(idx, shape), axis=1)
+    ptr = idx.copy()
+    seeds_flat = seeds.ravel()
+    for i in range(n):
+        if seeds_flat[i] > 0:
+            continue
+        best = i
+        for off in offsets:
+            c = coords[i] + off
+            if ((c < 0) | (c >= shape)).any():
+                continue
+            j = np.ravel_multi_index(tuple(c), shape)
+            if (h[j], j) < (h[best], best):
+                best = j
+        ptr[i] = best
+    # resolve
+    for _ in range(64):
+        new = ptr[ptr]
+        if (new == ptr).all():
+            break
+        ptr = new
+    lab = seeds_flat[ptr]
+    # fill from labeled regions (lowest labeled neighbor), to fixpoint
+    while True:
+        lab3 = lab.reshape(shape)
+        changed = False
+        order = np.argsort(h, kind="stable")
+        for i in order:
+            if lab[i] != 0:
+                continue
+            best_h, best_l = np.inf, 0
+            for off in offsets:
+                c = coords[i] + off
+                if ((c < 0) | (c >= shape)).any():
+                    continue
+                j = np.ravel_multi_index(tuple(c), shape)
+                if lab[j] > 0 and h[j] < best_h:
+                    best_h, best_l = h[j], lab[j]
+            if best_l > 0:
+                lab[i] = best_l
+                changed = True
+        if not changed:
+            break
+    return lab.reshape(shape)
+
+
+def test_watershed_unique_heights_matches_oracle(rng):
+    """With every local minimum seeded, descent semantics are deterministic
+    and must match the serial steepest-descent oracle exactly.  (When most
+    minima are unseeded the fill order is implementation-defined, which is
+    covered by the property tests below.)"""
+    shape = (12, 12, 12)
+    height = rng.permutation(np.prod(shape)).reshape(shape).astype(np.float32)
+    minima = np.asarray(local_maxima(jnp.asarray(-height)))
+    seeds = np.zeros(shape, np.int32)
+    seeds[minima] = np.arange(1, minima.sum() + 1)
+    got = np.asarray(seeded_watershed(jnp.asarray(height), jnp.asarray(seeds)))
+    want = _descent_oracle(height, seeds)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_watershed_all_voxels_labeled(rng):
+    shape = (16, 16, 16)
+    height = rng.random(shape).astype(np.float32)
+    seeds = np.zeros(shape, np.int32)
+    seeds[4, 4, 4] = 1
+    seeds[12, 12, 12] = 2
+    got = np.asarray(seeded_watershed(jnp.asarray(height), jnp.asarray(seeds)))
+    assert (got > 0).all()
+    assert set(np.unique(got)) <= {1, 2}
+    # seed voxels keep their labels
+    assert got[4, 4, 4] == 1 and got[12, 12, 12] == 2
+
+
+def test_watershed_regions_connected(rng):
+    shape = (20, 20)
+    height = rng.random(shape).astype(np.float32)
+    seeds = np.zeros(shape, np.int32)
+    seeds[2, 2] = 1
+    seeds[17, 17] = 2
+    seeds[2, 17] = 3
+    got = np.asarray(seeded_watershed(jnp.asarray(height), jnp.asarray(seeds)))
+    for l in (1, 2, 3):
+        region = got == l
+        if region.any():
+            _, n = ndi.label(region)
+            assert n == 1, f"label {l} split into {n} pieces"
+
+
+def test_watershed_respects_mask(rng):
+    shape = (16, 16)
+    height = rng.random(shape).astype(np.float32)
+    mask = np.ones(shape, bool)
+    mask[:, 8] = False  # wall
+    seeds = np.zeros(shape, np.int32)
+    seeds[8, 2] = 1
+    seeds[8, 14] = 2
+    got = np.asarray(
+        seeded_watershed(jnp.asarray(height), jnp.asarray(seeds), jnp.asarray(mask))
+    )
+    assert (got[:, 8] == 0).all()
+    assert (got[:, :8] == 1).all()
+    assert (got[:, 9:] == 2).all()
+
+
+def test_local_maxima_simple():
+    x = np.zeros((9, 9), np.float32)
+    x[2, 2] = 5.0
+    x[6, 6] = 3.0
+    m = np.asarray(local_maxima(jnp.asarray(x)))
+    assert m[2, 2] and m[6, 6]
+    # plateau: all plateau voxels are maxima
+    y = np.zeros((9, 9), np.float32)
+    y[4:6, 4:6] = 1.0
+    m = np.asarray(local_maxima(jnp.asarray(y)))
+    assert m[4:6, 4:6].all()
+
+
+def test_dt_watershed_pipeline(rng):
+    """End-to-end block kernel: threshold -> EDT -> seeds -> watershed."""
+    # two blobs separated by a boundary ridge
+    shape = (32, 32)
+    boundary = np.ones(shape, np.float32)
+    boundary[4:28, 4:14] = 0.0
+    boundary[4:28, 18:28] = 0.0
+    mask = boundary < 0.5
+    dist = distance_transform(jnp.asarray(mask))
+    seeds = dt_seeds(dist, jnp.asarray(mask), min_distance=2.0)
+    n_seeds = len(np.unique(np.asarray(seeds))) - 1
+    assert n_seeds >= 2
+    ws = np.asarray(
+        seeded_watershed(-dist, seeds, jnp.asarray(mask))
+    )
+    assert (ws[mask] > 0).all()
+    assert (ws[~mask] == 0).all()
+    # the two cavities must get different labels
+    assert ws[16, 8] != ws[16, 23]
