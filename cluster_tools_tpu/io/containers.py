@@ -122,6 +122,17 @@ class _ImmediateFuture:
         return self._v
 
 
+def _check_existing(key, have_shape, have_dtype, want_shape, want_dtype):
+    if tuple(have_shape) != tuple(int(s) for s in want_shape) or np.dtype(
+        have_dtype
+    ) != np.dtype(want_dtype):
+        raise ValueError(
+            f"dataset {key!r} exists with shape {tuple(have_shape)} / dtype "
+            f"{np.dtype(have_dtype)}, requested {tuple(want_shape)} / "
+            f"{np.dtype(want_dtype)}"
+        )
+
+
 
 class ZarrContainer:
     """A zarr (v2) or N5 container on the local filesystem, via tensorstore."""
@@ -230,9 +241,9 @@ class ZarrContainer:
         return store
 
     def require_dataset(self, key: str, **kwargs) -> Dataset:
-        if key in self:
-            return self[key]
-        return self.create_dataset(key, **kwargs)
+        # create_dataset's exist_ok path validates shape/dtype of an existing
+        # dataset against the request, which a bare self[key] would skip
+        return self.create_dataset(key, exist_ok=True, **kwargs)
 
     def __getitem__(self, key: str) -> Dataset:
         with self._lock:
@@ -303,8 +314,12 @@ class H5Container:
         self._f = h5py.File(path, mode)
 
     def create_dataset(self, key, shape, chunks, dtype, compression="gzip", exist_ok=True, fill_value=0):
-        if exist_ok and key in self._f:
-            return _H5Dataset(self._f[key])
+        if key in self._f:
+            if not exist_ok:
+                raise ValueError(f"dataset {key} exists")
+            ds = self._f[key]
+            _check_existing(key, ds.shape, ds.dtype, shape, dtype)
+            return _H5Dataset(ds)
         ds = self._f.create_dataset(
             key,
             shape=tuple(shape),
@@ -356,7 +371,9 @@ class MemoryContainer:
         if key in self._data:
             if not exist_ok:
                 raise ValueError(f"dataset {key} exists")
-            return self._data[key]
+            ds = self._data[key]
+            _check_existing(key, ds.shape, ds.dtype, shape, dtype)
+            return ds
         ds = _MemDataset(np.full(tuple(shape), fill_value, dtype=dtype), tuple(chunks))
         self._data[key] = ds
         return ds

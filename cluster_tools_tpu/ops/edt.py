@@ -20,7 +20,7 @@ Supports anisotropic ``sampling`` (e.g. CREMI's (40, 4, 4) nm voxels).
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

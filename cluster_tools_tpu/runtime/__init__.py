@@ -1,0 +1,2 @@
+from .task import BaseTask, SuccessTarget, build, DummyTask, WorkflowBase, get_task_cls
+from .executor import BlockwiseExecutor, get_devices, get_mesh

@@ -1,0 +1,1 @@
+"""The op/task library (reference: one subpackage per op, SURVEY.md §2a)."""

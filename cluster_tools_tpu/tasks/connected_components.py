@@ -1,0 +1,399 @@
+"""Blockwise connected components with global label stitching.
+
+Re-design of the reference's ``cluster_tools/connected_components/``
+(SURVEY.md §3.2).  The reference ran five luigi tasks: per-block vigra CCL ->
+prefix-sum label offsets -> per-face equivalence scan -> serial
+``nifty.ufd`` union-find -> blockwise write.  Two structural changes here:
+
+1. **No offset pass.**  Per-block labels are the *global flat index of the
+   component's minimum voxel + 1* — globally unique by construction (the
+   device CCL kernel already produces block-local min-voxel indices, which
+   the host shifts into volume coordinates).  The reference needed the
+   prefix-sum because vigra labels were 1..k per block.
+2. **The union-find merge is a device kernel** (pointer jumping over the
+   dense label table), not a serial C++ loop — the reference's named
+   scalability cliff (SURVEY.md §3.2 "serial on one node").
+
+Task chain (same barrier structure as the reference, so resume behaves the
+same):
+
+    BlockComponents   (mesh-batched)  per-block CCL -> global labels + uniques
+    MergeLabels       (driver)        merge per-block uniques -> dense table
+    BlockFaces        (host IO pool)  adjacent-face scan -> equivalence pairs
+    MergeAssignments  (device)        union-find -> assignment table
+    Write             (host IO pool)  apply assignment blockwise
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from ..ops.ccl import label_components
+from ..ops.unionfind import union_find, union_find_host
+from ..runtime.executor import BlockwiseExecutor
+from ..runtime.task import BaseTask, WorkflowBase, build
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader, pad_block_to
+
+import jax.numpy as jnp
+
+
+def _uniques_path(tmp_folder: str, block_id: int) -> str:
+    d = os.path.join(tmp_folder, "cc_uniques")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"block_{block_id}.npy")
+
+
+def _faces_path(tmp_folder: str, block_id: int) -> str:
+    d = os.path.join(tmp_folder, "cc_faces")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"block_{block_id}.npy")
+
+
+class BlockComponentsBase(BaseTask):
+    """Pass 1: per-block CCL on the thresholded/binary input.
+
+    Params: ``input_path/input_key`` (binary or real-valued volume),
+    ``output_path/output_key`` (uint64 labels), optional ``threshold`` +
+    ``threshold_mode`` ('greater'/'less'), optional ``mask_path/mask_key``.
+    """
+
+    task_name = "block_components"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "threshold": None,
+            "threshold_mode": "greater",
+            "connectivity": 1,
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        shape = inp.shape
+        block_shape = tuple(cfg["block_shape"])
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        done = set(self.blocks_done())
+        todo = [blocking.get_block(b) for b in block_ids if b not in done]
+
+        out_f = file_reader(cfg["output_path"])
+        out = out_f.require_dataset(
+            cfg["output_key"],
+            shape=shape,
+            chunks=block_shape,
+            dtype="uint64",
+        )
+
+        threshold = cfg.get("threshold")
+        mode = cfg.get("threshold_mode", "greater")
+        connectivity = int(cfg.get("connectivity", 1))
+        mask_ds = None
+        if cfg.get("mask_path"):
+            mask_ds = file_reader(cfg["mask_path"])[cfg["mask_key"]]
+
+        def load(block):
+            data = inp[block.bb]
+            if threshold is None:
+                m = data > 0
+            elif mode == "greater":
+                m = data > threshold
+            else:
+                m = data < threshold
+            if mask_ds is not None:
+                m &= mask_ds[block.bb] > 0
+            return (pad_block_to(m, block_shape).astype(bool),)
+
+        n_pad = int(np.prod(block_shape))
+
+        def kernel(m):
+            return label_components(m, connectivity=connectivity)
+
+        def store(block, raw):
+            # raw: padded-block flat index of component min voxel, sentinel=n
+            bs = block.shape
+            raw = raw[tuple(slice(0, s) for s in bs)]
+            fg = raw < n_pad
+            local = np.unravel_index(raw[fg].astype(np.int64), block_shape)
+            coords = tuple(
+                l + b for l, b in zip(local, block.begin)
+            )
+            glob = np.ravel_multi_index(coords, shape).astype(np.uint64) + 1
+            labels = np.zeros(bs, np.uint64)
+            labels[fg] = glob
+            out[block.bb] = labels
+            np.save(_uniques_path(self.tmp_folder, block.block_id), np.unique(glob))
+
+        executor = BlockwiseExecutor(
+            target=self.target,
+            device_batch=int(cfg.get("device_batch", 1)),
+            io_threads=max(1, self.max_jobs),
+        )
+        executor.map_blocks(
+            kernel,
+            todo,
+            load,
+            store,
+            on_block_done=lambda b: self.log_block_success(b.block_id),
+        )
+        return {"n_blocks": len(block_ids), "shape": list(shape)}
+
+
+class BlockComponentsLocal(BlockComponentsBase):
+    target = "local"
+
+
+class BlockComponentsTPU(BlockComponentsBase):
+    target = "tpu"
+
+
+class MergeLabelsBase(BaseTask):
+    """Merge per-block unique labels into the dense global label table.
+
+    Replaces the reference's ``merge_offsets`` prefix-sum (our labels are
+    globally unique already); the table maps sorted uint64 labels -> dense
+    int32 ids for the device union-find.
+    """
+
+    task_name = "merge_labels"
+
+    def run_impl(self):
+        cfg = self.get_config()
+        shape = file_reader(cfg["input_path"])[cfg["input_key"]].shape
+        block_ids = blocks_in_volume(
+            shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        uniques = [
+            np.load(_uniques_path(self.tmp_folder, b))
+            for b in block_ids
+            if os.path.exists(_uniques_path(self.tmp_folder, b))
+        ]
+        table = (
+            np.unique(np.concatenate(uniques))
+            if uniques
+            else np.zeros(0, np.uint64)
+        )
+        np.save(os.path.join(self.tmp_folder, "cc_label_table.npy"), table)
+        return {"n_labels": len(table)}
+
+
+class MergeLabelsLocal(MergeLabelsBase):
+    target = "local"
+
+
+class MergeLabelsTPU(MergeLabelsBase):
+    target = "tpu"
+
+
+class BlockFacesBase(BaseTask):
+    """Pass 2: scan adjacent block faces for label equivalences.
+
+    For every block and axis, reads the two 1-voxel slabs on either side of
+    the block's upper face and emits (label_a, label_b) pairs where both are
+    foreground (face-connectivity merge, as in the reference).  Host-side:
+    thin-slab IO is bandwidth-bound, not compute.
+    """
+
+    task_name = "block_faces"
+
+    def run_impl(self):
+        cfg = self.get_config()
+        if int(cfg.get("connectivity", 1)) != 1:
+            # diagonal adjacency across block faces (and edge/corner-adjacent
+            # blocks) is not stitched yet; refuse rather than silently split
+            # components at block boundaries
+            raise NotImplementedError(
+                "blockwise stitching currently supports connectivity=1 only"
+            )
+        ds = file_reader(cfg["output_path"])[cfg["output_key"]]
+        shape = ds.shape
+        block_shape = tuple(cfg["block_shape"])
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        done = set(self.blocks_done())
+        roi_set = set(block_ids)
+
+        def process(block_id: int):
+            block = blocking.get_block(block_id)
+            pairs = []
+            for axis in range(len(shape)):
+                nbr = blocking.neighbor_id(block_id, axis, 1)
+                if nbr is None or nbr not in roi_set:
+                    continue
+                face = block.end[axis]
+                bb_lo = tuple(
+                    slice(face - 1, face) if a == axis else slice(b, e)
+                    for a, (b, e) in enumerate(zip(block.begin, block.end))
+                )
+                bb_hi = tuple(
+                    slice(face, face + 1) if a == axis else slice(b, e)
+                    for a, (b, e) in enumerate(zip(block.begin, block.end))
+                )
+                lo = ds[bb_lo].ravel()
+                hi = ds[bb_hi].ravel()
+                both = (lo > 0) & (hi > 0)
+                if both.any():
+                    p = np.stack([lo[both], hi[both]], axis=1)
+                    pairs.append(np.unique(p, axis=0))
+            result = (
+                np.concatenate(pairs)
+                if pairs
+                else np.zeros((0, 2), np.uint64)
+            )
+            np.save(_faces_path(self.tmp_folder, block_id), result)
+            self.log_block_success(block_id)
+
+        todo = [b for b in block_ids if b not in done]
+        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
+            list(pool.map(process, todo))
+        return {"n_blocks": len(todo)}
+
+
+class BlockFacesLocal(BlockFacesBase):
+    target = "local"
+
+
+class BlockFacesTPU(BlockFacesBase):
+    target = "tpu"
+
+
+class MergeAssignmentsBase(BaseTask):
+    """Union-find over all face equivalences -> global assignment table.
+
+    The reference ran serial ``nifty.ufd`` here; we map labels to dense ids
+    and run the pointer-jumping union-find on device (host scipy fallback for
+    tiny problems).  The final assignment renumbers roots consecutively.
+    """
+
+    task_name = "merge_assignments"
+
+    @staticmethod
+    def default_task_config():
+        return {"threads_per_job": 1, "device_batch": 1, "use_device": True}
+
+    def run_impl(self):
+        cfg = self.get_config()
+        shape = file_reader(cfg["input_path"])[cfg["input_key"]].shape
+        table = np.load(os.path.join(self.tmp_folder, "cc_label_table.npy"))
+        block_ids = blocks_in_volume(
+            shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        pair_files = [
+            _faces_path(self.tmp_folder, b)
+            for b in block_ids
+            if os.path.exists(_faces_path(self.tmp_folder, b))
+        ]
+        pairs = (
+            np.concatenate([np.load(f) for f in pair_files])
+            if pair_files
+            else np.zeros((0, 2), np.uint64)
+        )
+        if len(pairs):
+            pairs = np.unique(pairs, axis=0)
+        n = len(table)
+        # dense ids: position in the sorted label table
+        dense_pairs = np.searchsorted(table, pairs).astype(np.int64)
+        if n and cfg.get("use_device", True) and len(dense_pairs):
+            roots = np.asarray(
+                union_find(jnp.asarray(dense_pairs.astype(np.int32)), n)
+            ).astype(np.int64)
+        else:
+            roots = union_find_host(dense_pairs, n)
+        # renumber roots consecutively 1..K
+        uniq_roots, assignment = np.unique(roots, return_inverse=True)
+        assignment = (assignment + 1).astype(np.uint64)
+        np.savez(
+            os.path.join(self.tmp_folder, "cc_assignments.npz"),
+            keys=table,
+            values=assignment,
+        )
+        return {"n_labels": n, "n_components": len(uniq_roots)}
+
+
+class MergeAssignmentsLocal(MergeAssignmentsBase):
+    target = "local"
+
+
+class MergeAssignmentsTPU(MergeAssignmentsBase):
+    target = "tpu"
+
+
+class ConnectedComponentsWorkflow(WorkflowBase):
+    """End-to-end blockwise CCL (reference: ``ConnectedComponentsWorkflow``)."""
+
+    task_name = "connected_components_workflow"
+
+    def requires(self):
+        from . import connected_components as cc_mod
+        from . import write as write_mod
+        from ..runtime.task import get_task_cls
+
+        cfg_common = dict(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+        )
+        p = self.params
+        # provisional per-block labels live in a tmp dataset, so the final
+        # Write never mutates its own input (crash-safe block resume)
+        tmp_path = os.path.join(self.tmp_folder, "cc_blocks.zarr")
+        tmp_key = "labels"
+        t1 = get_task_cls(cc_mod, "BlockComponents", self.target)(
+            **cfg_common,
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            output_path=tmp_path,
+            output_key=tmp_key,
+            **{
+                k: p[k]
+                for k in ("threshold", "threshold_mode", "mask_path", "mask_key", "block_shape", "connectivity")
+                if k in p
+            },
+        )
+        t2 = get_task_cls(cc_mod, "MergeLabels", self.target)(
+            **cfg_common,
+            dependencies=[t1],
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            **{k: p[k] for k in ("block_shape",) if k in p},
+        )
+        t3 = get_task_cls(cc_mod, "BlockFaces", self.target)(
+            **cfg_common,
+            dependencies=[t2],
+            output_path=tmp_path,
+            output_key=tmp_key,
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            **{k: p[k] for k in ("block_shape", "connectivity") if k in p},
+        )
+        t4 = get_task_cls(cc_mod, "MergeAssignments", self.target)(
+            **cfg_common,
+            dependencies=[t3],
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            **{k: p[k] for k in ("block_shape",) if k in p},
+        )
+        t5 = get_task_cls(write_mod, "Write", self.target)(
+            **cfg_common,
+            dependencies=[t4],
+            input_path=tmp_path,
+            input_key=tmp_key,
+            output_path=p["output_path"],
+            output_key=p["output_key"],
+            assignment_path=os.path.join(self.tmp_folder, "cc_assignments.npz"),
+            **{k: p[k] for k in ("block_shape",) if k in p},
+        )
+        return [t5]
+
+    def run_impl(self):
+        return {}
