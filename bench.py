@@ -1,0 +1,101 @@
+"""North-star benchmark: fused blockwise watershed+CCL to globally merged labels.
+
+Mirrors BASELINE.json's metric ("voxels/sec on CREMI blockwise watershed+CCL;
+wall-clock to merged labels").  The whole pipeline — halo exchange, fused
+DT-watershed per slab, two-pass union-find CC merge — runs as ONE compiled
+SPMD program over the device mesh (see cluster_tools_tpu/parallel/pipeline.py).
+
+The reference publishes no numbers (BASELINE.json "published": {}), so
+``vs_baseline`` is measured against the equivalent single-core host (scipy)
+pipeline run in-process on the same data — the reference's per-job compute
+path without scheduler overhead, i.e. a *generous* stand-in for one slurm
+worker of its 32-node baseline.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+from __graft_entry__ import _synthetic_boundaries
+
+
+def _host_baseline_vps(vol: np.ndarray, threshold: float) -> float:
+    """voxels/sec of the equivalent scipy pipeline (single core, in-process)."""
+    from scipy import ndimage
+
+    t0 = time.perf_counter()
+    fg = vol < threshold
+    dist = ndimage.distance_transform_edt(fg)
+    maxima = (
+        ndimage.maximum_filter(dist, size=3) == dist
+    ) & fg
+    seeds, _ = ndimage.label(maxima)
+    hmap = np.clip(vol * 255, 0, 255).astype(np.uint8)
+    ndimage.watershed_ift(hmap, seeds.astype(np.int32))
+    ndimage.label(fg)  # the CC pass
+    dt = time.perf_counter() - t0
+    return vol.size / dt
+
+
+def main():
+    import jax
+
+    from cluster_tools_tpu.parallel.mesh import backend_devices, make_mesh, mesh_axis_sizes
+    from cluster_tools_tpu.parallel.pipeline import make_ws_ccl_step
+
+    try:
+        devices = backend_devices("tpu")
+        backend = "tpu"
+    except RuntimeError:
+        devices = backend_devices("local")
+        backend = "cpu"
+    mesh = make_mesh(len(devices), axis_names=("dp", "sp"), devices=devices)
+    sizes = mesh_axis_sizes(mesh)
+    dp, sp = sizes["dp"], sizes["sp"]
+
+    threshold = 0.45
+    if backend == "tpu":
+        batch, z, y, x = dp, sp * 128, 128, 128
+    else:
+        batch, z, y, x = dp, sp * 16, 64, 64
+    vol = _synthetic_boundaries((batch, z, y, x))
+
+    step = make_ws_ccl_step(mesh, halo=4, threshold=threshold)
+    # compile + warm up
+    jax.block_until_ready(step(vol))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step(vol))
+        times.append(time.perf_counter() - t0)
+    vps = vol.size / min(times)
+
+    # host baseline on a crop, extrapolated per-voxel
+    crop = vol[0, : min(64, z), : min(64, y), : min(64, x)]
+    base_vps = _host_baseline_vps(crop, threshold)
+
+    print(
+        json.dumps(
+            {
+                "metric": "fused watershed+CCL merged labels",
+                "value": round(vps, 1),
+                "unit": "voxels/sec",
+                "vs_baseline": round(vps / base_vps, 3),
+                "backend": backend,
+                "mesh": {"dp": dp, "sp": sp},
+                "volume": list(vol.shape),
+                "baseline": "single-core scipy pipeline (reference per-job compute path)",
+                "baseline_voxels_per_sec": round(base_vps, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,28 @@ import numpy as np
 from jax import lax
 
 
+def _true_like(x: jnp.ndarray) -> jnp.ndarray:
+    """Scalar ``True`` carrying ``x``'s varying-manual-axes type.
+
+    Under ``shard_map``, ``lax.while_loop`` requires the initial carry to have
+    the same vma (varying-over-mesh-axes) type as the body output; a literal
+    ``True`` is unvarying.  Deriving the constant from ``x`` inherits the
+    right type in every context (jit, vmap, shard_map) with one fused reduce.
+    """
+    return jnp.any(x != x) | True
+
+
+def _match_vma(x: jnp.ndarray, ref: jnp.ndarray) -> jnp.ndarray:
+    """Give ``x`` the varying-manual-axes type of ``ref``.
+
+    Adds a ``ref``-derived zero so constants (e.g. ``arange`` parent tables)
+    can seed ``while_loop`` carries whose bodies mix in sharded data.  No-op
+    outside ``shard_map``.
+    """
+    z = (ref.ravel()[:1].sum() * 0).astype(x.dtype)
+    return x + z
+
+
 def _shift(x: jnp.ndarray, offset: int, axis: int, fill) -> jnp.ndarray:
     """y[i] = x[i - offset] along ``axis``, with ``fill`` shifted in."""
     n = x.shape[axis]
@@ -88,7 +110,7 @@ def _compress(flat: jnp.ndarray, sentinel) -> jnp.ndarray:
         f2 = gather(f)
         return f2, jnp.any(f2 != f)
 
-    flat, _ = lax.while_loop(cond, body, (flat, jnp.bool_(True)))
+    flat, _ = lax.while_loop(cond, body, (flat, _true_like(flat)))
     return flat
 
 
@@ -136,7 +158,8 @@ def label_components(mask: jnp.ndarray, connectivity: int = 1) -> jnp.ndarray:
         new = _compress(jnp.minimum(hooked, jnp.minimum(flat, nmin)), sentinel)
         return new, jnp.any(new != flat)
 
-    flat, _ = lax.while_loop(cond, body, (lab.ravel(), jnp.bool_(True)))
+    flat0 = lab.ravel()
+    flat, _ = lax.while_loop(cond, body, (flat0, _true_like(flat0)))
     return flat.reshape(shape)
 
 

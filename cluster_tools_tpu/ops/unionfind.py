@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .ccl import _match_vma, _true_like
+
 
 @partial(jax.jit, static_argnames=("n_labels",))
 def union_find(pairs: jnp.ndarray, n_labels: int) -> jnp.ndarray:
@@ -36,7 +38,7 @@ def union_find(pairs: jnp.ndarray, n_labels: int) -> jnp.ndarray:
     static shapes.
     """
     n = int(n_labels)
-    parent = jnp.arange(n, dtype=jnp.int32)
+    parent = _match_vma(jnp.arange(n, dtype=jnp.int32), pairs)
     # out-of-range endpoints (e.g. -1 padding) turn the whole pair into a
     # (0, 0) self-loop no-op rather than being clipped into a real label
     u, v = pairs[:, 0], pairs[:, 1]
@@ -54,7 +56,7 @@ def union_find(pairs: jnp.ndarray, n_labels: int) -> jnp.ndarray:
             f2 = f[f]
             return f2, jnp.any(f2 != f)
 
-        p, _ = lax.while_loop(cond, body, (p, jnp.bool_(True)))
+        p, _ = lax.while_loop(cond, body, (p, _true_like(p)))
         return p
 
     def cond(state):
@@ -71,7 +73,7 @@ def union_find(pairs: jnp.ndarray, n_labels: int) -> jnp.ndarray:
         p2 = compress(p2)
         return p2, jnp.any(p2 != p)
 
-    parent, _ = lax.while_loop(cond, body, (parent, jnp.bool_(True)))
+    parent, _ = lax.while_loop(cond, body, (parent, _true_like(parent)))
     return parent
 
 

@@ -1,0 +1,25 @@
+"""Mesh-level parallelism: spatial sharding, halo exchange, distributed merges.
+
+This package is the TPU-native replacement for the reference's *distribution
+machinery* — the slurm/LSF job fan-out plus shared-filesystem data plane
+(SURVEY.md §2c/§2d).  The reference's one first-class parallelism strategy is
+spatial data parallelism (block decomposition with read-side halos); here the
+same decomposition is expressed as sharded axes of a ``jax.sharding.Mesh``:
+
+- :mod:`mesh`      — mesh construction over CPU/TPU devices (dp x sp axes),
+- :mod:`halo`      — device-side ghost-zone exchange via ``lax.ppermute``
+                     over ICI (replaces overlapping filesystem reads),
+- :mod:`distributed_ccl` — globally consistent connected components over a
+  sharded volume: per-shard CCL, boundary-face equivalences, an
+  ``all_gather`` of the equivalence pairs over ICI, and a replicated
+  pointer-jumping union-find (replaces the reference's serial ``nifty.ufd``
+  merge job — its named scalability cliff, SURVEY.md §3.2).
+"""
+
+from .mesh import make_mesh, mesh_axis_sizes
+from .halo import exchange_halo, crop_halo, neighbor_face
+from .distributed_ccl import (
+    sharded_label_components,
+    distributed_connected_components,
+)
+from .pipeline import make_ws_ccl_step

@@ -1,0 +1,97 @@
+"""Device-mesh construction.
+
+The reference mapped blocks to slurm array jobs (``BaseClusterTask.
+prepare_jobs``, SURVEY.md §2a); here the "cluster" is a ``jax.sharding.Mesh``.
+Two axes cover this framework's parallelism:
+
+- ``dp`` — data parallel over independent volumes / block batches,
+- ``sp`` — spatial parallel: contiguous slabs of one volume, with halo
+  exchange and label-merge collectives over ICI (the analogue of sequence /
+  context parallelism for 3-D space, SURVEY.md §5.7).
+
+Multi-host pods extend the same mesh over DCN via ``jax.distributed`` — the
+mesh abstraction is identical, only the device list grows.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def _pick_grid(n: int, n_axes: int) -> Tuple[int, ...]:
+    """Factor ``n`` devices into a mesh grid, favoring the last (sp) axis."""
+    if n_axes == 1:
+        return (n,)
+    # give sp (last axis) the largest power-of-two factor, dp the rest
+    sp = 1
+    m = n
+    while m % 2 == 0 and sp < n // 2:
+        sp *= 2
+        m //= 2
+    if sp == 1:
+        sp = n  # odd n: everything on sp, dp=1
+    dp = n // sp
+    grid = [1] * n_axes
+    grid[-1] = sp
+    grid[0] = dp
+    return tuple(grid)
+
+
+def backend_devices(target: str = "local", n_devices: Optional[int] = None):
+    """Devices for a mesh: ``local`` = CPU (the fake-cluster test backend,
+    honoring ``xla_force_host_platform_device_count``), ``tpu`` = TPU chips."""
+    if target == "tpu":
+        devs = [d for d in jax.devices() if d.platform == "tpu"]
+        if not devs:
+            raise RuntimeError("target='tpu' but no TPU devices are visible")
+    elif target == "local":
+        try:
+            devs = jax.devices("cpu")
+        except RuntimeError:
+            devs = jax.devices()
+    else:
+        raise ValueError(f"unknown target {target!r}")
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    return devs
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = ("dp", "sp"),
+    grid: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    ``grid`` pins the per-axis sizes; otherwise devices are factored so the
+    spatial axis gets the largest power-of-two share (halo exchange and the
+    label-merge all_gather ride the densest axis).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    n = len(devices)
+    if grid is None:
+        grid = _pick_grid(n, len(axis_names))
+    if int(np.prod(grid)) != n:
+        raise ValueError(f"grid {grid} does not cover {n} devices")
+    dev_array = np.array(devices).reshape(grid)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

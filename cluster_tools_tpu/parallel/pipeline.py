@@ -1,0 +1,124 @@
+"""The fused, mesh-sharded watershed+CCL step — the framework's "train step".
+
+The reference's north-star workload (BASELINE.json) is: blockwise
+distance-transform watershed + connected components, with the two-pass
+union-find label merge, end-to-end to globally merged labels.  In the
+reference that was five luigi tasks and thousands of filesystem round-trips;
+here it is **one compiled SPMD program** over a ``(dp, sp)`` mesh:
+
+- ``dp`` shards a batch of independent volumes (block batches),
+- ``sp`` shards each volume into contiguous z-slabs,
+- halo exchange (``ppermute`` over ICI) replaces overlapping FS reads,
+- the fused DT-watershed kernel runs per slab,
+- the thresholded foreground is labeled with globally consistent components
+  via the distributed union-find merge (``all_gather`` + pointer jumping),
+- a ``psum`` over the whole mesh yields global statistics.
+
+This module is what ``__graft_entry__.dryrun_multichip`` compiles and runs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.watershed import distance_transform_watershed
+from .distributed_ccl import sharded_label_components
+from .halo import crop_halo, exchange_halo
+from .mesh import mesh_axis_sizes
+
+
+def _ws_ccl_shard(
+    boundaries: jnp.ndarray,
+    *,
+    sp_axis: str,
+    sp_size: int,
+    dp_axis: str,
+    halo: int,
+    threshold: float,
+    connectivity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-device body: local shard is (local_batch, z_slab, y, x)."""
+    local_b = boundaries.shape[0]
+    rank = lax.axis_index(sp_axis).astype(jnp.int32)
+
+    ws_out = []
+    cc_out = []
+    # static Python loop over the (small) local batch: collectives inside the
+    # body run once per volume on every rank in lockstep
+    for b in range(local_b):
+        vol = boundaries[b]
+        # halo exchange along the sharded z axis; border fill = 1.0 (pure
+        # boundary) so basins never leak out of the volume
+        padded = exchange_halo(vol, halo, 0, sp_axis, sp_size, fill=1.0)
+        ws = distance_transform_watershed(
+            padded, threshold=threshold, connectivity=connectivity
+        )
+        ws = crop_halo(ws, halo, 0)
+        # globalize watershed fragment ids by slab rank
+        n_pad = int(np.prod(padded.shape))
+        if sp_size * n_pad >= 2**31:
+            raise ValueError(
+                f"{sp_size} shards of {n_pad} padded voxels overflow int32 labels"
+            )
+        ws = jnp.where(ws > 0, ws + rank * jnp.int32(n_pad), 0)
+        ws_out.append(ws)
+
+        # globally merged connected components of the foreground mask — the
+        # two-pass union-find merge as ICI collectives
+        cc = sharded_label_components(
+            vol < threshold,
+            axis_name=sp_axis,
+            axis_size=sp_size,
+            connectivity=connectivity,
+        )
+        cc_out.append(cc)
+
+    ws_lab = jnp.stack(ws_out)
+    cc_lab = jnp.stack(cc_out)
+    # global foreground voxel count over the full mesh (dp and sp)
+    n_fg = lax.psum(
+        lax.psum(jnp.sum(cc_lab > 0), sp_axis), dp_axis
+    )
+    return ws_lab, cc_lab, n_fg
+
+
+def make_ws_ccl_step(
+    mesh: Mesh,
+    halo: int = 4,
+    threshold: float = 0.3,
+    connectivity: int = 1,
+    dp_axis: str = "dp",
+    sp_axis: str = "sp",
+):
+    """Compile the fused step for ``mesh``.
+
+    Returns a jitted function ``step(boundaries)`` taking a float32 batch of
+    volumes ``(B, Z, Y, X)`` with ``B % dp == 0`` and ``Z % sp == 0``; the
+    batch axis is sharded over ``dp``, the z axis over ``sp``.  Output:
+    ``(ws_labels, cc_labels, n_foreground)`` with labels sharded like the
+    input and the count replicated.
+    """
+    sizes = mesh_axis_sizes(mesh)
+    body = partial(
+        _ws_ccl_shard,
+        sp_axis=sp_axis,
+        sp_size=sizes[sp_axis],
+        dp_axis=dp_axis,
+        halo=halo,
+        threshold=threshold,
+        connectivity=connectivity,
+    )
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P(dp_axis, sp_axis),
+        out_specs=(P(dp_axis, sp_axis), P(dp_axis, sp_axis), P()),
+    )
+    return jax.jit(sharded)
