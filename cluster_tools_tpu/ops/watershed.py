@@ -202,6 +202,87 @@ def distance_transform_watershed(
     )
 
 
+@partial(jax.jit, static_argnames=("connectivity", "max_label"))
+def filter_small_segments(
+    labels: jnp.ndarray,
+    height: jnp.ndarray,
+    min_size: jnp.ndarray,
+    connectivity: int = 1,
+    max_label: Optional[int] = None,
+) -> jnp.ndarray:
+    """Remove segments below ``min_size`` voxels and grow survivors into the
+    freed space (reference: vigra ``sizeFilterSegInplace`` inside
+    ``_ws_block``, SURVEY.md §2a "watershed").
+
+    ``labels`` must be flat-index-based, values in [0, max_label] (default
+    ``max_label`` = block voxel count; pass ``2 * N`` for the two-pass
+    external-id encoding of :func:`dt_watershed_seeded`); sizes are counted
+    with a dense ``segment_sum`` over the block, small segments are cleared,
+    and the watershed fill relaxation re-grows the remaining labels.
+    """
+    n = int(np.prod(labels.shape)) if max_label is None else int(max_label)
+    flat = labels.ravel().astype(jnp.int32)
+    sizes = jax.ops.segment_sum(
+        jnp.ones_like(flat), jnp.clip(flat, 0, n), num_segments=n + 1
+    )
+    small = (sizes[jnp.clip(flat, 0, n)] < min_size) & (flat > 0)
+    kept = jnp.where(small, 0, flat).reshape(labels.shape)
+    # regrow: freed voxels adopt the label of their lowest labeled neighbor
+    grown = seeded_watershed(
+        height, kept, mask=labels > 0, connectivity=connectivity
+    )
+    return grown
+
+
+@partial(
+    jax.jit,
+    static_argnames=("sigma_seeds", "connectivity", "sampling"),
+)
+def dt_watershed_seeded(
+    boundaries: jnp.ndarray,
+    ext_seeds: jnp.ndarray,
+    threshold: float = 0.25,
+    sigma_seeds: float = 0.0,
+    min_seed_distance: float = 0.0,
+    sampling: Optional[Tuple[float, ...]] = None,
+    mask: Optional[jnp.ndarray] = None,
+    connectivity: int = 1,
+) -> jnp.ndarray:
+    """DT watershed honoring pre-existing external seeds (two-pass mode).
+
+    The reference's ``two_pass_watershed.py`` runs a checkerboard: pass-two
+    blocks seed from already-labeled pass-one neighbors so labels agree
+    across block faces without a stitching task (SURVEY.md §3.5).  Here
+    ``ext_seeds`` (int32, 0 = none, values 1..K dense) are the neighbor
+    labels visible in this block's halo; internal DT seeds are planted where
+    no external seed sits, and basins drain to whichever seed their steepest
+    path reaches.
+
+    Returns int32 labels: values > N are external ids (+N offset, N = block
+    voxel count); values in 1..N are new internal fragments (flat-index
+    based).  Callers split on N to map back.
+    """
+    from .edt import distance_transform_squared
+    from .filters import gaussian_smooth
+
+    n = int(np.prod(boundaries.shape))
+    valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
+    fg = (boundaries < threshold) & valid
+    dist = distance_transform_squared(fg, sampling=sampling)
+    if sigma_seeds > 0:
+        dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
+    internal = dt_seeds(
+        dist,
+        fg,
+        min_distance=min_seed_distance * min_seed_distance,
+        connectivity=connectivity,
+    )
+    ext = ext_seeds.astype(jnp.int32)
+    # external seeds dominate; internal ids live in 1..N, external in N+1..
+    seeds = jnp.where(ext > 0, ext + jnp.int32(n), internal)
+    return seeded_watershed(boundaries, seeds, mask=valid, connectivity=connectivity)
+
+
 @partial(jax.jit, static_argnames=("connectivity",))
 def dt_seeds(
     dist: jnp.ndarray,

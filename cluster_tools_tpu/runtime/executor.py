@@ -33,31 +33,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils.volume_utils import Block, Blocking
 
 
-def get_devices(target: str = "local", n_devices: Optional[int] = None):
-    """Devices backing the mesh for a given execution target.
-
-    ``local`` prefers CPU devices (the fake-cluster backend, as in the
-    reference's LocalTask doubling as the test backend); ``tpu`` requires
-    TPU devices.
-    """
-    if target == "tpu":
-        devs = [d for d in jax.devices() if d.platform == "tpu"]
-        if not devs:
-            raise RuntimeError("target='tpu' but no TPU devices are visible")
-    elif target == "local":
-        try:
-            devs = jax.devices("cpu")
-        except RuntimeError:
-            devs = jax.devices()
-    else:
-        raise ValueError(f"unknown target {target!r}")
-    if n_devices is not None:
-        if n_devices > len(devs):
-            raise ValueError(
-                f"requested {n_devices} devices, only {len(devs)} available"
-            )
-        devs = devs[:n_devices]
-    return devs
+# canonical device-selection policy lives in parallel/mesh.py
+from ..parallel.mesh import backend_devices as get_devices
 
 
 def get_mesh(

@@ -350,6 +350,7 @@ class ConnectedComponentsWorkflow(WorkflowBase):
         tmp_key = "labels"
         t1 = get_task_cls(cc_mod, "BlockComponents", self.target)(
             **cfg_common,
+            dependencies=self.dependencies,
             input_path=p["input_path"],
             input_key=p["input_key"],
             output_path=tmp_path,

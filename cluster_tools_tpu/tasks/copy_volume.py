@@ -1,0 +1,101 @@
+"""Blockwise dataset copy/convert (reference: ``cluster_tools/copy_volume/``,
+SURVEY.md §2a): h5 <-> n5 <-> zarr, dtype casts, chunk re-shaping, channel
+slicing, optional fixed-range normalization.  Pure host bandwidth —
+parallelized over the IO thread pool."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+
+
+class CopyVolumeBase(BaseTask):
+    """Params: ``input_path/input_key``, ``output_path/output_key``; optional
+    ``dtype`` (cast), ``out_chunks``, ``channel`` (int: slice a leading
+    channel axis), ``scale_factor``/``offset`` (affine y = x*scale + offset,
+    applied before the cast), ``fit_to_roi``."""
+
+    task_name = "copy_volume"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "dtype": None,
+            "out_chunks": None,
+            "channel": None,
+            "scale_factor": None,
+            "offset": None,
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        channel = cfg.get("channel")
+        shape = inp.shape[1:] if channel is not None else inp.shape
+        block_shape = tuple(cfg["block_shape"])
+        out_chunks = tuple(cfg.get("out_chunks") or block_shape)
+        dtype = cfg.get("dtype") or str(inp.dtype)
+        scale, offset = cfg.get("scale_factor"), cfg.get("offset")
+
+        out = file_reader(cfg["output_path"]).require_dataset(
+            cfg["output_key"], shape=shape, chunks=out_chunks, dtype=dtype
+        )
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        done = set(self.blocks_done())
+
+        def process(block_id):
+            bb = blocking.get_block(block_id).bb
+            data = inp[(channel,) + bb] if channel is not None else inp[bb]
+            if scale is not None or offset is not None:
+                data = data.astype(np.float64) * (
+                    1.0 if scale is None else scale
+                ) + (0.0 if offset is None else offset)
+            if np.issubdtype(np.dtype(dtype), np.integer) and not np.issubdtype(
+                data.dtype, np.integer
+            ):
+                info = np.iinfo(np.dtype(dtype))
+                data = np.clip(np.round(data), info.min, info.max)
+            out[bb] = data.astype(dtype)
+            self.log_block_success(block_id)
+
+        todo = [b for b in block_ids if b not in done]
+        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
+            list(pool.map(process, todo))
+        return {"n_blocks": len(todo), "shape": list(shape), "dtype": dtype}
+
+
+class CopyVolumeLocal(CopyVolumeBase):
+    target = "local"
+
+
+class CopyVolumeTPU(CopyVolumeBase):
+    target = "tpu"
+
+
+class CopyVolumeWorkflow(WorkflowBase):
+    task_name = "copy_volume_workflow"
+
+    def requires(self):
+        from . import copy_volume as cv_mod
+
+        return [
+            get_task_cls(cv_mod, "CopyVolume", self.target)(
+                tmp_folder=self.tmp_folder,
+                config_dir=self.config_dir,
+                max_jobs=self.max_jobs,
+                dependencies=self.dependencies,
+                **self.params,
+            )
+        ]
+
+    def run_impl(self):
+        return {}

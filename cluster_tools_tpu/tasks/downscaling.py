@@ -1,0 +1,182 @@
+"""Blockwise multiscale pyramid (reference: ``cluster_tools/downscaling/``,
+SURVEY.md §2a): per-scale blockwise downsampling (mean for raw data,
+nearest/mode for labels, min/max variants), chained over scale levels by the
+workflow, with paintera-style multiscale metadata (``downsamplingFactors``)
+written to the dataset attributes."""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Sequence
+
+import numpy as np
+
+from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+
+
+def _reduce_block(data: np.ndarray, factor: Sequence[int], mode: str) -> np.ndarray:
+    """Downsample one region by integer ``factor`` per axis."""
+    factor = tuple(int(f) for f in factor)
+    if mode == "nearest":
+        return data[tuple(slice(None, None, f) for f in factor)]
+    # pad up to a multiple with edge values so edge cells average real data
+    pad = [
+        (0, (-s) % f) for s, f in zip(data.shape, factor)
+    ]
+    if any(p[1] for p in pad):
+        data = np.pad(data, pad, mode="edge")
+    new_shape = []
+    for s, f in zip(data.shape, factor):
+        new_shape += [s // f, f]
+    blocks = data.reshape(new_shape)
+    axes = tuple(range(1, 2 * data.ndim, 2))
+    if mode == "mean":
+        return blocks.mean(axes).astype(np.float32)
+    if mode == "max":
+        return blocks.max(axes)
+    if mode == "min":
+        return blocks.min(axes)
+    if mode == "mode":
+        # majority vote per cell (labels): flatten cell axes, take the most
+        # frequent value.  O(cell) per voxel but cells are tiny (e.g. 2^3).
+        flat = np.moveaxis(blocks, axes, range(data.ndim, 2 * data.ndim))
+        flat = flat.reshape(flat.shape[: data.ndim] + (-1,))
+        out = np.empty(flat.shape[: data.ndim], dtype=data.dtype)
+        it = np.nditer(out, flags=["multi_index"], op_flags=["writeonly"])
+        for x in it:
+            vals, counts = np.unique(flat[it.multi_index], return_counts=True)
+            x[...] = vals[np.argmax(counts)]
+        return out
+    raise ValueError(f"unknown downscaling mode {mode!r}")
+
+
+class DownscalingBase(BaseTask):
+    """One scale step: ``input_path/input_key`` (scale s) ->
+    ``output_path/output_key`` (scale s+1), by ``scale_factor`` with
+    ``mode`` in mean/nearest/mode/max/min."""
+
+    task_name = "downscaling"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "scale_factor": [2, 2, 2],
+            "mode": "mean",
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        factor = tuple(int(f) for f in cfg["scale_factor"])
+        mode = cfg.get("mode", "mean")
+        in_shape = inp.shape
+        out_shape = tuple((s + f - 1) // f for s, f in zip(in_shape, factor))
+        block_shape = tuple(cfg["block_shape"])
+        dtype = "float32" if mode == "mean" else str(inp.dtype)
+        out = file_reader(cfg["output_path"]).require_dataset(
+            cfg["output_key"], shape=out_shape, chunks=block_shape, dtype=dtype
+        )
+        blocking = Blocking(out_shape, block_shape)
+        block_ids = blocks_in_volume(
+            out_shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        done = set(self.blocks_done())
+
+        def process(block_id):
+            block = blocking.get_block(block_id)
+            in_bb = tuple(
+                slice(b.start * f, min(b.stop * f, s))
+                for b, f, s in zip(block.bb, factor, in_shape)
+            )
+            out[block.bb] = _reduce_block(inp[in_bb], factor, mode).astype(dtype)
+            self.log_block_success(block_id)
+
+        todo = [b for b in block_ids if b not in done]
+        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
+            list(pool.map(process, todo))
+        # per-step factor; workflows overwrite with the cumulative factor
+        out.update_attrs(downsamplingFactors=list(factor), downscalingMode=mode)
+        return {"n_blocks": len(todo), "out_shape": list(out_shape)}
+
+
+class DownscalingLocal(DownscalingBase):
+    target = "local"
+
+
+class DownscalingTPU(DownscalingBase):
+    target = "tpu"
+
+
+class DownscalingWorkflow(WorkflowBase):
+    """Chain scale levels: writes ``<output_key_prefix>/s1..sN`` from
+    ``input_key`` (= s0), with cumulative ``downsamplingFactors`` metadata
+    (reference: ``DownscalingWorkflow`` + paintera scale metadata)."""
+
+    task_name = "downscaling_workflow"
+
+    def requires(self):
+        from . import downscaling as ds_mod
+
+        p = self.params
+        factors: List[Sequence[int]] = p["scale_factors"]
+        prefix = p.get("output_key_prefix", "")
+        mode = p.get("mode", "mean")
+        common = dict(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+        )
+        bs = {k: p[k] for k in ("block_shape",) if k in p}
+        prev_key = p["input_key"]
+        prev = None
+        tasks = []
+        for level, factor in enumerate(factors, start=1):
+            key = (prefix + "/" if prefix else "") + f"s{level}"
+            t = get_task_cls(ds_mod, "Downscaling", self.target)(
+                **common,
+                dependencies=self.dependencies if prev is None else [prev],
+                input_path=p["input_path"] if prev is None else p["output_path"],
+                input_key=prev_key,
+                output_path=p["output_path"],
+                output_key=key,
+                scale_factor=list(factor),
+                mode=mode,
+                **bs,
+            )
+            tasks.append(t)
+            prev, prev_key = t, key
+        return [tasks[-1]] if tasks else []
+
+    def run_impl(self):
+        p = self.params
+        prefix = p.get("output_key_prefix", "")
+        out = file_reader(p["output_path"])
+        # paintera-style multiscale metadata: downsamplingFactors must be
+        # cumulative relative to s0, so rewrite each level's attrs here
+        cum = []
+        acc = np.ones(len(p["scale_factors"][0]), int)
+        for level, f in enumerate(p["scale_factors"], start=1):
+            acc = acc * np.asarray(f, int)
+            cum.append([int(x) for x in acc])
+            key = (prefix + "/" if prefix else "") + f"s{level}"
+            out[key].update_attrs(downsamplingFactors=[int(x) for x in acc])
+        return {"cumulative_factors": cum}
+
+
+class PainteraToBdvWorkflow(WorkflowBase):
+    """Placeholder parity stub for the reference's paintera->bdv conversion
+    (depends on paintera/label_multisets tasks; completed in tasks/paintera.py)."""
+
+    task_name = "paintera_to_bdv_workflow"
+
+    def requires(self):
+        raise NotImplementedError(
+            "paintera->bdv conversion lands with the paintera task family"
+        )
+
+    def run_impl(self):
+        return {}

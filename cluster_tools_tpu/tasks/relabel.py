@@ -1,0 +1,148 @@
+"""Relabeling: make segmentation labels dense/consecutive.
+
+Reference: ``cluster_tools/relabel/`` (SURVEY.md §2a) — ``find_uniques`` (per
+block), ``find_labeling`` (merge -> global relabel table), then the generic
+``write`` task applies the table.  Our watershed/CC tasks emit globally
+unique but sparse uint64 labels (block-offset encodings), so this workflow is
+the standard finisher.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+
+
+def _uniques_dir(tmp_folder: str) -> str:
+    d = os.path.join(tmp_folder, "relabel_uniques")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class FindUniquesBase(BaseTask):
+    """Per-block unique labels -> npy files."""
+
+    task_name = "find_uniques"
+
+    def run_impl(self):
+        cfg = self.get_config()
+        ds = file_reader(cfg["input_path"])[cfg["input_key"]]
+        shape = ds.shape
+        block_shape = tuple(cfg["block_shape"])
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        done = set(self.blocks_done())
+        d = _uniques_dir(self.tmp_folder)
+
+        def process(block_id):
+            block = blocking.get_block(block_id)
+            u = np.unique(ds[block.bb])
+            np.save(os.path.join(d, f"block_{block_id}.npy"), u[u != 0])
+            self.log_block_success(block_id)
+
+        todo = [b for b in block_ids if b not in done]
+        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
+            list(pool.map(process, todo))
+        return {"n_blocks": len(todo)}
+
+
+class FindUniquesLocal(FindUniquesBase):
+    target = "local"
+
+
+class FindUniquesTPU(FindUniquesBase):
+    target = "tpu"
+
+
+class FindLabelingBase(BaseTask):
+    """Merge per-block uniques -> dense assignment table (labels 1..K)."""
+
+    task_name = "find_labeling"
+
+    def run_impl(self):
+        cfg = self.get_config()
+        shape = file_reader(cfg["input_path"])[cfg["input_key"]].shape
+        block_ids = blocks_in_volume(
+            shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        d = _uniques_dir(self.tmp_folder)
+        files = [
+            os.path.join(d, f"block_{b}.npy")
+            for b in block_ids
+            if os.path.exists(os.path.join(d, f"block_{b}.npy"))
+        ]
+        uniques = (
+            np.unique(np.concatenate([np.load(f) for f in files]))
+            if files
+            else np.zeros(0, np.uint64)
+        )
+        values = np.arange(1, len(uniques) + 1, dtype=np.uint64)
+        np.savez(
+            os.path.join(self.tmp_folder, cfg.get("assignment_name", "relabel_assignments") + ".npz"),
+            keys=uniques,
+            values=values,
+        )
+        return {"n_labels": int(len(uniques))}
+
+
+class FindLabelingLocal(FindLabelingBase):
+    target = "local"
+
+
+class FindLabelingTPU(FindLabelingBase):
+    target = "tpu"
+
+
+class RelabelWorkflow(WorkflowBase):
+    """find_uniques -> find_labeling -> write (reference: relabel workflow)."""
+
+    task_name = "relabel_workflow"
+
+    def requires(self):
+        from . import relabel as rl_mod
+        from . import write as write_mod
+
+        p = self.params
+        common = dict(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+        )
+        bs = {k: p[k] for k in ("block_shape",) if k in p}
+        t1 = get_task_cls(rl_mod, "FindUniques", self.target)(
+            **common,
+            dependencies=self.dependencies,
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            **bs,
+        )
+        t2 = get_task_cls(rl_mod, "FindLabeling", self.target)(
+            **common,
+            dependencies=[t1],
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            **bs,
+        )
+        t3 = get_task_cls(write_mod, "Write", self.target)(
+            **common,
+            dependencies=[t2],
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            output_path=p.get("output_path", p["input_path"]),
+            output_key=p.get("output_key", p["input_key"]),
+            assignment_path=os.path.join(
+                self.tmp_folder, "relabel_assignments.npz"
+            ),
+            **bs,
+        )
+        return [t3]
+
+    def run_impl(self):
+        return {}

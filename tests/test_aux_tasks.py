@@ -1,0 +1,219 @@
+"""Tests for relabel, statistics, copy_volume, downscaling,
+thresholded-components (+ size filter) task families, oracle-checked
+against numpy/scipy (SURVEY.md §4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+from .helpers import assert_labels_equivalent, random_blobs
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "config")
+    os.makedirs(config_dir, exist_ok=True)
+    with open(os.path.join(config_dir, "global.config"), "w") as f:
+        json.dump({"block_shape": [16, 16, 16]}, f)
+    return tmp_folder, config_dir, str(tmp_path)
+
+
+def _dataset(root, name, data, chunks=(16, 16, 16)):
+    path = os.path.join(root, f"{name}.zarr")
+    f = file_reader(path)
+    ds = f.require_dataset(
+        name, shape=data.shape, chunks=chunks, dtype=str(data.dtype)
+    )
+    ds[...] = data
+    return path
+
+
+def test_relabel_workflow_makes_labels_dense(rng, workspace):
+    from cluster_tools_tpu.tasks.relabel import RelabelWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    labels = rng.integers(0, 50, size=(32, 32, 32)).astype(np.uint64)
+    labels[labels > 0] += 100000  # sparse ids
+    path = _dataset(root, "labels", labels)
+    wf = RelabelWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="labels",
+        output_path=path,
+        output_key="dense",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    dense = file_reader(path)["dense"][:]
+    uniq = np.unique(dense)
+    n_fg = len(np.unique(labels[labels > 0]))
+    np.testing.assert_array_equal(uniq, np.arange(n_fg + 1))
+    assert_labels_equivalent(dense, labels)
+
+
+def test_statistics_workflow(rng, workspace):
+    from cluster_tools_tpu.tasks.statistics import DataStatisticsWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    data = rng.normal(5.0, 2.0, size=(32, 32, 32)).astype(np.float32)
+    path = _dataset(root, "raw", data)
+    wf = DataStatisticsWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    with open(os.path.join(tmp_folder, "statistics.json")) as f:
+        stats = json.load(f)
+    assert stats["count"] == data.size
+    np.testing.assert_allclose(stats["mean"], data.mean(), rtol=1e-6)
+    np.testing.assert_allclose(stats["std"], data.std(), rtol=1e-5)
+    np.testing.assert_allclose(stats["min"], data.min(), rtol=1e-6)
+    np.testing.assert_allclose(stats["max"], data.max(), rtol=1e-6)
+
+
+def test_copy_volume_cast_and_scale(rng, workspace):
+    from cluster_tools_tpu.tasks.copy_volume import CopyVolumeWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    data = rng.random((24, 24, 24)).astype(np.float32)
+    path = _dataset(root, "raw", data)
+    out_path = os.path.join(root, "out.zarr")
+    wf = CopyVolumeWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw",
+        output_path=out_path,
+        output_key="u8",
+        dtype="uint8",
+        scale_factor=255.0,
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    out = file_reader(out_path)["u8"][:]
+    assert out.dtype == np.uint8
+    np.testing.assert_array_equal(
+        out, np.clip(np.round(data.astype(np.float64) * 255.0), 0, 255)
+    )
+
+
+def test_downscaling_pyramid(rng, workspace):
+    from cluster_tools_tpu.tasks.downscaling import DownscalingWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    data = rng.random((32, 32, 32)).astype(np.float32)
+    path = _dataset(root, "raw", data)
+    wf = DownscalingWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key_prefix="ds",
+        scale_factors=[[2, 2, 2], [2, 2, 2]],
+        mode="mean",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    f = file_reader(path)
+    s1, s2 = f["ds/s1"][:], f["ds/s2"][:]
+    assert s1.shape == (16, 16, 16) and s2.shape == (8, 8, 8)
+    expect_s1 = data.reshape(16, 2, 16, 2, 16, 2).mean((1, 3, 5))
+    np.testing.assert_allclose(s1, expect_s1, rtol=1e-5)
+    np.testing.assert_allclose(
+        s2, expect_s1.reshape(8, 2, 8, 2, 8, 2).mean((1, 3, 5)), rtol=1e-5
+    )
+    assert f["ds/s1"].attrs["downsamplingFactors"] == [2, 2, 2]
+
+
+def test_downscaling_mode_nearest_labels(rng, workspace):
+    from cluster_tools_tpu.tasks.downscaling import _reduce_block
+
+    labels = rng.integers(0, 9, size=(8, 8, 8)).astype(np.uint64)
+    out = _reduce_block(labels, (2, 2, 2), "nearest")
+    np.testing.assert_array_equal(out, labels[::2, ::2, ::2])
+    out = _reduce_block(labels, (2, 2, 2), "mode")
+    assert out.shape == (4, 4, 4)
+    # each output cell's value must occur in its source cell
+    for i, j, k in [(0, 0, 0), (1, 2, 3), (3, 3, 3)]:
+        cell = labels[2 * i : 2 * i + 2, 2 * j : 2 * j + 2, 2 * k : 2 * k + 2]
+        assert out[i, j, k] in cell
+
+
+def test_thresholded_components_with_size_filter(rng, workspace):
+    from cluster_tools_tpu.tasks.thresholded_components import (
+        ThresholdedComponentsWorkflow,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    vol = ndi.gaussian_filter(rng.random((32, 32, 32)), 1.5).astype(np.float32)
+    thr = float(np.quantile(vol, 0.55))
+    path = _dataset(root, "raw", vol)
+    wf = ThresholdedComponentsWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key="labels",
+        threshold=thr,
+        min_size=10,
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    labels = file_reader(path)["labels"][:]
+    expected, _ = ndi.label(vol > thr)
+    sizes = np.bincount(expected.ravel())
+    keep = np.zeros_like(expected)
+    for lab in range(1, len(sizes)):
+        if sizes[lab] >= 10:
+            keep[expected == lab] = lab
+    assert_labels_equivalent(labels, keep)
+    # dense after filtering
+    uniq = np.unique(labels)
+    np.testing.assert_array_equal(uniq, np.arange(len(uniq)))
+
+
+def test_threshold_task(rng, workspace):
+    from cluster_tools_tpu.runtime.task import build as _build
+    from cluster_tools_tpu.tasks.thresholded_components import ThresholdLocal
+
+    tmp_folder, config_dir, root = workspace
+    data = rng.random((24, 24, 24)).astype(np.float32)
+    path = _dataset(root, "raw", data)
+    t = ThresholdLocal(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key="mask",
+        threshold=0.5,
+        block_shape=[16, 16, 16],
+    )
+    assert _build([t])
+    np.testing.assert_array_equal(
+        file_reader(path)["mask"][:], (data > 0.5).astype(np.uint8)
+    )
