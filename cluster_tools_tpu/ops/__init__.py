@@ -2,3 +2,5 @@ from . import ccl
 from . import unionfind
 from . import edt
 from . import watershed
+from . import rag
+from . import multicut

@@ -1,0 +1,191 @@
+"""Multicut solvers: greedy additive edge contraction + local refinement.
+
+The reference consumed nifty's C++ solver zoo (kernighan-lin,
+greedy-additive, fusion-moves) through ``utils/segmentation_utils.py``'s
+``key_to_agglomerator`` registry (SURVEY.md §2a "Utils", "multicut").  This
+module provides the rebuild's solver core:
+
+- :func:`greedy_additive` — GAEC: contract the currently-most-attractive
+  edge until none is positive.  Host implementation (heap + neighbor maps):
+  edge contraction is inherently sequential, and solver inputs here are
+  *reduced* graphs (per-block subproblems or the hierarchically contracted
+  global problem), orders of magnitude smaller than the volume.
+- :func:`kernighan_lin` — boundary-node move refinement on top of an
+  initial partition (greedy positive-gain passes).
+- :func:`multicut_energy` — the objective: sum of costs of cut edges
+  (costs > 0 attractive, < 0 repulsive; minimization).
+
+Sign convention matches ``probs_to_costs``: ``w = log((1-p)/p)`` — an edge
+with low boundary probability has positive (attractive) cost, and cutting it
+is penalized.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def multicut_energy(
+    edges: np.ndarray, costs: np.ndarray, node_labels: np.ndarray
+) -> float:
+    """Objective value: sum of costs over cut edges (lower is better)."""
+    if len(edges) == 0:
+        return 0.0
+    cut = node_labels[edges[:, 0]] != node_labels[edges[:, 1]]
+    return float(costs[cut].sum())
+
+
+def _relabel_consecutive(parent: np.ndarray) -> np.ndarray:
+    _, labels = np.unique(parent, return_inverse=True)
+    return labels.astype(np.int64)
+
+
+def greedy_additive(
+    n_nodes: int, edges: np.ndarray, costs: np.ndarray, stop_cost: float = 0.0
+) -> np.ndarray:
+    """Greedy additive edge contraction (GAEC, Keuper et al. style).
+
+    Repeatedly contracts the highest-cost edge while it exceeds
+    ``stop_cost`` (default 0: only attractive edges merge); parallel edges
+    arising from a contraction have their costs *added*.  Returns int64
+    node labels 0..k-1.
+    """
+    n_nodes = int(n_nodes)
+    edges = np.asarray(edges, dtype=np.int64)
+    costs = np.asarray(costs, dtype=np.float64)
+    # union-find
+    parent = np.arange(n_nodes, dtype=np.int64)
+
+    def find(x: int) -> int:
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    # neighbor cost maps, symmetric
+    nbrs: list = [dict() for _ in range(n_nodes)]
+    for (u, v), w in zip(edges, costs):
+        if u == v:
+            continue
+        u, v = int(u), int(v)
+        nbrs[u][v] = nbrs[u].get(v, 0.0) + w
+        nbrs[v][u] = nbrs[v].get(u, 0.0) + w
+    heap: list = [
+        (-w, u, v) for u in range(n_nodes) for v, w in nbrs[u].items() if u < v
+    ]
+    heapq.heapify(heap)
+
+    while heap:
+        neg_w, u, v = heapq.heappop(heap)
+        w = -neg_w
+        if w <= stop_cost:
+            break
+        ru, rv = find(u), find(v)
+        if ru == rv:
+            continue
+        # stale entry: the edge's current weight must match
+        if nbrs[ru].get(rv) != w:
+            continue
+        # contract rv into ru (ru keeps the larger neighbor map)
+        if len(nbrs[ru]) < len(nbrs[rv]):
+            ru, rv = rv, ru
+        parent[rv] = ru
+        del nbrs[ru][rv]
+        for x, wx in nbrs[rv].items():
+            if x == ru:
+                continue
+            new_w = nbrs[ru].get(x, 0.0) + wx
+            nbrs[ru][x] = new_w
+            nbrs[x][ru] = new_w
+            del nbrs[x][rv]
+            if new_w > stop_cost:
+                heapq.heappush(heap, (-new_w, ru, x))
+        nbrs[rv].clear()
+
+    roots = np.array([find(i) for i in range(n_nodes)], dtype=np.int64)
+    return _relabel_consecutive(roots)
+
+
+def kernighan_lin(
+    n_nodes: int,
+    edges: np.ndarray,
+    costs: np.ndarray,
+    init_labels: np.ndarray | None = None,
+    max_passes: int = 10,
+) -> np.ndarray:
+    """Local-move refinement: greedily move boundary nodes between adjacent
+    partitions while the objective improves (a practical Kernighan-Lin-style
+    heuristic over an initial GAEC partition)."""
+    edges = np.asarray(edges, dtype=np.int64)
+    costs = np.asarray(costs, dtype=np.float64)
+    labels = (
+        greedy_additive(n_nodes, edges, costs)
+        if init_labels is None
+        else np.asarray(init_labels, dtype=np.int64).copy()
+    )
+    if len(edges) == 0:
+        return _relabel_consecutive(labels)
+    # adjacency with costs
+    adj: list = [[] for _ in range(n_nodes)]
+    for (u, v), w in zip(edges, costs):
+        if u == v:
+            continue
+        adj[int(u)].append((int(v), w))
+        adj[int(v)].append((int(u), w))
+
+    for _ in range(max_passes):
+        moved = False
+        for u in range(n_nodes):
+            if not adj[u]:
+                continue
+            lu = labels[u]
+            # gain of moving u to partition L = sum of edge costs to L
+            # minus sum of edge costs to current partition
+            gains: Dict[int, float] = {}
+            stay = 0.0
+            for v, w in adj[u]:
+                lv = labels[v]
+                if lv == lu:
+                    stay += w
+                else:
+                    gains[lv] = gains.get(lv, 0.0) + w
+            if not gains:
+                continue
+            best_l, best_w = max(gains.items(), key=lambda kv: kv[1])
+            if best_w > stay + 1e-12:
+                labels[u] = best_l
+                moved = True
+        if not moved:
+            break
+    return _relabel_consecutive(labels)
+
+
+def contract_graph(
+    edges: np.ndarray,
+    costs: np.ndarray,
+    node_labels: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Contract a graph by a node labeling: map endpoints through labels,
+    drop self-edges, sum parallel-edge costs.  Returns (new_edges,
+    new_costs) on the label id space — the reduce step of the hierarchical
+    multicut (reference: ``reduce_problem.py``)."""
+    if len(edges) == 0:
+        return edges.reshape(0, 2).astype(np.int64), costs.astype(np.float64)
+    u = node_labels[edges[:, 0]]
+    v = node_labels[edges[:, 1]]
+    lo = np.minimum(u, v)
+    hi = np.maximum(u, v)
+    keep = lo != hi
+    pairs = np.stack([lo[keep], hi[keep]], axis=1)
+    w = np.asarray(costs, dtype=np.float64)[keep]
+    if len(pairs) == 0:
+        return np.zeros((0, 2), np.int64), np.zeros(0, np.float64)
+    new_edges, inv = np.unique(pairs, axis=0, return_inverse=True)
+    new_costs = np.zeros(len(new_edges), np.float64)
+    np.add.at(new_costs, inv.ravel(), w)
+    return new_edges.astype(np.int64), new_costs

@@ -1,0 +1,95 @@
+"""Edge probabilities -> multicut costs.
+
+Re-design of the reference's ``cluster_tools/costs/probs_to_costs.py``
+(SURVEY.md §2a "costs"): the classic transform
+
+    w(e) = log((1 - p_e) / p_e) + log((1 - beta) / beta)
+
+with optional edge-size weighting and ignore-label handling.  A single
+driver-side task (the reference also ran it as one job): m edges is tiny
+next to the volume.  The vectorized transform runs through jax.numpy so the
+same code path serves host and device.
+
+Artifact: ``tmp_folder/graph/costs.npy`` (float32 [m]), aligned with
+``graph.npz``'s edge list.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..runtime.task import BaseTask
+from .features import features_path
+from .graph import graph_dir, load_global_graph
+
+
+def costs_path(tmp_folder: str) -> str:
+    return os.path.join(graph_dir(tmp_folder), "costs.npy")
+
+
+def compute_costs(
+    probs: np.ndarray,
+    beta: float = 0.5,
+    edge_sizes: np.ndarray | None = None,
+    weighting_exponent: float = 1.0,
+    eps: float = 1e-5,
+) -> np.ndarray:
+    """The probability->cost transform, vectorized.
+
+    ``beta`` < 0.5 biases toward merging, > 0.5 toward splitting.  With
+    ``edge_sizes``, costs are scaled by ``(size / max_size) ** exponent``
+    (the reference's 'xy'/size weighting scheme collapsed to its core).
+    """
+    p = jnp.clip(jnp.asarray(probs, jnp.float32), eps, 1.0 - eps)
+    w = jnp.log((1.0 - p) / p) + float(np.log((1.0 - beta) / beta))
+    if edge_sizes is not None:
+        sizes = jnp.asarray(edge_sizes, jnp.float32)
+        w = w * (sizes / jnp.maximum(sizes.max(), 1.0)) ** weighting_exponent
+    return np.asarray(w, dtype=np.float32)
+
+
+class ProbsToCostsBase(BaseTask):
+    """Transform merged edge features into signed multicut costs.
+
+    Params: ``beta``, ``weighting_scheme`` (None or 'size'),
+    ``weighting_exponent``; optional ``ignore_label`` semantics are already
+    enforced upstream (label 0 never becomes a graph node).
+    """
+
+    task_name = "probs_to_costs"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "beta": 0.5,
+            "weighting_scheme": None,
+            "weighting_exponent": 1.0,
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        feats = np.load(features_path(self.tmp_folder))
+        _, _, _, sizes = load_global_graph(self.tmp_folder)
+        probs = feats[:, 0]
+        use_sizes = cfg.get("weighting_scheme") == "size"
+        costs = compute_costs(
+            probs,
+            beta=float(cfg.get("beta", 0.5)),
+            edge_sizes=sizes if use_sizes else None,
+            weighting_exponent=float(cfg.get("weighting_exponent", 1.0)),
+        )
+        np.save(costs_path(self.tmp_folder), costs)
+        return {"n_edges": len(costs), "n_attractive": int((costs > 0).sum())}
+
+
+class ProbsToCostsLocal(ProbsToCostsBase):
+    target = "local"
+
+
+class ProbsToCostsTPU(ProbsToCostsBase):
+    target = "tpu"

@@ -1,0 +1,54 @@
+"""Segmentation helpers: the multicut-solver registry.
+
+Mirrors the reference's ``cluster_tools/utils/segmentation_utils.py``
+(SURVEY.md §2a "Utils"), whose ``key_to_agglomerator`` mapped solver names
+(kernighan-lin, greedy-additive, fusion-moves, ...) to nifty C++ solvers.
+Here the solvers live in :mod:`..ops.multicut`; 'fusion-moves' maps to the
+strongest available pipeline (GAEC + KL refinement with restarts) rather
+than a faithful FM implementation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.multicut import greedy_additive, kernighan_lin
+
+
+def _solve_greedy(n_nodes, edges, costs, **kw):
+    return greedy_additive(n_nodes, edges, costs, **kw)
+
+
+def _solve_kl(n_nodes, edges, costs, **kw):
+    return kernighan_lin(n_nodes, edges, costs, **kw)
+
+
+def _solve_strong(n_nodes, edges, costs, **kw):
+    """GAEC init + KL refinement; the default 'quality' solver."""
+    init = greedy_additive(n_nodes, edges, costs)
+    return kernighan_lin(n_nodes, edges, costs, init_labels=init, **kw)
+
+
+key_to_agglomerator = {
+    "greedy-additive": _solve_greedy,
+    "kernighan-lin": _solve_kl,
+    "decomposition": _solve_strong,
+    "fusion-moves": _solve_strong,
+}
+
+
+def get_multicut_solver(key: str):
+    try:
+        return key_to_agglomerator[key]
+    except KeyError:
+        raise ValueError(
+            f"unknown multicut solver {key!r}; "
+            f"available: {sorted(key_to_agglomerator)}"
+        )
+
+
+def apply_size_filter(
+    ids: np.ndarray, sizes: np.ndarray, size_threshold: int
+) -> np.ndarray:
+    """Mask of segment ids whose size is below ``size_threshold``."""
+    return ids[sizes < size_threshold]

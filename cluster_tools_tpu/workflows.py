@@ -1,0 +1,149 @@
+"""End-to-end segmentation workflows.
+
+Re-design of the reference's ``cluster_tools/workflows.py`` (SURVEY.md §2a
+"Workflows", §3.3): the flagship ``MulticutSegmentationWorkflow`` chains
+
+    watershed (supervoxels) -> graph -> edge features -> costs
+    -> hierarchical multicut -> write
+
+with each stage the task family from :mod:`.tasks`.  Workflow classes follow
+the reference's pattern: one class per pipeline, ``target=`` selecting the
+backend trio member, parameters forwarded to the stage tasks, and
+``get_config()`` aggregating every stage's defaults for the config_dir.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+from .runtime.task import WorkflowBase, get_task_cls
+from .tasks import costs as costs_mod
+from .tasks import features as feat_mod
+from .tasks import graph as graph_mod
+from .tasks import multicut as mc_mod
+from .tasks import watershed as ws_mod
+from .tasks import write as write_mod
+from .tasks.multicut import assignments_path
+
+
+def _pick(p: Dict[str, Any], *names: str) -> Dict[str, Any]:
+    return {k: p[k] for k in names if k in p}
+
+
+class MulticutSegmentationWorkflow(WorkflowBase):
+    """boundary map -> supervoxels -> RAG -> features -> costs -> multicut
+    -> segmentation.
+
+    Params:
+      ``input_path/input_key``    boundary/affinity map (float),
+      ``ws_path/ws_key``          supervoxel dataset (created unless
+                                  ``skip_ws``),
+      ``output_path/output_key``  final segmentation,
+      ``skip_ws``                 use an existing supervoxel dataset,
+      ``two_pass_ws``             checkerboard two-pass watershed,
+      watershed params (``threshold``, ``sigma_seeds``, ``halo``, ...),
+      ``channel``                 boundary-map channel selector for features,
+      ``beta``/``weighting_scheme`` cost transform,
+      ``n_scales``                subproblem levels,
+      ``agglomerator``            solver key for subproblems + global solve.
+    """
+
+    task_name = "multicut_segmentation_workflow"
+
+    def requires(self):
+        p = self.params
+        common = dict(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+        )
+        ws_path, ws_key = p["ws_path"], p["ws_key"]
+        deps = list(self.dependencies)
+
+        if not p.get("skip_ws", False):
+            ws = ws_mod.WatershedWorkflow(
+                **common,
+                target=self.target,
+                dependencies=deps,
+                input_path=p["input_path"],
+                input_key=p["input_key"],
+                output_path=ws_path,
+                output_key=ws_key,
+                two_pass=p.get("two_pass_ws", False),
+                **_pick(
+                    p,
+                    "threshold",
+                    "sigma_seeds",
+                    "min_seed_distance",
+                    "sampling",
+                    "size_filter",
+                    "two_d",
+                    "halo",
+                    "block_shape",
+                    "mask_path",
+                    "mask_key",
+                ),
+            )
+            deps = [ws]
+
+        grid = _pick(p, "block_shape", "roi_begin", "roi_end")
+        g = graph_mod.GraphWorkflow(
+            **common,
+            target=self.target,
+            dependencies=deps,
+            input_path=ws_path,
+            input_key=ws_key,
+            **grid,
+        )
+        feats = feat_mod.EdgeFeaturesWorkflow(
+            **common,
+            target=self.target,
+            dependencies=[g],
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            labels_path=ws_path,
+            labels_key=ws_key,
+            **_pick(p, "channel"),
+            **grid,
+        )
+        costs = get_task_cls(costs_mod, "ProbsToCosts", self.target)(
+            **common,
+            dependencies=[feats],
+            **_pick(p, "beta", "weighting_scheme", "weighting_exponent"),
+        )
+        mc = mc_mod.MulticutWorkflow(
+            **common,
+            target=self.target,
+            dependencies=[costs],
+            input_path=ws_path,
+            input_key=ws_key,
+            **_pick(p, "n_scales", "agglomerator"),
+            **grid,
+        )
+        write = get_task_cls(write_mod, "Write", self.target)(
+            **common,
+            dependencies=[mc],
+            input_path=ws_path,
+            input_key=ws_key,
+            output_path=p["output_path"],
+            output_key=p["output_key"],
+            assignment_path=assignments_path(self.tmp_folder),
+            **_pick(p, "block_shape"),
+        )
+        return [write]
+
+    @staticmethod
+    def get_config() -> Dict[str, Dict[str, Any]]:
+        """Aggregated per-task default configs (reference pattern: workflows
+        expose ``get_config()`` so users can materialize + edit the JSONs)."""
+        return {
+            "global": WorkflowBase.default_global_config(),
+            "watershed": ws_mod.WatershedBase.default_task_config(),
+            "two_pass_watershed": ws_mod.TwoPassWatershedBase.default_task_config(),
+            "initial_sub_graphs": graph_mod.InitialSubGraphsBase.default_task_config(),
+            "block_edge_features": feat_mod.BlockEdgeFeaturesBase.default_task_config(),
+            "probs_to_costs": costs_mod.ProbsToCostsBase.default_task_config(),
+            "solve_subproblems": mc_mod.SolveSubproblemsBase.default_task_config(),
+            "solve_global": mc_mod.SolveGlobalBase.default_task_config(),
+        }

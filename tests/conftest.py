@@ -16,6 +16,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
+
+# the TPU-session sitecustomize force-updates jax_platforms to "axon,cpu"
+# (overriding the env var), which makes the first backend init dial the TPU
+# tunnel — a hang when the tunnel is down and wrong for tests regardless.
+# The config write wins over both; tests are CPU-mesh only (SURVEY.md §4).
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,122 @@
+"""Multicut solver tests: structured graphs with known optima, energy
+monotonicity, contraction correctness (SURVEY.md §4 oracle pattern:
+"multicut workflow checked for consistency/energy rather than exact
+labels")."""
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.ops.multicut import (
+    contract_graph,
+    greedy_additive,
+    kernighan_lin,
+    multicut_energy,
+)
+from cluster_tools_tpu.utils.segmentation_utils import (
+    get_multicut_solver,
+    key_to_agglomerator,
+)
+
+
+def two_cliques(n_per=4, w_in=2.0, w_out=-1.0):
+    """Two attractive cliques joined by repulsive edges; optimum = split."""
+    edges, costs = [], []
+    n = 2 * n_per
+    for a in range(n):
+        for b in range(a + 1, n):
+            same = (a < n_per) == (b < n_per)
+            edges.append((a, b))
+            costs.append(w_in if same else w_out)
+    return n, np.array(edges), np.array(costs)
+
+
+def enumerate_partitions(n):
+    """All set partitions of range(n) as label arrays (restricted growth)."""
+    def rec(prefix, k):
+        i = len(prefix)
+        if i == n:
+            yield np.array(prefix)
+            return
+        for lab in range(k + 1):
+            yield from rec(prefix + [lab], max(k, lab + 1))
+
+    yield from rec([], 0)
+
+
+def brute_force_optimum(n, edges, costs):
+    best, best_e = None, np.inf
+    for labels in enumerate_partitions(n):
+        e = multicut_energy(edges, costs, labels)
+        if e < best_e:
+            best, best_e = labels, e
+    return best, best_e
+
+
+@pytest.mark.parametrize("solver_key", sorted(key_to_agglomerator))
+def test_two_cliques_exact(solver_key):
+    n, edges, costs = two_cliques()
+    labels = get_multicut_solver(solver_key)(n, edges, costs)
+    assert len(np.unique(labels)) == 2
+    assert (labels[:4] == labels[0]).all() and (labels[4:] == labels[4]).all()
+    assert labels[0] != labels[4]
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_gaec_near_bruteforce_optimum(seed):
+    """On tiny random graphs GAEC+KL must come close to the true optimum
+    (and never beat it — sanity that the energy is computed consistently)."""
+    rng = np.random.default_rng(seed)
+    n = 6
+    edges = np.array([(a, b) for a in range(n) for b in range(a + 1, n)])
+    keep = rng.random(len(edges)) < 0.7
+    edges = edges[keep]
+    costs = rng.normal(size=len(edges))
+    _, opt_e = brute_force_optimum(n, edges, costs)
+    labels = kernighan_lin(n, edges, costs)
+    e = multicut_energy(edges, costs, labels)
+    assert e >= opt_e - 1e-9
+    assert e <= opt_e + 0.25 * abs(opt_e) + 1e-6, f"too far from optimum: {e} vs {opt_e}"
+
+
+def test_kl_never_worse_than_gaec():
+    rng = np.random.default_rng(7)
+    n = 30
+    m = 120
+    edges = rng.integers(0, n, size=(m, 2))
+    edges = edges[edges[:, 0] != edges[:, 1]]
+    costs = rng.normal(size=len(edges))
+    g = greedy_additive(n, edges, costs)
+    k = kernighan_lin(n, edges, costs, init_labels=g)
+    assert multicut_energy(edges, costs, k) <= multicut_energy(edges, costs, g) + 1e-9
+
+
+def test_gaec_merges_all_attractive():
+    n = 4
+    edges = np.array([[0, 1], [1, 2], [2, 3]])
+    costs = np.array([1.0, 0.5, 2.0])
+    labels = greedy_additive(n, edges, costs)
+    assert len(np.unique(labels)) == 1
+
+
+def test_gaec_parallel_edge_accumulation():
+    """Two weak attractions must outweigh one repulsion after contraction."""
+    # 0-1 attractive strong; (0-2, 1-2) each +0.6; 2-3 repulsive -1
+    edges = np.array([[0, 1], [0, 2], [1, 2], [2, 3]])
+    costs = np.array([5.0, 0.6, 0.6, -1.0])
+    labels = greedy_additive(4, edges, costs)
+    assert labels[0] == labels[1] == labels[2]
+    assert labels[3] != labels[0]
+
+
+def test_contract_graph():
+    edges = np.array([[0, 1], [1, 2], [2, 3], [0, 3]])
+    costs = np.array([1.0, -2.0, 3.0, 0.5])
+    node_labels = np.array([0, 0, 1, 1])  # merge 0-1 and 2-3
+    new_edges, new_costs = contract_graph(edges, costs, node_labels)
+    np.testing.assert_array_equal(new_edges, [[0, 1]])
+    np.testing.assert_allclose(new_costs, [-2.0 + 0.5])
+
+
+def test_contract_graph_empty():
+    e, c = contract_graph(np.zeros((0, 2), np.int64), np.zeros(0), np.zeros(0, np.int64))
+    assert len(e) == 0 and len(c) == 0

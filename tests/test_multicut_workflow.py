@@ -1,0 +1,142 @@
+"""End-to-end multicut segmentation workflow tests.
+
+Oracle pattern (SURVEY.md §4): with supervoxels that exactly tile the
+ground-truth regions (each GT region artificially split), the multicut over
+a clean boundary map must merge the artificial splits and keep the GT
+boundaries — recovering GT up to label bijection.  The full
+watershed-from-scratch variant is run as a smoke test for chain integrity.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils.volume_utils import file_reader
+from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+
+from .helpers import assert_labels_equivalent
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "config")
+    os.makedirs(config_dir, exist_ok=True)
+    with open(os.path.join(config_dir, "global.config"), "w") as f:
+        json.dump({"block_shape": [8, 8, 8]}, f)
+    return tmp_folder, config_dir, str(tmp_path)
+
+
+def make_case(shape=(16, 16, 16), noise=0.05, seed=0):
+    """GT = 2x2 boxes in (y, x); supervoxels split each box in z; boundary
+    map high on GT region interfaces only."""
+    rng = np.random.default_rng(seed)
+    gt = np.zeros(shape, np.uint64)
+    sv = np.zeros(shape, np.uint64)
+    hy, hx, hz = shape[1] // 2, shape[2] // 2, shape[0] // 2
+    for i, ys in enumerate([slice(0, hy), slice(hy, None)]):
+        for j, xs in enumerate([slice(0, hx), slice(hx, None)]):
+            gt[:, ys, xs] = 1 + 2 * i + j
+            sv[:hz, ys, xs] = 1 + 2 * (2 * i + j)
+            sv[hz:, ys, xs] = 2 + 2 * (2 * i + j)
+    bmap = np.full(shape, 0.05, np.float32)
+    # mark voxels adjacent to a GT interface
+    for axis in range(3):
+        sl_a = [slice(None)] * 3
+        sl_b = [slice(None)] * 3
+        sl_a[axis] = slice(0, -1)
+        sl_b[axis] = slice(1, None)
+        diff = gt[tuple(sl_a)] != gt[tuple(sl_b)]
+        bmap[tuple(sl_a)][diff] = 0.95
+        bmap[tuple(sl_b)][diff] = 0.95
+    bmap += rng.normal(0, noise, shape).astype(np.float32)
+    return gt, sv, np.clip(bmap, 0.0, 1.0)
+
+
+def _write_ds(path, key, data, chunks=(8, 8, 8)):
+    f = file_reader(path)
+    ds = f.create_dataset(
+        key, shape=data.shape, chunks=chunks, dtype=str(data.dtype)
+    )
+    ds[...] = data
+    return ds
+
+
+@pytest.mark.parametrize("n_scales", [1, 2])
+def test_multicut_recovers_gt_with_given_supervoxels(workspace, n_scales):
+    tmp_folder, config_dir, root = workspace
+    gt, sv, bmap = make_case()
+    path = os.path.join(root, "data.zarr")
+    _write_ds(path, "bmap", bmap)
+    _write_ds(path, "sv", sv)
+
+    wf = MulticutSegmentationWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="bmap",
+        ws_path=path,
+        ws_key="sv",
+        output_path=path,
+        output_key="seg",
+        skip_ws=True,
+        n_scales=n_scales,
+        beta=0.5,
+    )
+    assert build([wf]), "workflow failed (see logs in tmp_folder)"
+    seg = file_reader(path, "r")["seg"][...]
+    assert_labels_equivalent(seg, gt)
+
+
+def test_multicut_full_chain_with_watershed(workspace):
+    """Smoke: boundary map -> watershed -> multicut produces a dense
+    segmentation with far fewer segments than supervoxels."""
+    tmp_folder, config_dir, root = workspace
+    gt, _, bmap = make_case(noise=0.02)
+    path = os.path.join(root, "data.zarr")
+    _write_ds(path, "bmap", bmap)
+
+    wf = MulticutSegmentationWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="bmap",
+        ws_path=path,
+        ws_key="ws",
+        output_path=path,
+        output_key="seg",
+        threshold=0.5,
+        halo=[2, 2, 2],
+        beta=0.5,
+    )
+    assert build([wf]), "workflow failed (see logs in tmp_folder)"
+    f = file_reader(path, "r")
+    ws = f["ws"][...]
+    seg = f["seg"][...]
+    n_sv = len(np.setdiff1d(np.unique(ws), [0]))
+    n_seg = len(np.setdiff1d(np.unique(seg), [0]))
+    assert n_seg >= 1
+    assert n_seg <= n_sv
+    # watershed foreground is preserved by the relabeling
+    np.testing.assert_array_equal(seg > 0, ws > 0)
+    # the multicut must not under-segment across the clean GT boundaries:
+    # each output segment should be (mostly) contained in one GT region
+    fg = seg > 0
+    purity = 0
+    for s in np.setdiff1d(np.unique(seg), [0]):
+        _, cnt = np.unique(gt[seg == s], return_counts=True)
+        purity += cnt.max()
+    assert purity / fg.sum() > 0.9, "multicut merged across GT boundaries"
+
+
+def test_workflow_get_config():
+    cfg = MulticutSegmentationWorkflow.get_config()
+    assert "global" in cfg and "watershed" in cfg and "solve_global" in cfg
+    assert "beta" in cfg["probs_to_costs"]

@@ -1,0 +1,214 @@
+"""RAG kernel + graph/features task tests against numpy oracles
+(the reference's oracle pattern, SURVEY.md §4: blockwise vs single-shot)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.ops.rag import (
+    block_rag,
+    find_edge_ids,
+    merge_edge_lists,
+    merge_feature_lists,
+)
+
+
+def rag_oracle(seg, values=None):
+    """Brute-force RAG: edges, sizes, (mean,min,max,count) via python loops."""
+    from collections import defaultdict
+
+    acc = defaultdict(list)
+    ndim = seg.ndim
+    for axis in range(ndim):
+        for idx in np.ndindex(*[s - (1 if d == axis else 0) for d, s in enumerate(seg.shape)]):
+            jdx = tuple(i + (1 if d == axis else 0) for d, i in enumerate(idx))
+            u, v = seg[idx], seg[jdx]
+            if u == v or u == 0 or v == 0:
+                continue
+            key = (min(u, v), max(u, v))
+            val = max(values[idx], values[jdx]) if values is not None else 0.0
+            acc[key].append(val)
+    uv = np.array(sorted(acc), dtype=np.uint64).reshape(-1, 2)
+    sizes = np.array([len(acc[tuple(k)]) for k in uv], dtype=np.int64)
+    if values is None:
+        return uv, sizes, None
+    feats = np.array(
+        [
+            [np.mean(acc[tuple(k)]), np.min(acc[tuple(k)]), np.max(acc[tuple(k)]), len(acc[tuple(k)])]
+            for k in uv
+        ],
+        dtype=np.float32,
+    ).reshape(-1, 4)
+    return uv, sizes, feats
+
+
+def random_seg(rng, shape, n_labels=6, p_bg=0.2):
+    seg = rng.integers(1, n_labels + 1, size=shape).astype(np.uint64)
+    seg[rng.random(shape) < p_bg] = 0
+    return seg
+
+
+def test_block_rag_vs_oracle(rng):
+    seg = random_seg(rng, (7, 8, 9))
+    vals = rng.random((7, 8, 9)).astype(np.float32)
+    uv, sizes, feats = block_rag(seg, values=vals)
+    uv_o, sizes_o, feats_o = rag_oracle(seg, vals)
+    np.testing.assert_array_equal(uv, uv_o)
+    np.testing.assert_array_equal(sizes, sizes_o)
+    np.testing.assert_allclose(feats, feats_o, rtol=1e-5)
+
+
+def test_block_rag_2d(rng):
+    seg = random_seg(rng, (12, 13))
+    uv, sizes, _ = block_rag(seg)
+    uv_o, sizes_o, _ = rag_oracle(seg)
+    np.testing.assert_array_equal(uv, uv_o)
+    np.testing.assert_array_equal(sizes, sizes_o)
+
+
+def test_block_rag_empty():
+    seg = np.zeros((4, 4, 4), np.uint64)
+    uv, sizes, _ = block_rag(seg)
+    assert uv.shape == (0, 2) and sizes.shape == (0,)
+
+
+def test_blockwise_rag_matches_single_shot(rng):
+    """Blocks with +1 upper halo, merged, == single-shot RAG of the volume."""
+    seg = random_seg(rng, (16, 16, 16), n_labels=20)
+    vals = rng.random(seg.shape).astype(np.float32)
+    bs = (8, 8, 8)
+    parts, fparts = [], []
+    for z in range(0, 16, 8):
+        for y in range(0, 16, 8):
+            for x in range(0, 16, 8):
+                bb = tuple(
+                    slice(b, min(b + s + 1, 16)) for b, s in zip((z, y, x), bs)
+                )
+                uv, sizes, feats = block_rag(
+                    seg[bb], values=vals[bb], inner_shape=bs
+                )
+                parts.append((uv, sizes))
+                fparts.append((uv, feats))
+    uv_m, sizes_m = merge_edge_lists(parts)
+    uv_o, sizes_o, feats_o = rag_oracle(seg, vals)
+    np.testing.assert_array_equal(uv_m, uv_o)
+    np.testing.assert_array_equal(sizes_m, sizes_o)
+    feats_m = merge_feature_lists(uv_m, fparts)
+    np.testing.assert_allclose(feats_m[:, 0], feats_o[:, 0], rtol=1e-4)
+    np.testing.assert_allclose(feats_m[:, 1:], feats_o[:, 1:], rtol=1e-5)
+
+
+def test_find_edge_ids():
+    uv = np.array([[1, 2], [1, 5], [3, 4]], np.uint64)
+    q = np.array([[3, 4], [1, 2], [2, 7]], np.uint64)
+    np.testing.assert_array_equal(find_edge_ids(uv, q), [2, 0, -1])
+    assert find_edge_ids(uv, np.zeros((0, 2), np.uint64)).shape == (0,)
+
+
+def test_find_edge_ids_large_labels(rng):
+    """Regression: labels >= 256 must compare numerically, not byte-wise
+    (watershed labels are flat voxel indices, i.e. large uint64)."""
+    uv = rng.integers(1, 2**40, size=(500, 2)).astype(np.uint64)
+    uv = np.unique(np.sort(uv, axis=1), axis=0)
+    perm = rng.permutation(len(uv))
+    ids = find_edge_ids(uv, uv[perm])
+    np.testing.assert_array_equal(ids, perm)
+    missing = np.array([[3, 5]], np.uint64)
+    assert find_edge_ids(uv, missing)[0] in (-1,) or tuple(uv[find_edge_ids(uv, missing)[0]]) == (3, 5)
+
+
+def test_blockwise_rag_large_labels(rng):
+    """Blockwise merge with realistic (large, sparse) labels == single-shot."""
+    seg = random_seg(rng, (16, 16, 16), n_labels=30).astype(np.uint64)
+    # shift labels into the large-uint64 regime
+    seg[seg > 0] += np.uint64(10_000_000)
+    vals = rng.random(seg.shape).astype(np.float32)
+    bs = (8, 8, 8)
+    parts, fparts = [], []
+    for z in range(0, 16, 8):
+        for y in range(0, 16, 8):
+            for x in range(0, 16, 8):
+                bb = tuple(
+                    slice(b, min(b + s + 1, 16)) for b, s in zip((z, y, x), bs)
+                )
+                uv, sizes, feats = block_rag(seg[bb], values=vals[bb], inner_shape=bs)
+                parts.append((uv, sizes))
+                fparts.append((uv, feats))
+    uv_m, sizes_m = merge_edge_lists(parts)
+    uv_o, sizes_o, feats_o = rag_oracle(seg, vals)
+    np.testing.assert_array_equal(uv_m, uv_o)
+    np.testing.assert_array_equal(sizes_m, sizes_o)
+    feats_m = merge_feature_lists(uv_m, fparts)
+    np.testing.assert_allclose(feats_m[:, 0], feats_o[:, 0], rtol=1e-4)
+
+
+class TestGraphTasks:
+    @pytest.fixture
+    def workspace(self, tmp_path):
+        tmp_folder = str(tmp_path / "tmp")
+        config_dir = str(tmp_path / "config")
+        os.makedirs(config_dir, exist_ok=True)
+        with open(os.path.join(config_dir, "global.config"), "w") as f:
+            json.dump({"block_shape": [8, 8, 8]}, f)
+        return tmp_folder, config_dir, str(tmp_path)
+
+    def _make_data(self, root, rng, shape=(16, 16, 16)):
+        from cluster_tools_tpu.utils.volume_utils import file_reader
+
+        path = os.path.join(root, "data.zarr")
+        f = file_reader(path)
+        seg = random_seg(rng, shape, n_labels=25)
+        ds = f.create_dataset("seg", shape=shape, chunks=(8, 8, 8), dtype="uint64")
+        ds[...] = seg
+        vals = rng.random(shape).astype(np.float32)
+        dv = f.create_dataset("bmap", shape=shape, chunks=(8, 8, 8), dtype="float32")
+        dv[...] = vals
+        return path, seg, vals
+
+    def test_graph_features_costs_chain(self, workspace, rng):
+        from cluster_tools_tpu.runtime.task import build
+        from cluster_tools_tpu.tasks.costs import ProbsToCostsLocal, costs_path
+        from cluster_tools_tpu.tasks.features import (
+            EdgeFeaturesWorkflow,
+            features_path,
+        )
+        from cluster_tools_tpu.tasks.graph import GraphWorkflow, load_global_graph
+
+        tmp_folder, config_dir, root = workspace
+        path, seg, vals = self._make_data(root, rng)
+
+        common = dict(tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=4)
+        g = GraphWorkflow(
+            **common, target="local", input_path=path, input_key="seg"
+        )
+        feat = EdgeFeaturesWorkflow(
+            **common,
+            target="local",
+            dependencies=[g],
+            input_path=path,
+            input_key="bmap",
+            labels_path=path,
+            labels_key="seg",
+        )
+        costs = ProbsToCostsLocal(**common, dependencies=[feat], beta=0.5)
+        assert build([costs])
+
+        nodes, uv, edges, sizes = load_global_graph(tmp_folder)
+        uv_o, sizes_o, feats_o = rag_oracle(seg, vals)
+        np.testing.assert_array_equal(uv, uv_o)
+        np.testing.assert_array_equal(sizes, sizes_o)
+        np.testing.assert_array_equal(
+            nodes, np.setdiff1d(np.unique(seg), [0]).astype(np.uint64)
+        )
+        # dense edges round-trip to original labels
+        np.testing.assert_array_equal(nodes[edges], uv)
+
+        feats = np.load(features_path(tmp_folder))
+        np.testing.assert_allclose(feats[:, 0], feats_o[:, 0], rtol=1e-4)
+
+        w = np.load(costs_path(tmp_folder))
+        assert w.shape == (len(uv),)
+        p = np.clip(feats[:, 0], 1e-5, 1 - 1e-5)
+        np.testing.assert_allclose(w, np.log((1 - p) / p), rtol=1e-3, atol=1e-4)
