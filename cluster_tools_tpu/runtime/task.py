@@ -148,6 +148,42 @@ class BaseTask:
     def log_block_success(self, block_id: int):
         fu.log_block_success(self.tmp_folder, self.uid, block_id)
 
+    def host_block_map(self, block_ids: Sequence[int], process) -> int:
+        """Run ``process(block_id)`` for every block without a success
+        marker, on the host IO thread pool, marking each success.
+
+        The common scaffold of host-side blockwise tasks (thin-slab scans,
+        relabel writes, artifact dumps): resume-filtering, pooling, and
+        error propagation live here so every task behaves identically.
+        All failures are surfaced (not just the first): raises RuntimeError
+        listing every failed block.  Returns the number of blocks run.
+        """
+        done = set(self.blocks_done())
+        todo = [b for b in block_ids if b not in done]
+        errors: List[tuple] = []
+
+        def wrapped(block_id):
+            try:
+                process(block_id)
+                self.log_block_success(block_id)
+            except Exception:
+                errors.append((block_id, traceback.format_exc()))
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
+            list(pool.map(wrapped, todo))
+        if errors:
+            failed_ids = sorted(b for b, _ in errors)
+            detail = "\n".join(
+                f"-- block {b} --\n{tb}" for b, tb in errors[:5]
+            )
+            raise RuntimeError(
+                f"{self.task_name}: {len(errors)}/{len(todo)} blocks failed "
+                f"(ids: {failed_ids}); first tracebacks:\n{detail}"
+            )
+        return len(todo)
+
 
 class DummyTask(BaseTask):
     """No-op dependency placeholder (reference: ``DummyTask``)."""

@@ -27,7 +27,6 @@ same):
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
@@ -220,7 +219,6 @@ class BlockFacesBase(BaseTask):
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        done = set(self.blocks_done())
         roi_set = set(block_ids)
 
         def process(block_id: int):
@@ -251,12 +249,9 @@ class BlockFacesBase(BaseTask):
                 else np.zeros((0, 2), np.uint64)
             )
             np.save(_faces_path(self.tmp_folder, block_id), result)
-            self.log_block_success(block_id)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(todo)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class BlockFacesLocal(BlockFacesBase):

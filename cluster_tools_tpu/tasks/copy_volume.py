@@ -5,8 +5,6 @@ parallelized over the IO thread pool."""
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
@@ -31,46 +29,74 @@ class CopyVolumeBase(BaseTask):
             "channel": None,
             "scale_factor": None,
             "offset": None,
+            "fit_to_roi": False,
         }
 
     def run_impl(self):
         cfg = self.get_config()
         inp = file_reader(cfg["input_path"])[cfg["input_key"]]
         channel = cfg.get("channel")
-        shape = inp.shape[1:] if channel is not None else inp.shape
+        in_shape = inp.shape[1:] if channel is not None else inp.shape
         block_shape = tuple(cfg["block_shape"])
         out_chunks = tuple(cfg.get("out_chunks") or block_shape)
         dtype = cfg.get("dtype") or str(inp.dtype)
         scale, offset = cfg.get("scale_factor"), cfg.get("offset")
+        roi_begin, roi_end = cfg.get("roi_begin"), cfg.get("roi_end")
+        fit_to_roi = bool(cfg.get("fit_to_roi")) and roi_begin is not None
+        if fit_to_roi:
+            # output covers exactly the ROI, shifted to the origin
+            re = roi_end if roi_end is not None else in_shape
+            out_shape = tuple(int(e) - int(b) for b, e in zip(roi_begin, re))
+            shift = tuple(int(b) for b in roi_begin)
+        else:
+            out_shape = in_shape
+            shift = tuple(0 for _ in in_shape)
 
         out = file_reader(cfg["output_path"]).require_dataset(
-            cfg["output_key"], shape=shape, chunks=out_chunks, dtype=dtype
+            cfg["output_key"], shape=out_shape, chunks=out_chunks, dtype=dtype
         )
-        blocking = Blocking(shape, block_shape)
-        block_ids = blocks_in_volume(
-            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
-        )
-        done = set(self.blocks_done())
+        blocking = Blocking(in_shape, block_shape)
+        block_ids = blocks_in_volume(in_shape, block_shape, roi_begin, roi_end)
 
-        def process(block_id):
-            bb = blocking.get_block(block_id).bb
-            data = inp[(channel,) + bb] if channel is not None else inp[bb]
+        def _convert(data):
             if scale is not None or offset is not None:
                 data = data.astype(np.float64) * (
                     1.0 if scale is None else scale
                 ) + (0.0 if offset is None else offset)
-            if np.issubdtype(np.dtype(dtype), np.integer) and not np.issubdtype(
-                data.dtype, np.integer
-            ):
-                info = np.iinfo(np.dtype(dtype))
-                data = np.clip(np.round(data), info.min, info.max)
-            out[bb] = data.astype(dtype)
-            self.log_block_success(block_id)
+            target = np.dtype(dtype)
+            if np.issubdtype(target, np.integer) and target != data.dtype:
+                info = np.iinfo(target)
+                if np.issubdtype(data.dtype, np.integer):
+                    # narrowing / sign-changing int casts must clip, not wrap
+                    src = np.iinfo(data.dtype)
+                    lo = max(int(info.min), int(src.min))
+                    hi = min(int(info.max), int(src.max))
+                    data = np.clip(data, lo, hi)
+                else:
+                    data = np.clip(np.round(data), info.min, info.max)
+            return data.astype(dtype)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(todo), "shape": list(shape), "dtype": dtype}
+        roi_lo = tuple(int(b) for b in (roi_begin or [0] * len(in_shape)))
+        roi_hi = tuple(
+            int(e) for e in (roi_end if roi_end is not None else in_shape)
+        )
+
+        def process(block_id):
+            bb = blocking.get_block(block_id).bb
+            # clip to the ROI: blocks straddling a non-aligned ROI edge must
+            # not read/write outside it (out_bb would go negative/OOB)
+            bb = tuple(
+                slice(max(b.start, lo), min(b.stop, hi))
+                for b, lo, hi in zip(bb, roi_lo, roi_hi)
+            )
+            data = inp[(channel,) + bb] if channel is not None else inp[bb]
+            out_bb = tuple(
+                slice(b.start - s, b.stop - s) for b, s in zip(bb, shift)
+            )
+            out[out_bb] = _convert(data)
+
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n, "shape": list(out_shape), "dtype": dtype}
 
 
 class CopyVolumeLocal(CopyVolumeBase):

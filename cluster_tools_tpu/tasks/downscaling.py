@@ -7,7 +7,6 @@ written to the dataset attributes."""
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
 from typing import List, Sequence
 
 import numpy as np
@@ -33,7 +32,13 @@ def _reduce_block(data: np.ndarray, factor: Sequence[int], mode: str) -> np.ndar
     blocks = data.reshape(new_shape)
     axes = tuple(range(1, 2 * data.ndim, 2))
     if mode == "mean":
-        return blocks.mean(axes).astype(np.float32)
+        m = blocks.mean(axes)
+        if np.issubdtype(data.dtype, np.integer):
+            # keep the pyramid dtype-consistent with s0 (multiscale
+            # consumers require it): round back to the input integer type
+            info = np.iinfo(data.dtype)
+            m = np.clip(np.round(m), info.min, info.max)
+        return m.astype(data.dtype)
     if mode == "max":
         return blocks.max(axes)
     if mode == "min":
@@ -76,7 +81,7 @@ class DownscalingBase(BaseTask):
         in_shape = inp.shape
         out_shape = tuple((s + f - 1) // f for s, f in zip(in_shape, factor))
         block_shape = tuple(cfg["block_shape"])
-        dtype = "float32" if mode == "mean" else str(inp.dtype)
+        dtype = str(inp.dtype)
         out = file_reader(cfg["output_path"]).require_dataset(
             cfg["output_key"], shape=out_shape, chunks=block_shape, dtype=dtype
         )
@@ -84,8 +89,6 @@ class DownscalingBase(BaseTask):
         block_ids = blocks_in_volume(
             out_shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        done = set(self.blocks_done())
-
         def process(block_id):
             block = blocking.get_block(block_id)
             in_bb = tuple(
@@ -93,14 +96,11 @@ class DownscalingBase(BaseTask):
                 for b, f, s in zip(block.bb, factor, in_shape)
             )
             out[block.bb] = _reduce_block(inp[in_bb], factor, mode).astype(dtype)
-            self.log_block_success(block_id)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
+        n = self.host_block_map(block_ids, process)
         # per-step factor; workflows overwrite with the cumulative factor
         out.update_attrs(downsamplingFactors=list(factor), downscalingMode=mode)
-        return {"n_blocks": len(todo), "out_shape": list(out_shape)}
+        return {"n_blocks": n, "out_shape": list(out_shape)}
 
 
 class DownscalingLocal(DownscalingBase):

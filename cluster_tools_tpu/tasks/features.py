@@ -18,8 +18,6 @@ Artifacts (in ``tmp_folder/graph``, next to the graph):
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ..ops.rag import block_rag, merge_feature_lists
@@ -77,7 +75,6 @@ class BlockEdgeFeaturesBase(BaseTask):
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
         channel = cfg.get("channel")
-        done = set(self.blocks_done())
 
         def process(block_id: int):
             block = blocking.get_block(block_id)
@@ -88,12 +85,9 @@ class BlockEdgeFeaturesBase(BaseTask):
             np.savez(
                 block_features_path(self.tmp_folder, block_id), uv=uv, feats=feats
             )
-            self.log_block_success(block_id)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(block_ids)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class BlockEdgeFeaturesLocal(BlockEdgeFeaturesBase):

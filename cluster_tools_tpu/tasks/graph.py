@@ -20,8 +20,6 @@ Label 0 is background/ignore and never becomes a node.
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ..ops.rag import block_rag, find_edge_ids, merge_edge_lists
@@ -78,9 +76,6 @@ class InitialSubGraphsBase(BaseTask):
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        done = set(self.blocks_done())
-        todo = [b for b in block_ids if b not in done]
-
         def process(block_id: int):
             block = blocking.get_block(block_id)
             seg = np.asarray(ds[_upper_halo_bb(block, shape)])
@@ -95,11 +90,9 @@ class InitialSubGraphsBase(BaseTask):
                 uv=uv,
                 sizes=sizes,
             )
-            self.log_block_success(block_id)
 
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(block_ids)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class InitialSubGraphsLocal(InitialSubGraphsBase):
@@ -168,19 +161,15 @@ class MapEdgeIdsBase(BaseTask):
             shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
         )
         _, uv_global, _, _ = load_global_graph(self.tmp_folder)
-        done = set(self.blocks_done())
 
         def process(block_id: int):
             with np.load(block_graph_path(self.tmp_folder, block_id)) as f:
                 uv = f["uv"]
             ids = find_edge_ids(uv_global, uv)
             np.save(edge_ids_path(self.tmp_folder, block_id), ids)
-            self.log_block_success(block_id)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(block_ids)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class MapEdgeIdsLocal(MapEdgeIdsBase):

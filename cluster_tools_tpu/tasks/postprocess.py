@@ -6,8 +6,6 @@ graph-watershed reassignment variant lands with the graph tasks."""
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
@@ -34,19 +32,15 @@ class BlockLabelSizesBase(BaseTask):
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        done = set(self.blocks_done())
         d = _sizes_dir(self.tmp_folder)
 
         def process(block_id):
             labels = ds[blocking.get_block(block_id).bb]
             u, c = np.unique(labels[labels != 0], return_counts=True)
             np.savez(os.path.join(d, f"block_{block_id}.npz"), labels=u, counts=c)
-            self.log_block_success(block_id)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(todo)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class BlockLabelSizesLocal(BlockLabelSizesBase):
@@ -128,7 +122,7 @@ class SizeFilterWorkflow(WorkflowBase):
 
     def requires(self):
         from . import postprocess as pp_mod
-        from . import write as write_mod
+        from .relabel import staged_write_tasks
 
         p = self.params
         common = dict(
@@ -148,16 +142,18 @@ class SizeFilterWorkflow(WorkflowBase):
             **bs,
             **{k: p[k] for k in ("min_size", "max_size", "relabel") if k in p},
         )
-        t3 = get_task_cls(write_mod, "Write", self.target)(
-            **common,
-            dependencies=[t2],
-            **io,
-            output_path=p.get("output_path", p["input_path"]),
-            output_key=p.get("output_key", p["input_key"]),
+        t3 = staged_write_tasks(
+            self,
+            [t2],
             assignment_path=os.path.join(
                 self.tmp_folder, "size_filter_assignments.npz"
             ),
-            **bs,
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            output_path=p.get("output_path", p["input_path"]),
+            output_key=p.get("output_key", p["input_key"]),
+            stage_name="size_filter",
+            bs=bs,
         )
         return [t3]
 

@@ -10,8 +10,6 @@ the standard finisher.
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
@@ -38,19 +36,15 @@ class FindUniquesBase(BaseTask):
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        done = set(self.blocks_done())
         d = _uniques_dir(self.tmp_folder)
 
         def process(block_id):
             block = blocking.get_block(block_id)
             u = np.unique(ds[block.bb])
             np.save(os.path.join(d, f"block_{block_id}.npy"), u[u != 0])
-            self.log_block_success(block_id)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(todo)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class FindUniquesLocal(FindUniquesBase):
@@ -100,6 +94,62 @@ class FindLabelingTPU(FindLabelingBase):
     target = "tpu"
 
 
+def staged_write_tasks(
+    workflow: WorkflowBase,
+    deps,
+    assignment_path: str,
+    input_path: str,
+    input_key: str,
+    output_path: str,
+    output_key: str,
+    stage_name: str,
+    bs,
+):
+    """Build the final Write step, staging the input labels to a scratch
+    dataset first when writing in place.
+
+    In-place application is not crash-idempotent at the block grain: a crash
+    between a block's data write and its success marker would re-map already
+    relabeled values on resume.  Staging the original labels (a blockwise
+    copy) keeps Write's input immutable, restoring idempotency — the same
+    pattern the CC workflow uses for its provisional labels.
+    """
+    from . import copy_volume as cv_mod
+    from . import write as write_mod
+
+    common = dict(
+        tmp_folder=workflow.tmp_folder,
+        config_dir=workflow.config_dir,
+        max_jobs=workflow.max_jobs,
+    )
+    in_place = output_path == input_path and output_key == input_key
+    if in_place:
+        staged_path = os.path.join(workflow.tmp_folder, f"{stage_name}_src.zarr")
+        staged_key = "labels"
+        t_copy = get_task_cls(cv_mod, "CopyVolume", workflow.target)(
+            **common,
+            dependencies=deps,
+            input_path=input_path,
+            input_key=input_key,
+            output_path=staged_path,
+            output_key=staged_key,
+            **bs,
+        )
+        deps = [t_copy]
+        input_path, input_key = staged_path, staged_key
+    t_write = get_task_cls(write_mod, "Write", workflow.target)(
+        **common,
+        dependencies=deps,
+        input_path=input_path,
+        input_key=input_key,
+        output_path=output_path,
+        output_key=output_key,
+        assignment_path=assignment_path,
+        **bs,
+    )
+    return t_write
+
+
 class RelabelWorkflow(WorkflowBase):
     """find_uniques -> find_labeling -> write (reference: relabel workflow)."""
 
@@ -107,7 +157,6 @@ class RelabelWorkflow(WorkflowBase):
 
     def requires(self):
         from . import relabel as rl_mod
-        from . import write as write_mod
 
         p = self.params
         common = dict(
@@ -116,6 +165,7 @@ class RelabelWorkflow(WorkflowBase):
             max_jobs=self.max_jobs,
         )
         bs = {k: p[k] for k in ("block_shape",) if k in p}
+        assignment_name = p.get("assignment_name", "relabel_assignments")
         t1 = get_task_cls(rl_mod, "FindUniques", self.target)(
             **common,
             dependencies=self.dependencies,
@@ -128,19 +178,19 @@ class RelabelWorkflow(WorkflowBase):
             dependencies=[t1],
             input_path=p["input_path"],
             input_key=p["input_key"],
+            assignment_name=assignment_name,
             **bs,
         )
-        t3 = get_task_cls(write_mod, "Write", self.target)(
-            **common,
-            dependencies=[t2],
+        t3 = staged_write_tasks(
+            self,
+            [t2],
+            assignment_path=os.path.join(self.tmp_folder, assignment_name + ".npz"),
             input_path=p["input_path"],
             input_key=p["input_key"],
             output_path=p.get("output_path", p["input_path"]),
             output_key=p.get("output_key", p["input_key"]),
-            assignment_path=os.path.join(
-                self.tmp_folder, "relabel_assignments.npz"
-            ),
-            **bs,
+            stage_name="relabel",
+            bs=bs,
         )
         return [t3]
 

@@ -6,8 +6,6 @@ from __future__ import annotations
 
 import json
 import os
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
@@ -32,7 +30,6 @@ class BlockStatisticsBase(BaseTask):
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        done = set(self.blocks_done())
         d = _stats_dir(self.tmp_folder)
 
         def process(block_id):
@@ -43,12 +40,9 @@ class BlockStatisticsBase(BaseTask):
                     [data.size, data.sum(), (data**2).sum(), data.min(), data.max()]
                 ),
             )
-            self.log_block_success(block_id)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(todo)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class BlockStatisticsLocal(BlockStatisticsBase):

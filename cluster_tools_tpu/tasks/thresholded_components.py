@@ -10,8 +10,6 @@ mask (e.g. as an input mask for other ops).
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
@@ -44,7 +42,6 @@ class ThresholdBase(BaseTask):
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        done = set(self.blocks_done())
         thr = float(cfg["threshold"])
         mode = cfg.get("threshold_mode", "greater")
         ops = {
@@ -58,12 +55,9 @@ class ThresholdBase(BaseTask):
         def process(block_id):
             bb = blocking.get_block(block_id).bb
             out[bb] = ops[mode](inp[bb]).astype(np.uint8)
-            self.log_block_success(block_id)
 
-        todo = [b for b in block_ids if b not in done]
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(todo)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class ThresholdLocal(ThresholdBase):

@@ -213,6 +213,13 @@ class TwoPassWatershedBase(_WsTaskBase):
         ) = self._setup()
         if all(h == 0 for h in halo):
             raise ValueError("two-pass watershed requires a nonzero halo")
+        if cfg.get("two_d"):
+            # pass-one blocks would be segmented per-slice and pass-two in
+            # 3-D: refuse the inconsistent hybrid instead of producing it
+            raise NotImplementedError(
+                "two_d=True is not supported for the two-pass watershed; "
+                "use the single-pass watershed for per-slice segmentation"
+            )
         block_ids = [
             b
             for b in block_ids

@@ -11,8 +11,6 @@ thread pool.
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ..runtime.task import BaseTask
@@ -56,18 +54,14 @@ class WriteBase(BaseTask):
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        done = set(self.blocks_done())
-        todo = [b for b in block_ids if b not in done]
 
         def process(block_id):
             block = blocking.get_block(block_id)
             labels = inp[block.bb]
             out[block.bb] = apply_assignment_np(labels, keys, values)
-            self.log_block_success(block_id)
 
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(process, todo))
-        return {"n_blocks": len(todo)}
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
 
 
 class WriteLocal(WriteBase):

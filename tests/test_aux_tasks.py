@@ -217,3 +217,174 @@ def test_threshold_task(rng, workspace):
     np.testing.assert_array_equal(
         file_reader(path)["mask"][:], (data > 0.5).astype(np.uint8)
     )
+
+
+def test_copy_volume_int_narrowing_clips(rng, workspace):
+    """Regression: int->narrower-int casts must clip, not wrap modulo 2^n."""
+    from cluster_tools_tpu.tasks.copy_volume import CopyVolumeWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    data = np.zeros((16, 16, 16), np.uint64)
+    data[0, 0, 0] = 2**40      # > uint32 range
+    data[0, 0, 1] = 7
+    path = _dataset(root, "big", data)
+    wf = CopyVolumeWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="big",
+        output_path=path,
+        output_key="small",
+        dtype="uint32",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    out = file_reader(path)["small"][...]
+    assert out[0, 0, 0] == np.iinfo(np.uint32).max  # clipped, not wrapped
+    assert out[0, 0, 1] == 7
+
+
+def test_copy_volume_fit_to_roi(rng, workspace):
+    from cluster_tools_tpu.tasks.copy_volume import CopyVolumeWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    data = rng.random((32, 32, 32)).astype(np.float32)
+    path = _dataset(root, "roi_src", data)
+    wf = CopyVolumeWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="roi_src",
+        output_path=path,
+        output_key="roi_out",
+        roi_begin=[16, 0, 16],
+        roi_end=[32, 16, 32],
+        fit_to_roi=True,
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    out = file_reader(path)["roi_out"][...]
+    assert out.shape == (16, 16, 16)
+    np.testing.assert_array_equal(out, data[16:32, 0:16, 16:32])
+
+
+def test_copy_volume_fit_to_roi_unaligned(rng, workspace):
+    """Regression: non-block-aligned ROI edges must be clipped, not shifted
+    out of bounds."""
+    from cluster_tools_tpu.tasks.copy_volume import CopyVolumeWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    data = rng.random((32, 32, 32)).astype(np.float32)
+    path = _dataset(root, "roi_src2", data)
+    wf = CopyVolumeWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="roi_src2",
+        output_path=path,
+        output_key="roi_out2",
+        roi_begin=[8, 0, 5],
+        roi_end=[24, 16, 21],
+        fit_to_roi=True,
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    out = file_reader(path)["roi_out2"][...]
+    assert out.shape == (16, 16, 16)
+    np.testing.assert_array_equal(out, data[8:24, 0:16, 5:21])
+
+
+def test_downscaling_mean_preserves_integer_dtype(rng, workspace):
+    """Regression: the pyramid must keep s0's dtype (uint8 EM raw stays
+    uint8 through mean downscaling)."""
+    from cluster_tools_tpu.tasks.downscaling import DownscalingWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    data = rng.integers(0, 255, (32, 32, 32)).astype(np.uint8)
+    path = _dataset(root, "raw8", data)
+    wf = DownscalingWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw8",
+        output_path=path,
+        output_key_prefix="ds8",
+        scale_factors=[[2, 2, 2]],
+        mode="mean",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    s1 = file_reader(path)["ds8/s1"][...]
+    assert s1.dtype == np.uint8
+    expect = np.round(
+        data.astype(np.float64).reshape(16, 2, 16, 2, 16, 2).mean((1, 3, 5))
+    ).astype(np.uint8)
+    np.testing.assert_array_equal(s1, expect)
+
+
+def test_relabel_in_place_is_crash_safe(rng, workspace):
+    """In-place relabel stages the source labels: simulate a crash-resume by
+    rerunning the Write step after clearing its markers mid-way."""
+    from cluster_tools_tpu.tasks.relabel import RelabelWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    mask = random_blobs(rng, (32, 32, 32), p=0.3)
+    labels, _ = ndi.label(mask)
+    labels = labels.astype(np.uint64) * 1000  # sparse labels
+    path = _dataset(root, "seg_ip", labels)
+    wf = RelabelWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="seg_ip",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    first = file_reader(path)["seg_ip"][...]
+    # simulate a crash after the data writes but before success markers:
+    # rerun the whole workflow with markers/targets cleared -> same result
+    import glob as _glob
+    for f in _glob.glob(os.path.join(tmp_folder, "write.*")):
+        os.remove(f) if os.path.isfile(f) else None
+    import shutil
+    for f in _glob.glob(os.path.join(tmp_folder, "*.success.json")):
+        os.remove(f)
+    assert build([wf])
+    second = file_reader(path)["seg_ip"][...]
+    np.testing.assert_array_equal(first, second)
+    assert_labels_equivalent(second, labels)
+    uniq = np.setdiff1d(np.unique(second), [0])
+    np.testing.assert_array_equal(uniq, np.arange(1, len(uniq) + 1))
+
+
+def test_two_pass_watershed_rejects_two_d(workspace):
+    from cluster_tools_tpu.tasks.watershed import WatershedWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    data = np.zeros((8, 8, 8), np.float32)
+    path = _dataset(root, "bmap2d", data, chunks=(8, 8, 8))
+    wf = WatershedWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=1,
+        target="local",
+        input_path=path,
+        input_key="bmap2d",
+        output_path=path,
+        output_key="ws2d",
+        two_pass=True,
+        two_d=True,
+        halo=[2, 2, 2],
+        block_shape=[8, 8, 8],
+    )
+    assert not build([wf])  # the two-pass task must refuse, failing the build
