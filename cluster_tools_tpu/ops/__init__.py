@@ -4,3 +4,5 @@ from . import edt
 from . import watershed
 from . import rag
 from . import multicut
+from . import mws
+from . import agglomeration

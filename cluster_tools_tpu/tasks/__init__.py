@@ -1,1 +1,21 @@
 """The op/task library (reference: one subpackage per op, SURVEY.md §2a)."""
+
+from . import connected_components
+from . import copy_volume
+from . import costs
+from . import downscaling
+from . import evaluation
+from . import features
+from . import graph
+from . import morphology
+from . import multicut
+from . import node_labels
+from . import postprocess
+from . import relabel
+from . import statistics
+from . import thresholded_components
+from . import watershed
+from . import write
+from . import agglomerative_clustering
+from . import mutex_watershed
+from . import stitching

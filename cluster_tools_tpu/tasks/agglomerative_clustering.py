@@ -1,0 +1,66 @@
+"""Agglomerative clustering of the RAG (alternative to multicut).
+
+Re-design of the reference's ``cluster_tools/agglomerative_clustering/``
+(SURVEY.md §2a): GASP-style average-linkage agglomeration over the merged
+edge features, stopping at a boundary-probability threshold.  A single
+driver task — its input (graph + features) is tiny next to the volume; the
+voxel-scale passes are the graph/features tasks it depends on.
+
+Emits a write-task-compatible assignment table
+(``agglomerative_assignments.npz``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..ops.agglomeration import average_agglomeration
+from ..runtime.task import BaseTask, WorkflowBase
+from .features import features_path
+from .graph import load_global_graph
+
+
+def agglomerative_assignments_path(tmp_folder: str) -> str:
+    return os.path.join(tmp_folder, "agglomerative_assignments.npz")
+
+
+class AgglomerativeClusteringBase(BaseTask):
+    """Params: ``threshold`` (merge edges while mean boundary prob is below
+    it, default 0.5)."""
+
+    task_name = "agglomerative_clustering"
+
+    @staticmethod
+    def default_task_config():
+        return {"threads_per_job": 1, "device_batch": 1, "threshold": 0.5}
+
+    def run_impl(self):
+        cfg = self.get_config()
+        nodes, _, edges, sizes = load_global_graph(self.tmp_folder)
+        feats = np.load(features_path(self.tmp_folder))
+        labels = average_agglomeration(
+            len(nodes),
+            edges.astype(np.int64),
+            feats[:, 0],
+            sizes,
+            float(cfg.get("threshold", 0.5)),
+        )
+        np.savez(
+            agglomerative_assignments_path(self.tmp_folder),
+            keys=nodes,
+            values=(labels + 1).astype(np.uint64),
+        )
+        return {
+            "n_nodes": int(len(nodes)),
+            "n_clusters": int(labels.max()) + 1 if len(labels) else 0,
+        }
+
+
+class AgglomerativeClusteringLocal(AgglomerativeClusteringBase):
+    target = "local"
+
+
+class AgglomerativeClusteringTPU(AgglomerativeClusteringBase):
+    target = "tpu"

@@ -1,0 +1,302 @@
+"""Stitch independent per-block segmentations into consistent labels.
+
+Re-design of the reference's ``cluster_tools/stitching/`` (SURVEY.md §2a):
+the reference offered face-consensus assignments and stitch-via-multicut on
+the block-boundary graph.  Both are provided here:
+
+- **Face consensus** (:class:`StitchFacesBase` + union-find merge): for each
+  adjacent block face, accumulate per label-pair the mean value of an
+  underlying map (boundary probability or attractive affinity) over the
+  face contacts; pairs passing the threshold merge (union-find), and the
+  assignment is applied blockwise by the generic write task.
+- **Stitch-via-multicut**: build the block-boundary RAG with the graph +
+  features tasks on the *stitched-input* segmentation and run the multicut
+  chain — that is exactly the existing GraphWorkflow/MulticutWorkflow
+  composition, so it needs no extra code here (see
+  ``MulticutSegmentationWorkflow`` with ``skip_ws=True``).
+
+Criterion semantics: ``merge_mode='less'`` (default) merges a face pair if
+its mean map value is *below* ``stitch_threshold`` (boundary-map
+convention); ``'greater'`` merges above (affinity convention, used by the
+MWS workflow with the attractive channels averaged).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+from .features import _read_boundary_map
+
+
+def _stitch_dir(tmp_folder: str) -> str:
+    d = os.path.join(tmp_folder, "stitch_faces")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def stitch_assignments_path(tmp_folder: str) -> str:
+    return os.path.join(_stitch_dir(tmp_folder), "stitch_assignments.npz")
+
+
+class StitchFacesBase(BaseTask):
+    """Per-block face scan: label-pair statistics across each upper face.
+
+    Params: ``seg_path/seg_key`` (blockwise labels), ``input_path/
+    input_key`` (the map driving the merge criterion; optional ``channel``
+    reduces a leading channel axis).  For affinity inputs pass
+    ``axis_channels`` (one channel index per spatial axis, e.g. [0, 1, 2]
+    for the unit offsets): a face along axis ``a`` is then scored by channel
+    ``axis_channels[a]`` read on the upper side of the face — exactly the
+    affinity of the edges crossing it, instead of a direction-diluted
+    average.
+    """
+
+    task_name = "stitch_faces"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "channel": None,
+            "axis_channels": None,
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        ds_seg = file_reader(cfg["seg_path"])[cfg["seg_key"]]
+        ds_map = file_reader(cfg["input_path"])[cfg["input_key"]]
+        shape = ds_seg.shape
+        block_shape = tuple(cfg["block_shape"])
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        roi_set = set(block_ids)
+        channel = cfg.get("channel")
+        axis_channels = cfg.get("axis_channels")
+        d = _stitch_dir(self.tmp_folder)
+
+        def process(block_id):
+            block = blocking.get_block(block_id)
+            pairs, sums, counts = [], [], []
+            for axis in range(len(shape)):
+                nbr = blocking.neighbor_id(block_id, axis, 1)
+                if nbr is None or nbr not in roi_set:
+                    continue
+                face = block.end[axis]
+                bb_lo = tuple(
+                    slice(face - 1, face) if a == axis else slice(b, e)
+                    for a, (b, e) in enumerate(zip(block.begin, block.end))
+                )
+                bb_hi = tuple(
+                    slice(face, face + 1) if a == axis else slice(b, e)
+                    for a, (b, e) in enumerate(zip(block.begin, block.end))
+                )
+                lo = np.asarray(ds_seg[bb_lo]).ravel()
+                hi = np.asarray(ds_seg[bb_hi]).ravel()
+                if axis_channels is not None:
+                    # the crossing edge's affinity lives on the upper-side
+                    # voxel in the axis' attractive channel
+                    val = _read_boundary_map(
+                        ds_map, bb_hi, int(axis_channels[axis])
+                    ).ravel().astype(np.float64)
+                else:
+                    v_lo = _read_boundary_map(ds_map, bb_lo, channel).ravel()
+                    v_hi = _read_boundary_map(ds_map, bb_hi, channel).ravel()
+                    val = np.maximum(v_lo, v_hi).astype(np.float64)
+                both = (lo > 0) & (hi > 0) & (lo != hi)
+                if not both.any():
+                    continue
+                # canonicalize (min, max) so both orientations of a label
+                # pair pool into one row — the criterion must act on the
+                # pooled per-pair mean, not per-direction subsets
+                a = lo[both]
+                b = hi[both]
+                pq = np.stack(
+                    [np.minimum(a, b), np.maximum(a, b)], axis=1
+                ).astype(np.uint64)
+                uv, inv = np.unique(pq, axis=0, return_inverse=True)
+                s = np.zeros(len(uv))
+                np.add.at(s, inv.ravel(), val[both])
+                c = np.bincount(inv.ravel(), minlength=len(uv))
+                pairs.append(uv)
+                sums.append(s)
+                counts.append(c)
+            if pairs:
+                np.savez(
+                    os.path.join(d, f"block_{block_id}.npz"),
+                    pairs=np.concatenate(pairs),
+                    sums=np.concatenate(sums),
+                    counts=np.concatenate(counts),
+                )
+            else:
+                np.savez(
+                    os.path.join(d, f"block_{block_id}.npz"),
+                    pairs=np.zeros((0, 2), np.uint64),
+                    sums=np.zeros(0),
+                    counts=np.zeros(0, np.int64),
+                )
+
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n}
+
+
+class StitchFacesLocal(StitchFacesBase):
+    target = "local"
+
+
+class StitchFacesTPU(StitchFacesBase):
+    target = "tpu"
+
+
+class MergeStitchAssignmentsBase(BaseTask):
+    """Merge face statistics, apply the criterion, union-find, emit the
+    write-compatible assignment table (reference:
+    ``SimpleStitchAssignmentsBase``)."""
+
+    task_name = "merge_stitch_assignments"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "stitch_threshold": 0.5,
+            "merge_mode": "less",
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        ds_seg = file_reader(cfg["seg_path"])[cfg["seg_key"]]
+        shape = ds_seg.shape
+        block_shape = tuple(cfg["block_shape"])
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        d = _stitch_dir(self.tmp_folder)
+        all_pairs, all_sums, all_counts = [], [], []
+        for b in block_ids:
+            p = os.path.join(d, f"block_{b}.npz")
+            if os.path.exists(p):
+                with np.load(p) as f:
+                    all_pairs.append(f["pairs"])
+                    all_sums.append(f["sums"])
+                    all_counts.append(f["counts"])
+        # the node set must cover every label, merged or not: collect block
+        # uniques from the segmentation chunks
+        uniques = set()
+
+        def collect(block_id):
+            u = np.unique(np.asarray(ds_seg[blocking.get_block(block_id).bb]))
+            return u[u != 0]
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
+            for u in pool.map(collect, block_ids):
+                uniques.update(u.tolist())
+        nodes = np.array(sorted(uniques), dtype=np.uint64)
+
+        if all_pairs and sum(len(p) for p in all_pairs):
+            pairs = np.concatenate([p for p in all_pairs if len(p)])
+            sums = np.concatenate([s for s, p in zip(all_sums, all_pairs) if len(p)])
+            counts = np.concatenate(
+                [c for c, p in zip(all_counts, all_pairs) if len(p)]
+            )
+            uv, inv = np.unique(pairs, axis=0, return_inverse=True)
+            s = np.zeros(len(uv))
+            np.add.at(s, inv.ravel(), sums)
+            c = np.zeros(len(uv), np.int64)
+            np.add.at(c, inv.ravel(), counts)
+            mean = s / np.maximum(c, 1)
+            thr = float(cfg.get("stitch_threshold", 0.5))
+            mode = cfg.get("merge_mode", "less")
+            if mode == "less":
+                merge = mean < thr
+            elif mode == "greater":
+                merge = mean > thr
+            else:
+                raise ValueError(f"unknown merge_mode {mode!r}")
+            merge_pairs = np.searchsorted(nodes, uv[merge]).astype(np.int64)
+        else:
+            merge_pairs = np.zeros((0, 2), np.int64)
+
+        from ..ops.unionfind import union_find_host
+
+        roots = union_find_host(merge_pairs, len(nodes))
+        _, assignment = np.unique(roots, return_inverse=True)
+        np.savez(
+            stitch_assignments_path(self.tmp_folder),
+            keys=nodes,
+            values=(assignment + 1).astype(np.uint64),
+        )
+        return {
+            "n_labels": int(len(nodes)),
+            "n_merged_pairs": int(len(merge_pairs)),
+            "n_components": int(assignment.max()) + 1 if len(assignment) else 0,
+        }
+
+
+class MergeStitchAssignmentsLocal(MergeStitchAssignmentsBase):
+    target = "local"
+
+
+class MergeStitchAssignmentsTPU(MergeStitchAssignmentsBase):
+    target = "tpu"
+
+
+class StitchingWorkflow(WorkflowBase):
+    """stitch_faces -> merge_stitch_assignments -> write (in place on the
+    segmentation by default; crash-safe via the staged write)."""
+
+    task_name = "stitching_workflow"
+
+    def requires(self):
+        from . import stitching as st_mod
+        from .relabel import staged_write_tasks
+
+        p = self.params
+        common = dict(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+        )
+        grid = {
+            k: p[k] for k in ("block_shape", "roi_begin", "roi_end") if k in p
+        }
+        t1 = get_task_cls(st_mod, "StitchFaces", self.target)(
+            **common,
+            dependencies=self.dependencies,
+            seg_path=p["seg_path"],
+            seg_key=p["seg_key"],
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            **{k: p[k] for k in ("channel", "axis_channels") if k in p},
+            **grid,
+        )
+        t2 = get_task_cls(st_mod, "MergeStitchAssignments", self.target)(
+            **common,
+            dependencies=[t1],
+            seg_path=p["seg_path"],
+            seg_key=p["seg_key"],
+            **{k: p[k] for k in ("stitch_threshold", "merge_mode") if k in p},
+            **grid,
+        )
+        t3 = staged_write_tasks(
+            self,
+            [t2],
+            assignment_path=stitch_assignments_path(self.tmp_folder),
+            input_path=p["seg_path"],
+            input_key=p["seg_key"],
+            output_path=p.get("output_path", p["seg_path"]),
+            output_key=p.get("output_key", p["seg_key"]),
+            stage_name="stitch",
+            bs={k: p[k] for k in ("block_shape",) if k in p},
+        )
+        return [t3]

@@ -327,6 +327,12 @@ class WatershedWorkflow(WorkflowBase):
 
         p = dict(self.params)
         two_pass = bool(p.pop("two_pass", False))
+        if two_pass and p.get("two_d"):
+            # reject before pass one burns hours on even blocks — the
+            # two-pass task would refuse anyway (see TwoPassWatershedBase)
+            raise NotImplementedError(
+                "two_d=True is not supported with two_pass=True"
+            )
         common = dict(
             tmp_folder=self.tmp_folder,
             config_dir=self.config_dir,

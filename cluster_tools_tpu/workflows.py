@@ -147,3 +147,89 @@ class MulticutSegmentationWorkflow(WorkflowBase):
             "solve_subproblems": mc_mod.SolveSubproblemsBase.default_task_config(),
             "solve_global": mc_mod.SolveGlobalBase.default_task_config(),
         }
+
+
+class AgglomerativeClusteringWorkflow(WorkflowBase):
+    """boundary map -> supervoxels -> RAG -> features -> average-linkage
+    agglomeration -> segmentation (reference:
+    ``AgglomerativeClusteringWorkflow``).
+
+    Same parameters as :class:`MulticutSegmentationWorkflow` minus the
+    multicut ones, plus ``agglomeration_threshold`` (merge edges while the
+    mean boundary probability is below it)."""
+
+    task_name = "agglomerative_clustering_workflow"
+
+    def requires(self):
+        from .tasks import agglomerative_clustering as ac_mod
+        from .tasks.agglomerative_clustering import agglomerative_assignments_path
+
+        p = self.params
+        common = dict(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+        )
+        ws_path, ws_key = p["ws_path"], p["ws_key"]
+        deps = list(self.dependencies)
+        if not p.get("skip_ws", False):
+            ws = ws_mod.WatershedWorkflow(
+                **common,
+                target=self.target,
+                dependencies=deps,
+                input_path=p["input_path"],
+                input_key=p["input_key"],
+                output_path=ws_path,
+                output_key=ws_key,
+                two_pass=p.get("two_pass_ws", False),
+                **_pick(
+                    p,
+                    "threshold",
+                    "sigma_seeds",
+                    "min_seed_distance",
+                    "sampling",
+                    "size_filter",
+                    "two_d",
+                    "halo",
+                    "block_shape",
+                    "mask_path",
+                    "mask_key",
+                ),
+            )
+            deps = [ws]
+        grid = _pick(p, "block_shape", "roi_begin", "roi_end")
+        g = graph_mod.GraphWorkflow(
+            **common,
+            target=self.target,
+            dependencies=deps,
+            input_path=ws_path,
+            input_key=ws_key,
+            **grid,
+        )
+        feats = feat_mod.EdgeFeaturesWorkflow(
+            **common,
+            target=self.target,
+            dependencies=[g],
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            labels_path=ws_path,
+            labels_key=ws_key,
+            **_pick(p, "channel"),
+            **grid,
+        )
+        ac = get_task_cls(ac_mod, "AgglomerativeClustering", self.target)(
+            **common,
+            dependencies=[feats],
+            threshold=p.get("agglomeration_threshold", 0.5),
+        )
+        write = get_task_cls(write_mod, "Write", self.target)(
+            **common,
+            dependencies=[ac],
+            input_path=ws_path,
+            input_key=ws_key,
+            output_path=p["output_path"],
+            output_key=p["output_key"],
+            assignment_path=agglomerative_assignments_path(self.tmp_folder),
+            **_pick(p, "block_shape"),
+        )
+        return [write]

@@ -387,4 +387,6 @@ def test_two_pass_watershed_rejects_two_d(workspace):
         halo=[2, 2, 2],
         block_shape=[8, 8, 8],
     )
-    assert not build([wf])  # the two-pass task must refuse, failing the build
+    # rejected at DAG construction, before pass one runs any blocks
+    with pytest.raises(NotImplementedError):
+        build([wf])

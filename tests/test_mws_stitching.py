@@ -1,0 +1,183 @@
+"""Mutex watershed, agglomerative clustering, and stitching tests
+(SURVEY.md §4 oracle pattern)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.ops.agglomeration import average_agglomeration
+from cluster_tools_tpu.ops.mws import mutex_watershed, offset_edges
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+from .helpers import assert_labels_equivalent
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "config")
+    os.makedirs(config_dir, exist_ok=True)
+    with open(os.path.join(config_dir, "global.config"), "w") as f:
+        json.dump({"block_shape": [16, 16, 16]}, f)
+    return tmp_folder, config_dir, str(tmp_path)
+
+
+def make_affinities(gt, offsets, noise=0.0, rng=None):
+    """Affinities from a GT labeling: attractive channels high inside
+    objects, low across boundaries; repulsive channels high across
+    boundaries (push apart), low inside."""
+    shape = gt.shape
+    C = len(offsets)
+    affs = np.zeros((C,) + shape, np.float32)
+    for c, off in enumerate(offsets):
+        src = tuple(slice(max(0, -o), s - max(0, o)) for o, s in zip(off, shape))
+        dst = tuple(slice(max(0, o), s - max(0, -o)) for o, s in zip(off, shape))
+        same = gt[src] == gt[dst]
+        if c < gt.ndim:  # attractive
+            affs[c][src] = np.where(same, 0.9, 0.1)
+        else:  # repulsive
+            affs[c][src] = np.where(same, 0.1, 0.9)
+    if noise and rng is not None:
+        affs += rng.normal(0, noise, affs.shape).astype(np.float32)
+    return np.clip(affs, 0, 1)
+
+
+OFFSETS = [
+    [-1, 0, 0], [0, -1, 0], [0, 0, -1],
+    [-3, 0, 0], [0, -3, 0], [0, 0, -3],
+]
+
+
+def banded_gt(shape=(12, 12, 12)):
+    gt = np.ones(shape, np.uint64)
+    gt[:, shape[1] // 2 :, :] = 2
+    gt[:, :, shape[2] // 2 :] += 2
+    return gt
+
+
+def test_offset_edges_counts():
+    u, v, c = offset_edges((4, 4), [[-1, 0], [0, -1], [-2, 0]])
+    # per channel: 3*4, 4*3, 2*4 edges
+    assert (c == 0).sum() == 12 and (c == 1).sum() == 12 and (c == 2).sum() == 8
+    # all edges in range and distinct endpoints
+    assert (u != v).all()
+
+
+def test_mws_recovers_clean_segmentation(rng):
+    gt = banded_gt()
+    affs = make_affinities(gt, OFFSETS, noise=0.02, rng=rng)
+    seg = mutex_watershed(affs, OFFSETS)
+    assert_labels_equivalent(seg.astype(np.uint64), gt)
+
+
+def test_mws_respects_mask(rng):
+    gt = banded_gt()
+    affs = make_affinities(gt, OFFSETS)
+    mask = np.ones(gt.shape, bool)
+    mask[:3] = False
+    seg = mutex_watershed(affs, OFFSETS, mask=mask)
+    assert (seg[:3] == 0).all()
+    assert (seg[3:] > 0).all()
+
+
+def test_mws_strides_still_separates(rng):
+    gt = banded_gt()
+    affs = make_affinities(gt, OFFSETS, noise=0.02, rng=rng)
+    seg = mutex_watershed(affs, OFFSETS, strides=[2, 2, 2])
+    assert_labels_equivalent(seg.astype(np.uint64), gt)
+
+
+def test_average_agglomeration_simple():
+    # chain 0-1-2-3: cheap edges 0-1, 2-3; expensive middle edge
+    edges = np.array([[0, 1], [1, 2], [2, 3]])
+    probs = np.array([0.1, 0.9, 0.2])
+    sizes = np.ones(3)
+    labels = average_agglomeration(4, edges, probs, sizes, threshold=0.5)
+    assert labels[0] == labels[1]
+    assert labels[2] == labels[3]
+    assert labels[1] != labels[2]
+
+
+def test_average_agglomeration_weighted_mean():
+    """After merging, the parallel edge mean must be size-weighted: a large
+    cheap contact + small expensive one stays below threshold."""
+    # 0-1 merge first (0.0); then edges (0-2: p=0.8, size 1), (1-2: p=0.2,
+    # size 9) combine to mean 0.26 < 0.5 -> all merge
+    edges = np.array([[0, 1], [0, 2], [1, 2]])
+    probs = np.array([0.0, 0.8, 0.2])
+    sizes = np.array([1.0, 1.0, 9.0])
+    labels = average_agglomeration(3, edges, probs, sizes, threshold=0.5)
+    assert labels[0] == labels[1] == labels[2]
+    # unweighted the combined mean would be 0.5 (not < 0.5): check the
+    # size-weighting is what merges it
+    labels_u = average_agglomeration(
+        3, edges, probs, np.ones(3), threshold=0.5
+    )
+    assert labels_u[0] == labels_u[1] != labels_u[2]
+
+
+def test_mws_workflow_blockwise_with_stitching(rng, workspace):
+    from cluster_tools_tpu.tasks.mutex_watershed import MwsWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    shape = (16, 32, 32)
+    gt = np.ones(shape, np.uint64)
+    gt[:, 16:, :] = 2
+    gt[:, :, 16:] += 2
+    affs = make_affinities(gt, OFFSETS, noise=0.02, rng=rng)
+    path = os.path.join(root, "affs.zarr")
+    f = file_reader(path)
+    ds = f.require_dataset(
+        "affs", shape=affs.shape, chunks=(len(OFFSETS), 16, 16, 16), dtype="float32"
+    )
+    ds[...] = affs
+    wf = MwsWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="affs",
+        output_path=path,
+        output_key="seg",
+        offsets=OFFSETS,
+        halo=[2, 2, 2],
+        stitch_threshold=0.5,
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf]), "workflow failed (see logs)"
+    seg = file_reader(path, "r")["seg"][...]
+    assert (seg > 0).all()
+    assert_labels_equivalent(seg, gt)
+
+
+def test_agglomerative_clustering_workflow(rng, workspace):
+    from cluster_tools_tpu.workflows import AgglomerativeClusteringWorkflow
+    from tests.test_multicut_workflow import make_case, _write_ds
+
+    tmp_folder, config_dir, root = workspace
+    gt, sv, bmap = make_case()
+    path = os.path.join(root, "data.zarr")
+    _write_ds(path, "bmap", bmap, chunks=(8, 8, 8))
+    _write_ds(path, "sv", sv, chunks=(8, 8, 8))
+    wf = AgglomerativeClusteringWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="bmap",
+        ws_path=path,
+        ws_key="sv",
+        output_path=path,
+        output_key="seg",
+        skip_ws=True,
+        agglomeration_threshold=0.5,
+        block_shape=[8, 8, 8],
+    )
+    assert build([wf])
+    seg = file_reader(path, "r")["seg"][...]
+    assert_labels_equivalent(seg, gt)
