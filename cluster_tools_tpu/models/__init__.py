@@ -1,0 +1,11 @@
+"""Flax models for the inference task (boundary/affinity CNNs).
+
+The reference's inference task loaded arbitrary PyTorch models per job
+(SURVEY.md §2a "inference"); the rebuild ships a TPU-native model family —
+3-D U-Nets in flax, bfloat16 compute — plus a registry so checkpoints can
+name their architecture.
+"""
+
+from .unet import UNet3D, get_model
+
+__all__ = ["UNet3D", "get_model"]

@@ -26,7 +26,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_BIG = jnp.float32(1e12)
+# numpy (not jnp) so importing this module never triggers jax backend
+# initialization — with the TPU plugin registered that would dial the chip
+# at import time
+_BIG = np.float32(1e12)
 
 
 def _edt_1d_axis(f: jnp.ndarray, axis: int, w: float) -> jnp.ndarray:

@@ -32,7 +32,7 @@ from jax import lax
 
 from .ccl import _shift_nd, _neighbor_offsets, _compress, _true_like, label_components, finalize_labels
 
-_BIG = jnp.float32(3e38)
+_BIG = np.float32(3e38)  # numpy: no backend init at import
 
 
 def _descent_pointers(

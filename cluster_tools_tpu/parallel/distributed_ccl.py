@@ -34,7 +34,7 @@ from ..ops.ccl import label_components
 from ..ops.unionfind import union_find
 from .halo import neighbor_face
 
-_INT32_MAX = jnp.int32(np.iinfo(np.int32).max)
+_INT32_MAX = np.int32(np.iinfo(np.int32).max)  # numpy: no backend init at import
 
 
 def _boundary_pairs(

@@ -19,3 +19,10 @@ from . import write
 from . import agglomerative_clustering
 from . import mutex_watershed
 from . import stitching
+from . import debugging
+from . import distances
+from . import ilastik
+from . import inference
+from . import label_multisets
+from . import paintera
+from . import skeletons

