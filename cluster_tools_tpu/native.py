@@ -1,0 +1,161 @@
+"""ctypes bindings for the native runtime kernels (``native/ct_native.cpp``).
+
+The reference outsourced host-side merge hot spots to C++ (``nifty.ufd``
+union-find, the nifty multicut solvers — SURVEY.md §2b); here the same
+stages call a small C++ shared library when available and fall back to the
+pure-Python implementations otherwise.  The library is built on first use
+(``g++ -O3 -shared``, ~1 s) and cached next to the source.
+
+Public API:
+
+- :func:`available` — True when the library is importable/buildable,
+- :func:`union_find` — min-label roots over equivalence pairs,
+- :func:`greedy_additive` — GAEC node labels,
+- :func:`merge_edge_features` — the count-weighted per-edge feature merge.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libct_native.so"))
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "ct_native.cpp")
+    if not os.path.exists(src):
+        return False
+    # compile to a process-unique temp path and rename into place: renames
+    # are atomic, so concurrent builders can't interleave writes into one
+    # corrupt .so (which would permanently disable the native path)
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", tmp, src],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            # stale/corrupt artifact (e.g. from an interrupted build of an
+            # older source): rebuild once before giving up
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                return None
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        lib.ct_union_find.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p]
+        lib.ct_union_find.restype = ctypes.c_int
+        lib.ct_greedy_additive.argtypes = [
+            ctypes.c_int64,
+            i64p,
+            f64p,
+            ctypes.c_int64,
+            ctypes.c_double,
+            i64p,
+        ]
+        lib.ct_greedy_additive.restype = ctypes.c_int
+        lib.ct_merge_edge_features.argtypes = [
+            u64p,
+            f64p,
+            ctypes.c_int64,
+            u64p,
+            ctypes.c_int64,
+            f64p,
+            f64p,
+            f64p,
+            f64p,
+        ]
+        lib.ct_merge_edge_features.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def union_find(pairs: np.ndarray, n_labels: int) -> Optional[np.ndarray]:
+    """Min-label component roots, or None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    pairs = np.ascontiguousarray(np.asarray(pairs).reshape(-1, 2), np.int64)
+    out = np.empty(int(n_labels), np.int64)
+    lib.ct_union_find(pairs, len(pairs), int(n_labels), out)
+    return out
+
+
+def greedy_additive(
+    n_nodes: int, edges: np.ndarray, costs: np.ndarray, stop_cost: float = 0.0
+) -> Optional[np.ndarray]:
+    """GAEC labels 0..k-1, or None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    edges = np.ascontiguousarray(np.asarray(edges).reshape(-1, 2), np.int64)
+    costs = np.ascontiguousarray(np.asarray(costs, np.float64))
+    out = np.empty(int(n_nodes), np.int64)
+    lib.ct_greedy_additive(
+        int(n_nodes), edges, costs, len(edges), float(stop_cost), out
+    )
+    return out
+
+
+def merge_edge_features(parts, table: np.ndarray):
+    """Accumulate per-block (uv, feats[m, 4]) parts onto the lexsorted
+    ``table``: (weighted-mean sums, min, max, count sums) per table row, or
+    None when the library is unavailable.  ``parts`` iterates (uv, feats)."""
+    lib = _load()
+    if lib is None:
+        return None
+    table = np.ascontiguousarray(np.asarray(table).reshape(-1, 2), np.uint64)
+    k = len(table)
+    wsums = np.zeros(k, np.float64)
+    mins = np.full(k, np.inf)
+    maxs = np.full(k, -np.inf)
+    counts = np.zeros(k, np.float64)
+    for uv, feats in parts:
+        if len(uv) == 0:
+            continue
+        uv = np.ascontiguousarray(np.asarray(uv).reshape(-1, 2), np.uint64)
+        feats = np.ascontiguousarray(np.asarray(feats, np.float64)).reshape(-1, 4)
+        lib.ct_merge_edge_features(
+            uv, feats, len(uv), table, k, wsums, mins, maxs, counts
+        )
+    return wsums, mins, maxs, counts

@@ -56,6 +56,13 @@ def greedy_additive(
     n_nodes = int(n_nodes)
     edges = np.asarray(edges, dtype=np.int64)
     costs = np.asarray(costs, dtype=np.float64)
+
+    from .. import native
+
+    labels = native.greedy_additive(n_nodes, edges, costs, stop_cost)
+    if labels is not None:
+        return labels
+
     # union-find
     parent = np.arange(n_nodes, dtype=np.int64)
 
@@ -189,3 +196,127 @@ def contract_graph(
     new_costs = np.zeros(len(new_edges), np.float64)
     np.add.at(new_costs, inv.ravel(), w)
     return new_edges.astype(np.int64), new_costs
+
+
+def lifted_multicut_energy(
+    edges: np.ndarray,
+    costs: np.ndarray,
+    lifted_edges: np.ndarray,
+    lifted_costs: np.ndarray,
+    node_labels: np.ndarray,
+) -> float:
+    """Lifted objective: local cut costs + lifted cut costs (lower is
+    better; a lifted edge is 'cut' when its endpoints are in different
+    clusters, regardless of graph connectivity)."""
+    e = multicut_energy(edges, costs, node_labels)
+    if len(lifted_edges):
+        cut = node_labels[lifted_edges[:, 0]] != node_labels[lifted_edges[:, 1]]
+        e += float(np.asarray(lifted_costs, np.float64)[cut].sum())
+    return e
+
+
+def lifted_greedy_additive(
+    n_nodes: int,
+    edges: np.ndarray,
+    costs: np.ndarray,
+    lifted_edges: np.ndarray,
+    lifted_costs: np.ndarray,
+    stop_cost: float = 0.0,
+) -> np.ndarray:
+    """GAEC for the lifted multicut (Keuper et al. style).
+
+    Clusters may only contract along *local* edges, but the merge priority
+    is the combined local+lifted cost between the two clusters; lifted
+    weights merge additively alongside local ones.  Returns int64 labels.
+    """
+    n_nodes = int(n_nodes)
+    edges = np.asarray(edges, dtype=np.int64)
+    costs = np.asarray(costs, dtype=np.float64)
+    lifted_edges = np.asarray(lifted_edges, dtype=np.int64).reshape(-1, 2)
+    lifted_costs = np.asarray(lifted_costs, dtype=np.float64)
+    if len(lifted_edges) == 0:
+        # plain multicut: reuse the (native-accelerated) GAEC
+        return greedy_additive(n_nodes, edges, costs, stop_cost)
+
+    parent = np.arange(n_nodes, dtype=np.int64)
+
+    def find(x: int) -> int:
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    local: list = [dict() for _ in range(n_nodes)]
+    lifted: list = [dict() for _ in range(n_nodes)]
+    for (u, v), w in zip(edges, costs):
+        if u == v:
+            continue
+        u, v = int(u), int(v)
+        local[u][v] = local[u].get(v, 0.0) + w
+        local[v][u] = local[u][v]
+    for (u, v), w in zip(lifted_edges, lifted_costs):
+        if u == v:
+            continue
+        u, v = int(u), int(v)
+        lifted[u][v] = lifted[u].get(v, 0.0) + w
+        lifted[v][u] = lifted[u][v]
+
+    def prio(u, v):
+        return local[u][v] + lifted[u].get(v, 0.0)
+
+    heap = [
+        (-prio(u, v), u, v)
+        for u in range(n_nodes)
+        for v in local[u]
+        if u < v
+    ]
+    heapq.heapify(heap)
+
+    while heap:
+        neg_w, u, v = heapq.heappop(heap)
+        w = -neg_w
+        if w <= stop_cost:
+            break
+        ru, rv = find(u), find(v)
+        if ru == rv:
+            continue
+        if rv not in local[ru] or abs(prio(ru, rv) - w) > 1e-12:
+            continue  # stale
+        if len(local[ru]) + len(lifted[ru]) < len(local[rv]) + len(lifted[rv]):
+            ru, rv = rv, ru
+        parent[rv] = ru
+        del local[ru][rv]
+        lifted[ru].pop(rv, None)
+        # merge local neighbor costs
+        for x, wx in local[rv].items():
+            if x == ru:
+                continue
+            nw = local[ru].get(x, 0.0) + wx
+            local[ru][x] = nw
+            local[x][ru] = nw
+            del local[x][rv]
+        # merge lifted neighbor costs
+        for x, wx in lifted[rv].items():
+            if x == ru:
+                continue
+            nw = lifted[ru].get(x, 0.0) + wx
+            lifted[ru][x] = nw
+            lifted[x][ru] = nw
+            del lifted[x][rv]
+        # only pairs whose priority changed need re-pushing: local
+        # neighbors inherited from rv, and ru-neighbors whose lifted part
+        # changed (lifted[rv] also landed on ru)
+        changed = set(local[rv]) | (set(lifted[rv]) & set(local[ru]))
+        changed.discard(ru)
+        local[rv].clear()
+        lifted[rv].clear()
+        for x in changed:
+            if x in local[ru]:
+                p = prio(ru, x)
+                if p > stop_cost:
+                    heapq.heappush(heap, (-p, ru, x))
+
+    roots = np.array([find(i) for i in range(n_nodes)], dtype=np.int64)
+    return _relabel_consecutive(roots)

@@ -160,21 +160,28 @@ def merge_feature_lists(uv_global: np.ndarray, parts) -> np.ndarray:
     counts are summed.  Edges absent from all parts get zeros.
     """
     m = len(uv_global)
-    s = np.zeros(m, np.float64)
-    mn = np.full(m, np.inf)
-    mx = np.full(m, -np.inf)
-    cnt = np.zeros(m, np.float64)
-    for uv, feats in parts:
-        if len(uv) == 0:
-            continue
-        ids = find_edge_ids(uv_global, uv)
-        ok = ids >= 0
-        ids = ids[ok]
-        f = feats[ok].astype(np.float64)
-        np.add.at(s, ids, f[:, 0] * f[:, 3])
-        np.minimum.at(mn, ids, f[:, 1])
-        np.maximum.at(mx, ids, f[:, 2])
-        np.add.at(cnt, ids, f[:, 3])
+
+    from .. import native
+
+    merged = native.merge_edge_features(parts, uv_global)
+    if merged is not None:
+        s, mn, mx, cnt = merged
+    else:
+        s = np.zeros(m, np.float64)
+        mn = np.full(m, np.inf)
+        mx = np.full(m, -np.inf)
+        cnt = np.zeros(m, np.float64)
+        for uv, feats in parts:
+            if len(uv) == 0:
+                continue
+            ids = find_edge_ids(uv_global, uv)
+            ok = ids >= 0
+            ids = ids[ok]
+            f = feats[ok].astype(np.float64)
+            np.add.at(s, ids, f[:, 0] * f[:, 3])
+            np.minimum.at(mn, ids, f[:, 1])
+            np.maximum.at(mx, ids, f[:, 2])
+            np.add.at(cnt, ids, f[:, 3])
     has = cnt > 0
     mean = np.zeros(m, np.float64)
     mean[has] = s[has] / cnt[has]

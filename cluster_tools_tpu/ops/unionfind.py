@@ -78,17 +78,24 @@ def union_find(pairs: jnp.ndarray, n_labels: int) -> jnp.ndarray:
 
 
 def union_find_host(pairs: np.ndarray, n_labels: int) -> np.ndarray:
-    """Host-side oracle/driver path via scipy sparse connected components.
+    """Host-side driver path: the native C++ union-find when built
+    (cluster_tools_tpu/native.py), else scipy sparse connected components.
 
     Returns the same contract as :func:`union_find`: each label mapped to the
     minimum label of its component.
     """
-    from scipy.sparse import coo_matrix
-    from scipy.sparse.csgraph import connected_components
-
     pairs = np.asarray(pairs)
     if pairs.size == 0:
         return np.arange(n_labels, dtype=np.int64)
+
+    from .. import native
+
+    roots = native.union_find(pairs.astype(np.int64, copy=False), n_labels)
+    if roots is not None:
+        return roots
+
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components
     data = np.ones(len(pairs), dtype=np.uint8)
     g = coo_matrix(
         (data, (pairs[:, 0], pairs[:, 1])), shape=(n_labels, n_labels)

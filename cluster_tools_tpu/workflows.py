@@ -233,3 +233,128 @@ class AgglomerativeClusteringWorkflow(WorkflowBase):
             **_pick(p, "block_shape"),
         )
         return [write]
+
+
+class LiftedMulticutSegmentationWorkflow(WorkflowBase):
+    """Lifted multicut segmentation (reference:
+    ``LiftedMulticutSegmentationWorkflow``): the multicut chain plus a
+    node-label attribution that induces sparse lifted edges —
+
+        ws -> graph -> features -> costs
+           -> node_labels (overlap with ``labels_path/labels_key``, e.g. a
+              nucleus or semantic segmentation)
+           -> sparse lifted neighborhood -> lifted costs
+           -> hierarchical lifted multicut -> write
+
+    Extra params over :class:`MulticutSegmentationWorkflow`:
+    ``labels_path/labels_key`` (the attribution volume),
+    ``max_graph_distance``, ``w_attractive``/``w_repulsive``."""
+
+    task_name = "lifted_multicut_segmentation_workflow"
+
+    def requires(self):
+        from .tasks import lifted_features as lf_mod
+        from .tasks import lifted_multicut as lmc_mod
+        from .tasks import node_labels as nl_mod
+        from .tasks.lifted_multicut import lmc_assignments_path
+
+        p = self.params
+        common = dict(
+            tmp_folder=self.tmp_folder,
+            config_dir=self.config_dir,
+            max_jobs=self.max_jobs,
+        )
+        ws_path, ws_key = p["ws_path"], p["ws_key"]
+        deps = list(self.dependencies)
+        if not p.get("skip_ws", False):
+            ws = ws_mod.WatershedWorkflow(
+                **common,
+                target=self.target,
+                dependencies=deps,
+                input_path=p["input_path"],
+                input_key=p["input_key"],
+                output_path=ws_path,
+                output_key=ws_key,
+                two_pass=p.get("two_pass_ws", False),
+                **_pick(
+                    p,
+                    "threshold",
+                    "sigma_seeds",
+                    "min_seed_distance",
+                    "sampling",
+                    "size_filter",
+                    "two_d",
+                    "halo",
+                    "block_shape",
+                    "mask_path",
+                    "mask_key",
+                ),
+            )
+            deps = [ws]
+        grid = _pick(p, "block_shape", "roi_begin", "roi_end")
+        g = graph_mod.GraphWorkflow(
+            **common,
+            target=self.target,
+            dependencies=deps,
+            input_path=ws_path,
+            input_key=ws_key,
+            **grid,
+        )
+        feats = feat_mod.EdgeFeaturesWorkflow(
+            **common,
+            target=self.target,
+            dependencies=[g],
+            input_path=p["input_path"],
+            input_key=p["input_key"],
+            labels_path=ws_path,
+            labels_key=ws_key,
+            **_pick(p, "channel"),
+            **grid,
+        )
+        costs = get_task_cls(costs_mod, "ProbsToCosts", self.target)(
+            **common,
+            dependencies=[feats],
+            **_pick(p, "beta", "weighting_scheme", "weighting_exponent"),
+        )
+        nl = nl_mod.NodeLabelWorkflow(
+            **common,
+            target=self.target,
+            dependencies=[g],
+            input_path=ws_path,
+            input_key=ws_key,
+            labels_path=p["labels_path"],
+            labels_key=p["labels_key"],
+            **grid,
+        )
+        lifted_nh = get_task_cls(
+            lf_mod, "SparseLiftedNeighborhood", self.target
+        )(
+            **common,
+            dependencies=[g],
+            **_pick(p, "max_graph_distance"),
+        )
+        lifted_costs = get_task_cls(lf_mod, "CostsFromNodeLabels", self.target)(
+            **common,
+            dependencies=[nl, lifted_nh],
+            **_pick(p, "w_attractive", "w_repulsive"),
+        )
+        lmc = lmc_mod.LiftedMulticutWorkflow(
+            **common,
+            target=self.target,
+            dependencies=[costs, lifted_costs],
+            input_path=ws_path,
+            input_key=ws_key,
+            **_pick(p, "n_scales"),
+            **grid,
+        )
+        write = get_task_cls(write_mod, "Write", self.target)(
+            **common,
+            dependencies=[lmc],
+            input_path=ws_path,
+            input_key=ws_key,
+            output_path=p["output_path"],
+            output_key=p["output_key"],
+            assignment_path=lmc_assignments_path(self.tmp_folder),
+            **_pick(p, "block_shape"),
+        )
+        return [write]

@@ -1,0 +1,160 @@
+// Native runtime kernels for cluster_tools_tpu.
+//
+// The reference framework outsourced its host-side merge hot spots to C++
+// (nifty.ufd union-find, nifty multicut solvers — SURVEY.md §2b).  The
+// rebuild keeps the device path in JAX/XLA and provides these C++ kernels
+// for the host-side merge/solver stages, loaded via ctypes
+// (cluster_tools_tpu/native.py) with pure-Python fallbacks.
+//
+// C ABI only — no pybind11 (not in the image); arrays are passed as raw
+// pointers from numpy via ctypes.
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// path-halving find over an int64 parent array
+inline int64_t find_root(std::vector<int64_t>& parent, int64_t x) {
+  while (parent[x] != x) {
+    parent[x] = parent[parent[x]];
+    x = parent[x];
+  }
+  return x;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Union-find over equivalence pairs; writes, for every label in
+// [0, n_labels), the minimum label of its component — the same contract as
+// the Python union_find_host.  Returns 0 on success.
+int ct_union_find(const int64_t* pairs, int64_t n_pairs, int64_t n_labels,
+                  int64_t* out_roots) {
+  std::vector<int64_t> parent(n_labels);
+  for (int64_t i = 0; i < n_labels; ++i) parent[i] = i;
+  for (int64_t i = 0; i < n_pairs; ++i) {
+    int64_t u = pairs[2 * i], v = pairs[2 * i + 1];
+    if (u < 0 || v < 0 || u >= n_labels || v >= n_labels) continue;
+    int64_t ru = find_root(parent, u), rv = find_root(parent, v);
+    if (ru == rv) continue;
+    // union by min so roots are component minima without a second pass
+    if (ru < rv)
+      parent[rv] = ru;
+    else
+      parent[ru] = rv;
+  }
+  for (int64_t i = 0; i < n_labels; ++i) out_roots[i] = find_root(parent, i);
+  return 0;
+}
+
+// Greedy additive edge contraction (GAEC).  edges: [n_edges, 2] int64,
+// costs: [n_edges] double.  Writes consecutive labels 0..k-1 to out_labels
+// [n_nodes].  Matches the Python greedy_additive (ops/multicut.py) —
+// contract the highest-cost edge while > stop_cost, parallel edges add.
+int ct_greedy_additive(int64_t n_nodes, const int64_t* edges,
+                       const double* costs, int64_t n_edges, double stop_cost,
+                       int64_t* out_labels) {
+  std::vector<int64_t> parent(n_nodes);
+  for (int64_t i = 0; i < n_nodes; ++i) parent[i] = i;
+  std::vector<std::unordered_map<int64_t, double>> nbrs(n_nodes);
+  for (int64_t i = 0; i < n_edges; ++i) {
+    int64_t u = edges[2 * i], v = edges[2 * i + 1];
+    if (u == v || u < 0 || v < 0 || u >= n_nodes || v >= n_nodes) continue;
+    nbrs[u][v] += costs[i];
+    nbrs[v][u] = nbrs[u][v];
+  }
+  struct Entry {
+    double w;
+    int64_t u, v;
+    bool operator<(const Entry& o) const { return w < o.w; }
+  };
+  std::priority_queue<Entry> heap;
+  for (int64_t u = 0; u < n_nodes; ++u)
+    for (auto& kv : nbrs[u])
+      if (u < kv.first) heap.push({kv.second, u, kv.first});
+
+  while (!heap.empty()) {
+    Entry e = heap.top();
+    heap.pop();
+    if (e.w <= stop_cost) break;
+    int64_t ru = find_root(parent, e.u), rv = find_root(parent, e.v);
+    if (ru == rv) continue;
+    auto it = nbrs[ru].find(rv);
+    if (it == nbrs[ru].end() || it->second != e.w) continue;  // stale
+    if (nbrs[ru].size() < nbrs[rv].size()) std::swap(ru, rv);
+    parent[rv] = ru;
+    nbrs[ru].erase(rv);
+    for (auto& kv : nbrs[rv]) {
+      int64_t x = kv.first;
+      if (x == ru) continue;
+      double nw = nbrs[ru][x] + kv.second;  // default 0.0 + w
+      nbrs[ru][x] = nw;
+      nbrs[x][ru] = nw;
+      nbrs[x].erase(rv);
+      if (nw > stop_cost) heap.push({nw, ru, x});
+    }
+    nbrs[rv].clear();
+  }
+
+  // consecutive relabeling of roots, ordered by root id (matches
+  // np.unique(roots, return_inverse=True))
+  std::vector<int64_t> roots(n_nodes);
+  for (int64_t i = 0; i < n_nodes; ++i) roots[i] = find_root(parent, i);
+  std::vector<int64_t> sorted_roots;
+  sorted_roots.reserve(n_nodes);
+  {
+    std::vector<bool> is_root(n_nodes, false);
+    for (int64_t i = 0; i < n_nodes; ++i) is_root[roots[i]] = true;
+    for (int64_t i = 0; i < n_nodes; ++i)
+      if (is_root[i]) sorted_roots.push_back(i);
+  }
+  std::unordered_map<int64_t, int64_t> dense;
+  dense.reserve(sorted_roots.size() * 2);
+  for (size_t i = 0; i < sorted_roots.size(); ++i)
+    dense[sorted_roots[i]] = static_cast<int64_t>(i);
+  for (int64_t i = 0; i < n_nodes; ++i) out_labels[i] = dense[roots[i]];
+  return 0;
+}
+
+// Merge per-block edge features onto a global lexsorted edge table.
+// pairs: [m, 2] uint64 (lo, hi); feats: [m, 4] double rows
+// (mean, min, max, count); table: [k, 2] uint64 lexsorted unique edges.
+// Accumulates count-weighted mean sums, min of mins, max of maxs, and
+// count sums — the merge_feature_lists contract.  Returns the number of
+// pairs not found in the table.
+int64_t ct_merge_edge_features(const uint64_t* pairs, const double* feats,
+                               int64_t m, const uint64_t* table, int64_t k,
+                               double* wsums, double* mins, double* maxs,
+                               double* counts) {
+  int64_t unmatched = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    uint64_t lo = pairs[2 * i], hi = pairs[2 * i + 1];
+    int64_t a = 0, b = k;
+    while (a < b) {
+      int64_t mid = (a + b) / 2;
+      uint64_t tl = table[2 * mid], th = table[2 * mid + 1];
+      if (tl < lo || (tl == lo && th < hi))
+        a = mid + 1;
+      else
+        b = mid;
+    }
+    if (a >= k || table[2 * a] != lo || table[2 * a + 1] != hi) {
+      ++unmatched;
+      continue;
+    }
+    double mean = feats[4 * i], mn = feats[4 * i + 1], mx = feats[4 * i + 2],
+           cnt = feats[4 * i + 3];
+    wsums[a] += mean * cnt;
+    if (mn < mins[a]) mins[a] = mn;
+    if (mx > maxs[a]) maxs[a] = mx;
+    counts[a] += cnt;
+  }
+  return unmatched;
+}
+
+}  // extern "C"
