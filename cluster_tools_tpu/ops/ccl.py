@@ -198,3 +198,54 @@ def label_components_batch(
 ) -> jnp.ndarray:
     """vmapped :func:`label_components` over a leading block-batch axis."""
     return jax.vmap(partial(label_components, connectivity=connectivity))(masks)
+
+
+@partial(jax.jit, static_argnames=("connectivity",))
+def label_components_keyed(keys: jnp.ndarray, connectivity: int = 1) -> jnp.ndarray:
+    """Label connected components of equal-valued regions.
+
+    Like :func:`label_components`, but voxels connect only where their
+    ``keys`` are equal and non-zero — the kernel behind
+    connected-components-on-a-segmentation (each segment splits into its
+    spatially connected parts; reference: the postprocess CC task).
+
+    ``keys`` must be an integer array (map uint64 segment ids to dense
+    int32 on host first); 0 is background.  Returns the same flat-index
+    representative encoding as :func:`label_components`.
+    """
+    shape = keys.shape
+    n = int(np.prod(shape))
+    sentinel = jnp.int32(n)
+    mask = keys != 0
+    idx = jnp.arange(n, dtype=jnp.int32).reshape(shape)
+    lab = jnp.where(mask, idx, sentinel)
+    offsets = _neighbor_offsets(len(shape), connectivity)
+
+    def neighbor_min(lab3):
+        m = lab3
+        for off in offsets:
+            for o in (off, tuple(-x for x in off)):
+                cand = _shift_nd(lab3, o, sentinel)
+                same = _shift_nd(keys, o, 0) == keys
+                m = jnp.minimum(m, jnp.where(same, cand, sentinel))
+        return jnp.where(mask, m, sentinel)
+
+    def cond(state):
+        flat, changed = state
+        return changed
+
+    def body(state):
+        flat, _ = state
+        lab3 = flat.reshape(shape)
+        nmin = neighbor_min(lab3).ravel()
+        improved = nmin < flat
+        root = jnp.clip(flat, 0, n - 1)
+        upd = jnp.where(improved, nmin, sentinel)
+        hooked = flat.at[root].min(upd, mode="drop")
+        hooked = jnp.where(flat == sentinel, sentinel, hooked)
+        new = _compress(jnp.minimum(hooked, jnp.minimum(flat, nmin)), sentinel)
+        return new, jnp.any(new != flat)
+
+    flat0 = lab.ravel()
+    flat, _ = lax.while_loop(cond, body, (flat0, _true_like(flat0)))
+    return flat.reshape(shape)

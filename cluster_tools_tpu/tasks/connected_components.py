@@ -31,7 +31,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..ops.ccl import label_components
+from ..ops.ccl import label_components, label_components_keyed
 from ..ops.unionfind import union_find, union_find_host
 from ..runtime.executor import BlockwiseExecutor
 from ..runtime.task import BaseTask, WorkflowBase, build
@@ -70,6 +70,9 @@ class BlockComponentsBase(BaseTask):
             "threshold": None,
             "threshold_mode": "greater",
             "connectivity": 1,
+            # keyed=True: components of equal-valued regions (CC on a
+            # segmentation, each segment split into its connected parts)
+            "keyed": False,
         }
 
     def run_impl(self):
@@ -95,12 +98,22 @@ class BlockComponentsBase(BaseTask):
         threshold = cfg.get("threshold")
         mode = cfg.get("threshold_mode", "greater")
         connectivity = int(cfg.get("connectivity", 1))
+        keyed = bool(cfg.get("keyed", False))
         mask_ds = None
         if cfg.get("mask_path"):
             mask_ds = file_reader(cfg["mask_path"])[cfg["mask_key"]]
 
         def load(block):
             data = inp[block.bb]
+            if keyed:
+                # dense per-block int32 keys (device kernels can't take
+                # uint64 labels); key identity only matters within a block
+                _, keys = np.unique(np.asarray(data), return_inverse=True)
+                keys = keys.reshape(np.asarray(data).shape).astype(np.int32)
+                keys[np.asarray(data) == 0] = 0
+                if mask_ds is not None:
+                    keys[~(np.asarray(mask_ds[block.bb]) > 0)] = 0
+                return (pad_block_to(keys, block_shape),)
             if threshold is None:
                 m = data > 0
             elif mode == "greater":
@@ -114,6 +127,8 @@ class BlockComponentsBase(BaseTask):
         n_pad = int(np.prod(block_shape))
 
         def kernel(m):
+            if keyed:
+                return label_components_keyed(m, connectivity=connectivity)
             return label_components(m, connectivity=connectivity)
 
         def store(block, raw):
@@ -212,6 +227,10 @@ class BlockFacesBase(BaseTask):
             raise NotImplementedError(
                 "blockwise stitching currently supports connectivity=1 only"
             )
+        keyed = bool(cfg.get("keyed", False))
+        inp_ds = (
+            file_reader(cfg["input_path"])[cfg["input_key"]] if keyed else None
+        )
         ds = file_reader(cfg["output_path"])[cfg["output_key"]]
         shape = ds.shape
         block_shape = tuple(cfg["block_shape"])
@@ -240,6 +259,13 @@ class BlockFacesBase(BaseTask):
                 lo = ds[bb_lo].ravel()
                 hi = ds[bb_hi].ravel()
                 both = (lo > 0) & (hi > 0)
+                if keyed:
+                    # CC-on-segmentation: only merge across the face where
+                    # the ORIGINAL segment label matches
+                    both &= (
+                        np.asarray(inp_ds[bb_lo]).ravel()
+                        == np.asarray(inp_ds[bb_hi]).ravel()
+                    )
                 if both.any():
                     p = np.stack([lo[both], hi[both]], axis=1)
                     pairs.append(np.unique(p, axis=0))
@@ -352,7 +378,7 @@ class ConnectedComponentsWorkflow(WorkflowBase):
             output_key=tmp_key,
             **{
                 k: p[k]
-                for k in ("threshold", "threshold_mode", "mask_path", "mask_key", "block_shape", "connectivity")
+                for k in ("threshold", "threshold_mode", "mask_path", "mask_key", "block_shape", "connectivity", "keyed")
                 if k in p
             },
         )
@@ -370,7 +396,7 @@ class ConnectedComponentsWorkflow(WorkflowBase):
             output_key=tmp_key,
             input_path=p["input_path"],
             input_key=p["input_key"],
-            **{k: p[k] for k in ("block_shape", "connectivity") if k in p},
+            **{k: p[k] for k in ("block_shape", "connectivity", "keyed") if k in p},
         )
         t4 = get_task_cls(cc_mod, "MergeAssignments", self.target)(
             **cfg_common,

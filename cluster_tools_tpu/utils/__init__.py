@@ -1,3 +1,5 @@
 from . import volume_utils
 from . import function_utils
 from . import task_utils
+from . import segmentation_utils
+from . import parse_utils

@@ -1,0 +1,240 @@
+"""CC-on-segmentation, hole filling, and graph-watershed size filter tests
+(scipy oracles, SURVEY.md §4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+from .helpers import assert_labels_equivalent
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "config")
+    os.makedirs(config_dir, exist_ok=True)
+    with open(os.path.join(config_dir, "global.config"), "w") as f:
+        json.dump({"block_shape": [16, 16, 16]}, f)
+    return tmp_folder, config_dir, str(tmp_path)
+
+
+def _dataset(root, name, data, chunks=(16, 16, 16)):
+    path = os.path.join(root, f"{name}.zarr")
+    f = file_reader(path)
+    ds = f.require_dataset(
+        name, shape=data.shape, chunks=chunks, dtype=str(data.dtype)
+    )
+    ds[...] = data
+    return path
+
+
+def cc_on_seg_oracle(seg):
+    out = np.zeros_like(seg)
+    nxt = 1
+    for k in np.unique(seg):
+        if k == 0:
+            continue
+        cc, n = ndi.label(seg == k)
+        for c in range(1, n + 1):
+            out[cc == c] = nxt
+            nxt += 1
+    return out
+
+
+def test_cc_on_segmentation(workspace, rng):
+    from cluster_tools_tpu.tasks.postprocess import (
+        ConnectedComponentsOnSegmentationWorkflow,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (32, 32, 32)
+    seg = np.zeros(shape, np.uint64)
+    # label 1: two disconnected slabs; label 2: one slab between them
+    seg[:, :, 0:8] = 1
+    seg[:, :, 12:20] = 2
+    seg[:, :, 24:32] = 1
+    path = _dataset(root, "seg", seg)
+    wf = ConnectedComponentsOnSegmentationWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="seg",
+        output_path=path,
+        output_key="cc",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["cc"][...]
+    assert_labels_equivalent(got, cc_on_seg_oracle(seg))
+
+
+def test_cc_on_segmentation_random(workspace, rng):
+    from cluster_tools_tpu.tasks.postprocess import (
+        ConnectedComponentsOnSegmentationWorkflow,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (24, 24, 24)
+    seg = rng.integers(0, 4, shape).astype(np.uint64)
+    path = _dataset(root, "segr", seg)
+    wf = ConnectedComponentsOnSegmentationWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="segr",
+        output_path=path,
+        output_key="cc",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["cc"][...]
+    assert_labels_equivalent(got, cc_on_seg_oracle(seg))
+
+
+def test_fill_holes(workspace):
+    from cluster_tools_tpu.tasks.postprocess import FillHolesWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    shape = (24, 24, 24)
+    seg = np.zeros(shape, np.uint64)
+    seg[2:22, 2:22, 2:22] = 5
+    seg[8:14, 8:14, 8:14] = 0      # internal cavity -> must fill with 5
+    seg[2:22, 2:22, 18:22] = 7     # second object adjacent
+    seg[10:12, 10:12, 19:21] = 0   # cavity inside 7 -> fill with 7
+    path = _dataset(root, "seg", seg)
+    wf = FillHolesWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="seg",
+        output_path=path,
+        output_key="filled",
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["filled"][...]
+    want = seg.copy()
+    want[8:14, 8:14, 8:14] = 5
+    want[10:12, 10:12, 19:21] = 7
+    np.testing.assert_array_equal(got, want)
+    # true background (outside the objects, border-connected) stays 0
+    assert (got[0] == 0).all()
+
+
+def test_graph_watershed_size_filter(workspace):
+    from cluster_tools_tpu.tasks.postprocess import (
+        GraphWatershedSizeFilterWorkflow,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (16, 16, 32)
+    seg = np.zeros(shape, np.uint64)
+    seg[:, :, 0:14] = 1
+    seg[:, :, 14:16] = 3     # small sliver between 1 and 2
+    seg[:, :, 16:32] = 2
+    # boundary map: the 3|1 interface is weak (low prob), 3|2 strong
+    bmap = np.full(shape, 0.1, np.float32)
+    bmap[:, :, 15:17] = 0.9   # strong boundary between sliver and 2
+    p1 = _dataset(root, "seg", seg)
+    p2 = _dataset(root, "bmap", bmap)
+    wf = GraphWatershedSizeFilterWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=p1,
+        input_key="seg",
+        boundary_path=p2,
+        boundary_key="bmap",
+        output_path=p1,
+        output_key="filtered",
+        min_size=16 * 16 * 4,  # the sliver (16*16*2) is below threshold
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    got = file_reader(p1, "r")["filtered"][...]
+    # sliver absorbed into object 1 (the weak-boundary side)
+    assert (got[:, :, 14:16] == 1).all()
+    assert (got[:, :, 0:14] == 1).all()
+    assert (got[:, :, 16:] == 2).all()
+
+
+def test_cli_run_and_report(workspace, rng):
+    """The CLI drives a workflow from a json config and reports runtimes."""
+    import subprocess, sys
+
+    from cluster_tools_tpu.utils.parse_utils import parse_runtimes
+
+    tmp_folder, config_dir, root = workspace
+    mask = (rng.random((24, 24, 24)) > 0.6).astype(np.uint8)
+    path = _dataset(root, "mask", mask)
+    run_cfg = {
+        "tmp_folder": tmp_folder,
+        "config_dir": config_dir,
+        "max_jobs": 2,
+        "target": "local",
+        "params": {
+            "input_path": path,
+            "input_key": "mask",
+            "output_path": path,
+            "output_key": "labels",
+            "block_shape": [16, 16, 16],
+        },
+    }
+    cfg_path = os.path.join(root, "run.json")
+    with open(cfg_path, "w") as f:
+        json.dump(run_cfg, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import sys; from cluster_tools_tpu.cli import main;"
+         f"sys.exit(main(['run', 'connected_components', '--config', {cfg_path!r}]))"],
+        capture_output=True, text=True, env=env, cwd="/root/repo", timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "SUCCESS" in out.stdout
+    got = file_reader(path, "r")["labels"][...]
+    want, _ = ndi.label(mask)
+    assert_labels_equivalent(got, want.astype(np.uint64))
+    # runtime report has entries
+    rows = parse_runtimes(tmp_folder)
+    assert any("block_components" in uid for uid in rows)
+    out2 = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import sys; from cluster_tools_tpu.cli import main;"
+         f"sys.exit(main(['report', {tmp_folder!r}]))"],
+        capture_output=True, text=True, env=env, cwd="/root/repo", timeout=120,
+    )
+    assert out2.returncode == 0 and "TOTAL" in out2.stdout
+
+
+def test_cli_configs(workspace):
+    import subprocess, sys
+
+    tmp_folder, config_dir, root = workspace
+    out_dir = os.path.join(root, "cfgs")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "import sys; from cluster_tools_tpu.cli import main;"
+         f"sys.exit(main(['configs', 'multicut', '--out', {out_dir!r}]))"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd="/root/repo", timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert os.path.exists(os.path.join(out_dir, "global.config"))
+    assert os.path.exists(os.path.join(out_dir, "watershed.config"))
