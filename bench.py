@@ -111,7 +111,11 @@ def _host_baseline_vps(vol: np.ndarray, threshold: float) -> float:
 
 def main():
     log(f"start; env JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}")
-    accel = _probe_accelerator(PROBE_TIMEOUT)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        log("JAX_PLATFORMS=cpu pinned by caller; skipping accelerator probe")
+        accel = None
+    else:
+        accel = _probe_accelerator(PROBE_TIMEOUT)
     if accel is None:
         # pin to CPU before the first in-process backend init (env + config,
         # beating the sitecustomize's own jax.config.update)
@@ -155,7 +159,11 @@ def main():
     vol = _synthetic_boundaries((batch, z, y, x))
     log("synthetic volume ready")
 
-    step = make_ws_ccl_step(mesh, halo=halo, threshold=threshold)
+    # EDT capped at the halo scale: beyond it, distances are halo-clipped
+    # anyway, and the cascade cost is linear in the cap
+    step = make_ws_ccl_step(
+        mesh, halo=halo, threshold=threshold, dt_max_distance=float(halo)
+    )
     log("compiling + warming up fused ws+ccl step")
     t0 = time.perf_counter()
     jax.block_until_ready(step(vol))

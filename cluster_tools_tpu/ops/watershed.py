@@ -132,7 +132,9 @@ def local_maxima(x: jnp.ndarray, connectivity: int = 1) -> jnp.ndarray:
 
 @partial(
     jax.jit,
-    static_argnames=("sigma_seeds", "connectivity", "sampling", "two_d"),
+    static_argnames=(
+        "sigma_seeds", "connectivity", "sampling", "two_d", "dt_max_distance"
+    ),
 )
 def distance_transform_watershed(
     boundaries: jnp.ndarray,
@@ -143,6 +145,7 @@ def distance_transform_watershed(
     mask: Optional[jnp.ndarray] = None,
     connectivity: int = 1,
     two_d: bool = False,
+    dt_max_distance: Optional[float] = None,
 ) -> jnp.ndarray:
     """Fused per-block distance-transform watershed (the flagship kernel).
 
@@ -161,6 +164,11 @@ def distance_transform_watershed(
     offsets keeping labels unique across the block.  Labels are block-local
     (min-voxel flat index based); callers globalize by block offset.  vmap
     over a leading batch axis for mesh-wide execution.
+
+    ``dt_max_distance`` caps the EDT at that physical distance (values below
+    the cap stay exact; the cascade cost drops from O(extent) to O(cap) per
+    axis).  Seeds beyond the cap merge into plateau components — pass a cap
+    comfortably above the expected object radius (e.g. the halo).
     """
     from .edt import distance_transform_squared
     from .filters import gaussian_smooth
@@ -178,6 +186,7 @@ def distance_transform_watershed(
                 mask=m2,
                 connectivity=connectivity,
                 two_d=False,
+                dt_max_distance=dt_max_distance,
             )
         )(boundaries, valid)
         per_slice = int(np.prod(boundaries.shape[1:]))
@@ -187,7 +196,9 @@ def distance_transform_watershed(
         return jnp.where(lab > 0, lab + offs, 0)
 
     fg = (boundaries < threshold) & valid
-    dist = distance_transform_squared(fg, sampling=sampling)
+    dist = distance_transform_squared(
+        fg, sampling=sampling, max_distance=dt_max_distance
+    )
     if sigma_seeds > 0:
         dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
     # dist is the *squared* EDT, so the seed floor compares squared
@@ -236,7 +247,7 @@ def filter_small_segments(
 
 @partial(
     jax.jit,
-    static_argnames=("sigma_seeds", "connectivity", "sampling"),
+    static_argnames=("sigma_seeds", "connectivity", "sampling", "dt_max_distance"),
 )
 def dt_watershed_seeded(
     boundaries: jnp.ndarray,
@@ -247,6 +258,7 @@ def dt_watershed_seeded(
     sampling: Optional[Tuple[float, ...]] = None,
     mask: Optional[jnp.ndarray] = None,
     connectivity: int = 1,
+    dt_max_distance: Optional[float] = None,
 ) -> jnp.ndarray:
     """DT watershed honoring pre-existing external seeds (two-pass mode).
 
@@ -268,7 +280,9 @@ def dt_watershed_seeded(
     n = int(np.prod(boundaries.shape))
     valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
     fg = (boundaries < threshold) & valid
-    dist = distance_transform_squared(fg, sampling=sampling)
+    dist = distance_transform_squared(
+        fg, sampling=sampling, max_distance=dt_max_distance
+    )
     if sigma_seeds > 0:
         dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
     internal = dt_seeds(

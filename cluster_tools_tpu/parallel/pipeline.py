@@ -43,6 +43,7 @@ def _ws_ccl_shard(
     halo: int,
     threshold: float,
     connectivity: int,
+    dt_max_distance: Optional[float],
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-device body: local shard is (local_batch, z_slab, y, x)."""
     local_b = boundaries.shape[0]
@@ -58,7 +59,10 @@ def _ws_ccl_shard(
         # boundary) so basins never leak out of the volume
         padded = exchange_halo(vol, halo, 0, sp_axis, sp_size, fill=1.0)
         ws = distance_transform_watershed(
-            padded, threshold=threshold, connectivity=connectivity
+            padded,
+            threshold=threshold,
+            connectivity=connectivity,
+            dt_max_distance=dt_max_distance,
         )
         ws = crop_halo(ws, halo, 0)
         # globalize watershed fragment ids by slab rank
@@ -96,6 +100,7 @@ def make_ws_ccl_step(
     connectivity: int = 1,
     dp_axis: str = "dp",
     sp_axis: str = "sp",
+    dt_max_distance: Optional[float] = None,
 ):
     """Compile the fused step for ``mesh``.
 
@@ -114,6 +119,7 @@ def make_ws_ccl_step(
         halo=halo,
         threshold=threshold,
         connectivity=connectivity,
+        dt_max_distance=dt_max_distance,
     )
     sharded = jax.shard_map(
         body,
