@@ -13,7 +13,9 @@ same decomposition is expressed as sharded axes of a ``jax.sharding.Mesh``:
   sharded volume: per-shard CCL, boundary-face equivalences, an
   ``all_gather`` of the equivalence pairs over ICI, and a replicated
   pointer-jumping union-find (replaces the reference's serial ``nifty.ufd``
-  merge job — its named scalability cliff, SURVEY.md §3.2).
+  merge job — its named scalability cliff, SURVEY.md §3.2),
+- :mod:`multihost` — the DCN layer: ``jax.distributed`` wiring, pod-spanning
+  meshes, and a local multi-process launcher (the fake-pod test backend).
 """
 
 from .mesh import make_mesh, mesh_axis_sizes
@@ -23,3 +25,4 @@ from .distributed_ccl import (
     distributed_connected_components,
 )
 from .pipeline import make_ws_ccl_step
+from .multihost import initialize as initialize_distributed, pod_mesh

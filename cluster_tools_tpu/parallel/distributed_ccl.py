@@ -4,25 +4,39 @@ This is the fully device-resident form of the reference's two-pass CCL
 (SURVEY.md §3.2): there, per-block CCL jobs wrote partial labels to N5, a
 face-scan task emitted equivalence pairs to npy files, and one *serial*
 ``nifty.ufd`` job merged them.  Here the volume lives sharded across the mesh
-(one contiguous slab per device along the ``sp`` axis) and the whole merge is
-three collectives:
+— contiguous slabs along one axis, or a full 2-D/3-D spatial decomposition
+over several mesh axes — and the whole merge is three collectives:
 
 1. per-shard CCL (:func:`~cluster_tools_tpu.ops.ccl.label_components`) with
-   labels globalized by shard rank — no offset prefix-sum needed,
-2. cross-shard face equivalences via a nearest-neighbor ``ppermute``,
-3. ``all_gather`` of the (fixed-capacity) pair lists over ICI, then a
-   *replicated* pointer-jumping union-find over the compressed boundary-label
-   table, and a local relabel through it.
+   labels globalized by linearized shard rank — no offset prefix-sum needed,
+2. cross-shard face equivalences via a nearest-neighbor ``ppermute`` per
+   sharded axis,
+3. ``all_gather`` of the (fixed-capacity) pair lists over every sharded mesh
+   axis, then a *replicated* pointer-jumping union-find over the compressed
+   boundary-label table, and a local relabel through it.
 
-The union-find domain is only the labels that touch a shard boundary (at most
-``2 * S * face_area``), never the full label space — so the replicated solve
-stays small regardless of volume size.
+The union-find domain is only the labels that touch a shard boundary (at
+most ``2 * S * total_face_area``), never the full label space — so the
+replicated solve stays small regardless of volume size.
+
+Label-space ceilings: by default a shard's labels are globalized as
+``flat_index + rank * n_slab`` (int32), which overflows once
+``n_shards * n_slab >= 2**31``.  Passing ``max_labels_per_shard=C`` compacts
+each shard's labels to dense ``1..K`` first (``K <= C``) and globalizes as
+``rank * (C + 1) + k`` — the ceiling becomes ``n_shards * (C + 1)``, letting
+teravoxel volumes run in int32 as long as no single shard holds more than
+``C`` components.  A shard exceeding ``C`` produces aliased labels; every
+public entry point therefore computes a mesh-wide overflow flag
+(``return_overflow=True`` here and on
+:func:`distributed_connected_components`; the fused pipeline returns it
+unconditionally) so callers can detect the condition and re-run with a
+bigger cap or more shards.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -30,11 +44,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.ccl import label_components
+from ..ops.ccl import label_components, relabel_consecutive
 from ..ops.unionfind import union_find
 from .halo import neighbor_face
 
 _INT32_MAX = np.int32(np.iinfo(np.int32).max)  # numpy: no backend init at import
+
+# (array_axis, mesh_axis_name, mesh_axis_size)
+ShardAxis = Tuple[int, str, int]
 
 
 def _boundary_pairs(
@@ -59,20 +76,46 @@ def _boundary_pairs(
     )
 
 
+def _norm_shard_axes(
+    axis_name: Optional[str],
+    axis_size: Optional[int],
+    shard_axis: int,
+    shard_axes: Optional[Sequence[ShardAxis]],
+) -> Tuple[ShardAxis, ...]:
+    if shard_axes is not None:
+        if axis_name is not None:
+            raise ValueError("pass either axis_name/axis_size or shard_axes, not both")
+        return tuple((int(a), str(n), int(s)) for a, n, s in shard_axes)
+    if axis_name is None or axis_size is None:
+        raise ValueError("axis_name and axis_size required without shard_axes")
+    return ((int(shard_axis), axis_name, int(axis_size)),)
+
+
 def sharded_label_components(
     mask: jnp.ndarray,
     *,
-    axis_name: str,
-    axis_size: int,
+    axis_name: Optional[str] = None,
+    axis_size: Optional[int] = None,
     connectivity: int = 1,
     shard_axis: int = 0,
-) -> jnp.ndarray:
-    """Connected components of a volume sharded in slabs along ``shard_axis``.
+    shard_axes: Optional[Sequence[ShardAxis]] = None,
+    max_labels_per_shard: Optional[int] = None,
+    return_overflow: bool = False,
+):
+    """Connected components of a volume sharded over one or more mesh axes.
 
-    Must run inside ``jax.shard_map``; ``mask`` is the local boolean slab.
-    Returns int32 labels that are **globally consistent across all shards**:
-    every component gets the (globalized) flat index + 1 of its minimum voxel
-    in the *first* shard it touches; background is 0.
+    Must run inside ``jax.shard_map``; ``mask`` is the local boolean shard.
+    Single-axis (slab) sharding: pass ``axis_name``/``axis_size`` (+
+    ``shard_axis``).  Multi-axis decomposition: pass ``shard_axes`` as a
+    sequence of ``(array_axis, mesh_axis_name, mesh_axis_size)`` — e.g. a
+    (2, 2, 2) spatial grid shards z, y and x each over its own mesh axis,
+    with face equivalences exchanged per axis.
+
+    Returns int32 labels that are **globally consistent across all shards**;
+    background is 0.  With ``max_labels_per_shard`` set, per-shard labels are
+    compacted before globalization (see module docstring); with
+    ``return_overflow`` also returns a replicated bool that is True when any
+    shard exceeded the compaction capacity (labels are then unreliable).
 
     Cross-shard stitching uses face connectivity, so ``connectivity`` must be
     1 (same restriction as the blockwise ``block_faces`` task).
@@ -81,24 +124,53 @@ def sharded_label_components(
         raise NotImplementedError(
             "cross-shard stitching supports connectivity=1 only"
         )
+    axes = _norm_shard_axes(axis_name, axis_size, shard_axis, shard_axes)
     shape = mask.shape
     n_slab = int(np.prod(shape))
-    if axis_size * n_slab >= 2**31:
-        raise ValueError(
-            f"{axis_size} shards of {n_slab} voxels overflow int32 labels; "
-            "use more/smaller shards per program or process in block batches"
-        )
-    rank = lax.axis_index(axis_name)
+    n_shards = int(np.prod([s for _, _, s in axes]))
 
-    # 1. per-shard CCL; globalize by rank so labels are unique across shards
+    # linearized shard rank, first listed axis slowest
+    rank = jnp.int32(0)
+    for _, name, size in axes:
+        rank = rank * jnp.int32(size) + lax.axis_index(name).astype(jnp.int32)
+
+    # 1. per-shard CCL; globalize so labels are unique across shards
     raw = label_components(mask, connectivity=connectivity)
-    glob = jnp.where(
-        raw == n_slab, 0, raw + 1 + rank.astype(jnp.int32) * jnp.int32(n_slab)
-    ).astype(jnp.int32)
+    # constant-False flag carrying the shard data's vma type, so the pmax
+    # reduction below is legal with or without compaction
+    overflow = raw.ravel()[0] * 0 > 0
+    if max_labels_per_shard is None:
+        if n_shards * n_slab >= 2**31:
+            raise ValueError(
+                f"{n_shards} shards of {n_slab} voxels overflow int32 labels; "
+                "pass max_labels_per_shard to compact per-shard label spaces"
+            )
+        local = jnp.where(raw == n_slab, 0, raw + 1).astype(jnp.int32)
+        glob = jnp.where(local > 0, local + rank * jnp.int32(n_slab), 0)
+    else:
+        cap = int(max_labels_per_shard)
+        if n_shards * (cap + 1) >= 2**31:
+            raise ValueError(
+                f"{n_shards} shards x {cap} labels still overflow int32"
+            )
+        local = jnp.where(raw == n_slab, 0, raw + 1).astype(jnp.int32)
+        dense, n_fg = relabel_consecutive(local, max_labels=cap)
+        overflow = n_fg > cap
+        glob = jnp.where(dense > 0, dense + rank * jnp.int32(cap + 1), 0)
 
-    # 2. cross-shard equivalences + 3. all_gather and replicated union-find
-    pairs = _boundary_pairs(glob, shard_axis, axis_name, axis_size)
-    all_pairs = lax.all_gather(pairs, axis_name).reshape(-1, 2)
+    # 2. cross-shard equivalences per sharded axis
+    pairs = jnp.concatenate(
+        [_boundary_pairs(glob, a, name, size) for a, name, size in axes], axis=0
+    )
+    # 3. all_gather over every sharded mesh axis, then a replicated solve
+    all_pairs = pairs
+    for _, name, _ in axes:
+        all_pairs = lax.all_gather(all_pairs, name).reshape(-1, 2)
+    if return_overflow:
+        ov = overflow.astype(jnp.int32)
+        for _, name, _ in axes:
+            ov = lax.pmax(ov, name)
+        overflow = ov > 0
 
     # compress the (sparse) boundary labels into a dense table
     cap = int(all_pairs.shape[0]) * 2
@@ -114,33 +186,47 @@ def sharded_label_components(
     # 4. local relabel through the boundary table
     pos = jnp.clip(jnp.searchsorted(keys, glob), 0, cap - 1)
     hit = (keys[pos] == glob) & (glob > 0)
-    return jnp.where(hit, rep[pos], glob)
+    labels = jnp.where(hit, rep[pos], glob)
+    if return_overflow:
+        return labels, overflow
+    return labels
 
 
 def distributed_connected_components(
     mask,
     mesh: Mesh,
-    sp_axis: str = "sp",
+    sp_axis: Union[str, Sequence[str]] = "sp",
     connectivity: int = 1,
+    max_labels_per_shard: Optional[int] = None,
+    return_overflow: bool = False,
 ):
-    """shard_map wrapper: CCL of a full volume sharded in slabs over ``sp_axis``.
+    """shard_map wrapper: CCL of a full volume sharded over ``sp_axis``.
 
-    ``mask``'s leading dimension is sharded over ``sp_axis``; remaining axes
-    are replicated.  Returns globally consistent int32 labels with the same
-    sharding.
+    ``sp_axis`` may be one mesh axis name (volume sharded in slabs along its
+    leading dimension) or a sequence of names (leading dimensions sharded
+    over the respective axes — a 2-D/3-D spatial decomposition).  Returns
+    globally consistent int32 labels with the same sharding; with
+    ``return_overflow`` also a replicated bool that is True when any shard
+    exceeded ``max_labels_per_shard`` (labels are then unreliable — re-run
+    with a bigger cap or more shards).
     """
     from .mesh import mesh_axis_sizes
 
-    size = mesh_axis_sizes(mesh)[sp_axis]
+    sizes = mesh_axis_sizes(mesh)
+    names = [sp_axis] if isinstance(sp_axis, str) else list(sp_axis)
+    shard_axes = tuple(
+        (i, name, sizes[name]) for i, name in enumerate(names)
+    )
     fn = jax.shard_map(
         partial(
             sharded_label_components,
-            axis_name=sp_axis,
-            axis_size=size,
+            shard_axes=shard_axes,
             connectivity=connectivity,
+            max_labels_per_shard=max_labels_per_shard,
+            return_overflow=return_overflow,
         ),
         mesh=mesh,
-        in_specs=P(sp_axis),
-        out_specs=P(sp_axis),
+        in_specs=P(*names),
+        out_specs=(P(*names), P()) if return_overflow else P(*names),
     )
     return fn(mask)

@@ -10,7 +10,10 @@ Two axes cover this framework's parallelism:
   context parallelism for 3-D space, SURVEY.md §5.7).
 
 Multi-host pods extend the same mesh over DCN via ``jax.distributed`` — the
-mesh abstraction is identical, only the device list grows.
+mesh abstraction is identical, only the device list grows.  See
+:mod:`~cluster_tools_tpu.parallel.multihost` for the wiring
+(``initialize`` + ``pod_mesh``) and the local fake-pod launcher the tests
+use.
 """
 
 from __future__ import annotations

@@ -1,0 +1,240 @@
+"""Multi-host (DCN) execution: ``jax.distributed`` wiring + process launcher.
+
+The reference scaled past one machine by submitting slurm/LSF array jobs that
+only ever talked through the shared filesystem (SURVEY.md §2d).  The
+TPU-native equivalent is a **multi-process JAX program**: every host runs the
+same SPMD program, ``jax.distributed.initialize`` wires the processes into
+one runtime over DCN, and the global ``Mesh`` simply spans all hosts'
+devices — collectives ride ICI within a slice and DCN across hosts, with no
+code change in the ops (the same ``shard_map`` programs run unmodified).
+
+Three pieces live here:
+
+- :func:`initialize` — ``jax.distributed.initialize`` wrapper with the
+  session-specific CPU-platform pinning (the PJRT sitecustomize would
+  otherwise dial the TPU tunnel in every worker, see ``tests/conftest.py``),
+- :func:`pod_mesh` — a mesh over **all** processes' devices (the multi-host
+  form of :func:`~cluster_tools_tpu.parallel.mesh.make_mesh`),
+- :func:`launch_workers` / :func:`worker_main` — a subprocess launcher that
+  runs an N-process CPU pod on one machine, used by the multi-process test
+  (the CI stand-in for a real v5p pod, mirroring how the reference's
+  ``target='local'`` stood in for slurm, SURVEY.md §4) and by
+  ``__graft_entry__.dryrun_multiprocess``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_ENV_COORD = "CT_MP_COORDINATOR"
+_ENV_NPROC = "CT_MP_NUM_PROCESSES"
+_ENV_PID = "CT_MP_PROCESS_ID"
+_ENV_TARGET = "CT_MP_TARGET"
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    platform: Optional[str] = None,
+) -> None:
+    """Join this process into the distributed JAX runtime.
+
+    On a real pod (GKE/TPU VM) all arguments are discovered from the
+    environment and may be omitted.  ``platform='cpu'`` pins the CPU backend
+    *before* initialization — required for the local fake-pod tests, where
+    the PJRT plugin on PYTHONPATH would otherwise dial TPU hardware from
+    every worker.
+    """
+    import jax
+
+    if platform is not None:
+        os.environ["JAX_PLATFORMS"] = platform
+        jax.config.update("jax_platforms", platform)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def pod_mesh(
+    axis_names: Sequence[str] = ("dp", "sp"),
+    grid: Optional[Sequence[int]] = None,
+):
+    """Mesh spanning every device of every process in the distributed job.
+
+    Identical in shape-semantics to :func:`make_mesh`, but always over the
+    *global* device list — after :func:`initialize`, ``jax.devices()``
+    contains all hosts' devices and the returned mesh crosses DCN.
+    Collective layout: keep the ``sp`` (spatial/halo) axis within a host
+    where possible; ``jax.devices()`` orders devices process-major, so the
+    default factoring puts the fastest-varying (last) mesh axis across
+    devices of the same process.
+    """
+    import jax
+
+    from .mesh import make_mesh
+
+    return make_mesh(
+        len(jax.devices()), axis_names=axis_names, grid=grid, devices=jax.devices()
+    )
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_workers(
+    num_processes: int,
+    target: str,
+    devices_per_process: int = 1,
+    timeout: float = 600.0,
+    extra_env: Optional[Dict[str, str]] = None,
+) -> List[Tuple[int, str, str]]:
+    """Run ``target`` (``"module:function"``) in an N-process local CPU pod.
+
+    Spawns ``num_processes`` Python subprocesses, each pinned to the CPU
+    platform with ``devices_per_process`` virtual devices, joined through a
+    ``jax.distributed`` coordinator on a free localhost port.  The target
+    function runs in every process after initialization (classic SPMD).
+
+    Returns ``[(returncode, stdout, stderr), ...]`` per process; raises on
+    timeout.  This is the DCN analogue of the reference's LocalTask
+    fake-cluster: real multi-process collectives, one machine.
+    """
+    coord = f"127.0.0.1:{free_port()}"
+    # workers must be able to import this package regardless of their cwd
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    procs = []
+    for pid in range(num_processes):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(
+            {
+                _ENV_COORD: coord,
+                _ENV_NPROC: str(num_processes),
+                _ENV_PID: str(pid),
+                _ENV_TARGET: target,
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={devices_per_process}"
+                ).strip(),
+            }
+        )
+        if extra_env:
+            env.update(extra_env)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    "from cluster_tools_tpu.parallel.multihost import worker_main; "
+                    "worker_main()",
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                start_new_session=True,
+            )
+        )
+    results = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            results.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results
+
+
+def worker_main() -> None:
+    """Entry point of a :func:`launch_workers` subprocess.
+
+    Reads the coordinator/process config from the environment, pins the CPU
+    platform (beating the sitecustomize's own config write), joins the
+    distributed runtime, and calls the target function.
+    """
+    import importlib
+
+    coord = os.environ[_ENV_COORD]
+    nproc = int(os.environ[_ENV_NPROC])
+    pid = int(os.environ[_ENV_PID])
+    target = os.environ[_ENV_TARGET]
+
+    initialize(
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=pid,
+        platform="cpu",
+    )
+    mod_name, fn_name = target.split(":")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    fn()
+
+
+def cc_pod_demo() -> None:
+    """SPMD demo/test body: distributed CC across process boundaries.
+
+    Every process holds a z-slab of one volume; connected components are
+    merged across the process (DCN) cuts by the same
+    :func:`~cluster_tools_tpu.parallel.distributed_ccl.
+    distributed_connected_components` program that runs single-host — only
+    the mesh spans further.  Each process validates the full result against
+    a scipy oracle and prints ``CC_POD_OK``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from scipy import ndimage
+
+    from .distributed_ccl import distributed_connected_components
+
+    mesh = pod_mesh(axis_names=("sp",))
+    sp = int(mesh.devices.size)
+    pid = jax.process_index()
+
+    # deterministic volume, generated identically in every process
+    rng = np.random.default_rng(7)
+    mask_np = rng.random((sp * 8, 24, 24)) > 0.35  # dense: components span cuts
+    sharding = NamedSharding(mesh, P("sp"))
+    mask = jax.make_array_from_callback(
+        mask_np.shape, sharding, lambda idx: jnp.asarray(mask_np[idx])
+    )
+
+    labels = distributed_connected_components(mask, mesh, sp_axis="sp")
+    # replicate so every process can fetch the full result
+    replicated = jax.jit(
+        lambda x: x, out_shardings=NamedSharding(mesh, P(None))
+    )(labels)
+    ours = np.asarray(replicated)
+
+    ref, nref = ndimage.label(mask_np)
+    assert (ours > 0).sum() == (ref > 0).sum()
+    fwd: dict = {}
+    for o, r in zip(ours.ravel().tolist(), ref.ravel().tolist()):
+        if o > 0:
+            assert fwd.setdefault(o, r) == r, "label split across components"
+    assert len(fwd) == nref, (len(fwd), nref)
+    # prove the merge crossed a process boundary: some component must span
+    # the cut between the first and second process's slabs
+    slab = mask_np.shape[0] // sp
+    cut_lo, cut_hi = ours[slab - 1], ours[slab]
+    spans = set(cut_lo[cut_lo > 0].ravel()) & set(cut_hi[cut_hi > 0].ravel())
+    assert spans, "no component spans the process-boundary cut"
+    print(
+        f"CC_POD_OK pid={pid} processes={jax.process_count()} "
+        f"devices={sp} components={nref} spanning={len(spans)}",
+        flush=True,
+    )

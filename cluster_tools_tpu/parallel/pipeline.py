@@ -28,6 +28,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.ccl import _match_vma, relabel_consecutive
 from ..ops.watershed import distance_transform_watershed
 from .distributed_ccl import sharded_label_components
 from .halo import crop_halo, exchange_halo
@@ -44,6 +45,7 @@ def _ws_ccl_shard(
     threshold: float,
     connectivity: int,
     dt_max_distance: Optional[float],
+    max_labels_per_shard: Optional[int],
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-device body: local shard is (local_batch, z_slab, y, x)."""
     local_b = boundaries.shape[0]
@@ -51,6 +53,10 @@ def _ws_ccl_shard(
 
     ws_out = []
     cc_out = []
+    # per-shard ws-compaction overflow (varies over dp x sp); cc overflow
+    # arrives already sp-reduced from sharded_label_components
+    ws_overflow = _match_vma(jnp.zeros((), jnp.int32), boundaries)
+    cc_overflow = None
     # static Python loop over the (small) local batch: collectives inside the
     # body run once per volume on every rank in lockstep
     for b in range(local_b):
@@ -65,22 +71,44 @@ def _ws_ccl_shard(
             dt_max_distance=dt_max_distance,
         )
         ws = crop_halo(ws, halo, 0)
-        # globalize watershed fragment ids by slab rank
+        # globalize watershed fragment ids by slab rank; with a compaction
+        # cap, fragment ids are densified first so the label space is
+        # sp_size * cap instead of sp_size * padded_voxels (the int32
+        # ceiling that blocked teravoxel volumes)
         n_pad = int(np.prod(padded.shape))
-        if sp_size * n_pad >= 2**31:
-            raise ValueError(
-                f"{sp_size} shards of {n_pad} padded voxels overflow int32 labels"
+        if max_labels_per_shard is not None:
+            cap = int(max_labels_per_shard)
+            if sp_size * (cap + 1) >= 2**31:
+                raise ValueError(
+                    f"{sp_size} shards x {cap} ws fragments overflow int32"
+                )
+            ws, n_frag = relabel_consecutive(ws, max_labels=cap)
+            ws_overflow = jnp.maximum(
+                ws_overflow, (n_frag > cap).astype(jnp.int32)
             )
-        ws = jnp.where(ws > 0, ws + rank * jnp.int32(n_pad), 0)
+            ws = jnp.where(ws > 0, ws + rank * jnp.int32(cap + 1), 0)
+        else:
+            if sp_size * n_pad >= 2**31:
+                raise ValueError(
+                    f"{sp_size} shards of {n_pad} padded voxels overflow int32 "
+                    "labels; pass max_labels_per_shard"
+                )
+            ws = jnp.where(ws > 0, ws + rank * jnp.int32(n_pad), 0)
         ws_out.append(ws)
 
         # globally merged connected components of the foreground mask — the
         # two-pass union-find merge as ICI collectives
-        cc = sharded_label_components(
+        cc, cc_over = sharded_label_components(
             vol < threshold,
             axis_name=sp_axis,
             axis_size=sp_size,
             connectivity=connectivity,
+            max_labels_per_shard=max_labels_per_shard,
+            return_overflow=True,
+        )
+        cc_over = cc_over.astype(jnp.int32)
+        cc_overflow = (
+            cc_over if cc_overflow is None else jnp.maximum(cc_overflow, cc_over)
         )
         cc_out.append(cc)
 
@@ -90,7 +118,10 @@ def _ws_ccl_shard(
     n_fg = lax.psum(
         lax.psum(jnp.sum(cc_lab > 0), sp_axis), dp_axis
     )
-    return ws_lab, cc_lab, n_fg
+    # mesh-wide label-compaction overflow flag (always False w/o compaction)
+    overflow = jnp.maximum(lax.pmax(ws_overflow, sp_axis), cc_overflow)
+    overflow = lax.pmax(overflow, dp_axis) > 0
+    return ws_lab, cc_lab, n_fg, overflow
 
 
 def make_ws_ccl_step(
@@ -101,14 +132,17 @@ def make_ws_ccl_step(
     dp_axis: str = "dp",
     sp_axis: str = "sp",
     dt_max_distance: Optional[float] = None,
+    max_labels_per_shard: Optional[int] = None,
 ):
     """Compile the fused step for ``mesh``.
 
     Returns a jitted function ``step(boundaries)`` taking a float32 batch of
     volumes ``(B, Z, Y, X)`` with ``B % dp == 0`` and ``Z % sp == 0``; the
     batch axis is sharded over ``dp``, the z axis over ``sp``.  Output:
-    ``(ws_labels, cc_labels, n_foreground)`` with labels sharded like the
-    input and the count replicated.
+    ``(ws_labels, cc_labels, n_foreground, overflow)`` with labels sharded
+    like the input and the scalars replicated; ``overflow`` is True when any
+    shard exceeded ``max_labels_per_shard`` (labels unreliable — raise the
+    cap or add shards; always False without compaction).
     """
     sizes = mesh_axis_sizes(mesh)
     body = partial(
@@ -120,11 +154,12 @@ def make_ws_ccl_step(
         threshold=threshold,
         connectivity=connectivity,
         dt_max_distance=dt_max_distance,
+        max_labels_per_shard=max_labels_per_shard,
     )
     sharded = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=P(dp_axis, sp_axis),
-        out_specs=(P(dp_axis, sp_axis), P(dp_axis, sp_axis), P()),
+        out_specs=(P(dp_axis, sp_axis), P(dp_axis, sp_axis), P(), P()),
     )
     return jax.jit(sharded)

@@ -1,0 +1,28 @@
+"""Multi-host (DCN) path: real multi-process collectives on one machine.
+
+The reference's cross-machine story was slurm jobs + shared FS (SURVEY.md
+§2d); ours is ``jax.distributed`` + a pod-spanning mesh.  CI stand-in: N
+local processes x K virtual CPU devices joined through a localhost
+coordinator — the same runtime wiring as a v5p pod, minus the hardware.
+"""
+
+import pytest
+
+from cluster_tools_tpu.parallel.multihost import launch_workers
+
+
+@pytest.mark.parametrize(
+    "num_processes,devices_per_process", [(2, 1), (2, 2)]
+)
+def test_cc_merges_across_process_boundaries(num_processes, devices_per_process):
+    results = launch_workers(
+        num_processes,
+        "cluster_tools_tpu.parallel.multihost:cc_pod_demo",
+        devices_per_process=devices_per_process,
+        timeout=300,
+    )
+    assert len(results) == num_processes
+    for pid, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"worker {pid} failed:\n{err[-2000:]}"
+        assert "CC_POD_OK" in out, f"worker {pid} missing success marker:\n{out[-500:]}"
+        assert f"processes={num_processes}" in out

@@ -82,6 +82,62 @@ def test_distributed_ccl_component_spanning_all_shards():
     assert (labels[~mask] == 0).all()
 
 
+def test_distributed_ccl_two_axis_sharding(rng):
+    # one volume sharded along BOTH z and y — a (2, 4) spatial decomposition
+    mesh = _mesh(("spz", "spy"))
+    sizes = mesh_axis_sizes(mesh)
+    sz, sy = sizes["spz"], sizes["spy"]
+    shape = (sz * 6, sy * 6, 20)
+    mask = random_blobs(rng, shape, p=0.45)
+    labels = np.asarray(
+        distributed_connected_components(mask, mesh, sp_axis=("spz", "spy"))
+    )
+    expected, _ = ndimage.label(mask, structure=ndimage.generate_binary_structure(3, 1))
+    assert_labels_equivalent(labels, expected)
+
+
+def test_distributed_ccl_compacted_labels(rng):
+    # per-shard compaction: same result, label space capped at shards*cap
+    mesh = _mesh(("sp",))
+    sp = mesh_axis_sizes(mesh)["sp"]
+    shape = (sp * 8, 24, 24)
+    mask = random_blobs(rng, shape, p=0.4)
+    labels = np.asarray(
+        distributed_connected_components(
+            mask, mesh, sp_axis="sp", max_labels_per_shard=512
+        )
+    )
+    expected, _ = ndimage.label(mask, structure=ndimage.generate_binary_structure(3, 1))
+    assert_labels_equivalent(labels, expected)
+    assert labels.max() < sp * 513, "labels escaped the compacted space"
+
+
+def test_sharded_ccl_overflow_flag():
+    # a shard with more components than the cap must raise the overflow flag
+    import jax as _jax
+    from cluster_tools_tpu.parallel.distributed_ccl import sharded_label_components
+
+    mesh = _mesh(("sp",))
+    sp = mesh_axis_sizes(mesh)["sp"]
+    shape = (sp * 8, 9, 9)
+    mask = np.zeros(shape, bool)
+    mask[::2, ::2, ::2] = True  # isolated voxels: ~81 components per shard
+
+    def body(m):
+        return sharded_label_components(
+            m,
+            axis_name="sp",
+            axis_size=sp,
+            max_labels_per_shard=8,
+            return_overflow=True,
+        )
+
+    _, overflow = _jax.shard_map(
+        body, mesh=mesh, in_specs=P("sp"), out_specs=(P("sp"), P())
+    )(mask)
+    assert bool(overflow)
+
+
 def test_ws_ccl_step_shapes_and_consistency(rng):
     mesh = _mesh(("dp", "sp"))
     sizes = mesh_axis_sizes(mesh)
@@ -89,16 +145,29 @@ def test_ws_ccl_step_shapes_and_consistency(rng):
     b, z, y, x = dp, sp * 8, 16, 16
     vol = rng.random((b, z, y, x)).astype(np.float32)
     step = make_ws_ccl_step(mesh, halo=2, threshold=0.5)
-    ws, cc, n_fg = jax.block_until_ready(step(vol))
+    ws, cc, n_fg, overflow = jax.block_until_ready(step(vol))
     ws, cc = np.asarray(ws), np.asarray(cc)
     assert ws.shape == vol.shape and cc.shape == vol.shape
     assert int(n_fg) == int((cc > 0).sum())
+    assert not bool(overflow)
     # merged CC labels must match scipy on each batch element
     for i in range(b):
         expected, _ = ndimage.label(
             vol[i] < 0.5, structure=ndimage.generate_binary_structure(3, 1)
         )
         assert_labels_equivalent(cc[i], expected)
+    # compacted-label mode: identical segmentation, bounded label space
+    step_c = make_ws_ccl_step(mesh, halo=2, threshold=0.5, max_labels_per_shard=2048)
+    ws2, cc2, n_fg2, overflow2 = jax.block_until_ready(step_c(vol))
+    assert int(n_fg2) == int(n_fg)
+    assert not bool(overflow2)
+    for i in range(b):
+        assert_labels_equivalent(np.asarray(cc2)[i], cc[i])
+        assert_labels_equivalent(np.asarray(ws2)[i], ws[i])
+    # an absurdly small cap must trip the overflow flag
+    step_o = make_ws_ccl_step(mesh, halo=2, threshold=0.5, max_labels_per_shard=4)
+    *_, overflow3 = jax.block_until_ready(step_o(vol))
+    assert bool(overflow3)
 
 
 def test_graft_entry_single_chip():
