@@ -46,7 +46,7 @@ def _ws_ccl_shard(
     connectivity: int,
     dt_max_distance: Optional[float],
     max_labels_per_shard: Optional[int],
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-device body: local shard is (local_batch, z_slab, y, x)."""
     local_b = boundaries.shape[0]
     rank = lax.axis_index(sp_axis).astype(jnp.int32)

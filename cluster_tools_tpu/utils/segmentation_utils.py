@@ -3,16 +3,22 @@
 Mirrors the reference's ``cluster_tools/utils/segmentation_utils.py``
 (SURVEY.md §2a "Utils"), whose ``key_to_agglomerator`` mapped solver names
 (kernighan-lin, greedy-additive, fusion-moves, ...) to nifty C++ solvers.
-Here the solvers live in :mod:`..ops.multicut`; 'fusion-moves' maps to the
-strongest available pipeline (GAEC + KL refinement with restarts) rather
-than a faithful FM implementation.
+Here every key maps to its faithful counterpart in :mod:`..ops.multicut`:
+GAEC, true Kernighan-Lin (gain sequences + joins), fusion moves, and the
+attractive-component decomposition solver.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from ..ops.multicut import greedy_additive, kernighan_lin
+from ..ops.multicut import (
+    decompose_solve,
+    fusion_moves,
+    greedy_additive,
+    greedy_node_moves,
+    kernighan_lin,
+)
 
 
 def _solve_greedy(n_nodes, edges, costs, **kw):
@@ -23,17 +29,24 @@ def _solve_kl(n_nodes, edges, costs, **kw):
     return kernighan_lin(n_nodes, edges, costs, **kw)
 
 
-def _solve_strong(n_nodes, edges, costs, **kw):
-    """GAEC init + KL refinement; the default 'quality' solver."""
-    init = greedy_additive(n_nodes, edges, costs)
-    return kernighan_lin(n_nodes, edges, costs, init_labels=init, **kw)
+def _solve_fm(n_nodes, edges, costs, **kw):
+    return fusion_moves(n_nodes, edges, costs, **kw)
+
+
+def _solve_decompose(n_nodes, edges, costs, **kw):
+    return decompose_solve(n_nodes, edges, costs, **kw)
+
+
+def _solve_node_moves(n_nodes, edges, costs, **kw):
+    return greedy_node_moves(n_nodes, edges, costs, **kw)
 
 
 key_to_agglomerator = {
     "greedy-additive": _solve_greedy,
     "kernighan-lin": _solve_kl,
-    "decomposition": _solve_strong,
-    "fusion-moves": _solve_strong,
+    "fusion-moves": _solve_fm,
+    "decomposition": _solve_decompose,
+    "greedy-node-moves": _solve_node_moves,
 }
 
 
