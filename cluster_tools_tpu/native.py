@@ -65,16 +65,30 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if not os.path.exists(_LIB_PATH) and not _build():
             return None
-        try:
+
+        def _open():
             lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            # stale/corrupt artifact (e.g. from an interrupted build of an
-            # older source): rebuild once before giving up
+            # symbol probe: a library built from older source loads fine but
+            # lacks newer kernels — treat it as stale
+            for sym in (
+                "ct_union_find",
+                "ct_greedy_additive",
+                "ct_merge_edge_features",
+                "ct_mutex_watershed",
+            ):
+                getattr(lib, sym)
+            return lib
+
+        try:
+            lib = _open()
+        except (OSError, AttributeError):
+            # stale/corrupt artifact (interrupted build or older source):
+            # rebuild once before giving up
             if not _build():
                 return None
             try:
-                lib = ctypes.CDLL(_LIB_PATH)
-            except OSError:
+                lib = _open()
+            except (OSError, AttributeError):
                 return None
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
@@ -102,6 +116,17 @@ def _load() -> Optional[ctypes.CDLL]:
             f64p,
         ]
         lib.ct_merge_edge_features.restype = ctypes.c_int64
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.ct_mutex_watershed.argtypes = [
+            ctypes.c_int64,
+            i64p,
+            i64p,
+            u8p,
+            i64p,
+            ctypes.c_int64,
+            i64p,
+        ]
+        lib.ct_mutex_watershed.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -134,6 +159,31 @@ def greedy_additive(
     lib.ct_greedy_additive(
         int(n_nodes), edges, costs, len(edges), float(stop_cost), out
     )
+    return out
+
+
+def mutex_watershed(
+    n_nodes: int,
+    u: np.ndarray,
+    v: np.ndarray,
+    is_attractive: np.ndarray,
+    order: np.ndarray,
+) -> Optional[np.ndarray]:
+    """Mutex-watershed component roots per node, or None when unavailable.
+
+    ``order`` is the edge processing order (indices sorted by decreasing
+    priority, numpy ``argsort`` on the host); semantics match the Python
+    ``_MutexUnionFind`` loop in ``ops/mws.py`` exactly.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    u = np.ascontiguousarray(np.asarray(u), np.int64)
+    v = np.ascontiguousarray(np.asarray(v), np.int64)
+    att = np.ascontiguousarray(np.asarray(is_attractive), np.uint8)
+    order = np.ascontiguousarray(np.asarray(order), np.int64)
+    out = np.empty(int(n_nodes), np.int64)
+    lib.ct_mutex_watershed(int(n_nodes), u, v, att, order, len(order), out)
     return out
 
 

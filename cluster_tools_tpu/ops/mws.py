@@ -7,11 +7,13 @@ process all (attractive and repulsive) edges in order of decreasing priority;
 attractive edges union their endpoints unless a mutex constraint forbids it,
 repulsive edges install a mutex between their endpoints' clusters.
 
-Edge generation (the bandwidth-heavy, regular part) is vectorized; the
-constraint loop is inherently sequential over the sorted edge list and runs
-on host per block — blocks are processed batch-parallel across the IO pool,
-and the C++ runtime extension (``native/``) provides the fast path when
-built.
+Edge generation and priority sorting (the bandwidth-heavy, regular parts)
+are vectorized; the constraint loop is inherently sequential over the
+sorted edge list and runs on host per block — blocks are processed
+batch-parallel across the IO pool.  The loop executes in the C++ runtime
+extension (``ct_mutex_watershed`` in ``native/ct_native.cpp``, built on
+first use) with the pure-Python ``_MutexUnionFind`` loop as fallback and
+as the parity oracle (``tests/test_mws_stitching.py``).
 
 Convention (as in the reference stack): ``offsets[:ndim]`` are the unit
 ("attractive") offsets; all further offsets are long-range ("repulsive").
@@ -160,18 +162,22 @@ def mutex_watershed(
         u, v, w, is_attractive = u[keep], v[keep], w[keep], is_attractive[keep]
 
     order = np.argsort(-w, kind="stable")
-    uf = _MutexUnionFind(n)
-    for i in order:
-        ru, rv = uf.find(int(u[i])), uf.find(int(v[i]))
-        if ru == rv:
-            continue
-        if is_attractive[i]:
-            if not uf.has_mutex(ru, rv):
-                uf.merge(ru, rv)
-        else:
-            uf.add_mutex(ru, rv)
 
-    roots = np.array([uf.find(i) for i in range(n)], dtype=np.int64)
+    from .. import native
+
+    roots = native.mutex_watershed(n, u, v, is_attractive, order)
+    if roots is None:
+        uf = _MutexUnionFind(n)
+        for i in order:
+            ru, rv = uf.find(int(u[i])), uf.find(int(v[i]))
+            if ru == rv:
+                continue
+            if is_attractive[i]:
+                if not uf.has_mutex(ru, rv):
+                    uf.merge(ru, rv)
+            else:
+                uf.add_mutex(ru, rv)
+        roots = np.array([uf.find(i) for i in range(n)], dtype=np.int64)
     _, labels = np.unique(roots, return_inverse=True)
     labels = labels.astype(np.int64).reshape(shape) + 1
     if mask is not None:

@@ -13,6 +13,7 @@
 #include <cstring>
 #include <queue>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -155,6 +156,65 @@ int64_t ct_merge_edge_features(const uint64_t* pairs, const double* feats,
     counts[a] += cnt;
   }
   return unmatched;
+}
+
+// Mutex watershed constraint loop (Wolf et al.; the affogato capability,
+// SURVEY.md §2b).  Edges arrive PRE-SORTED by decreasing priority via
+// `order` (numpy argsort on the host — the regular, vectorizable part).
+// Attractive edges union their endpoint clusters unless a mutex forbids
+// it; repulsive edges install a mutex between the clusters.  Mutex sets
+// merge small-into-large.  Writes per-node component roots to out_roots.
+int ct_mutex_watershed(int64_t n_nodes, const int64_t* u, const int64_t* v,
+                       const uint8_t* is_attractive, const int64_t* order,
+                       int64_t n_edges, int64_t* out_roots) {
+  std::vector<int64_t> parent(n_nodes);
+  std::vector<int8_t> rank(n_nodes, 0);
+  for (int64_t i = 0; i < n_nodes; ++i) parent[i] = i;
+  // per-root mutex partners; roots without constraints hold no entry
+  std::unordered_map<int64_t, std::unordered_set<int64_t>> mutexes;
+
+  auto has_mutex = [&](int64_t ra, int64_t rb) {
+    auto it = mutexes.find(ra);
+    return it != mutexes.end() && it->second.count(rb) > 0;
+  };
+
+  for (int64_t k = 0; k < n_edges; ++k) {
+    const int64_t e = order[k];
+    int64_t ru = find_root(parent, u[e]);
+    int64_t rv = find_root(parent, v[e]);
+    if (ru == rv) continue;
+    if (is_attractive[e]) {
+      // check against the smaller mutex set
+      auto iu = mutexes.find(ru), iv = mutexes.find(rv);
+      size_t su = iu == mutexes.end() ? 0 : iu->second.size();
+      size_t sv = iv == mutexes.end() ? 0 : iv->second.size();
+      if (su <= sv ? has_mutex(ru, rv) : has_mutex(rv, ru)) continue;
+      // union by rank
+      if (rank[ru] < rank[rv]) std::swap(ru, rv);
+      else if (rank[ru] == rank[rv]) ++rank[ru];
+      parent[rv] = ru;
+      // fold rv's mutex set into ru's (small set moves), updating partners
+      auto ib = mutexes.find(rv);
+      if (ib != mutexes.end()) {
+        auto moved = std::move(ib->second);
+        mutexes.erase(ib);
+        auto& ma = mutexes[ru];
+        for (int64_t x : moved) {
+          auto ix = mutexes.find(x);
+          if (ix != mutexes.end()) {
+            ix->second.erase(rv);
+            ix->second.insert(ru);
+          }
+          ma.insert(x);
+        }
+      }
+    } else {
+      mutexes[ru].insert(rv);
+      mutexes[rv].insert(ru);
+    }
+  }
+  for (int64_t i = 0; i < n_nodes; ++i) out_roots[i] = find_root(parent, i);
+  return 0;
 }
 
 }  // extern "C"
