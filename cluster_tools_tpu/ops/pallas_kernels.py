@@ -37,27 +37,21 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .ccl import _shift
 
 # Sentinel must exceed any global flat index (volumes are int32-bounded
 # anyway: > 2**31 voxels per shard is rejected upstream).
 BIG = 2**30
 
+# watershed pointer-propagation: value read from outside the tile
+WS_MARKER = -(2**30)
 
-def _shift_fill(x: jnp.ndarray, axis: int, sh: int, fill: int) -> jnp.ndarray:
-    """y[i] = x[i - sh] along ``axis`` with ``fill`` shifted in (static slices)."""
-    n = x.shape[axis]
-    pad_shape = list(x.shape)
-    pad_shape[axis] = 1
-    pad = jnp.full(pad_shape, jnp.int32(fill))
-    if sh > 0:
-        body = lax.slice_in_dim(x, 0, n - 1, axis=axis)
-        return jnp.concatenate([pad, body], axis=axis)
-    body = lax.slice_in_dim(x, 1, n, axis=axis)
-    return jnp.concatenate([body, pad], axis=axis)
+# descent-direction codes 1..6 in this order; 0 = self (terminal)
+WS_OFFS = ((-1, 0, 0), (1, 0, 0), (0, -1, 0), (0, 1, 0), (0, 0, -1), (0, 0, 1))
 
 
 def _ccl_kernel(tile_shape, mask_ref, out_ref):
@@ -77,8 +71,8 @@ def _ccl_kernel(tile_shape, mask_ref, out_ref):
     def nmin(l):
         m = l
         for ax in range(3):
-            m = jnp.minimum(m, _shift_fill(l, ax, 1, BIG))
-            m = jnp.minimum(m, _shift_fill(l, ax, -1, BIG))
+            m = jnp.minimum(m, _shift(l, 1, ax, jnp.int32(BIG)))
+            m = jnp.minimum(m, _shift(l, -1, ax, jnp.int32(BIG)))
         return m
 
     def cond(s):
@@ -123,6 +117,99 @@ def tile_ccl_pallas(
         ),
         interpret=interpret,
     )(mask.astype(jnp.int32))
+
+
+def ws_propagate_step(value, dirs, gidx, axes, ny, nx):
+    """One step of label flow along descent pointers (shared kernel/XLA math).
+
+    Every voxel whose direction code is ``d`` copies the value of its descent
+    target (the neighbor at ``WS_OFFS[d-1]``); terminals (code 0) keep their
+    value.  A copy that would read outside the tile (the shifted-in
+    ``WS_MARKER``) resolves to the *exit code* ``-(target_gidx + 2)`` instead,
+    freezing the fragment until the cross-tile chase resolves it.
+
+    ``axes`` maps the three spatial offsets onto array axes (kernel: (0,1,2);
+    XLA tiled fallback: trailing axes of a batched array); ``ny``/``nx`` are
+    the *global* volume dims for flat-index arithmetic.
+    """
+    new = value
+    for code, off in enumerate(WS_OFFS, start=1):
+        foff = (off[0] * ny + off[1]) * nx + off[2]
+        v_t = value
+        for ax, s in zip(axes, off):
+            if s:
+                v_t = _shift(v_t, -s, ax, jnp.int32(WS_MARKER))
+        sel = dirs == code
+        exit_code = -(gidx + jnp.int32(foff)) - 2
+        new = jnp.where(
+            sel,
+            jnp.where(v_t == jnp.int32(WS_MARKER), exit_code, v_t),
+            new,
+        )
+    return new
+
+
+def _ws_kernel(tile_shape, dir_ref, seed_ref, out_ref):
+    tz, ty, tx = tile_shape
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+    ny = pl.num_programs(1) * ty
+    nx = pl.num_programs(2) * tx
+    gz = lax.broadcasted_iota(jnp.int32, tile_shape, 0) + i * tz
+    gy = lax.broadcasted_iota(jnp.int32, tile_shape, 1) + j * ty
+    gx = lax.broadcasted_iota(jnp.int32, tile_shape, 2) + k * tx
+    gidx = (gz * ny + gy) * nx + gx
+    dirs = dir_ref[:]
+    sv = seed_ref[:]  # -1 invalid, 0 unseeded, >0 seed label
+    terminal = dirs == 0
+    value = jnp.where(
+        sv > 0, sv, jnp.where(terminal & (sv == 0), -gidx - 2, jnp.int32(0))
+    )
+
+    def cond(s):
+        return s[1]
+
+    def body(s):
+        v, _ = s
+        v2 = ws_propagate_step(v, dirs, gidx, (0, 1, 2), ny, nx)
+        return v2, jnp.any(v2 != v)
+
+    value, _ = lax.while_loop(cond, body, (value, True))
+    out_ref[:] = value
+
+
+@partial(jax.jit, static_argnames=("tile", "interpret"))
+def tile_ws_propagate_pallas(
+    dirs: jnp.ndarray,
+    seeds_or_invalid: jnp.ndarray,
+    tile: Tuple[int, int, int] = (16, 16, 128),
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """In-tile watershed label flow along a descent-direction field.
+
+    ``dirs``: int32 codes (0 = terminal/self, 1..6 = ``WS_OFFS``).
+    ``seeds_or_invalid``: int32, -1 = masked out, 0 = no seed, >0 = seed id.
+    Output per voxel: seed label (>0), 0 (invalid), ``-(t + 2)`` (drains to
+    the unseeded in-tile terminal ``t``), or ``-(g + 2)`` for an exit whose
+    target voxel ``g`` lies in another tile (resolved by ``tile_ws``).
+    """
+    z, y, x = dirs.shape
+    tz, ty, tx = tile
+    assert z % tz == 0 and y % ty == 0 and x % tx == 0
+    return pl.pallas_call(
+        partial(_ws_kernel, tile),
+        out_shape=jax.ShapeDtypeStruct((z, y, x), jnp.int32),
+        grid=(z // tz, y // ty, x // tx),
+        in_specs=[
+            pl.BlockSpec(tile, lambda i, j, k: (i, j, k), memory_space=pltpu.VMEM),
+            pl.BlockSpec(tile, lambda i, j, k: (i, j, k), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            tile, lambda i, j, k: (i, j, k), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(dirs.astype(jnp.int32), seeds_or_invalid.astype(jnp.int32))
 
 
 def _apply_kernel(cap, old_ref, new_ref, lab_ref, out_ref):

@@ -237,31 +237,22 @@ def _tile_id_of(v: jnp.ndarray, shape, tile) -> jnp.ndarray:
 
 
 def build_remap_tables(
-    ea: jnp.ndarray,
-    eb: jnp.ndarray,
-    root_a: jnp.ndarray,
-    root_b: jnp.ndarray,
-    shape: Tuple[int, int, int],
-    tile: Tuple[int, int, int],
+    tile_ids: jnp.ndarray,
+    old_vals: jnp.ndarray,
+    new_vals: jnp.ndarray,
+    n_tiles: int,
     table_cap: int = DEFAULT_TABLE_CAP,
 ):
-    """Per-tile (old_label -> root) tables for the VMEM apply kernel.
+    """Per-tile (old_label -> new_label) tables for the VMEM apply kernel.
 
-    Returns ``(old_tbl, new_tbl, overflow)`` with tables shaped
+    ``tile_ids``: which tile each entry belongs to (``BIG`` = drop the
+    entry); duplicates of (tile, old) collapse to one slot.  Returns
+    ``(old_tbl, new_tbl, overflow)`` with tables shaped
     ``(n_tiles, table_cap)``; unused slots hold -1.
     """
-    z, y, x = shape
-    tz, ty, tx = tile
-    n_tiles = (z // tz) * (y // ty) * (x // tx)
-    v = jnp.concatenate([ea, eb])
-    r = jnp.concatenate([root_a, root_b])
-    changed = (v < BIG) & (r != v)
-    tid = jnp.where(changed, _tile_id_of(v, shape, tile), jnp.int32(BIG))
-    # sort by (tile, value); drop duplicates (same value appears in many edges)
-    tid, v, r = lax.sort((tid, v, r), num_keys=2)
+    tid, v, r = lax.sort((tile_ids, old_vals, new_vals), num_keys=2)
     dup = (tid == _shift1(tid, 0, -1)) & (v == _shift1(v, 0, -1))
     valid = (tid < BIG) & (~dup)
-    idx = jnp.arange(v.shape[0], dtype=jnp.int32)
     # within-tile slot rank counting only valid entries
     cnt = jnp.cumsum(valid.astype(jnp.int32))
     is_first = (tid != _shift1(tid, 0, -1)) & (tid < BIG)
@@ -365,8 +356,15 @@ def label_components_tiled(
     )
 
     if impl == "pallas":
+        n_tiles = (zp // tz) * (yp // ty) * (xp // tx)
+        v = jnp.concatenate([ea, eb])
+        r = jnp.concatenate([root_a, root_b])
+        changed = (v < BIG) & (r != v)
+        tids = jnp.where(
+            changed, _tile_id_of(v, (zp, yp, xp), tile), jnp.int32(BIG)
+        )
         old_tbl, new_tbl, tbl_overflow = build_remap_tables(
-            ea, eb, root_a, root_b, (zp, yp, xp), tile, table_cap=table_cap
+            tids, v, r, n_tiles, table_cap=table_cap
         )
 
         def fast(args):

@@ -1,0 +1,514 @@
+"""Two-level seeded watershed: in-tile pointer flow + small basin graphs.
+
+Round-2's ``seeded_watershed`` (ops/watershed.py) resolves the steepest-
+descent pointer forest with full-volume pointer jumping and grows labels into
+unseeded basins one voxel ring per iteration — both dominated by the TPU's
+~165M elem/s random-gather rate (see ops/tile_ccl.py for the measurements).
+This module keeps the exact same *descent semantics* (lex-min ``(height,
+flat_index)`` over the closed neighborhood — the reference's
+``vigra.watershedsNew`` per-block behavior, SURVEY.md §2a "watershed") but
+restructures the resolution:
+
+1. **Descent directions** (dense XLA): each voxel stores a 3-bit code for
+   which neighbor it drains to — no pointer table, no gathers.
+2. **In-tile flow** (``pallas_kernels.tile_ws_propagate_pallas``): labels
+   flow along the pointer forest *inside* (16, 16, 128) VMEM tiles as dense
+   select/shift steps to a fixpoint.  Each voxel ends with its basin's seed
+   label, the code of its unseeded in-tile terminal, or an *exit code*
+   naming the voxel its path leaves the tile through.
+3. **Exit chase** (XLA, small): unique exit codes are collected from tile
+   boundary strips (capacity-compacted), then chased across tiles by
+   pointer-jumping on arrays of edge size — basins are object-scale, so
+   chains are a few hops.
+4. **Apply**: per-tile value-remap tables (the ops/tile_ccl machinery) or a
+   gather fallback.
+5. **Unseeded-basin fill**: instead of ring-growing, basins without seeds
+   merge into their neighbor across the *lowest saddle* (Boruvka rounds on a
+   compacted basin-boundary edge list) — minimum-spanning-forest watershed
+   semantics, strictly closer to priority-flood than the old relaxation, and
+   O(log) rounds of small-array work instead of O(basin diameter) full-volume
+   sweeps.  Basins with no seeded reachable neighbor keep label 0 (legacy
+   behavior).
+
+When every basin is seeded (e.g. the oracle test's fully-seeded minima) the
+result is bit-identical to the legacy kernel; only unseeded-basin fill order
+differs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .ccl import _match_vma, _shift, _true_like
+from .pallas_kernels import WS_MARKER, WS_OFFS, ws_propagate_step
+from .tile_ccl import (
+    BIG,
+    DEFAULT_TABLE_CAP,
+    _compact,
+    _round_up,
+    _shift1,
+    _tile_for,
+    _tile_id_of,
+    build_remap_tables,
+)
+
+_BIGF = np.float32(3e38)
+
+DEFAULT_EXIT_CAP = 1 << 19
+DEFAULT_FILL_CAP = 1 << 19
+
+
+def _sortable_float_key(f: jnp.ndarray) -> jnp.ndarray:
+    """Monotone float32 -> int32 key (total order, NaN-free inputs)."""
+    u = lax.bitcast_convert_type(f.astype(jnp.float32), jnp.int32)
+    return u ^ ((u >> 31) & jnp.int32(0x7FFFFFFF))
+
+
+def descent_directions(
+    height: jnp.ndarray,
+    is_seed: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """Code 0..6 of each voxel's steepest-descent target (0 = self).
+
+    Identical tiebreak to ``watershed._descent_pointers``: lexicographic min
+    of ``(height, flat_index)`` over the closed 6-neighborhood; seeds and
+    invalid voxels are terminals.  Dense shifts only.
+    """
+    shape = height.shape
+    n = int(np.prod(shape))
+    z, y, x = shape
+    idx = jnp.arange(n, dtype=jnp.int32).reshape(shape)
+    h = jnp.where(valid, height.astype(jnp.float32), _BIGF)
+
+    best_h = h
+    best_i = idx
+    best_d = jnp.zeros(shape, jnp.int32)
+    for code, off in enumerate(WS_OFFS, start=1):
+        nh = h
+        ni = idx
+        for ax, s in enumerate(off):
+            if s:
+                nh = _shift(nh, -s, ax, _BIGF)
+                ni = _shift(ni, -s, ax, jnp.int32(n))
+        better = (nh < best_h) | ((nh == best_h) & (ni < best_i))
+        best_h = jnp.where(better, nh, best_h)
+        best_i = jnp.where(better, ni, best_i)
+        best_d = jnp.where(better, jnp.int32(code), best_d)
+    return jnp.where(is_seed | ~valid, 0, best_d)
+
+
+def tile_ws_propagate_xla(
+    dirs: jnp.ndarray, sv: jnp.ndarray, tile: Tuple[int, int, int]
+) -> jnp.ndarray:
+    """Portable in-tile pointer flow (same math as the Mosaic kernel)."""
+    z, y, x = dirs.shape
+    tz, ty, tx = tile
+    gz, gy, gx = z // tz, y // ty, x // tx
+
+    def to_tiles(a):
+        return (
+            a.reshape(gz, tz, gy, ty, gx, tx)
+            .transpose(0, 2, 4, 1, 3, 5)
+            .reshape(gz * gy * gx, tz, ty, tx)
+        )
+
+    def from_tiles(a):
+        return (
+            a.reshape(gz, gy, gx, tz, ty, tx)
+            .transpose(0, 3, 1, 4, 2, 5)
+            .reshape(z, y, x)
+        )
+
+    idx = jnp.arange(z * y * x, dtype=jnp.int32).reshape(z, y, x)
+    gidx = to_tiles(idx)
+    dirs_t = to_tiles(dirs)
+    sv_t = to_tiles(sv)
+    terminal = dirs_t == 0
+    value = jnp.where(
+        sv_t > 0, sv_t, jnp.where(terminal & (sv_t == 0), -gidx - 2, 0)
+    ).astype(jnp.int32)
+
+    def cond(s):
+        return s[1]
+
+    def body(s):
+        v, _ = s
+        v2 = ws_propagate_step(v, dirs_t, gidx, (1, 2, 3), y, x)
+        return v2, jnp.any(v2 != v)
+
+    value, _ = lax.while_loop(cond, body, (value, _true_like(value)))
+    return from_tiles(value)
+
+
+def _strip_entries(values: jnp.ndarray, tile, axis: int, side: int):
+    """(value, tile_id) arrays for one family of tile-boundary slabs."""
+    t = tile[axis]
+    n = values.shape[axis]
+    start = 0 if side == 0 else t - 1
+    sl = lax.slice_in_dim(values, start, n, stride=t, axis=axis)
+    shape = sl.shape
+    tz, ty, tx = tile
+    div = [tz, ty, tx]
+    ids = []
+    for ax in range(3):
+        io = lax.broadcasted_iota(jnp.int32, shape, ax)
+        if ax == axis:
+            ids.append(io)  # slab index == tile index along the sliced axis
+        else:
+            ids.append(io // div[ax])
+    z, y, x = values.shape
+    gy, gx = y // ty, x // tx
+    tid = (ids[0] * gy + ids[1]) * gx + ids[2]
+    return sl, tid
+
+
+def collect_negative_values(
+    values: jnp.ndarray, tile: Tuple[int, int, int], cap: int
+):
+    """Deduped (value, tile_id) pairs for negative labels on tile boundaries.
+
+    Every cross-tile fragment touches a boundary strip of each tile it
+    occupies, so this covers all (tile, value) incidences needed for exits
+    and fill remaps.  Returns ``(vals, tids, overflow)``.
+    """
+    vs, ts = [], []
+    overflow = _match_vma(jnp.zeros((), jnp.int32), values)
+    for axis in range(3):
+        for side in (0, 1):
+            sl, tid = _strip_entries(values, tile, axis, side)
+            neg = sl <= -2
+            dedup_axis = 2 if axis != 2 else 1
+            prev = _shift1(sl, dedup_axis, -1)
+            prev_t = _shift1(tid, dedup_axis, -1)
+            keep = neg & ((sl != prev) | (tid != prev_t))
+            (v, t_), kept = _compact(keep, (sl, tid), cap, BIG)
+            overflow = jnp.maximum(overflow, (kept > cap).astype(jnp.int32))
+            vs.append(v)
+            ts.append(t_)
+    v = jnp.concatenate(vs)
+    t_ = jnp.concatenate(ts)
+    v, t_ = lax.sort((v, t_), num_keys=2)
+    dup = (v == _shift1(v, 0, BIG)) & (t_ == _shift1(t_, 0, BIG))
+    keep = (~dup) & (v < BIG)
+    (cv, ct), n_kept = _compact(keep, (v, t_), cap, BIG)
+    overflow = jnp.maximum(overflow, (n_kept > cap).astype(jnp.int32))
+    return cv, ct, overflow > 0
+
+
+def value_join(
+    query_vals: jnp.ndarray, table_vals: jnp.ndarray, table_finals: jnp.ndarray
+) -> jnp.ndarray:
+    """For each query value, the table's final (or the query itself if absent).
+
+    Sort-based join — ``searchsorted`` lowers to a binary-search gather chain
+    that measured ~50x slower than a sort at these sizes on TPU.
+    """
+    nq = query_vals.shape[0]
+    nt = table_vals.shape[0]
+    keys = jnp.concatenate([table_vals, query_vals])
+    is_query = jnp.concatenate(
+        [jnp.zeros((nt,), jnp.int32), jnp.ones((nq,), jnp.int32)]
+    )
+    payload = jnp.concatenate([table_finals, query_vals])
+    slot = jnp.concatenate(
+        [jnp.full((nt,), -1, jnp.int32), jnp.arange(nq, dtype=jnp.int32)]
+    )
+    keys, is_query, payload, slot = lax.sort(
+        (keys, is_query, payload, slot), num_keys=2
+    )
+    pos = jnp.arange(nt + nq, dtype=jnp.int32)
+    last_tbl = lax.cummax(jnp.where(is_query == 0, pos, -1))
+    tbl_key = keys[jnp.clip(last_tbl, 0, nt + nq - 1)]
+    tbl_fin = payload[jnp.clip(last_tbl, 0, nt + nq - 1)]
+    res = jnp.where((last_tbl >= 0) & (tbl_key == keys), tbl_fin, keys)
+    out = jnp.zeros((nq,), jnp.int32)
+    out = out.at[jnp.where(is_query == 1, slot, nq)].set(res, mode="drop")
+    return out
+
+
+def chase_exits(values: jnp.ndarray, codes: jnp.ndarray, max_hops: int = 256):
+    """Resolve exit codes by following values across tiles.
+
+    ``codes``: negative codes (``BIG``-padded).  Returns ``(finals,
+    unconverged)``: the final value each code's chain reaches (a seed label
+    (>0), 0, or the unseeded terminal code of its basin), and a flag that is
+    True when a chain exceeded ``max_hops`` (finals then hold intermediate
+    codes — callers must fold this into their overflow report).
+    """
+    n = values.size
+    flat = values.ravel()
+    active0 = codes <= -2
+    g = jnp.where(active0, -codes - 2, 0)
+    val = jnp.where(active0, flat[jnp.clip(g, 0, n - 1)], codes)
+
+    def cond(s):
+        _, _, moved, hops = s
+        return moved & (hops < max_hops)
+
+    def body(s):
+        g, val, _, hops = s
+        active = (val <= -2) & (val != -g - 2)
+        g2 = jnp.where(active, -val - 2, g)
+        val2 = jnp.where(active, flat[jnp.clip(g2, 0, n - 1)], val)
+        return g2, val2, jnp.any(active), hops + 1
+
+    g, val, moved, _ = lax.while_loop(
+        cond, body, (g, val, _true_like(g), jnp.int32(0))
+    )
+    return jnp.where(active0, val, codes), moved
+
+
+def _resolve_codes_gather(values: jnp.ndarray, codes, finals) -> jnp.ndarray:
+    """Fallback apply: scatter code resolutions into a voxel-indexed table."""
+    n = values.size
+    table = _match_vma(-jnp.arange(n, dtype=jnp.int32) - 2, values)
+    pos = jnp.where(codes <= -2, -codes - 2, n)
+    table = table.at[pos].set(finals, mode="drop")
+    flat = values.ravel()
+    looked = table[jnp.clip(-flat - 2, 0, n - 1)]
+    return jnp.where(flat <= -2, looked, flat).reshape(values.shape)
+
+
+def fill_unseeded_basins(
+    labels: jnp.ndarray,
+    height: jnp.ndarray,
+    fill_cap: int = DEFAULT_FILL_CAP,
+    max_rounds: int = 16,
+):
+    """Merge unseeded basins across their lowest saddles (Boruvka rounds).
+
+    ``labels``: >0 seeded basin label, <= -2 unseeded basin code, 0 invalid.
+    Returns ``(edge_vals, edge_finals, overflow)`` — the remap (old basin
+    code -> final label, 0 if unreachable) for every unseeded basin seen on
+    a boundary, for the caller to apply.
+    """
+    h = height.astype(jnp.float32)
+    evs_a, evs_b, evs_h = [], [], []
+    overflow = _match_vma(jnp.zeros((), jnp.int32), labels)
+    for axis in range(3):
+        na = labels.shape[axis]
+        a = lax.slice_in_dim(labels, 0, na - 1, axis=axis)
+        b = lax.slice_in_dim(labels, 1, na, axis=axis)
+        ha = lax.slice_in_dim(h, 0, na - 1, axis=axis)
+        hb = lax.slice_in_dim(h, 1, na, axis=axis)
+        saddle = _sortable_float_key(jnp.maximum(ha, hb))
+        flag = (a != b) & (a != 0) & (b != 0) & ((a < 0) | (b < 0))
+        dedup_axis = 2 if axis != 2 else 1
+        keep = flag & (
+            (a != _shift1(a, dedup_axis, 0)) | (b != _shift1(b, dedup_axis, 0))
+        )
+        (pa, pb, ph), kept = _compact(keep, (a, b, saddle), fill_cap, BIG)
+        overflow = jnp.maximum(overflow, (kept > fill_cap).astype(jnp.int32))
+        evs_a.append(pa)
+        evs_b.append(pb)
+        evs_h.append(ph)
+    a = jnp.concatenate(evs_a)
+    b = jnp.concatenate(evs_b)
+    hk = jnp.concatenate(evs_h)
+
+    # dense ids over all endpoint values
+    m2 = a.shape[0] * 2
+    vals = jnp.concatenate([a, b])
+    slots = jnp.arange(m2, dtype=jnp.int32)
+    sv, ss = lax.sort((vals, slots), num_keys=1)
+    is_new = sv != _shift1(sv, 0, -BIG)
+    rank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    uniq = jnp.full((m2,), jnp.int32(BIG)).at[rank].set(sv)
+    dense = jnp.zeros((m2,), jnp.int32).at[ss].set(rank)
+    da, db = dense[: a.shape[0]], dense[a.shape[0]:]
+    edge_pad = a >= BIG
+
+    parent = _match_vma(jnp.arange(m2, dtype=jnp.int32), labels)
+
+    def round_cond(s):
+        _, changed, it = s
+        return changed & (it < max_rounds)
+
+    eid = jnp.arange(a.shape[0], dtype=jnp.int32)
+
+    def round_body(s):
+        P, _, it = s
+        ra = P[da]
+        rb = P[db]
+        alive = (ra != rb) & (~edge_pad)
+        # orient every edge both ways; only negative-valued roots hook.
+        # Composite weight (saddle, edge_id) is globally distinct and seen
+        # identically from both endpoints, so the min-edge graph is a forest
+        # plus 2-cycles only (the classic Boruvka distinct-weight argument) —
+        # ties on raw saddle height cannot form longer hook cycles.
+        keys = jnp.concatenate([ra, rb])
+        partners = jnp.concatenate([rb, ra])
+        sk = jnp.concatenate([hk, hk])
+        ek = jnp.concatenate([eid, eid])
+        live = jnp.concatenate([alive, alive]) & (
+            jnp.concatenate([uniq[ra], uniq[rb]]) <= -2
+        )
+        keys = jnp.where(live, keys, jnp.int32(BIG))
+        keys, _, _, partners = lax.sort((keys, sk, ek, partners), num_keys=3)
+        first = (keys != _shift1(keys, 0, BIG)) & (keys < BIG)
+        np_ = P.shape[0]
+        parent2 = jnp.arange(np_, dtype=jnp.int32)
+        parent2 = parent2.at[jnp.where(first, keys, np_)].set(
+            jnp.where(first, partners, 0), mode="drop"
+        )
+        # break 2-cycles: the lower id stays a root
+        pp = parent2[parent2]
+        me = jnp.arange(np_, dtype=jnp.int32)
+        parent2 = jnp.where((pp == me) & (me < parent2), me, parent2)
+        # jump to closure
+        parent2 = parent2[parent2]
+        parent2 = parent2[parent2]
+        parent2 = parent2[parent2]
+        newP = parent2[P]
+        return newP, jnp.any(newP != P), it + 1
+
+    parent, unconverged, _ = lax.while_loop(
+        round_cond, round_body, (parent, _true_like(da), jnp.int32(0))
+    )
+    # a max_rounds exit leaves basins mid-chain: report, never hide
+    overflow = jnp.maximum(overflow, unconverged.astype(jnp.int32))
+
+    root_val = uniq[parent]
+    final_of = jnp.where(root_val > 0, root_val, 0)
+    # remap for every unseeded endpoint value
+    edge_vals = uniq
+    edge_finals = jnp.where(uniq <= -2, final_of, uniq)
+    return edge_vals, edge_finals, overflow > 0
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "impl", "tile", "exit_cap", "fill_cap", "table_cap", "interpret",
+    ),
+)
+def seeded_watershed_tiled(
+    height: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    impl: str = "auto",
+    tile: Optional[Tuple[int, int, int]] = None,
+    exit_cap: int = DEFAULT_EXIT_CAP,
+    fill_cap: int = DEFAULT_FILL_CAP,
+    table_cap: int = DEFAULT_TABLE_CAP,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Seeded watershed with the two-level tile machinery.
+
+    Contract matches :func:`~cluster_tools_tpu.ops.watershed.seeded_watershed`
+    (labels int32, 0 outside mask / unreachable) up to unseeded-basin fill
+    order: unseeded basins take the label across their lowest saddle
+    (minimum-spanning-forest watershed) rather than ring-growing.  Returns
+    ``(labels, overflow)``.
+    """
+    if height.ndim != 3:
+        raise ValueError("seeded_watershed_tiled expects a 3-D volume")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    z, y, x = height.shape
+    tile = _tile_for(height.shape) if tile is None else tile
+    tz, ty, tx = tile
+    zp, yp, xp = _round_up(z, tz), _round_up(y, ty), _round_up(x, tx)
+    if zp * yp * xp >= BIG:
+        raise ValueError(
+            f"padded volume {(zp, yp, xp)} has >= 2**30 voxels; shard it"
+        )
+    padded = (zp != z) or (yp != y) or (xp != x)
+    valid = jnp.ones(height.shape, bool) if mask is None else mask.astype(bool)
+    h = height.astype(jnp.float32)
+    s = seeds.astype(jnp.int32)
+    if padded:
+        pads = ((0, zp - z), (0, yp - y), (0, xp - x))
+        h = jnp.pad(h, pads, constant_values=_BIGF)
+        s = jnp.pad(s, pads)
+        valid = jnp.pad(valid, pads)
+
+    dirs = descent_directions(h, s > 0, valid)
+    sv = jnp.where(valid, s, -1)
+
+    if impl == "pallas":
+        from .pallas_kernels import apply_remap_pallas, tile_ws_propagate_pallas
+
+        values = tile_ws_propagate_pallas(dirs, sv, tile=tile, interpret=interpret)
+    else:
+        values = tile_ws_propagate_xla(dirs, sv, tile)
+
+    # cross-tile exits: collect, chase, remap
+    codes, code_tiles, overflow = collect_negative_values(values, tile, exit_cap)
+    finals, chase_unconverged = chase_exits(values, codes)
+    overflow = overflow | chase_unconverged
+    n_tiles = (zp // tz) * (yp // ty) * (xp // tx)
+
+    if impl == "pallas":
+        changed = (codes <= -2) & (finals != codes)
+        tids = jnp.where(changed, code_tiles, jnp.int32(BIG))
+        old_tbl, new_tbl, tbl_overflow = build_remap_tables(
+            tids, codes, finals, n_tiles, table_cap=table_cap
+        )
+
+        def fast(args):
+            v, o, nw = args
+            return apply_remap_pallas(
+                v, o, nw, tile=tile, cap=table_cap, interpret=interpret
+            )
+
+        def slow(args):
+            v, _, _ = args
+            return _resolve_codes_gather(v, codes, finals)
+
+        values = lax.cond(tbl_overflow, slow, fast, (values, old_tbl, new_tbl))
+    else:
+        values = _resolve_codes_gather(values, codes, finals)
+
+    # unseeded-basin fill across lowest saddles
+    fill_vals, fill_finals, fill_overflow = fill_unseeded_basins(
+        values, h, fill_cap=fill_cap
+    )
+    overflow = overflow | fill_overflow
+
+    if impl == "pallas":
+        # tiles needing a basin's entry: strip incidences + the terminal's tile
+        bvals, btiles, b_overflow = collect_negative_values(values, tile, exit_cap)
+        overflow = overflow | b_overflow
+        # map each (value, tile) incidence to its fill final
+        bfin = value_join(bvals, fill_vals, fill_finals)
+        # terminal-tile incidences for interior basins
+        tvals = fill_vals
+        t_of = _tile_id_of(jnp.where(tvals <= -2, -tvals - 2, 0), (zp, yp, xp), tile)
+        ttiles = jnp.where(tvals <= -2, t_of, jnp.int32(BIG))
+        all_vals = jnp.concatenate([bvals, tvals])
+        all_fin = jnp.concatenate([bfin, jnp.where(tvals <= -2, fill_finals, tvals)])
+        all_tiles = jnp.concatenate(
+            [jnp.where((bvals <= -2) & (bfin != bvals), btiles, jnp.int32(BIG)),
+             jnp.where((tvals <= -2) & (fill_finals != tvals), ttiles, jnp.int32(BIG))]
+        )
+        old2, new2, tbl_overflow2 = build_remap_tables(
+            all_tiles, all_vals, all_fin, n_tiles, table_cap=table_cap
+        )
+
+        def fast2(args):
+            v, o, nw = args
+            return apply_remap_pallas(
+                v, o, nw, tile=tile, cap=table_cap, interpret=interpret
+            )
+
+        def slow2(args):
+            v, _, _ = args
+            return _resolve_codes_gather(v, fill_vals, fill_finals)
+
+        values = lax.cond(tbl_overflow2, slow2, fast2, (values, old2, new2))
+    else:
+        values = _resolve_codes_gather(values, fill_vals, fill_finals)
+
+    # leftover negatives (basins with no seeded reachable neighbor) -> 0
+    out = jnp.where(values > 0, values, 0).astype(jnp.int32)
+    if padded:
+        out = out[:z, :y, :x]
+    return out, overflow

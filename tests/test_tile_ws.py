@@ -1,0 +1,136 @@
+"""Two-level (tile + basin-graph) watershed vs the legacy kernel and oracles.
+
+Covers the TPU fast path's portable half (XLA tile phase + exit chase +
+saddle-union fill) and the Mosaic kernels in interpreter mode.  Descent
+semantics must be bit-identical to ``ops.watershed.seeded_watershed`` when
+every basin is seeded; unseeded-basin fill is minimum-spanning-forest
+(lowest-saddle) order, checked by property tests (reference semantics:
+SURVEY.md §2a "watershed" — vigra floods every voxel from the seed set).
+"""
+
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.watershed import local_maxima, seeded_watershed
+from cluster_tools_tpu.ops.tile_ws import seeded_watershed_tiled
+
+
+def test_all_minima_seeded_matches_legacy(rng):
+    # fully seeded: no fill; must equal the legacy kernel bit for bit
+    shape = (16, 16, 128)
+    height = rng.permutation(np.prod(shape)).reshape(shape).astype(np.float32)
+    minima = np.asarray(local_maxima(jnp.asarray(-height)))
+    seeds = np.zeros(shape, np.int32)
+    seeds[minima] = np.arange(1, minima.sum() + 1)
+    legacy = np.asarray(seeded_watershed(jnp.asarray(height), jnp.asarray(seeds)))
+    got, ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    assert not bool(ovf)
+    np.testing.assert_array_equal(np.asarray(got), legacy)
+
+
+def test_all_voxels_labeled_sparse_seeds(rng):
+    shape = (24, 24, 130)  # padding path too
+    height = rng.random(shape).astype(np.float32)
+    seeds = np.zeros(shape, np.int32)
+    seeds[4, 4, 10] = 1
+    seeds[20, 20, 100] = 2
+    got, ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    assert not bool(ovf)
+    got = np.asarray(got)
+    assert (got > 0).all()
+    assert set(np.unique(got)) <= {1, 2}
+    assert got[4, 4, 10] == 1 and got[20, 20, 100] == 2
+
+
+def test_regions_connected(rng):
+    shape = (20, 20, 128)
+    height = rng.random(shape).astype(np.float32)
+    seeds = np.zeros(shape, np.int32)
+    seeds[2, 2, 10] = 1
+    seeds[17, 17, 100] = 2
+    seeds[2, 17, 60] = 3
+    got, _ = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    got = np.asarray(got)
+    for l in (1, 2, 3):
+        region = got == l
+        if region.any():
+            _, n = ndi.label(region, structure=ndi.generate_binary_structure(3, 1))
+            assert n == 1, f"label {l} split into {n} pieces"
+
+
+def test_respects_mask(rng):
+    shape = (16, 16, 128)
+    height = rng.random(shape).astype(np.float32)
+    mask = np.ones(shape, bool)
+    mask[:, :, 64] = False  # wall splits the volume
+    seeds = np.zeros(shape, np.int32)
+    seeds[8, 8, 10] = 1
+    seeds[8, 8, 100] = 2
+    got, _ = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), jnp.asarray(mask), impl="xla"
+    )
+    got = np.asarray(got)
+    assert (got[~mask] == 0).all()
+    assert (got[:, :, :64][mask[:, :, :64]] == 1).all()
+    assert (got[:, :, 65:][mask[:, :, 65:]] == 2).all()
+
+
+def test_unreachable_basin_stays_zero(rng):
+    # an unseeded pocket enclosed by mask keeps label 0 (legacy behavior)
+    shape = (16, 16, 128)
+    height = rng.random(shape).astype(np.float32)
+    mask = np.ones(shape, bool)
+    mask[4:9, 4:9, 30] = False
+    mask[4:9, 4:9, 40] = False
+    mask[4:9, [4, 8], 31:40] = False
+    mask[[4, 8], 4:9, 31:40] = False
+    seeds = np.zeros(shape, np.int32)
+    seeds[1, 1, 1] = 1
+    got, _ = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), jnp.asarray(mask), impl="xla"
+    )
+    got = np.asarray(got)
+    pocket = np.zeros(shape, bool)
+    pocket[5:8, 5:8, 31:40] = True
+    assert (got[pocket & mask] == 0).all()
+    # everything connected to the seed is labeled 1
+    outside = mask.copy()
+    outside[3:10, 3:10, 29:41] = False
+    assert (got[outside] == 1).all()
+
+
+def test_pallas_interpret_matches_xla(rng):
+    shape = (16, 32, 128)
+    height = rng.random(shape).astype(np.float32)
+    seeds = np.zeros(shape, np.int32)
+    pts = rng.integers(0, [16, 32, 128], size=(5, 3))
+    for i, p in enumerate(pts):
+        seeds[tuple(p)] = i + 1
+    a, ovf_a = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    b, ovf_b = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="pallas", interpret=True
+    )
+    assert not bool(ovf_a) and not bool(ovf_b)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overflow_flag(rng):
+    height = rng.random((32, 32, 128)).astype(np.float32)
+    seeds = np.zeros((32, 32, 128), np.int32)
+    seeds[0, 0, 0] = 1
+    _, ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla",
+        exit_cap=8, fill_cap=8,
+    )
+    assert bool(ovf)
