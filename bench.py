@@ -1,29 +1,36 @@
 """North-star benchmark: fused blockwise watershed+CCL to globally merged labels.
 
 Mirrors BASELINE.json's metric ("voxels/sec on CREMI blockwise watershed+CCL;
-wall-clock to merged labels").  The whole pipeline — halo exchange, fused
-DT-watershed per slab, two-pass union-find CC merge — runs as ONE compiled
-SPMD program over the device mesh (see cluster_tools_tpu/parallel/pipeline.py).
+wall-clock to merged labels") and covers the BASELINE config list:
 
-Hardened for the driver session (round-1 postmortem: rc=124 with no output):
+- config 1: connected components on a 512^3 binary volume (tiled two-level CCL)
+- config 2: distance-transform watershed, halo=32 (fused DT+seeds+flood)
+- config 3: watershed + label-merge to globally merged labels (the fused SPMD
+  step — per-shard watershed, cross-shard union-find collectives); this is
+  the headline metric
+- config 4: region-adjacency graph + multicut (GAEC) agglomeration on the
+  watershed fragments of a crop
 
-- The accelerator backend is probed in a SUBPROCESS with a timeout.  The
-  session's ``axon`` PJRT plugin dials a TPU tunnel on first backend init,
-  which can hang for many minutes when the tunnel is down; a hung probe must
-  not take the whole benchmark with it.  On probe timeout/failure the bench
-  forces ``JAX_PLATFORMS=cpu`` and still emits its JSON line.
-- Every stage prints a timestamped progress line to STDERR (stdout carries
-  exactly one JSON line), so a driver-side timeout leaves a diagnosable tail.
-- Volume sizes adapt to the backend: BASELINE.md-scale (512-extent,
-  halo>=16) on an accelerator, reduced sizes on the CPU fallback.
+Hardening (round-1 postmortem: rc=124 with no output):
+
+- The accelerator backend is probed in a SUBPROCESS with a timeout; on
+  timeout/failure the bench pins CPU and still emits its JSON line.
+- Every stage prints a timestamped line to STDERR; stdout carries exactly one
+  JSON line.
+
+Honest timing (round-3 postmortem): on the tunneled ``axon`` platform,
+``jax.block_until_ready`` returns after *enqueue*, not completion — round 2's
+numbers were transfer/dispatch artifacts.  Every timed region here therefore
+synchronizes by fetching a scalar element of each output (a real device
+round-trip, ~tens of ms, included in the measurement), and the benchmark
+volume is synthesized ON DEVICE (the tunnel moves host arrays at ~50MB/s;
+uploading a 537MB volume per run would swamp compute).  The per-stage
+breakdown (VERDICT r2 #2) goes to stderr and the JSON ``stages_ms`` object.
 
 The reference publishes no numbers (BASELINE.json "published": {}), so
 ``vs_baseline`` measures against the equivalent single-core host (scipy)
-pipeline run in-process on the same data — the reference's per-job compute
-path without scheduler overhead, i.e. one worker of its 32-node baseline.
-``vs_32core`` divides by 32 as the whole-cluster stand-in.
-
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+pipeline on the same data — one worker of the reference's 32-node baseline —
+and ``vs_32core`` divides by 32 as the whole-cluster stand-in.
 """
 
 from __future__ import annotations
@@ -39,7 +46,7 @@ import numpy as np
 
 _T0 = time.monotonic()
 PROBE_TIMEOUT = float(os.environ.get("CT_BENCH_PROBE_TIMEOUT", "240"))
-ACCEL_PLATFORMS = ("tpu", "axon")  # platforms treated as the bench target
+ACCEL_PLATFORMS = ("tpu", "axon")
 
 
 def log(msg: str) -> None:
@@ -61,9 +68,6 @@ def _probe_accelerator(timeout: float) -> str | None:
         "print('PROBE_RESULT:' + ','.join(plats), flush=True)\n"
     )
     log(f"probing accelerator backend in subprocess (timeout {timeout:.0f}s)")
-    # own session + process-group kill: the PJRT plugin may spawn tunnel
-    # helpers that inherit the pipes and would keep communicate() blocked
-    # forever after a plain subprocess.run timeout kill
     proc = subprocess.Popen(
         [sys.executable, "-c", code],
         stdout=subprocess.PIPE,
@@ -93,6 +97,34 @@ def _probe_accelerator(timeout: float) -> str | None:
     return None
 
 
+def _sync(out) -> None:
+    """Force completion by fetching one element of every output leaf.
+
+    ``block_until_ready`` is NOT sufficient on the tunneled axon platform —
+    it returns after enqueue.  A d2h fetch of a single element cannot
+    complete before the producing computation has.
+    """
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        arr = leaf.ravel()[0] if getattr(leaf, "ndim", 0) else leaf
+        np.asarray(jax.device_get(arr))
+
+
+def _timeit(name, fn, *args, runs=3):
+    """(best_seconds, last_output); compiles on the first (untimed) call."""
+    out = fn(*args)
+    _sync(out)
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync(out)
+        best = min(best, time.perf_counter() - t0)
+    log(f"{name}: best of {runs} = {best:.3f}s")
+    return best, out
+
+
 def _host_baseline_vps(vol: np.ndarray, threshold: float) -> float:
     """voxels/sec of the equivalent scipy pipeline (single core, in-process)."""
     from scipy import ndimage
@@ -109,6 +141,37 @@ def _host_baseline_vps(vol: np.ndarray, threshold: float) -> float:
     return vol.size / dt
 
 
+def _host_rag_gaec(seg: np.ndarray, boundaries: np.ndarray) -> float:
+    """Wall-clock of a single-core numpy RAG + host GAEC on the same crop."""
+    t0 = time.perf_counter()
+    pairs = []
+    vals = []
+    for axis in range(3):
+        sl_a = tuple(slice(0, -1) if d == axis else slice(None) for d in range(3))
+        sl_b = tuple(slice(1, None) if d == axis else slice(None) for d in range(3))
+        u, v = seg[sl_a].ravel(), seg[sl_b].ravel()
+        m = (u != v) & (u != 0) & (v != 0)
+        pairs.append(
+            np.stack([np.minimum(u[m], v[m]), np.maximum(u[m], v[m])], 1)
+        )
+        vals.append(np.maximum(boundaries[sl_a].ravel()[m], boundaries[sl_b].ravel()[m]))
+    pr = np.concatenate(pairs)
+    bv = np.concatenate(vals)
+    uv, inv, sizes = np.unique(pr, axis=0, return_inverse=True, return_counts=True)
+    mean = np.zeros(len(uv))
+    np.add.at(mean, inv.ravel(), bv)
+    mean /= sizes
+    from cluster_tools_tpu.tasks.costs import compute_costs
+    from cluster_tools_tpu.ops.multicut import greedy_additive
+
+    dense = np.unique(uv)
+    remap = {int(g): i for i, g in enumerate(dense)}
+    e = np.array([[remap[int(a)], remap[int(b)]] for a, b in uv], np.int64)
+    costs = compute_costs(mean.astype(np.float32))
+    greedy_additive(len(dense), e, costs)
+    return time.perf_counter() - t0
+
+
 def main():
     log(f"start; env JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}")
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -117,15 +180,15 @@ def main():
     else:
         accel = _probe_accelerator(PROBE_TIMEOUT)
     if accel is None:
-        # pin to CPU before the first in-process backend init (env + config,
-        # beating the sitecustomize's own jax.config.update)
         from __graft_entry__ import _force_cpu_platform
 
         _force_cpu_platform(8)
 
     import jax
+    import jax.numpy as jnp
 
-    from __graft_entry__ import _synthetic_boundaries
+    from cluster_tools_tpu.ops.tile_ccl import label_components_tiled
+    from cluster_tools_tpu.ops.tile_ws import dt_watershed_tiled
     from cluster_tools_tpu.parallel.mesh import make_mesh, mesh_axis_sizes
     from cluster_tools_tpu.parallel.pipeline import make_ws_ccl_step
 
@@ -147,63 +210,159 @@ def main():
     dp, sp = sizes["dp"], sizes["sp"]
 
     threshold = 0.45
-    if backend in ACCEL_PLATFORMS:
-        # BASELINE.md scale: 512-extent volume, halo >= 16 (config 2);
-        # each sp shard's z-slab must stay >= halo for the exchange
-        halo = 16
+    on_accel = backend in ACCEL_PLATFORMS
+    if on_accel:
+        # BASELINE config 2 scale: 512-extent volume, halo=32
+        halo = 32
         batch, z, y, x = dp, sp * max(halo, 512 // sp), 512, 512
     else:
         halo = 8
-        batch, z, y, x = dp, sp * max(halo, 32), 128, 128
+        batch, z, y, x = dp, sp * max(halo, 32), 64, 128
     log(f"mesh dp={dp} sp={sp}; volume ({batch},{z},{y},{x}), halo={halo}")
-    vol = _synthetic_boundaries((batch, z, y, x))
-    log("synthetic volume ready")
 
-    # EDT capped at the halo scale: beyond it, distances are halo-clipped
-    # anyway, and the cascade cost is linear in the cap
-    step = make_ws_ccl_step(
-        mesh, halo=halo, threshold=threshold, dt_max_distance=float(halo)
-    )
-    log("compiling + warming up fused ws+ccl step")
+    # deterministic CREMI-like boundary map, synthesized ON DEVICE (see
+    # module docstring: the tunnel cannot feed host arrays at benchmark rate)
+    @jax.jit
+    def synth(key):
+        v = jax.random.uniform(key, (batch, z, y, x), jnp.float32)
+        # 4 box passes per axis: object scale like a (downsampled) CREMI
+        # boundary map rather than voxel-scale noise plateaus
+        for axis in range(1, 4):
+            for _ in range(4):
+                v = (v + jnp.roll(v, 1, axis) + jnp.roll(v, -1, axis)) / 3.0
+        lo, hi = v.min(), v.max()
+        return (v - lo) / jnp.maximum(hi - lo, 1e-6)
+
     t0 = time.perf_counter()
-    jax.block_until_ready(step(vol))
-    log(f"compile+warmup done in {time.perf_counter() - t0:.1f}s")
+    vol = synth(jax.random.PRNGKey(0))
+    _sync(vol)
+    log(f"on-device synthetic volume ready in {time.perf_counter() - t0:.1f}s")
 
-    times = []
-    for i in range(3):
-        t0 = time.perf_counter()
-        jax.block_until_ready(step(vol))
-        times.append(time.perf_counter() - t0)
-        log(f"timed run {i + 1}/3: {times[-1]:.3f}s")
-    vps = vol.size / min(times)
-    log(f"device throughput: {vps:,.0f} voxels/s")
+    # ---- headline / config 3: fused watershed + merged-CC step ----
+    min_seed_distance = 2.0  # reference configs suppress sub-voxel seed plateaus
+    step = make_ws_ccl_step(
+        mesh, halo=halo, threshold=threshold, dt_max_distance=float(halo),
+        min_seed_distance=min_seed_distance, impl="auto",
+    )
+    log("config 3 (headline): compiling fused ws+ccl step")
+    t_fused, out = _timeit("fused ws+ccl step", step, vol)
+    ws_lab, cc_lab, n_fg, overflow = out
+    n_fg = int(n_fg)
+    overflow = bool(overflow)
+    vps = vol.size / t_fused
+    log(
+        f"fused: {vps:,.0f} voxels/s, n_fg={n_fg}, overflow={overflow}"
+    )
 
-    # host baseline on a crop, extrapolated per-voxel
-    crop_z, crop_yx = min(128, z), min(128, y)
-    crop = vol[0, :crop_z, :crop_yx, :crop_yx]
+    # ---- config 1: connected components on the binary mask ----
+    fg3 = (vol < threshold)[0]
+    cc1 = jax.jit(lambda m: label_components_tiled(m, impl="auto"))
+    t_cc, (_, cc_ovf) = _timeit("config 1: tiled CCL on binary mask", cc1, fg3)
+    log(f"config 1 overflow={bool(cc_ovf)}")
+
+    # ---- config 2: DT watershed alone (halo-free single block) ----
+    ws1 = jax.jit(
+        lambda b: dt_watershed_tiled(
+            b, threshold=threshold, dt_max_distance=float(halo),
+            min_seed_distance=min_seed_distance, impl="auto",
+        )
+    )
+    t_ws, (_, ws_ovf) = _timeit("config 2: fused DT watershed", ws1, vol[0])
+    log(f"config 2 overflow={bool(ws_ovf)}")
+
+    # ---- per-stage breakdown (VERDICT r2 #2) ----
+    from cluster_tools_tpu.ops.edt import distance_transform_squared
+    from cluster_tools_tpu.ops.watershed import local_maxima
+
+    stages = {}
+    b0 = vol[0]
+    fgm = jax.jit(lambda v: (v < threshold))
+    stages["threshold"], fg_ = _timeit("stage threshold", fgm, b0, runs=2)
+    edt = jax.jit(
+        lambda m: distance_transform_squared(m, max_distance=float(halo))
+    )
+    stages["edt"], dist_ = _timeit("stage edt", edt, fg_, runs=2)
+    msd2 = min_seed_distance * min_seed_distance
+    mx = jax.jit(lambda d, m: local_maxima(d, 1) & m & (d >= msd2))
+    stages["maxima"], maxima_ = _timeit("stage maxima", mx, dist_, fg_, runs=2)
+    sccl = jax.jit(lambda m: label_components_tiled(m, impl="auto")[0])
+    stages["seed_ccl"], _ = _timeit("stage seed CCL", sccl, maxima_, runs=2)
+    stages["ws_total"] = t_ws
+    stages["cc_total"] = t_cc
+    stages_ms = {k: round(v * 1000, 1) for k, v in stages.items()}
+    log(f"stages: {stages_ms}")
+
+    # ---- host baseline on a crop ----
+    crop_n = 128 if on_accel else 32
+    crop = np.asarray(vol[0, :crop_n, :crop_n, :crop_n])
     log(f"running single-core scipy baseline on crop {crop.shape}")
-    base_vps = _host_baseline_vps(np.asarray(crop), threshold)
+    base_vps = _host_baseline_vps(crop, threshold)
     log(f"baseline throughput: {base_vps:,.0f} voxels/s (single core)")
 
-    print(
-        json.dumps(
-            {
-                "metric": "fused watershed+CCL merged labels",
-                "value": round(vps, 1),
-                "unit": "voxels/sec",
-                "vs_baseline": round(vps / base_vps, 3),
-                "vs_32core": round(vps / (32 * base_vps), 3),
-                "backend": backend,
-                "mesh": {"dp": dp, "sp": sp},
-                "volume": list(vol.shape),
-                "halo": halo,
-                "baseline": "single-core scipy pipeline (reference per-job compute path)",
-                "baseline_voxels_per_sec": round(base_vps, 1),
-                "best_run_seconds": round(min(times), 3),
-            }
-        ),
-        flush=True,
+    # ---- config 4: RAG + multicut agglomeration on a ws-fragment crop ----
+    from cluster_tools_tpu.tasks.costs import compute_costs
+    from cluster_tools_tpu.ops.multicut import greedy_additive
+    from cluster_tools_tpu.ops.rag import block_rag
+
+    rag_n = 128 if on_accel else 32
+    seg_crop = np.asarray(ws_lab[0, :rag_n, :rag_n, :rag_n])
+    bnd_crop = np.asarray(vol[0, :rag_n, :rag_n, :rag_n])
+    t0 = time.perf_counter()
+    uv, rag_sizes, feats = block_rag(seg_crop, bnd_crop)
+    dense = np.unique(uv)
+    remap = np.zeros(int(dense.max()) + 2, np.int64) if len(dense) else None
+    if remap is not None:
+        remap[dense.astype(np.int64)] = np.arange(len(dense))
+        e = remap[uv.astype(np.int64)]
+        costs = compute_costs(feats[:, 0])
+        greedy_additive(len(dense), e, costs)
+    t_rag = time.perf_counter() - t0
+    log(
+        f"config 4: RAG+GAEC on {seg_crop.shape}: {t_rag:.3f}s "
+        f"({len(uv)} edges, {len(dense)} nodes)"
     )
+    t_rag_host = _host_rag_gaec(seg_crop, bnd_crop)
+    log(f"config 4 host equivalent: {t_rag_host:.3f}s")
+
+    result = {
+        "metric": "fused watershed+CCL merged labels",
+        "value": round(vps, 1),
+        "unit": "voxels/sec",
+        "vs_baseline": round(vps / base_vps, 3),
+        "vs_32core": round(vps / (32 * base_vps), 3),
+        "backend": backend,
+        "mesh": {"dp": dp, "sp": sp},
+        "collectives_measured": dp * sp > 1,
+        "volume": list(vol.shape),
+        "halo": halo,
+        "overflow": overflow,
+        "timing": "sync-by-scalar-fetch (block_until_ready does not block on axon)",
+        "baseline": "single-core scipy pipeline (reference per-job compute path)",
+        "baseline_voxels_per_sec": round(base_vps, 1),
+        "best_run_seconds": round(t_fused, 3),
+        "stages_ms": stages_ms,
+        "configs": {
+            "cc_binary_512": {
+                "seconds": round(t_cc, 3),
+                "voxels_per_sec": round(fg3.size / t_cc, 1),
+            },
+            "dt_watershed_halo": {
+                "seconds": round(t_ws, 3),
+                "voxels_per_sec": round(vol[0].size / t_ws, 1),
+            },
+            "ws_ccl_fused": {
+                "seconds": round(t_fused, 3),
+                "voxels_per_sec": round(vps, 1),
+            },
+            "rag_multicut_crop": {
+                "crop": list(seg_crop.shape),
+                "seconds": round(t_rag, 3),
+                "host_seconds": round(t_rag_host, 3),
+                "n_edges": int(len(uv)),
+            },
+        },
+    }
+    print(json.dumps(result), flush=True)
     log("done")
 
 

@@ -10,9 +10,11 @@ h5py for HDF5 inputs, behind one small uniform API:
     ds[bb] = block          # numpy in / numpy out
     arr = ds[bb]
 
-Datasets are addressed by key (group paths like ``volumes/raw`` work).  All
-reads/writes are synchronous numpy round-trips at this layer; the async
-host->HBM streaming pipeline lives in :mod:`cluster_tools_tpu.io.prefetch`.
+Datasets are addressed by key (group paths like ``volumes/raw`` work).
+``__getitem__``/``__setitem__`` are synchronous numpy round-trips;
+``read_async``/``write_async`` return storage-level futures, consumed by the
+bounded-window pipelines in :mod:`cluster_tools_tpu.io.prefetch` and by
+``BlockwiseExecutor``'s batch assembly.
 """
 
 from __future__ import annotations

@@ -1,0 +1,126 @@
+"""Async chunk -> host -> HBM streaming: bounded-window read pipelines.
+
+The reference's jobs overlapped nothing: each block did a synchronous z5 read,
+compute, synchronous write (SURVEY.md §3.1 hot loop).  The TPU rebuild's
+executor overlaps three stages (reads ahead, device compute, writes behind);
+this module supplies the read side as *futures* so that an entire batch of
+chunk reads is in flight concurrently inside the storage layer (tensorstore
+performs the chunk IO on its own C++ thread pool, no GIL involved) instead of
+serializing per block.
+
+Use :class:`BlockPrefetcher` for streaming iteration, or
+:func:`async_loader` to build a future-returning ``load_fn`` for
+``BlockwiseExecutor`` (which resolves futures batch-at-a-time).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterable, Iterator, Sequence, Tuple
+
+import numpy as np
+
+
+class _Resolved:
+    """Future-like wrapper for values that are already materialized."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
+def as_future(value):
+    """Wrap ``value`` in a .result() interface unless it already has one."""
+    return value if hasattr(value, "result") else _Resolved(value)
+
+
+class BlockPrefetcher:
+    """Iterate ``(item, array)`` with a bounded window of in-flight reads.
+
+    ``read_fn(item)`` must return either a numpy array or a future-like
+    object with ``.result()`` (e.g. a tensorstore read future from
+    ``Dataset.read_async``).  At any moment at most ``depth`` reads are in
+    flight; results are yielded in submission order.
+    """
+
+    def __init__(
+        self,
+        read_fn: Callable,
+        items: Sequence,
+        depth: int = 2,
+    ):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self._read_fn = read_fn
+        self._items = list(items)
+        self._depth = depth
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self) -> Iterator[Tuple[object, np.ndarray]]:
+        end = object()  # private sentinel: items may legitimately be None
+        window: deque = deque()
+        it = iter(self._items)
+        for item in it:
+            window.append((item, as_future(self._read_fn(item))))
+            if len(window) >= self._depth:
+                break
+        while window:
+            item, fut = window[0]
+            arr = np.asarray(fut.result())
+            window.popleft()
+            # refill after the head resolves: exactly ``depth`` reads are in
+            # flight while waiting, and again while the consumer works
+            nxt = next(it, end)
+            if nxt is not end:
+                window.append((nxt, as_future(self._read_fn(nxt))))
+            yield item, arr
+
+
+class _MappedFuture:
+    """Future whose result is transformed on resolution (e.g. padding)."""
+
+    def __init__(self, fut, fn):
+        self._fut = fut
+        self._fn = fn
+
+    def result(self):
+        return self._fn(self._fut.result())
+
+
+def async_loader(
+    dataset,
+    bb_fn: Callable,
+    *more: Tuple,
+    pad_to=None,
+    pad_mode: str = "edge",
+) -> Callable:
+    """Build a future-returning ``load_fn`` for ``BlockwiseExecutor``.
+
+    ``bb_fn(block)`` gives the bounding box to read from ``dataset``; each
+    extra ``(dataset_i, bb_fn_i)`` pair adds another input stream.  The
+    returned callable issues every read as a storage-level future so the
+    executor's batch assembly has all of a batch's chunk IO in flight at
+    once.  ``pad_to`` (a uniform outer shape) pads each block on resolution
+    — required whenever edge blocks are clipped, since the executor stacks a
+    batch into one array.
+    """
+    streams = ((dataset, bb_fn),) + tuple(more)
+
+    def load(block):
+        futs = tuple(ds.read_async(fn(block)) for ds, fn in streams)
+        if pad_to is None:
+            return futs
+        from ..utils.volume_utils import pad_block_to
+
+        return tuple(
+            _MappedFuture(
+                f, lambda a: pad_block_to(np.asarray(a), pad_to, mode=pad_mode)
+            )
+            for f in futs
+        )
+
+    return load

@@ -63,7 +63,11 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
+        src = os.path.join(_NATIVE_DIR, "ct_native.cpp")
+        stale = os.path.exists(_LIB_PATH) and os.path.exists(src) and (
+            os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        )
+        if (stale or not os.path.exists(_LIB_PATH)) and not _build():
             return None
 
         def _open():
@@ -127,6 +131,16 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p,
         ]
         lib.ct_mutex_watershed.restype = ctypes.c_int
+        lib.ct_kernighan_lin.argtypes = [
+            ctypes.c_int64,
+            i64p,
+            f64p,
+            ctypes.c_int64,
+            i64p,
+            ctypes.c_int64,
+            ctypes.c_double,
+        ]
+        lib.ct_kernighan_lin.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -160,6 +174,28 @@ def greedy_additive(
         int(n_nodes), edges, costs, len(edges), float(stop_cost), out
     )
     return out
+
+
+def kernighan_lin(
+    n_nodes: int,
+    edges: np.ndarray,
+    costs: np.ndarray,
+    init_labels: np.ndarray,
+    max_outer: int = 20,
+    epsilon: float = 1e-9,
+) -> Optional[np.ndarray]:
+    """KL refinement of ``init_labels`` (copied), or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    edges = np.ascontiguousarray(np.asarray(edges).reshape(-1, 2), np.int64)
+    costs = np.ascontiguousarray(np.asarray(costs, np.float64))
+    labels = np.ascontiguousarray(np.asarray(init_labels, np.int64)).copy()
+    lib.ct_kernighan_lin(
+        int(n_nodes), edges, costs, len(edges), labels, int(max_outer),
+        float(epsilon),
+    )
+    return labels
 
 
 def mutex_watershed(

@@ -287,6 +287,29 @@ def kernighan_lin(
     if len(edges) == 0:
         return _relabel_consecutive(labels)
 
+    from .. import native
+
+    refined = native.kernighan_lin(
+        n_nodes, edges, costs, labels, max_outer=max_outer, epsilon=epsilon
+    )
+    if refined is not None:
+        return _relabel_consecutive(refined)
+    return _kernighan_lin_python(
+        n_nodes, edges, costs, labels, max_outer, epsilon
+    )
+
+
+def _kernighan_lin_python(
+    n_nodes: int,
+    edges: np.ndarray,
+    costs: np.ndarray,
+    labels: np.ndarray,
+    max_outer: int = 20,
+    epsilon: float = 1e-9,
+) -> np.ndarray:
+    """Pure-Python KL sweep — fallback and the native kernel's parity oracle
+    (``tests/test_multicut.py::test_kl_native_python_parity``).  Mutates and
+    returns a relabeled copy of ``labels``."""
     adj: List[List[Tuple[int, float]]] = [[] for _ in range(n_nodes)]
     for (u, v), w in zip(edges, costs):
         if u == v:

@@ -12,8 +12,9 @@ are vectorized; the constraint loop is inherently sequential over the
 sorted edge list and runs on host per block — blocks are processed
 batch-parallel across the IO pool.  The loop executes in the C++ runtime
 extension (``ct_mutex_watershed`` in ``native/ct_native.cpp``, built on
-first use) with the pure-Python ``_MutexUnionFind`` loop as fallback and
-as the parity oracle (``tests/test_mws_stitching.py``).
+first use) with :func:`python_constraint_loop` as fallback;
+``tests/test_mws_stitching.py::test_native_python_constraint_parity`` runs
+both on the same sorted edges and asserts identical partitions.
 
 Convention (as in the reference stack): ``offsets[:ndim]`` are the unit
 ("attractive") offsets; all further offsets are long-range ("repulsive").
@@ -111,6 +112,34 @@ class _MutexUnionFind:
                 ma.add(x)
 
 
+def python_constraint_loop(
+    n: int,
+    u: np.ndarray,
+    v: np.ndarray,
+    is_attractive: np.ndarray,
+    order: np.ndarray,
+) -> np.ndarray:
+    """Pure-Python mutex constraint loop — the native kernel's parity oracle.
+
+    Same contract as ``native.mutex_watershed``: process edges in ``order``,
+    merging attractive pairs unless a mutex forbids it, installing mutexes
+    for repulsive pairs; returns per-voxel int64 roots.
+    ``tests/test_mws_stitching.py::test_native_python_constraint_parity``
+    asserts both paths produce the same partition and records the speedup.
+    """
+    uf = _MutexUnionFind(n)
+    for i in order:
+        ru, rv = uf.find(int(u[i])), uf.find(int(v[i]))
+        if ru == rv:
+            continue
+        if is_attractive[i]:
+            if not uf.has_mutex(ru, rv):
+                uf.merge(ru, rv)
+        else:
+            uf.add_mutex(ru, rv)
+    return np.array([uf.find(i) for i in range(n)], dtype=np.int64)
+
+
 def mutex_watershed(
     affs: np.ndarray,
     offsets: Sequence[Sequence[int]],
@@ -167,17 +196,7 @@ def mutex_watershed(
 
     roots = native.mutex_watershed(n, u, v, is_attractive, order)
     if roots is None:
-        uf = _MutexUnionFind(n)
-        for i in order:
-            ru, rv = uf.find(int(u[i])), uf.find(int(v[i]))
-            if ru == rv:
-                continue
-            if is_attractive[i]:
-                if not uf.has_mutex(ru, rv):
-                    uf.merge(ru, rv)
-            else:
-                uf.add_mutex(ru, rv)
-        roots = np.array([uf.find(i) for i in range(n)], dtype=np.int64)
+        roots = python_constraint_loop(n, u, v, is_attractive, order)
     _, labels = np.unique(roots, return_inverse=True)
     labels = labels.astype(np.int64).reshape(shape) + 1
     if mask is not None:

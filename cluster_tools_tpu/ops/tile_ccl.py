@@ -56,6 +56,18 @@ def _round_up(v: int, m: int) -> int:
     return ((v + m - 1) // m) * m
 
 
+def _auto_cap(n_voxels: int, default: int, divisor: int) -> int:
+    """Volume-scaled capacity: static (shape-derived), bounded by ``default``.
+
+    Tiny volumes (tests, the driver dry-run) would otherwise pay the full
+    multi-million-element sort/compact overhead of benchmark-scale caps.
+    The 16384 floor keeps adversarially dense small volumes (sparse seeds in
+    pure noise: most strip voxels carry basin codes) inside capacity while
+    still costing microseconds.
+    """
+    return max(16384, min(default, _round_up(n_voxels // divisor, 1024)))
+
+
 def _tile_for(shape: Tuple[int, int, int]) -> Tuple[int, int, int]:
     """Pick a lane-aligned tile; tiny axes get padded up to one tile."""
     z, y, x = shape
@@ -171,8 +183,11 @@ def merge_face_pairs(
         (pa, pb), kept = _face_pairs_axis(labels, tile, axis, pair_cap)
         pair_lists.append((pa, pb))
         overflow = jnp.maximum(overflow, (kept > pair_cap).astype(jnp.int32))
-    a = jnp.concatenate([p[0] for p in pair_lists])
-    b = jnp.concatenate([p[1] for p in pair_lists])
+    # the concat inherits the labels' varying-manual-axes type even when every
+    # axis had a single tile (all-constant empty pair lists) — required for
+    # the while_loop carries below under shard_map
+    a = _match_vma(jnp.concatenate([p[0] for p in pair_lists]), labels)
+    b = _match_vma(jnp.concatenate([p[1] for p in pair_lists]), labels)
     # value-dedup: one small sort, duplicates & padding end up adjacent/last
     a, b = lax.sort((a, b), num_keys=2)
     dup = (a == _shift1(a, 0, -1)) & (b == _shift1(b, 0, -1))
@@ -301,8 +316,8 @@ def label_components_tiled(
     connectivity: int = 1,
     impl: str = "auto",
     tile: Optional[Tuple[int, int, int]] = None,
-    pair_cap: int = DEFAULT_PAIR_CAP,
-    edge_cap: int = DEFAULT_EDGE_CAP,
+    pair_cap: Optional[int] = None,
+    edge_cap: Optional[int] = None,
     table_cap: int = DEFAULT_TABLE_CAP,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -320,7 +335,9 @@ def label_components_tiled(
     ``impl``: "pallas" (TPU VMEM kernels), "xla" (portable), or "auto"
     (pallas exactly when the default backend is TPU).  ``connectivity`` must
     be 1 (face connectivity) — callers needing the full neighborhood use the
-    legacy kernel.
+    legacy kernel.  Capacities default to volume-scaled values (static,
+    shape-derived); pass explicit caps for workloads with unusually many
+    fragments per tile face.
     """
     if mask.ndim != 3:
         raise ValueError("label_components_tiled expects a 3-D mask")
@@ -340,6 +357,10 @@ def label_components_tiled(
             "volume (parallel.distributed_ccl) instead"
         )
     padded = (zp != z) or (yp != y) or (xp != x)
+    if pair_cap is None:
+        pair_cap = _auto_cap(zp * yp * xp, DEFAULT_PAIR_CAP, 32)
+    if edge_cap is None:
+        edge_cap = _auto_cap(zp * yp * xp, DEFAULT_EDGE_CAP, 128)
     m = mask.astype(bool)
     if padded:
         m = jnp.pad(m, ((0, zp - z), (0, yp - y), (0, xp - x)))

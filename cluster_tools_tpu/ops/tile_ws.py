@@ -50,6 +50,7 @@ from .pallas_kernels import WS_MARKER, WS_OFFS, ws_propagate_step
 from .tile_ccl import (
     BIG,
     DEFAULT_TABLE_CAP,
+    _auto_cap,
     _compact,
     _round_up,
     _shift1,
@@ -60,8 +61,8 @@ from .tile_ccl import (
 
 _BIGF = np.float32(3e38)
 
-DEFAULT_EXIT_CAP = 1 << 19
-DEFAULT_FILL_CAP = 1 << 19
+DEFAULT_EXIT_CAP = 1 << 21
+DEFAULT_FILL_CAP = 1 << 21
 
 
 def _sortable_float_key(f: jnp.ndarray) -> jnp.ndarray:
@@ -395,8 +396,8 @@ def seeded_watershed_tiled(
     mask: Optional[jnp.ndarray] = None,
     impl: str = "auto",
     tile: Optional[Tuple[int, int, int]] = None,
-    exit_cap: int = DEFAULT_EXIT_CAP,
-    fill_cap: int = DEFAULT_FILL_CAP,
+    exit_cap: Optional[int] = None,
+    fill_cap: Optional[int] = None,
     table_cap: int = DEFAULT_TABLE_CAP,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -421,6 +422,15 @@ def seeded_watershed_tiled(
             f"padded volume {(zp, yp, xp)} has >= 2**30 voxels; shard it"
         )
     padded = (zp != z) or (yp != y) or (xp != x)
+    if exit_cap is None:
+        # n/3 >= the total strip voxel count for the default tile, so exits
+        # can never overflow below ~6M voxels; fill edges can reach ~n/2 in
+        # pure-noise/sparse-seed regimes, so fill uses divisor 1.  Above the
+        # absolute bounds both rely on realistic fragment density plus the
+        # overflow flag.
+        exit_cap = _auto_cap(zp * yp * xp, DEFAULT_EXIT_CAP, 3)
+    if fill_cap is None:
+        fill_cap = _auto_cap(zp * yp * xp, DEFAULT_FILL_CAP, 1)
     valid = jnp.ones(height.shape, bool) if mask is None else mask.astype(bool)
     h = height.astype(jnp.float32)
     s = seeds.astype(jnp.int32)
@@ -512,3 +522,69 @@ def seeded_watershed_tiled(
     if padded:
         out = out[:z, :y, :x]
     return out, overflow
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "threshold", "sigma_seeds", "min_seed_distance", "sampling",
+        "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
+        "exit_cap", "fill_cap", "table_cap", "interpret",
+    ),
+)
+def dt_watershed_tiled(
+    boundaries: jnp.ndarray,
+    threshold: float = 0.25,
+    sigma_seeds: float = 0.0,
+    min_seed_distance: float = 0.0,
+    sampling: Optional[Tuple[float, ...]] = None,
+    mask: Optional[jnp.ndarray] = None,
+    dt_max_distance: Optional[float] = None,
+    impl: str = "auto",
+    tile: Optional[Tuple[int, int, int]] = None,
+    pair_cap: Optional[int] = None,
+    edge_cap: Optional[int] = None,
+    exit_cap: Optional[int] = None,
+    fill_cap: Optional[int] = None,
+    table_cap: int = DEFAULT_TABLE_CAP,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused distance-transform watershed on the two-level machinery.
+
+    The same pipeline as
+    :func:`~cluster_tools_tpu.ops.watershed.distance_transform_watershed`
+    (threshold -> capped EDT -> seeds = CCL of DT maxima plateaus -> seeded
+    watershed; reference ``_ws_block``, SURVEY.md §2a "watershed") with the
+    seed CCL and the flood running on the tiled kernels.  3-D only,
+    connectivity 1.  Returns ``(labels, overflow)``; labels are
+    ``seed_rep + 1`` flat-index based, 0 outside mask/unreached.
+    """
+    from .edt import distance_transform_squared
+    from .filters import gaussian_smooth
+    from .tile_ccl import label_components_tiled
+    from .watershed import local_maxima
+
+    valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
+    fg = (boundaries < threshold) & valid
+    dist = distance_transform_squared(
+        fg, sampling=sampling, max_distance=dt_max_distance
+    )
+    if sigma_seeds > 0:
+        dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
+    maxima = (
+        local_maxima(dist, 1)
+        & fg
+        & (dist >= min_seed_distance * min_seed_distance)
+    )
+    raw, seed_overflow = label_components_tiled(
+        maxima, impl=impl, tile=tile, pair_cap=pair_cap, edge_cap=edge_cap,
+        table_cap=table_cap, interpret=interpret,
+    )
+    n = int(np.prod(boundaries.shape))
+    seeds = jnp.where(raw == n, 0, raw + 1).astype(jnp.int32)
+    labels, ws_overflow = seeded_watershed_tiled(
+        boundaries, seeds, mask=valid, impl=impl, tile=tile,
+        exit_cap=exit_cap, fill_cap=fill_cap, table_cap=table_cap,
+        interpret=interpret,
+    )
+    return labels, seed_overflow | ws_overflow

@@ -44,7 +44,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.ccl import label_components, relabel_consecutive
+from ..ops.ccl import _match_vma, label_components, relabel_consecutive
 from ..ops.unionfind import union_find
 from .halo import neighbor_face
 
@@ -101,6 +101,7 @@ def sharded_label_components(
     shard_axes: Optional[Sequence[ShardAxis]] = None,
     max_labels_per_shard: Optional[int] = None,
     return_overflow: bool = False,
+    impl: str = "legacy",
 ):
     """Connected components of a volume sharded over one or more mesh axes.
 
@@ -119,6 +120,11 @@ def sharded_label_components(
 
     Cross-shard stitching uses face connectivity, so ``connectivity`` must be
     1 (same restriction as the blockwise ``block_faces`` task).
+
+    ``impl``: per-shard CCL kernel — "legacy" (ops.ccl hook/compress),
+    "tiled"/"pallas"/"xla"/"auto" (the two-level ops.tile_ccl machinery; on
+    3-D shards with connectivity 1 this is the TPU fast path, and its
+    capacity overflow is folded into the returned overflow flag).
     """
     if connectivity != 1:
         raise NotImplementedError(
@@ -135,10 +141,22 @@ def sharded_label_components(
         rank = rank * jnp.int32(size) + lax.axis_index(name).astype(jnp.int32)
 
     # 1. per-shard CCL; globalize so labels are unique across shards
-    raw = label_components(mask, connectivity=connectivity)
+    use_tiled = impl != "legacy" and mask.ndim == 3 and connectivity == 1
+    if use_tiled:
+        from ..ops.tile_ccl import label_components_tiled
+
+        tiled_impl = "xla" if impl == "tiled" else impl
+        raw, tiled_overflow = label_components_tiled(
+            mask, connectivity=connectivity, impl=tiled_impl
+        )
+    else:
+        raw = label_components(mask, connectivity=connectivity)
+        tiled_overflow = None
     # constant-False flag carrying the shard data's vma type, so the pmax
     # reduction below is legal with or without compaction
     overflow = raw.ravel()[0] * 0 > 0
+    if tiled_overflow is not None:
+        overflow = overflow | tiled_overflow
     if max_labels_per_shard is None:
         if n_shards * n_slab >= 2**31:
             raise ValueError(
@@ -155,8 +173,16 @@ def sharded_label_components(
             )
         local = jnp.where(raw == n_slab, 0, raw + 1).astype(jnp.int32)
         dense, n_fg = relabel_consecutive(local, max_labels=cap)
-        overflow = n_fg > cap
+        overflow = overflow | (n_fg > cap)
         glob = jnp.where(dense > 0, dense + rank * jnp.int32(cap + 1), 0)
+
+    if n_shards == 1:
+        # no cross-shard faces exist: per-shard labels are already global.
+        # This also keeps the single-chip benchmark free of the (empty)
+        # pair/merge machinery.
+        if return_overflow:
+            return glob, overflow
+        return glob
 
     # 2. cross-shard equivalences per sharded axis
     pairs = jnp.concatenate(
@@ -183,10 +209,21 @@ def sharded_label_components(
     # keys are sorted ascending, so the min dense root is the min label
     rep = keys[parent]
 
-    # 4. local relabel through the boundary table
-    pos = jnp.clip(jnp.searchsorted(keys, glob), 0, cap - 1)
-    hit = (keys[pos] == glob) & (glob > 0)
-    labels = jnp.where(hit, rep[pos], glob)
+    # 4. local relabel through the boundary table.  A searchsorted over the
+    # full shard would binary-search-gather per voxel (measured ~50x slower
+    # than one direct gather on TPU); instead scatter the merged reps into a
+    # table over this shard's own label range and gather once.
+    span = (n_slab if max_labels_per_shard is None
+            else int(max_labels_per_shard) + 1)
+    base = rank * jnp.int32(span)
+    table = _match_vma(jnp.arange(span + 1, dtype=jnp.int32), glob) + base
+    loc = keys - base  # position of each boundary label if it is ours
+    mine = (keys != _INT32_MAX) & (loc >= 1) & (loc <= span)
+    table = table.at[jnp.where(mine, loc, span + 1)].set(
+        rep, mode="drop"
+    )
+    idx = jnp.clip(glob - base, 0, span)
+    labels = jnp.where(glob > 0, table[idx], 0)
     if return_overflow:
         return labels, overflow
     return labels
@@ -199,6 +236,7 @@ def distributed_connected_components(
     connectivity: int = 1,
     max_labels_per_shard: Optional[int] = None,
     return_overflow: bool = False,
+    impl: str = "legacy",
 ):
     """shard_map wrapper: CCL of a full volume sharded over ``sp_axis``.
 
@@ -224,6 +262,7 @@ def distributed_connected_components(
             connectivity=connectivity,
             max_labels_per_shard=max_labels_per_shard,
             return_overflow=return_overflow,
+            impl=impl,
         ),
         mesh=mesh,
         in_specs=P(*names),

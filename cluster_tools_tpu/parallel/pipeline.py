@@ -45,11 +45,16 @@ def _ws_ccl_shard(
     threshold: float,
     connectivity: int,
     dt_max_distance: Optional[float],
+    min_seed_distance: float,
     max_labels_per_shard: Optional[int],
+    impl: str,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-device body: local shard is (local_batch, z_slab, y, x)."""
     local_b = boundaries.shape[0]
     rank = lax.axis_index(sp_axis).astype(jnp.int32)
+    # the tiled (two-level VMEM) kernels are 3-D/connectivity-1 only; the
+    # legacy dense fixpoint covers the rest
+    tiled_ok = impl != "legacy" and connectivity == 1
 
     ws_out = []
     cc_out = []
@@ -64,12 +69,26 @@ def _ws_ccl_shard(
         # halo exchange along the sharded z axis; border fill = 1.0 (pure
         # boundary) so basins never leak out of the volume
         padded = exchange_halo(vol, halo, 0, sp_axis, sp_size, fill=1.0)
-        ws = distance_transform_watershed(
-            padded,
-            threshold=threshold,
-            connectivity=connectivity,
-            dt_max_distance=dt_max_distance,
-        )
+        if tiled_ok:
+            from ..ops.tile_ws import dt_watershed_tiled
+
+            tiled_impl = "xla" if impl == "tiled" else impl
+            ws, ws_over = dt_watershed_tiled(
+                padded,
+                threshold=threshold,
+                dt_max_distance=dt_max_distance,
+                min_seed_distance=min_seed_distance,
+                impl=tiled_impl,
+            )
+            ws_overflow = jnp.maximum(ws_overflow, ws_over.astype(jnp.int32))
+        else:
+            ws = distance_transform_watershed(
+                padded,
+                threshold=threshold,
+                min_seed_distance=min_seed_distance,
+                connectivity=connectivity,
+                dt_max_distance=dt_max_distance,
+            )
         ws = crop_halo(ws, halo, 0)
         # globalize watershed fragment ids by slab rank; with a compaction
         # cap, fragment ids are densified first so the label space is
@@ -105,6 +124,7 @@ def _ws_ccl_shard(
             connectivity=connectivity,
             max_labels_per_shard=max_labels_per_shard,
             return_overflow=True,
+            impl=impl,
         )
         cc_over = cc_over.astype(jnp.int32)
         cc_overflow = (
@@ -132,7 +152,9 @@ def make_ws_ccl_step(
     dp_axis: str = "dp",
     sp_axis: str = "sp",
     dt_max_distance: Optional[float] = None,
+    min_seed_distance: float = 0.0,
     max_labels_per_shard: Optional[int] = None,
+    impl: str = "auto",
 ):
     """Compile the fused step for ``mesh``.
 
@@ -141,8 +163,13 @@ def make_ws_ccl_step(
     batch axis is sharded over ``dp``, the z axis over ``sp``.  Output:
     ``(ws_labels, cc_labels, n_foreground, overflow)`` with labels sharded
     like the input and the scalars replicated; ``overflow`` is True when any
-    shard exceeded ``max_labels_per_shard`` (labels unreliable — raise the
-    cap or add shards; always False without compaction).
+    shard exceeded ``max_labels_per_shard``, a tiled-kernel capacity, or a
+    compaction cap (labels unreliable — raise the cap or add shards).
+
+    ``impl`` selects the per-shard kernels: "auto" (two-level VMEM tile
+    machinery, Mosaic on TPU / portable XLA elsewhere — the fast path),
+    "pallas"/"xla"/"tiled" to force a tiled variant, or "legacy" (round-2
+    dense fixpoint kernels).
     """
     sizes = mesh_axis_sizes(mesh)
     body = partial(
@@ -154,7 +181,9 @@ def make_ws_ccl_step(
         threshold=threshold,
         connectivity=connectivity,
         dt_max_distance=dt_max_distance,
+        min_seed_distance=min_seed_distance,
         max_labels_per_shard=max_labels_per_shard,
+        impl=impl,
     )
     sharded = jax.shard_map(
         body,

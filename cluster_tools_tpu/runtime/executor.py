@@ -92,7 +92,16 @@ class BlockwiseExecutor:
 
         def load_batch(batch_idx: int):
             batch = blocks[batch_idx * bs : (batch_idx + 1) * bs]
+            # load_fn may return futures (e.g. io.prefetch.async_loader's
+            # tensorstore read futures): issue EVERY read of the batch first,
+            # then resolve — the storage layer runs the chunk IO concurrently
             per_block = [load_fn(b) for b in batch]
+            per_block = [
+                tuple(
+                    x.result() if hasattr(x, "result") else x for x in pb
+                )
+                for pb in per_block
+            ]
             n_args = len(per_block[0])
             # pad the final partial batch by repeating the last block so the
             # compiled shape stays static; padded outputs are dropped
@@ -115,9 +124,12 @@ class BlockwiseExecutor:
                     pending_loads.append(pool.submit(load_batch, i + prefetch))
                 arrays = tuple(jax.device_put(a, sharding) for a in arrays)
                 out = batched_kernel(*arrays)
-                out_np = jax.tree_util.tree_map(np.asarray, out)
 
-                def store_batch(batch=batch, out_np=out_np):
+                def store_batch(batch=batch, out=out):
+                    # the device->host copy happens HERE, on the IO pool, so
+                    # the dispatch loop is free to enqueue the next batch
+                    # while this one's outputs stream back
+                    out_np = jax.tree_util.tree_map(np.asarray, out)
                     for j, blk in enumerate(batch):
                         block_out = jax.tree_util.tree_map(
                             lambda a: a[j], out_np
@@ -128,9 +140,11 @@ class BlockwiseExecutor:
                             on_block_done(blk)
 
                 write_futures.append(pool.submit(store_batch))
-                # backpressure: don't let pending store batches (each pinning
-                # a full batch of host outputs) grow without bound
-                while len(write_futures) > 2 * self.io_threads:
+                # backpressure: each pending store closure pins its batch's
+                # DEVICE output buffers until its d2h copy runs, so the bound
+                # must be a small constant (not thread-count) or HBM fills
+                # with undrained outputs
+                while len(write_futures) > 2:
                     write_futures.pop(0).result()
             for f in write_futures:
                 f.result()

@@ -58,22 +58,13 @@ def n_features(sigmas: Sequence[float] = DEFAULT_SIGMAS) -> int:
     return 1 + 3 * len(sigmas)
 
 
-def train_pixel_classifier(
-    raw: np.ndarray,
-    labels: np.ndarray,
-    sigmas: Sequence[float] = DEFAULT_SIGMAS,
-    n_steps: int = 300,
-    lr: float = 0.5,
+def fit_linear_classifier(
+    X: np.ndarray, y: np.ndarray, n_steps: int = 300, lr: float = 0.5,
     seed: int = 0,
 ):
-    """Train logistic regression on sparse annotations (labels: 0 =
-    unlabeled, 1..K = classes).  Returns (W, b) as numpy arrays."""
+    """Logistic regression on featurized examples; returns (W, b) numpy."""
     import optax
 
-    feats = np.asarray(feature_bank(jnp.asarray(raw, jnp.float32), tuple(sigmas)))
-    mask = labels > 0
-    X = feats[mask].astype(np.float32)
-    y = labels[mask].astype(np.int32) - 1
     n_classes = int(y.max()) + 1
     # standardize features for conditioning; fold into W/b afterwards
     mu, sd = X.mean(0), X.std(0) + 1e-6
@@ -109,6 +100,175 @@ def train_pixel_classifier(
     return W_raw.astype(np.float32), b_raw.astype(np.float32)
 
 
+def train_pixel_classifier(
+    raw: np.ndarray,
+    labels: np.ndarray,
+    sigmas: Sequence[float] = DEFAULT_SIGMAS,
+    n_steps: int = 300,
+    lr: float = 0.5,
+    seed: int = 0,
+):
+    """Train logistic regression on sparse annotations (labels: 0 =
+    unlabeled, 1..K = classes).  Returns (W, b) as numpy arrays."""
+    feats = np.asarray(feature_bank(jnp.asarray(raw, jnp.float32), tuple(sigmas)))
+    mask = labels > 0
+    X = feats[mask].astype(np.float32)
+    y = labels[mask].astype(np.int32) - 1
+    return fit_linear_classifier(X, y, n_steps=n_steps, lr=lr, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# ilastik .ilp project ingestion (reference capability: execute an existing
+# ilastik pixel-classification project; SURVEY.md §2a "ilastik")
+# ---------------------------------------------------------------------------
+
+# ilastik feature-id strings -> (scale-parameterized) device filters.  The
+# two eigenvalue features (Hessian/structure tensor) have no separable
+# implementation in ops.filters yet and are rejected with a clear error.
+ILP_SUPPORTED_FEATURES = (
+    "GaussianSmoothing",
+    "LaplacianOfGaussian",
+    "GaussianGradientMagnitude",
+    "DifferenceOfGaussians",
+)
+
+
+def _ilp_single_feature(x: jnp.ndarray, fid: str, sigma: float) -> jnp.ndarray:
+    if fid == "GaussianSmoothing":
+        return gaussian_smooth(x, sigma=sigma)
+    if fid == "GaussianGradientMagnitude":
+        return gradient_magnitude(x, sigma=sigma)
+    if fid == "LaplacianOfGaussian":
+        sm = gaussian_smooth(x, sigma=sigma)
+        lap = jnp.zeros_like(sm)
+        for axis in range(x.ndim):
+            lap = lap + (jnp.roll(sm, 1, axis) + jnp.roll(sm, -1, axis) - 2 * sm)
+        return lap
+    if fid == "DifferenceOfGaussians":
+        # ilastik's DoG pairs sigma with 0.66*sigma
+        return gaussian_smooth(x, sigma=sigma) - gaussian_smooth(
+            x, sigma=0.66 * sigma
+        )
+    raise ValueError(f"unsupported ilastik feature id {fid!r}")
+
+
+@partial(jax.jit, static_argnames=("selections",))
+def ilp_feature_bank(
+    x: jnp.ndarray, selections: Tuple[Tuple[str, float], ...]
+) -> jnp.ndarray:
+    """Featurize with an .ilp project's (feature_id, sigma) selections."""
+    feats = [_ilp_single_feature(x, fid, float(s)) for fid, s in selections]
+    return jnp.stack(feats, axis=-1)
+
+
+def _parse_block_slice(s: str) -> Tuple[slice, ...]:
+    """ilastik blockSlice attr: '[1:4,0:10,5:9]' (may carry a channel dim)."""
+    s = s.strip().strip("[]")
+    out = []
+    for part in s.split(","):
+        lo, hi = part.split(":")
+        out.append(slice(int(lo), int(hi)))
+    return tuple(out)
+
+
+def load_ilp_project(path: str):
+    """Parse an ilastik pixel-classification project (.ilp h5 file).
+
+    Returns ``(selections, label_blocks)``:
+
+    - ``selections``: tuple of (feature_id, sigma) pairs from
+      ``FeatureSelections`` (ids x scales masked by ``SelectionMatrix``),
+    - ``label_blocks``: list of (slices, uint8 labels) sparse annotation
+      blocks from ``PixelClassification/LabelSets`` (0 = unlabeled).
+
+    The classifier itself is re-fit from the project's own annotations: the
+    serialized forest blob is a vigra RandomForest binary whose undocumented
+    topology layout we refuse to guess at; the annotations plus feature
+    selections reproduce the project's behavior with the native classifier.
+    A project without label sets therefore cannot be ingested.
+    """
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        fs = f["FeatureSelections"]
+        ids = [
+            i.decode() if isinstance(i, bytes) else str(i)
+            for i in fs["FeatureIds"][:]
+        ]
+        scales = [float(s) for s in fs["Scales"][:]]
+        matrix = np.asarray(fs["SelectionMatrix"][:], bool)
+        selections = []
+        for fi, fid in enumerate(ids):
+            for si, sig in enumerate(scales):
+                if matrix[fi, si]:
+                    if fid not in ILP_SUPPORTED_FEATURES:
+                        raise ValueError(
+                            f"ilastik feature {fid!r} is not supported "
+                            f"(supported: {ILP_SUPPORTED_FEATURES})"
+                        )
+                    selections.append((fid, sig))
+        label_blocks = []
+        ls = f.get("PixelClassification/LabelSets")
+        if ls is not None:
+            for lane in ls.values():
+                for blk in lane.values():
+                    bs = blk.attrs.get("blockSlice")
+                    if bs is None:
+                        continue
+                    if isinstance(bs, bytes):
+                        bs = bs.decode()
+                    data = np.asarray(blk[:], np.uint8)
+                    sl = _parse_block_slice(bs)
+                    # ilastik appends a channel axis to label blocks
+                    if data.ndim == len(sl) and data.shape[-1] == 1:
+                        data = data[..., 0]
+                        sl = sl[:-1]
+                    label_blocks.append((sl, data))
+    if not label_blocks:
+        raise ValueError(
+            f"{path}: no label annotations found — the serialized vigra "
+            "forest alone cannot be executed; re-save the project with its "
+            "training labels included"
+        )
+    return tuple(selections), label_blocks
+
+
+def train_from_ilp(
+    ilp_path: str,
+    raw: np.ndarray,
+    checkpoint_path: str,
+    n_steps: int = 300,
+    lr: float = 0.5,
+    seed: int = 0,
+) -> int:
+    """Fit the native classifier from an .ilp project's features + labels.
+
+    ``raw`` is the annotated raw volume (ilastik projects reference it by
+    external path; the caller resolves it).  Writes the standard npz
+    checkpoint consumed by :class:`IlastikPredictionBase` (with the .ilp
+    ``selections`` recorded) and returns the number of classes.
+    """
+    selections, label_blocks = load_ilp_project(ilp_path)
+    labels = np.zeros(raw.shape, np.uint8)
+    for sl, data in label_blocks:
+        labels[sl] = data
+    feats = np.asarray(
+        ilp_feature_bank(jnp.asarray(raw, jnp.float32), selections)
+    )
+    mask = labels > 0
+    X = feats[mask].astype(np.float32)
+    y = labels[mask].astype(np.int32) - 1
+    W, b = fit_linear_classifier(X, y, n_steps=n_steps, lr=lr, seed=seed)
+    np.savez(
+        checkpoint_path,
+        W=W,
+        b=b,
+        sigmas=np.zeros(0, np.float32),  # unused on the ilp path
+        ilp_features=np.array([f"{fid}:{s}" for fid, s in selections]),
+    )
+    return W.shape[1]
+
+
 class IlastikPredictionBase(BaseTask):
     """Blockwise pixel-classification prediction (reference:
     ``IlastikPredictionBase``).
@@ -138,6 +298,12 @@ class IlastikPredictionBase(BaseTask):
         with np.load(cfg["checkpoint_path"]) as f:
             W, b = jnp.asarray(f["W"]), jnp.asarray(f["b"])
             sigmas = tuple(float(s) for s in f["sigmas"])
+            selections = None
+            if "ilp_features" in f and len(f["ilp_features"]):
+                selections = tuple(
+                    (s.rsplit(":", 1)[0], float(s.rsplit(":", 1)[1]))
+                    for s in f["ilp_features"].tolist()
+                )
         n_classes = W.shape[1]
 
         out = file_reader(cfg["output_path"]).require_dataset(
@@ -159,7 +325,10 @@ class IlastikPredictionBase(BaseTask):
             return (pad_block_to(data, outer, mode="edge"),)
 
         def kernel(x):
-            feats = feature_bank(x, sigmas)
+            if selections is not None:
+                feats = ilp_feature_bank(x, selections)
+            else:
+                feats = feature_bank(x, sigmas)
             logits = feats @ W + b
             probs = jax.nn.softmax(logits, axis=-1)
             return jnp.moveaxis(probs, -1, 0)

@@ -62,6 +62,13 @@ class _WsTaskBase(BaseTask):
             "two_d": False,
             "connectivity": 1,
             "halo": [4, 4, 4],
+            # EDT cap in physical (sampling) units; None derives it from the
+            # halo.  Uncapped, a >160-extent block selects the O(n^2)
+            # broadcast min-plus and allocates an (.., n, n) intermediate —
+            # the cap keeps the erosion cascade O(cap) per axis, and
+            # distances beyond the halo scale are meaningless blockwise
+            # anyway (SURVEY.md §7 hard part 5).
+            "dt_max_distance": None,
         }
 
     def _setup(self):
@@ -84,12 +91,26 @@ class _WsTaskBase(BaseTask):
 
     def _kernel_params(self, cfg):
         sampling = cfg.get("sampling")
+        dt_max = cfg.get("dt_max_distance")
+        if dt_max is None:
+            # halo-derived default with a floor of 8.  Trade-off: the capped
+            # EDT saturates object interiors thicker than 2x the cap into
+            # one constant plateau, so two thick bodies joined by an equally
+            # thick neck collapse to a single seed (uncapped they could
+            # separate).  Uncapped, a >160-extent block instead selects the
+            # O(n^2) broadcast min-plus and allocates tens of GB.  Workloads
+            # with very thick objects should set dt_max_distance explicitly
+            # above the object radius.
+            halo = cfg.get("halo") or [0]
+            samp = sampling or [1.0] * len(halo)
+            dt_max = max(8.0, max(float(h) * float(s) for h, s in zip(halo, samp)))
         return dict(
             threshold=float(cfg["threshold"]),
             sigma_seeds=float(cfg.get("sigma_seeds") or 0.0),
             min_seed_distance=float(cfg.get("min_seed_distance") or 0.0),
             sampling=None if sampling is None else tuple(sampling),
             connectivity=int(cfg.get("connectivity", 1)),
+            dt_max_distance=float(dt_max),
         )
 
     def _store_labels(self, out, block, raw, n_outer, size_dtype=np.uint64):

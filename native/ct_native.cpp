@@ -9,6 +9,7 @@
 // C ABI only — no pybind11 (not in the image); arrays are passed as raw
 // pointers from numpy via ctypes.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <queue>
@@ -215,6 +216,175 @@ int ct_mutex_watershed(int64_t n_nodes, const int64_t* u, const int64_t* v,
   }
   for (int64_t i = 0; i < n_nodes; ++i) out_roots[i] = find_root(parent, i);
   return 0;
+}
+
+// Kernighan-Lin multicut refinement (Keuper et al.'s KLj scheme) — the
+// native port of ops/multicut.py::kernighan_lin's sweep, kept operation-
+// for-operation parallel so the two paths can be parity-tested:
+// per adjacent-partition pair, build the gain sequence (every member
+// tentatively flipped once, best-gain-first, negative gains included),
+// apply the best positive prefix or the outright join, whichever is better.
+// labels: in = initial partition (e.g. GAEC), out = refined; returns the
+// number of outer sweeps executed.
+int ct_kernighan_lin(int64_t n_nodes, const int64_t* edges,
+                     const double* costs, int64_t n_edges, int64_t* labels,
+                     int64_t max_outer, double epsilon) {
+  // CSR adjacency (both directions, original edge order preserved)
+  std::vector<int64_t> deg(n_nodes, 0);
+  for (int64_t e = 0; e < n_edges; ++e) {
+    int64_t u = edges[2 * e], v = edges[2 * e + 1];
+    if (u == v) continue;
+    ++deg[u];
+    ++deg[v];
+  }
+  std::vector<int64_t> off(n_nodes + 1, 0);
+  for (int64_t i = 0; i < n_nodes; ++i) off[i + 1] = off[i] + deg[i];
+  std::vector<int64_t> nbr(off[n_nodes]);
+  std::vector<double> wgt(off[n_nodes]);
+  {
+    std::vector<int64_t> pos(off.begin(), off.end() - 1);
+    for (int64_t e = 0; e < n_edges; ++e) {
+      int64_t u = edges[2 * e], v = edges[2 * e + 1];
+      if (u == v) continue;
+      nbr[pos[u]] = v;
+      wgt[pos[u]++] = costs[e];
+      nbr[pos[v]] = u;
+      wgt[pos[v]++] = costs[e];
+    }
+  }
+
+  // scratch reused across pairs: node -> index within the current pair
+  // (-1 = not in pair), sized once
+  std::vector<int64_t> in_pair(n_nodes, -1);
+
+  for (int64_t outer = 0; outer < max_outer; ++outer) {
+    // members per label, rebuilt each sweep and maintained across pairs
+    std::unordered_map<int64_t, std::vector<int64_t>> members;
+    for (int64_t i = 0; i < n_nodes; ++i) members[labels[i]].push_back(i);
+
+    // adjacent label pairs from the current cut, sorted for determinism
+    std::vector<std::pair<int64_t, int64_t>> pairs;
+    {
+      std::unordered_set<uint64_t> seen;
+      for (int64_t e = 0; e < n_edges; ++e) {
+        int64_t lu = labels[edges[2 * e]], lv = labels[edges[2 * e + 1]];
+        if (lu == lv) continue;
+        int64_t a = lu < lv ? lu : lv, b = lu < lv ? lv : lu;
+        uint64_t key = (static_cast<uint64_t>(a) << 32) ^
+                       static_cast<uint64_t>(b & 0xffffffff);
+        if (seen.insert(key).second) pairs.emplace_back(a, b);
+      }
+      std::sort(pairs.begin(), pairs.end());
+    }
+
+    double improved = 0.0;
+    for (auto [la, lb] : pairs) {
+      auto ita = members.find(la);
+      auto itb = members.find(lb);
+      if (ita == members.end() || itb == members.end()) continue;
+      std::vector<int64_t>& va = ita->second;
+      std::vector<int64_t>& vb = itb->second;
+      if (va.empty() || vb.empty()) continue;
+
+      const int64_t ka = static_cast<int64_t>(va.size());
+      const int64_t k = ka + static_cast<int64_t>(vb.size());
+      std::vector<int64_t> mem;
+      mem.reserve(k);
+      mem.insert(mem.end(), va.begin(), va.end());
+      mem.insert(mem.end(), vb.begin(), vb.end());
+      for (int64_t i = 0; i < k; ++i) in_pair[mem[i]] = i;
+      std::vector<int8_t> side(k);
+      for (int64_t i = 0; i < k; ++i) side[i] = i < ka ? 0 : 1;
+
+      // D[i] = gain of flipping member i; cut_ab = join gain
+      std::vector<double> d(k, 0.0);
+      double cut_ab = 0.0;
+      for (int64_t i = 0; i < k; ++i) {
+        int64_t u = mem[i];
+        for (int64_t p = off[u]; p < off[u + 1]; ++p) {
+          int64_t j = in_pair[nbr[p]];
+          if (j < 0) continue;
+          if (side[j] == side[i]) {
+            d[i] -= wgt[p];
+          } else {
+            d[i] += wgt[p];
+            if (i < j) cut_ab += wgt[p];
+          }
+        }
+      }
+      const double join_gain = cut_ab;
+
+      // tentative sequence, rolled back to the best prefix.  Lazy max-heap
+      // ordered by (gain desc, index asc) — identical pop order to a linear
+      // argmax scan (numpy's first-max tie-break), O(k log k) instead of
+      // O(k^2) so giant partitions stay tractable; stale entries (gain no
+      // longer current) are skipped on pop.
+      std::vector<char> moved(k, 0);
+      std::vector<int64_t> order;
+      order.reserve(k);
+      using HeapEntry = std::pair<double, int64_t>;  // (gain, -index)
+      std::priority_queue<HeapEntry> heap;
+      for (int64_t i = 0; i < k; ++i) heap.emplace(d[i], -i);
+      double cum = 0.0, best_gain = -1e300;
+      int64_t best_k = 0;
+      for (int64_t step = 0; step < k; ++step) {
+        int64_t best_i = -1;
+        while (true) {
+          HeapEntry top = heap.top();
+          heap.pop();
+          int64_t i = -top.second;
+          if (!moved[i] && top.first == d[i]) {
+            best_i = i;
+            break;
+          }
+        }
+        moved[best_i] = 1;
+        order.push_back(best_i);
+        cum += d[best_i];
+        if (cum > best_gain) {
+          best_gain = cum;
+          best_k = step + 1;
+        }
+        int64_t u = mem[best_i];
+        int8_t old_side = side[best_i];
+        side[best_i] = 1 - old_side;
+        for (int64_t p = off[u]; p < off[u + 1]; ++p) {
+          int64_t j = in_pair[nbr[p]];
+          if (j < 0 || moved[j]) continue;
+          d[j] += side[j] == old_side ? 2.0 * wgt[p] : -2.0 * wgt[p];
+          heap.emplace(d[j], -j);
+        }
+      }
+
+      // member lists stay sorted by node id so the A-then-B member order
+      // (and with it every float accumulation and argmax tie-break) matches
+      // the Python path's np.where-derived lists exactly
+      if (join_gain > best_gain && join_gain > epsilon) {
+        for (int64_t u : vb) labels[u] = la;
+        const int64_t mid = static_cast<int64_t>(va.size());
+        va.insert(va.end(), vb.begin(), vb.end());
+        std::inplace_merge(va.begin(), va.begin() + mid, va.end());
+        vb.clear();
+        improved += join_gain;
+      } else if (best_gain > epsilon && best_k != k) {
+        // (flipping ALL nodes is a relabeling no-op — skip, as in Python)
+        for (int64_t s = 0; s < best_k; ++s) {
+          int64_t u = mem[order[s]];
+          labels[u] = labels[u] == la ? lb : la;
+        }
+        va.clear();
+        vb.clear();
+        for (int64_t i = 0; i < k; ++i)
+          (labels[mem[i]] == la ? va : vb).push_back(mem[i]);
+        std::sort(va.begin(), va.end());
+        std::sort(vb.begin(), vb.end());
+        improved += best_gain;
+      }
+      for (int64_t i = 0; i < k; ++i) in_pair[mem[i]] = -1;
+    }
+    if (improved <= epsilon) return static_cast<int>(outer + 1);
+  }
+  return static_cast<int>(max_outer);
 }
 
 }  // extern "C"

@@ -164,3 +164,117 @@ def test_pixel_classification_end_to_end(workspace, rng):
     pred_class = probs.argmax(0).astype(np.uint8)
     acc = (pred_class == gt).mean()
     assert acc > 0.95, f"pixel classification accuracy too low: {acc}"
+
+
+def _write_minimal_ilp(path, label_blocks, feature_ids, scales, matrix):
+    """Synthetic ilastik pixel-classification project (the h5 layout ilastik
+    writes: FeatureSelections + sparse LabelSets blocks with blockSlice)."""
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        fs = f.create_group("FeatureSelections")
+        fs.create_dataset(
+            "FeatureIds", data=np.array([s.encode() for s in feature_ids])
+        )
+        fs.create_dataset("Scales", data=np.asarray(scales, np.float64))
+        fs.create_dataset("SelectionMatrix", data=np.asarray(matrix, bool))
+        lane = f.create_group("PixelClassification/LabelSets/labels000")
+        for i, (sl, data) in enumerate(label_blocks):
+            ds = lane.create_dataset(f"block{i:04d}", data=data[..., None])
+            bs = "[" + ",".join(f"{s.start}:{s.stop}" for s in sl) + ",0:1]"
+            ds.attrs["blockSlice"] = bs
+
+
+def test_ilp_project_ingestion(workspace, rng):
+    """r2 VERDICT #7: consume an existing ilastik .ilp (feature selections +
+    annotations) and run it through the prediction task."""
+    from cluster_tools_tpu.tasks.ilastik import (
+        IlastikPredictionWorkflow,
+        ilp_feature_bank,
+        load_ilp_project,
+        train_from_ilp,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (24, 48, 48)
+    gt = np.zeros(shape, np.uint8)
+    gt[:, 24:, :] = 1
+    raw = (np.where(gt == 1, 0.8, 0.2) + rng.normal(0, 0.05, shape)).astype(
+        np.float32
+    )
+
+    # scribbles in two annotation blocks, the ilastik way
+    blk1 = (slice(4, 12), slice(2, 20), slice(2, 40))
+    blk2 = (slice(4, 12), slice(28, 46), slice(2, 40))
+    lb1 = np.zeros((8, 18, 38), np.uint8)
+    lb1[rng.random(lb1.shape) < 0.2] = 1
+    lb2 = np.zeros((8, 18, 38), np.uint8)
+    lb2[rng.random(lb2.shape) < 0.2] = 2
+
+    ids = ["GaussianSmoothing", "GaussianGradientMagnitude",
+           "LaplacianOfGaussian", "DifferenceOfGaussians"]
+    scales = [0.7, 1.6, 3.5]
+    matrix = np.zeros((4, 3), bool)
+    matrix[0] = [True, True, True]   # smoothing at all scales
+    matrix[1, 1] = True              # gradient magnitude at 1.6
+    matrix[3, 2] = True              # DoG at 3.5
+
+    ilp = os.path.join(root, "project.ilp")
+    _write_minimal_ilp(ilp, [(blk1, lb1), (blk2, lb2)], ids, scales, matrix)
+
+    selections, blocks = load_ilp_project(ilp)
+    assert len(selections) == 5
+    assert len(blocks) == 2
+    feats = np.asarray(ilp_feature_bank(jnp.asarray(raw), selections))
+    assert feats.shape == shape + (5,)
+
+    ckpt = os.path.join(root, "ilp.npz")
+    n_classes = train_from_ilp(ilp, raw, ckpt, n_steps=200)
+    assert n_classes == 2
+
+    path = os.path.join(root, "ilp_data.zarr")
+    f = file_reader(path)
+    f.require_dataset("raw", shape=shape, chunks=(16, 16, 16), dtype="float32")[
+        ...
+    ] = raw
+    wf = IlastikPredictionWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key="probs",
+        checkpoint_path=ckpt,
+        halo=[8, 8, 8],
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    probs = file_reader(path, "r")["probs"][...]
+    pred_class = probs.argmax(0).astype(np.uint8)
+    acc = (pred_class == gt).mean()
+    assert acc > 0.9, f"ilp-project classification accuracy too low: {acc}"
+
+
+def test_ilp_rejects_unsupported_and_unlabeled(workspace, rng):
+    from cluster_tools_tpu.tasks.ilastik import load_ilp_project
+
+    tmp_folder, config_dir, root = workspace
+    # unsupported feature id
+    ilp = os.path.join(root, "bad.ilp")
+    m = np.zeros((1, 1), bool)
+    m[0, 0] = True
+    lb = np.zeros((4, 4, 4), np.uint8)
+    lb[0, 0, 0] = 1
+    _write_minimal_ilp(
+        ilp, [((slice(0, 4), slice(0, 4), slice(0, 4)), lb)],
+        ["HessianOfGaussianEigenvalues"], [1.0], m,
+    )
+    with pytest.raises(ValueError, match="not supported"):
+        load_ilp_project(ilp)
+    # no annotations
+    ilp2 = os.path.join(root, "empty.ilp")
+    _write_minimal_ilp(ilp2, [], ["GaussianSmoothing"], [1.0], m)
+    with pytest.raises(ValueError, match="no label annotations"):
+        load_ilp_project(ilp2)

@@ -240,3 +240,74 @@ def test_contract_graph():
 def test_contract_graph_empty():
     e, c = contract_graph(np.zeros((0, 2), np.int64), np.zeros(0), np.zeros(0, np.int64))
     assert len(e) == 0 and len(c) == 0
+
+
+def test_kl_native_python_parity(rng):
+    """r2 VERDICT #8: the C++ KL must match the Python sweep exactly (same
+    gain sequences, same tie-breaks) on random multicut problems."""
+    from cluster_tools_tpu import native
+    from cluster_tools_tpu.ops.multicut import (
+        _kernighan_lin_python,
+        greedy_additive,
+        multicut_energy,
+    )
+
+    for seed in range(3):
+        r = np.random.default_rng(seed)
+        n = 60
+        edges = []
+        for _ in range(220):
+            u, v = r.integers(0, n, 2)
+            if u != v:
+                edges.append((min(u, v), max(u, v)))
+        edges = np.array(sorted(set(edges)), np.int64)
+        costs = r.normal(0, 1, len(edges))
+        init = greedy_additive(n, edges, costs)
+        nat = native.kernighan_lin(n, edges, costs, init)
+        if nat is None:
+            pytest.skip("native extension unavailable")
+        from cluster_tools_tpu.ops.multicut import _relabel_consecutive
+
+        nat = _relabel_consecutive(nat)
+        py = _kernighan_lin_python(n, edges, costs, init.copy())
+        np.testing.assert_array_equal(nat, py)
+        # and both must not be worse than the init
+        e_init = multicut_energy(edges, costs, init)
+        assert multicut_energy(edges, costs, nat) <= e_init + 1e-9
+
+
+def test_kl_native_scales_to_1e5_nodes():
+    """The global solve on a 1e5-node RAG-like graph completes in seconds
+    (r2 VERDICT #8 'done' criterion)."""
+    import time
+
+    from cluster_tools_tpu import native
+    from cluster_tools_tpu.ops.multicut import (
+        greedy_additive,
+        kernighan_lin,
+        multicut_energy,
+    )
+
+    if native.kernighan_lin(1, np.zeros((0, 2), np.int64), np.zeros(0),
+                            np.zeros(1, np.int64)) is None:
+        pytest.skip("native extension unavailable")
+
+    r = np.random.default_rng(0)
+    n = 100_000
+    # RAG-like: ~3 edges per node on a 3-D-ish neighborhood structure
+    side = round(n ** (1 / 3)) + 1
+    edges = []
+    for off in (1, side, side * side):
+        u = np.arange(n - off)
+        edges.append(np.stack([u, u + off], 1))
+    edges = np.concatenate(edges).astype(np.int64)
+    costs = r.normal(-0.1, 1.0, len(edges))
+
+    t0 = time.perf_counter()
+    labels = kernighan_lin(n, edges, costs)
+    dt = time.perf_counter() - t0
+    e_kl = multicut_energy(edges, costs, labels)
+    e_gaec = multicut_energy(edges, costs, greedy_additive(n, edges, costs))
+    assert e_kl <= e_gaec + 1e-6
+    assert dt < 30.0, f"global KL too slow: {dt:.1f}s"
+    print(f"\nKL on {n} nodes / {len(edges)} edges: {dt:.2f}s (GAEC {e_gaec:.1f} -> KL {e_kl:.1f})")

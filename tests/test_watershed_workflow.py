@@ -119,3 +119,55 @@ def test_size_filter_removes_small_fragments(rng, workspace):
     assert counts.min() >= 20
     # filtering must not *create* labels
     assert np.isin(uniq, np.unique(np.asarray(lab))).all()
+
+
+def test_ws_task_large_block_capped_edt(workspace, rng):
+    """A >160-extent block must run through the capped erosion-cascade EDT.
+
+    Before the halo-derived ``dt_max_distance`` default (VERDICT r2 #5), an
+    uncapped 256-extent block selected the O(n^2) broadcast min-plus, which
+    materializes an (..., 256, 256) intermediate per line — BASELINE-shape
+    blocks could not run through the *task* path at all.
+    """
+    vol = _boundary_volume(rng, (8, 8, 256))
+    tmp_folder, config_dir, root = workspace
+    path = os.path.join(root, "ws_big.zarr")
+    f = file_reader(path)
+    ds = f.require_dataset(
+        "boundaries", shape=vol.shape, chunks=(8, 8, 256), dtype="float32"
+    )
+    ds[...] = vol
+    wf = WatershedWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=1,
+        target="local",
+        input_path=path,
+        input_key="boundaries",
+        output_path=path,
+        output_key="labels",
+        block_shape=[8, 8, 256],
+        halo=[2, 2, 8],
+        two_pass=False,
+        threshold=0.5,
+    )
+    assert build([wf])
+    labels = np.asarray(file_reader(path)["labels"][:])
+    fg = vol < 0.5
+    # the flood covers ridges too (vigra semantics): everything is labeled
+    assert (labels[fg] > 0).mean() > 0.95
+    assert len(np.unique(labels[labels > 0])) > 1
+
+
+def test_ws_task_config_respects_explicit_dt_cap(workspace, rng):
+    from cluster_tools_tpu.tasks.watershed import WatershedBase
+
+    cfg = dict(WatershedBase.default_task_config())
+    assert cfg["dt_max_distance"] is None  # halo-derived by default
+    cfg["halo"] = [4, 4, 4]
+    cfg["threshold"] = 0.5
+    kp = WatershedBase.__new__(WatershedBase)._kernel_params(cfg)
+    assert kp["dt_max_distance"] == 8.0  # floor dominates a 4-voxel halo
+    cfg["dt_max_distance"] = 12.5
+    kp = WatershedBase.__new__(WatershedBase)._kernel_params(cfg)
+    assert kp["dt_max_distance"] == 12.5
