@@ -79,6 +79,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 "ct_greedy_additive",
                 "ct_merge_edge_features",
                 "ct_mutex_watershed",
+                "ct_kernighan_lin",
             ):
                 getattr(lib, sym)
             return lib

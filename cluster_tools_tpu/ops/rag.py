@@ -67,6 +67,84 @@ def axis_edge_scan(
     return lo, hi, val, valid
 
 
+@partial(jax.jit, static_argnames=("edge_cap", "with_values", "inner_shape"))
+def device_edge_aggregate(
+    seg: jnp.ndarray,
+    values: Optional[jnp.ndarray],
+    edge_cap: int,
+    with_values: bool = True,
+    inner_shape: Optional[Tuple[int, ...]] = None,
+):
+    """Sorted, deduplicated RAG edges + per-edge stats, entirely on device.
+
+    Replaces the host-side ``np.unique(pairs, axis=0)`` in :func:`block_rag`
+    (1-2s per 128^3 block, after a device->host transfer of every adjacent
+    pair) with one multi-operand device sort + segmented reductions — the
+    same sort-compact machinery as ops/tile_ccl.
+
+    ``seg``: int32 labels (0 = background) — callers with uint64 global ids
+    densify first.  Returns ``(lo, hi, count, vsum, vmin, vmax, n_edges)``
+    with static length ``edge_cap`` (slots past ``n_edges`` hold lo=hi=0);
+    ``n_edges > edge_cap`` means overflow (results truncated).
+    """
+    from jax import lax
+
+    INT_MAX = jnp.int32(np.iinfo(np.int32).max)
+    inner = tuple(inner_shape) if inner_shape is not None else seg.shape
+    los, his, vals = [], [], []
+    for axis in range(seg.ndim):
+        # the block-ownership halo convention (module docstring): inner+1
+        # along the scan axis, inner along the others
+        bb = tuple(
+            slice(0, min(inner[d] + 1, seg.shape[d]))
+            if d == axis
+            else slice(0, inner[d])
+            for d in range(seg.ndim)
+        )
+        lo, hi, val, valid = axis_edge_scan(
+            seg[bb], None if values is None else values[bb], axis,
+            with_values=with_values,
+        )
+        los.append(jnp.where(valid, lo, INT_MAX))
+        his.append(jnp.where(valid, hi, INT_MAX))
+        vals.append(val)
+    lo = jnp.concatenate(los).astype(jnp.int32)
+    hi = jnp.concatenate(his).astype(jnp.int32)
+    val = jnp.concatenate(vals).astype(jnp.float32)
+    lo, hi, val = lax.sort((lo, hi, val), num_keys=2)
+    valid = lo != INT_MAX
+    is_first = valid & (
+        (lo != jnp.concatenate([INT_MAX[None], lo[:-1]]))
+        | (hi != jnp.concatenate([INT_MAX[None], hi[:-1]]))
+    )
+    seg_id = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+    n_edges = jnp.where(valid.any(), seg_id[-1] + 1, 0)
+    sid = jnp.where(valid, jnp.minimum(seg_id, edge_cap), edge_cap)
+    ones = valid.astype(jnp.int32)
+    count = jax.ops.segment_sum(ones, sid, num_segments=edge_cap + 1)[:-1]
+    out_lo = jnp.zeros((edge_cap + 1,), jnp.int32).at[sid].max(
+        jnp.where(valid, lo, 0), mode="drop"
+    )[:-1]
+    out_hi = jnp.zeros((edge_cap + 1,), jnp.int32).at[sid].max(
+        jnp.where(valid, hi, 0), mode="drop"
+    )[:-1]
+    if with_values:
+        vsum = jax.ops.segment_sum(
+            jnp.where(valid, val, 0.0), sid, num_segments=edge_cap + 1
+        )[:-1]
+        vmin = jax.ops.segment_min(
+            jnp.where(valid, val, jnp.float32(np.inf)), sid,
+            num_segments=edge_cap + 1,
+        )[:-1]
+        vmax = jax.ops.segment_max(
+            jnp.where(valid, val, jnp.float32(-np.inf)), sid,
+            num_segments=edge_cap + 1,
+        )[:-1]
+    else:
+        vsum = vmin = vmax = jnp.zeros((edge_cap,), jnp.float32)
+    return out_lo, out_hi, count, vsum, vmin, vmax, n_edges
+
+
 def block_rag(
     seg: np.ndarray,
     values: Optional[np.ndarray] = None,
@@ -86,9 +164,23 @@ def block_rag(
     - ``sizes``  int64 [m], number of voxel-face contacts per edge,
     - ``feats``  float32 [m, 4] per-edge (mean, min, max, count) of the
       boundary values, or None.
+
+    3-D blocks dedup on device (:func:`device_edge_aggregate` — one sort +
+    segmented reductions instead of shipping every adjacent pair to the host
+    for ``np.unique``); other ranks use the host path
+    (:func:`_block_rag_host`, also the device path's parity oracle).
     """
-    with_values = values is not None
     inner = tuple(inner_shape) if inner_shape is not None else seg.shape
+    if seg.ndim == 3:
+        return _block_rag_device(seg, values, inner)
+    return _block_rag_host(seg, values, inner)
+
+
+def _block_rag_host(
+    seg: np.ndarray, values: Optional[np.ndarray], inner: Tuple[int, ...]
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Host-dedup RAG extraction (np.unique over all adjacent pairs)."""
+    with_values = values is not None
     seg_j = jnp.asarray(seg)
     val_j = jnp.asarray(values, dtype=jnp.float32) if with_values else None
     los, his, vals = [], [], []
@@ -132,6 +224,58 @@ def block_rag(
         [s / sizes, mn, mx, sizes.astype(np.float64)], axis=1
     ).astype(np.float32)
     return uv, sizes.astype(np.int64), feats
+
+
+def _block_rag_device(
+    seg: np.ndarray, values: Optional[np.ndarray], inner: Tuple[int, ...]
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Device-dedup path of :func:`block_rag` (3-D blocks).
+
+    Labels are densified on host (one unique over the block's voxels — tiny
+    next to a unique over every adjacent *pair*), aggregated on device, and
+    mapped back to the original uint64 ids.  The static edge capacity starts
+    at a power-of-two estimate and doubles on overflow, so each capacity
+    bucket compiles once per process.
+    """
+    with_values = values is not None
+    uniq = np.unique(seg)
+    if uniq[0] != 0:
+        # dtype-preserving prepend: a bare [0] would promote uint64
+        # labels to float64 and corrupt ids above 2**53
+        uniq = np.concatenate([np.zeros(1, uniq.dtype), uniq])
+    if len(uniq) >= 2**31:
+        raise ValueError("block has too many labels for int32 densification")
+    dense = np.searchsorted(uniq, seg).astype(np.int32)
+    vals_j = None if values is None else jnp.asarray(values, jnp.float32)
+
+    cap = 1 << 14
+    while True:
+        lo, hi, count, vsum, vmin, vmax, n_edges = device_edge_aggregate(
+            jnp.asarray(dense), vals_j, cap, with_values=with_values,
+            inner_shape=tuple(inner),
+        )
+        n = int(n_edges)
+        if n <= cap:
+            break
+        while cap < n:
+            cap *= 2
+    lo = np.asarray(lo[:n]).astype(np.int64)
+    hi = np.asarray(hi[:n]).astype(np.int64)
+    sizes = np.asarray(count[:n]).astype(np.int64)
+    uv = np.stack([uniq[lo], uniq[hi]], axis=1).astype(np.uint64)
+    if not with_values:
+        return uv, sizes, None
+    s = np.asarray(vsum[:n], np.float64)
+    feats = np.stack(
+        [
+            s / np.maximum(sizes, 1),
+            np.asarray(vmin[:n], np.float64),
+            np.asarray(vmax[:n], np.float64),
+            sizes.astype(np.float64),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    return uv, sizes, feats
 
 
 def merge_edge_lists(edge_lists) -> Tuple[np.ndarray, np.ndarray]:

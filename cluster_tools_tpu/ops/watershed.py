@@ -73,6 +73,15 @@ def seeded_watershed(
     unreachable from any seed.  Matches steepest-descent watershed semantics
     (vigra's default) up to the deterministic (height, index) plateau
     tiebreak.
+
+    Caveat: the unseeded-basin fill below is an unordered relaxation — an
+    unseeded basin adopts whatever labeled neighbor reaches it first, which
+    can cross a *higher* ridge than the basin's true lowest saddle (measured
+    on synthetic EM: ~35% fragment impurity vs ~6.5% for the saddle-ordered
+    fill).  :func:`cluster_tools_tpu.ops.tile_ws.seeded_watershed_tiled`
+    implements the height-ordered (minimum-spanning-forest) fill and is the
+    default task/pipeline kernel; this function remains for 2-D mode,
+    connectivity > 1, and as the fully-seeded oracle.
     """
     shape = height.shape
     n = int(np.prod(shape))
