@@ -245,6 +245,16 @@ def main():
         min_seed_distance=min_seed_distance, impl="auto",
     )
     log("config 3 (headline): compiling fused ws+ccl step")
+    profile_dir = os.environ.get("CT_BENCH_PROFILE")
+    if profile_dir:
+        # SURVEY.md §5.1: per-kernel traces on demand — view with
+        # tensorboard or xprof.  One profiled run after warmup.
+        out0 = step(vol)
+        _sync(out0)
+        log(f"profiling one step into {profile_dir}")
+        with jax.profiler.trace(profile_dir):
+            out0 = step(vol)
+            _sync(out0)
     t_fused, out = _timeit("fused ws+ccl step", step, vol)
     ws_lab, cc_lab, n_fg, overflow = out
     n_fg = int(n_fg)

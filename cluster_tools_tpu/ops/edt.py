@@ -80,13 +80,52 @@ def _edt_1d_axis(f: jnp.ndarray, axis: int, w: float, radius: int) -> jnp.ndarra
     return lax.fori_loop(0, radius, body, f)
 
 
-@partial(jax.jit, static_argnames=("sampling", "radii"))
+@partial(jax.jit, static_argnames=("sampling", "radii", "impl", "interpret"))
 def _dt_squared_impl(
-    mask: jnp.ndarray, sampling: Tuple[float, ...], radii: Tuple[int, ...]
+    mask: jnp.ndarray,
+    sampling: Tuple[float, ...],
+    radii: Tuple[int, ...],
+    impl: str = "auto",
+    interpret: bool = False,
 ) -> jnp.ndarray:
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     f = jnp.where(mask, _BIG, jnp.float32(0.0))
+    if impl == "pallas" and mask.ndim == 3:
+        return _dt_squared_pallas(f, sampling, radii, interpret=interpret)
     for axis in range(mask.ndim):
         f = _edt_1d_axis(f, axis, float(sampling[axis]) ** 2, radii[axis])
+    return jnp.minimum(f, _BIG)
+
+
+def _dt_squared_pallas(
+    f: jnp.ndarray,
+    sampling: Tuple[float, ...],
+    radii: Tuple[int, ...],
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Per-axis VMEM erosion cascades; pads to tile multiples with +BIG
+    (pad values never win a min, and padded lanes are cropped after)."""
+    from .pallas_kernels import edt_cascade_pallas
+
+    z, y, x = f.shape
+    zp = -(-z // 8) * 8
+    yp = -(-y // 8) * 8
+    xp = -(-x // 128) * 128
+    padded = (zp, yp, xp) != (z, y, x)
+    if padded:
+        f = jnp.pad(
+            f, ((0, zp - z), (0, yp - y), (0, xp - x)), constant_values=_BIG
+        )
+    for axis in range(3):
+        r = min(radii[axis], f.shape[axis] - 1)
+        if r > 0:
+            f = edt_cascade_pallas(
+                f, axis, r, float(sampling[axis]) ** 2, float(_BIG),
+                interpret=interpret,
+            )
+    if padded:
+        f = f[:z, :y, :x]
     return jnp.minimum(f, _BIG)
 
 
