@@ -588,3 +588,73 @@ def dt_watershed_tiled(
         interpret=interpret,
     )
     return labels, seed_overflow | ws_overflow
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "threshold", "sigma_seeds", "min_seed_distance", "sampling",
+        "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
+        "exit_cap", "fill_cap", "table_cap", "interpret",
+    ),
+)
+def dt_watershed_seeded_tiled(
+    boundaries: jnp.ndarray,
+    ext_seeds: jnp.ndarray,
+    threshold: float = 0.25,
+    sigma_seeds: float = 0.0,
+    min_seed_distance: float = 0.0,
+    sampling: Optional[Tuple[float, ...]] = None,
+    mask: Optional[jnp.ndarray] = None,
+    dt_max_distance: Optional[float] = None,
+    impl: str = "auto",
+    tile: Optional[Tuple[int, int, int]] = None,
+    pair_cap: Optional[int] = None,
+    edge_cap: Optional[int] = None,
+    exit_cap: Optional[int] = None,
+    fill_cap: Optional[int] = None,
+    table_cap: int = DEFAULT_TABLE_CAP,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two-pass-mode DT watershed on the tiled machinery.
+
+    Same contract as
+    :func:`~cluster_tools_tpu.ops.watershed.dt_watershed_seeded`
+    (checkerboard pass two, SURVEY.md §3.5): ``ext_seeds`` (int32, dense
+    1..K, 0 = none) are neighbor labels from pass one; internal DT seeds are
+    planted where no external seed sits.  Output values > N are external
+    (+N offset, N = voxel count); 1..N are new internal fragments.  Returns
+    ``(labels, overflow)``.
+    """
+    from .edt import distance_transform_squared
+    from .filters import gaussian_smooth
+    from .tile_ccl import label_components_tiled
+    from .watershed import local_maxima
+
+    n = int(np.prod(boundaries.shape))
+    valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
+    fg = (boundaries < threshold) & valid
+    dist = distance_transform_squared(
+        fg, sampling=sampling, max_distance=dt_max_distance
+    )
+    if sigma_seeds > 0:
+        dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
+    maxima = (
+        local_maxima(dist, 1)
+        & fg
+        & (dist >= min_seed_distance * min_seed_distance)
+    )
+    raw, seed_overflow = label_components_tiled(
+        maxima, impl=impl, tile=tile, pair_cap=pair_cap, edge_cap=edge_cap,
+        table_cap=table_cap, interpret=interpret,
+    )
+    internal = jnp.where(raw == n, 0, raw + 1).astype(jnp.int32)
+    ext = ext_seeds.astype(jnp.int32)
+    # external seeds dominate; internal ids live in 1..N, external in N+1..
+    seeds = jnp.where(ext > 0, ext + jnp.int32(n), internal)
+    labels, ws_overflow = seeded_watershed_tiled(
+        boundaries, seeds, mask=valid, impl=impl, tile=tile,
+        exit_cap=exit_cap, fill_cap=fill_cap, table_cap=table_cap,
+        interpret=interpret,
+    )
+    return labels, seed_overflow | ws_overflow

@@ -74,11 +74,8 @@ class _WsTaskBase(BaseTask):
             # measured 6.5% fragment impurity vs 35% for the legacy ring
             # fill, which can adopt labels THROUGH membranes), "legacy"
             # (round-2 dense fixpoint), or explicit "pallas"/"xla".  2-D
-            # mode and connectivity != 1 always use legacy.  The TWO-PASS
-            # task ignores this key: its externally-seeded kernel
-            # (dt_watershed_seeded) has no tiled variant yet, so both passes
-            # run legacy there — single-pass + stitching is the recommended
-            # route until then.
+            # mode and connectivity != 1 always use legacy.  Honored by both
+            # the single-pass and the two-pass (externally seeded) tasks.
             "impl": "auto",
         }
 
@@ -326,8 +323,19 @@ class TwoPassWatershedBase(_WsTaskBase):
                 m = np.ones(outer, bool)
             return data, dense, m
 
+        impl = str(cfg.get("impl", "auto"))
+        use_tiled = impl != "legacy" and int(kp.get("connectivity", 1)) == 1
+
         def kernel(b, ext, m):
-            lab = dt_watershed_seeded(b, ext, mask=m, **kp)
+            if use_tiled:
+                from ..ops.tile_ws import dt_watershed_seeded_tiled
+
+                tk = {k: v for k, v in kp.items() if k != "connectivity"}
+                lab, _ovf = dt_watershed_seeded_tiled(
+                    b, ext, mask=m, impl=impl, **tk
+                )
+            else:
+                lab = dt_watershed_seeded(b, ext, mask=m, **kp)
             if size_filter > 0:
                 # external ids live in (N, 2N]; widen the size-count domain
                 lab = filter_small_segments(

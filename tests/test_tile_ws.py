@@ -134,3 +134,30 @@ def test_overflow_flag(rng):
         exit_cap=8, fill_cap=8,
     )
     assert bool(ovf)
+
+
+def test_dt_watershed_seeded_tiled_external_encoding(rng):
+    """Two-pass mode: external seeds dominate their basins and come back
+    with the +N offset; unseeded regions get internal flat-index fragments
+    (same contract as the legacy dt_watershed_seeded)."""
+    from cluster_tools_tpu.ops.tile_ws import dt_watershed_seeded_tiled
+
+    shape = (16, 16, 128)
+    n = int(np.prod(shape))
+    b = rng.random(shape).astype(np.float32) * 0.2
+    b[:, :, 60:68] = 0.95  # a wall splits the volume in x
+    ext = np.zeros(shape, np.int32)
+    ext[2:6, 2:6, 2:6] = 3  # pass-one neighbor label (dense id 3)
+    lab, ovf = dt_watershed_seeded_tiled(
+        jnp.asarray(b), jnp.asarray(ext), threshold=0.5, impl="xla"
+    )
+    assert not bool(ovf)
+    lab = np.asarray(lab)
+    # the external basin keeps id 3 + N across the left side
+    assert (lab[2:6, 2:6, 2:6] == 3 + n).all()
+    left = lab[:, :, :60]
+    assert ((left == 3 + n) | ((left >= 1) & (left <= n))).all()
+    # right of the wall is unreachable from the external seed: internal only
+    right = lab[:, :, 68:]
+    assert (right <= n).all() and (right >= 0).all()
+    assert (right > 0).any()
