@@ -240,17 +240,33 @@ def main():
 
     # ---- headline / config 3: fused watershed + merged-CC step ----
     min_seed_distance = 2.0  # reference configs suppress sub-voxel seed plateaus
-    step = make_ws_ccl_step(
-        mesh, halo=halo, threshold=threshold, dt_max_distance=float(halo),
-        min_seed_distance=min_seed_distance, impl="auto",
-    )
-    log("config 3 (headline): compiling fused ws+ccl step")
+    # impl ladder: the Mosaic kernels are the fast path, but the headline
+    # JSON must survive a compile/runtime failure on whatever hardware state
+    # the driver finds — fall back to the portable tiled XLA kernels, then
+    # to the round-2 legacy kernels, before giving up
+    step = None
+    headline_impl = "none"
+    for impl in ("auto", "xla", "legacy"):
+        try:
+            candidate = make_ws_ccl_step(
+                mesh, halo=halo, threshold=threshold,
+                dt_max_distance=float(halo),
+                min_seed_distance=min_seed_distance, impl=impl,
+            )
+            log(f"config 3 (headline): compiling fused ws+ccl step (impl={impl})")
+            out0 = candidate(vol)
+            _sync(out0)
+            step = candidate
+            headline_impl = impl
+            break
+        except Exception as e:
+            log(f"impl={impl} FAILED: {type(e).__name__}: {str(e)[:300]}")
+    if step is None:
+        raise RuntimeError("every fused-step impl failed; see stderr")
     profile_dir = os.environ.get("CT_BENCH_PROFILE")
     if profile_dir:
         # SURVEY.md §5.1: per-kernel traces on demand — view with
         # tensorboard or xprof.  One profiled run after warmup.
-        out0 = step(vol)
-        _sync(out0)
         log(f"profiling one step into {profile_dir}")
         with jax.profiler.trace(profile_dir):
             out0 = step(vol)
@@ -374,6 +390,7 @@ def main():
         "vs_baseline": round(vps / base_vps, 3),
         "vs_32core": round(vps / (32 * base_vps), 3),
         "backend": backend,
+        "impl": headline_impl,
         "mesh": {"dp": dp, "sp": sp},
         "collectives_measured": dp * sp > 1,
         "volume": list(vol.shape),
