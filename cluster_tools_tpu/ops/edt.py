@@ -144,6 +144,7 @@ def distance_transform_squared(
     mask: jnp.ndarray,
     sampling: Optional[Sequence[float]] = None,
     max_distance: Optional[float] = None,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Squared EDT of a boolean mask: distance to the nearest background voxel.
 
@@ -157,6 +158,9 @@ def distance_transform_squared(
     larger distances saturate (at least ``max_distance**2``).  Inside
     blockwise pipelines pass the halo/seed scale — the cascade cost is linear
     in the per-axis radius, so a cap turns O(n) iterations into O(cap).
+
+    ``impl``: "auto" (VMEM cascade kernel on TPU, XLA elsewhere), "pallas",
+    or "xla".
     """
     sampling = _norm_sampling(mask.ndim, sampling)
     if max_distance is None:
@@ -165,7 +169,7 @@ def distance_transform_squared(
         radii = tuple(
             int(np.ceil(float(max_distance) / s)) for s in sampling
         )
-    return _dt_squared_impl(mask, sampling, radii)
+    return _dt_squared_impl(mask, sampling, radii, impl=impl)
 
 
 def distance_transform(

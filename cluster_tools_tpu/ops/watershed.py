@@ -205,8 +205,11 @@ def distance_transform_watershed(
         return jnp.where(lab > 0, lab + offs, 0)
 
     fg = (boundaries < threshold) & valid
+    # impl="xla": the legacy kernel is the predictable fallback and runs
+    # under vmap (entry(), executor batches) where the Mosaic EDT lifting
+    # is untested on this hardware; the tiled pipeline uses the VMEM EDT
     dist = distance_transform_squared(
-        fg, sampling=sampling, max_distance=dt_max_distance
+        fg, sampling=sampling, max_distance=dt_max_distance, impl="xla"
     )
     if sigma_seeds > 0:
         dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
@@ -290,7 +293,7 @@ def dt_watershed_seeded(
     valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
     fg = (boundaries < threshold) & valid
     dist = distance_transform_squared(
-        fg, sampling=sampling, max_distance=dt_max_distance
+        fg, sampling=sampling, max_distance=dt_max_distance, impl="xla"
     )
     if sigma_seeds > 0:
         dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)

@@ -1,0 +1,133 @@
+"""One-shot TPU measurement battery for the round-3 kernels.
+
+Run when the chip is reachable: per-kernel timings at bench scale, tile-size
+and capacity sensitivity, and the fused-step breakdown.  Everything syncs by
+scalar fetch (block_until_ready returns after enqueue on axon).
+
+    python scripts/tpu_measure.py [--quick]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+T0 = time.monotonic()
+
+
+def log(m):
+    print(f"[+{time.monotonic() - T0:.1f}s] {m}", flush=True)
+
+
+def sync(out):
+    for leaf in jax.tree_util.tree_leaves(out):
+        arr = leaf.ravel()[0] if getattr(leaf, "ndim", 0) else leaf
+        np.asarray(jax.device_get(arr))
+
+
+def timeit(name, fn, *args, runs=3):
+    try:
+        out = fn(*args)
+        sync(out)
+    except Exception as e:
+        log(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}")
+        return None, None
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    log(f"{name}: {best * 1000:.0f}ms")
+    return best, out
+
+
+def main():
+    quick = "--quick" in sys.argv
+    log(f"devices: {jax.devices()}")
+    from cluster_tools_tpu.ops.tile_ccl import label_components_tiled
+    from cluster_tools_tpu.ops.tile_ws import dt_watershed_tiled, seeded_watershed_tiled
+    from cluster_tools_tpu.ops.edt import _dt_squared_impl
+    from cluster_tools_tpu.parallel.mesh import make_mesh
+    from cluster_tools_tpu.parallel.pipeline import make_ws_ccl_step
+
+    side = 256 if quick else 512
+    halo = 32
+
+    @jax.jit
+    def synth(key):
+        v = jax.random.uniform(key, (side + 2 * halo, side, side), jnp.float32)
+        for axis in range(3):
+            for _ in range(4):
+                v = (v + jnp.roll(v, 1, axis) + jnp.roll(v, -1, axis)) / 3.0
+        lo, hi = v.min(), v.max()
+        return (v - lo) / jnp.maximum(hi - lo, 1e-6)
+
+    vol = synth(jax.random.PRNGKey(0))
+    sync(vol)
+    log(f"volume {vol.shape} ready")
+    fg = vol < 0.45
+    sync(fg)
+
+    # EDT: pallas vs xla
+    radii = (halo, halo, halo)
+    timeit("EDT xla cap=32", lambda m: _dt_squared_impl(m, (1.0, 1.0, 1.0), radii, impl="xla"), fg)
+    timeit("EDT pallas cap=32", lambda m: _dt_squared_impl(m, (1.0, 1.0, 1.0), radii, impl="pallas"), fg)
+
+    # tiled CCL, both impls
+    timeit("CCL tiled pallas", lambda m: label_components_tiled(m, impl="pallas"), fg)
+    if not quick:
+        timeit("CCL tiled xla", lambda m: label_components_tiled(m, impl="xla"), fg)
+
+    # DT watershed fused, both impls
+    timeit(
+        "dt_ws tiled pallas",
+        lambda b: dt_watershed_tiled(
+            b, threshold=0.45, dt_max_distance=float(halo),
+            min_seed_distance=2.0, impl="pallas",
+        ),
+        vol,
+    )
+
+    # table-cap sensitivity on the watershed
+    for cap in (32, 64, 128):
+        timeit(
+            f"dt_ws pallas table_cap={cap}",
+            lambda b, c=cap: dt_watershed_tiled(
+                b, threshold=0.45, dt_max_distance=float(halo),
+                min_seed_distance=2.0, impl="pallas", table_cap=c,
+            ),
+            vol,
+            runs=2,
+        )
+
+    # tile-shape sensitivity on CCL
+    for tile in ((8, 16, 128), (16, 16, 128), (32, 16, 128), (16, 32, 128)):
+        timeit(
+            f"CCL pallas tile={tile}",
+            lambda m, t=tile: label_components_tiled(m, impl="pallas", tile=t),
+            fg,
+            runs=2,
+        )
+
+    # the full fused mesh step at bench config
+    mesh = make_mesh(1, axis_names=("dp", "sp"), devices=jax.devices())
+    volb = vol[None, halo:-halo]  # (1, side, side, side)
+    for impl in ("auto", "legacy") if not quick else ("auto",):
+        step = make_ws_ccl_step(
+            mesh, halo=halo, threshold=0.45, dt_max_distance=float(halo),
+            min_seed_distance=2.0, impl=impl,
+        )
+        t, out = timeit(f"fused step impl={impl}", step, volb, runs=3)
+        if t:
+            log(f"  -> {volb.size / t:,.0f} voxels/s")
+
+    log("battery done")
+
+
+if __name__ == "__main__":
+    main()
