@@ -78,8 +78,13 @@ def main():
     timeit("EDT xla cap=32", lambda m: _dt_squared_impl(m, (1.0, 1.0, 1.0), radii, impl="xla"), fg)
     timeit("EDT pallas cap=32", lambda m: _dt_squared_impl(m, (1.0, 1.0, 1.0), radii, impl="pallas"), fg)
 
-    # tiled CCL, both impls
+    # tiled CCL, both impls + the doubling kernel
     timeit("CCL tiled pallas", lambda m: label_components_tiled(m, impl="pallas"), fg)
+    from cluster_tools_tpu.ops.pallas_kernels import tile_ccl_pallas
+
+    crop = fg[: side, :, :]
+    timeit("in-tile CCL unit-step", lambda m: tile_ccl_pallas(m), crop)
+    timeit("in-tile CCL doubling", lambda m: tile_ccl_pallas(m, doubling=True), crop)
     if not quick:
         timeit("CCL tiled xla", lambda m: label_components_tiled(m, impl="xla"), fg)
 
