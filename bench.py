@@ -415,6 +415,12 @@ def main():
                 "voxels_per_sec": round(vps, 1),
             },
             "rag_multicut_crop": rag_result,
+            "teravoxel_multihost": {
+                "status": "not benchable on this rig (single chip); the "
+                "capability is exercised by dryrun_multichip's 2-axis "
+                "decomposition with int32-safe compaction and the "
+                "multi-process DCN pod test (tests/test_multihost.py)",
+            },
         },
     }
     print(json.dumps(result), flush=True)
