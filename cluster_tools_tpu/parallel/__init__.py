@@ -19,6 +19,7 @@ same decomposition is expressed as sharded axes of a ``jax.sharding.Mesh``:
 """
 
 from .mesh import make_mesh, mesh_axis_sizes
+from .reshard import reshard_axis, transpose_sharding
 from .halo import exchange_halo, crop_halo, neighbor_face
 from .distributed_ccl import (
     sharded_label_components,

@@ -1,0 +1,69 @@
+"""Shard-axis transposition over ICI: the all-to-all reshard primitive.
+
+The reference had no analogue — its jobs only ever exchanged data through
+the filesystem (SURVEY.md §2d).  On a mesh, changing which *spatial* axis is
+sharded is one ``lax.all_to_all`` over ICI, the exact pattern
+sequence-parallel attention uses to flip between sequence- and head-sharded
+layouts (SURVEY.md §5.7 maps sequence parallelism onto spatial
+decomposition).
+
+Use it when an op needs one axis resident in full — e.g. an exact
+(uncapped) separable EDT pass along z on a z-sharded volume: reshard so x is
+the sharded axis, run the z pass locally at full extent, reshard back.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def reshard_axis(
+    x: jnp.ndarray, axis_name: str, from_axis: int, to_axis: int
+) -> jnp.ndarray:
+    """Inside ``shard_map``: move the sharded dimension of a volume.
+
+    ``x`` is the local shard of a volume globally sharded along
+    ``from_axis``; the result is the local shard of the same volume sharded
+    along ``to_axis`` (``from_axis`` becomes fully resident).  ``to_axis``'s
+    local extent must be divisible by the mesh axis size.
+    """
+    if from_axis == to_axis:
+        return x
+    return lax.all_to_all(
+        x, axis_name, split_axis=to_axis, concat_axis=from_axis, tiled=True
+    )
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis_name", "from_axis", "to_axis"))
+def transpose_sharding(
+    vol: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    from_axis: int = 0,
+    to_axis: int = 2,
+) -> jnp.ndarray:
+    """Whole-volume wrapper: input sharded along ``from_axis``, output along
+    ``to_axis`` — one ICI all-to-all, no host round trip."""
+    spec_in = [None] * vol.ndim
+    spec_in[from_axis] = axis_name
+    spec_out = [None] * vol.ndim
+    spec_out[to_axis] = axis_name
+
+    fn = jax.shard_map(
+        partial(
+            reshard_axis,
+            axis_name=axis_name,
+            from_axis=from_axis,
+            to_axis=to_axis,
+        ),
+        mesh=mesh,
+        in_specs=P(*spec_in),
+        out_specs=P(*spec_out),
+    )
+    return fn(vol)

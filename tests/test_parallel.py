@@ -183,3 +183,25 @@ def test_graft_entry_dryrun():
     import __graft_entry__ as g
 
     g.dryrun_multichip(len(backend_devices("local")))
+
+
+def test_reshard_axis_roundtrip():
+    """all-to-all shard transposition: values identical to the unsharded
+    volume under both layouts, and a z->x->z round trip is the identity."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from cluster_tools_tpu.parallel.mesh import make_mesh
+    from cluster_tools_tpu.parallel.reshard import transpose_sharding
+
+    mesh = make_mesh(4, axis_names=("sp",))
+    rng = np.random.default_rng(3)
+    vol = jnp.asarray(rng.random((8, 12, 16)).astype(np.float32))
+    vz = jax.device_put(vol, NamedSharding(mesh, P("sp")))
+    vx = transpose_sharding(vz, mesh, "sp", from_axis=0, to_axis=2)
+    np.testing.assert_allclose(np.asarray(vx), np.asarray(vol))
+    # the output really is sharded along x now
+    shard_shapes = {s.data.shape for s in vx.addressable_shards}
+    assert shard_shapes == {(8, 12, 4)}
+    back = transpose_sharding(vx, mesh, "sp", from_axis=2, to_axis=0)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(vol))
+    assert {s.data.shape for s in back.addressable_shards} == {(2, 12, 16)}
