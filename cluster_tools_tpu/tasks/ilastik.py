@@ -122,33 +122,42 @@ def train_pixel_classifier(
 # ilastik pixel-classification project; SURVEY.md §2a "ilastik")
 # ---------------------------------------------------------------------------
 
-# ilastik feature-id strings -> (scale-parameterized) device filters.  The
-# two eigenvalue features (Hessian/structure tensor) have no separable
-# implementation in ops.filters yet and are rejected with a clear error.
+# ilastik feature-id strings -> (scale-parameterized) device filters;
+# eigenvalue features contribute 3 channels each (the ilastik convention)
 ILP_SUPPORTED_FEATURES = (
     "GaussianSmoothing",
     "LaplacianOfGaussian",
     "GaussianGradientMagnitude",
     "DifferenceOfGaussians",
+    "HessianOfGaussianEigenvalues",
+    "StructureTensorEigenvalues",
 )
 
 
 def _ilp_single_feature(x: jnp.ndarray, fid: str, sigma: float) -> jnp.ndarray:
+    """One selection's channels: (*shape, c) with c = 1 or 3."""
+    from ..ops.filters import hessian_eigenvalues, structure_tensor_eigenvalues
+
     if fid == "GaussianSmoothing":
-        return gaussian_smooth(x, sigma=sigma)
+        return gaussian_smooth(x, sigma=sigma)[..., None]
     if fid == "GaussianGradientMagnitude":
-        return gradient_magnitude(x, sigma=sigma)
+        return gradient_magnitude(x, sigma=sigma)[..., None]
     if fid == "LaplacianOfGaussian":
         sm = gaussian_smooth(x, sigma=sigma)
         lap = jnp.zeros_like(sm)
         for axis in range(x.ndim):
             lap = lap + (jnp.roll(sm, 1, axis) + jnp.roll(sm, -1, axis) - 2 * sm)
-        return lap
+        return lap[..., None]
     if fid == "DifferenceOfGaussians":
         # ilastik's DoG pairs sigma with 0.66*sigma
-        return gaussian_smooth(x, sigma=sigma) - gaussian_smooth(
-            x, sigma=0.66 * sigma
-        )
+        return (
+            gaussian_smooth(x, sigma=sigma)
+            - gaussian_smooth(x, sigma=0.66 * sigma)
+        )[..., None]
+    if fid == "HessianOfGaussianEigenvalues":
+        return hessian_eigenvalues(x, sigma=sigma)
+    if fid == "StructureTensorEigenvalues":
+        return structure_tensor_eigenvalues(x, sigma=sigma)
     raise ValueError(f"unsupported ilastik feature id {fid!r}")
 
 
@@ -156,9 +165,13 @@ def _ilp_single_feature(x: jnp.ndarray, fid: str, sigma: float) -> jnp.ndarray:
 def ilp_feature_bank(
     x: jnp.ndarray, selections: Tuple[Tuple[str, float], ...]
 ) -> jnp.ndarray:
-    """Featurize with an .ilp project's (feature_id, sigma) selections."""
+    """Featurize with an .ilp project's (feature_id, sigma) selections.
+
+    Channel count is ``sum(3 if eigenvalue feature else 1)``, in selection
+    order — matching ilastik's feature-matrix layout.
+    """
     feats = [_ilp_single_feature(x, fid, float(s)) for fid, s in selections]
-    return jnp.stack(feats, axis=-1)
+    return jnp.concatenate(feats, axis=-1)
 
 
 def _parse_block_slice(s: str) -> Tuple[slice, ...]:

@@ -269,7 +269,7 @@ def test_ilp_rejects_unsupported_and_unlabeled(workspace, rng):
     lb[0, 0, 0] = 1
     _write_minimal_ilp(
         ilp, [((slice(0, 4), slice(0, 4), slice(0, 4)), lb)],
-        ["HessianOfGaussianEigenvalues"], [1.0], m,
+        ["Vesselness"], [1.0], m,
     )
     with pytest.raises(ValueError, match="not supported"):
         load_ilp_project(ilp)
@@ -278,3 +278,54 @@ def test_ilp_rejects_unsupported_and_unlabeled(workspace, rng):
     _write_minimal_ilp(ilp2, [], ["GaussianSmoothing"], [1.0], m)
     with pytest.raises(ValueError, match="no label annotations"):
         load_ilp_project(ilp2)
+
+
+def test_symmetric3_eigenvalues_vs_lapack(rng):
+    from cluster_tools_tpu.ops.filters import _symmetric3_eigenvalues
+
+    m = rng.normal(0, 1, (200, 3, 3)).astype(np.float32)
+    sym = (m + np.transpose(m, (0, 2, 1))) / 2
+    got = np.asarray(
+        _symmetric3_eigenvalues(
+            jnp.asarray(sym[:, 0, 0]), jnp.asarray(sym[:, 0, 1]),
+            jnp.asarray(sym[:, 0, 2]), jnp.asarray(sym[:, 1, 1]),
+            jnp.asarray(sym[:, 1, 2]), jnp.asarray(sym[:, 2, 2]),
+        )
+    )
+    want = np.linalg.eigvalsh(sym)[:, ::-1]  # descending
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_eigenvalue_filters_semantics(rng):
+    from cluster_tools_tpu.ops.filters import (
+        hessian_eigenvalues,
+        structure_tensor_eigenvalues,
+    )
+
+    # bright gaussian blob: at the center, all Hessian eigenvalues < 0
+    z, y, x = np.mgrid[:17, :17, :17].astype(np.float32)
+    blob = np.exp(-(((z - 8) ** 2 + (y - 8) ** 2 + (x - 8) ** 2) / 18.0))
+    he = np.asarray(hessian_eigenvalues(jnp.asarray(blob), sigma=1.0))
+    assert (he[8, 8, 8] < 0).all()
+    # eigenvalues come back sorted descending
+    assert (np.diff(he, axis=-1) <= 1e-5).all()
+
+    # planar step: structure tensor has one dominant eigenvalue at the face
+    step = np.zeros((16, 16, 16), np.float32)
+    step[:, :, 8:] = 1.0
+    st = np.asarray(structure_tensor_eigenvalues(jnp.asarray(step), sigma=1.0))
+    e = st[8, 8, 8]
+    assert e[0] > 10 * max(abs(e[1]), abs(e[2]), 1e-6)
+
+
+def test_ilp_eigenvalue_features_channels(rng):
+    from cluster_tools_tpu.tasks.ilastik import ilp_feature_bank
+
+    x = jnp.asarray(rng.random((8, 12, 16)).astype(np.float32))
+    sel = (
+        ("GaussianSmoothing", 1.0),
+        ("HessianOfGaussianEigenvalues", 1.0),
+        ("StructureTensorEigenvalues", 1.6),
+    )
+    feats = np.asarray(ilp_feature_bank(x, sel))
+    assert feats.shape == (8, 12, 16, 1 + 3 + 3)
