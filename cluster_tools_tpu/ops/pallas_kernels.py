@@ -54,6 +54,24 @@ WS_MARKER = -(2**30)
 WS_OFFS = ((-1, 0, 0), (1, 0, 0), (0, -1, 0), (0, 1, 0), (0, 0, -1), (0, 0, 1))
 
 
+def _out_struct(shape, dtype, *like) -> jax.ShapeDtypeStruct:
+    """Output aval for ``pallas_call`` whose varying-manual-axes match ``like``.
+
+    Under ``shard_map(check_vma=True)`` (the default) ``pallas_call`` refuses a
+    plain ``ShapeDtypeStruct`` — the output's ``vma`` must be stated.  The
+    kernels here are purely per-shard, so the output varies over exactly the
+    axes their inputs vary over.
+    """
+    vma = frozenset()
+    for a in like:
+        v = getattr(jax.typeof(a), "vma", None)
+        if v:
+            vma = vma | v
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _ccl_kernel_doubling(tile_shape, mask_ref, out_ref):
     """In-tile CCL via guarded run-doubling propagation.
 
@@ -165,7 +183,7 @@ def tile_ccl_pallas(
     kernel = _ccl_kernel_doubling if doubling else _ccl_kernel
     return pl.pallas_call(
         partial(kernel, tile),
-        out_shape=jax.ShapeDtypeStruct((z, y, x), jnp.int32),
+        out_shape=_out_struct((z, y, x), jnp.int32, mask),
         grid=(z // tz, y // ty, x // tx),
         in_specs=[
             pl.BlockSpec(tile, lambda i, j, k: (i, j, k), memory_space=pltpu.VMEM)
@@ -257,7 +275,7 @@ def tile_ws_propagate_pallas(
     assert z % tz == 0 and y % ty == 0 and x % tx == 0
     return pl.pallas_call(
         partial(_ws_kernel, tile),
-        out_shape=jax.ShapeDtypeStruct((z, y, x), jnp.int32),
+        out_shape=_out_struct((z, y, x), jnp.int32, dirs, seeds_or_invalid),
         grid=(z // tz, y // ty, x // tx),
         in_specs=[
             pl.BlockSpec(tile, lambda i, j, k: (i, j, k), memory_space=pltpu.VMEM),
@@ -312,7 +330,7 @@ def edt_cascade_pallas(
     assert z % tz == 0 and y % ty == 0 and x % tx == 0, (f.shape, tile)
     return pl.pallas_call(
         partial(_edt_kernel, axis, radius, w, big),
-        out_shape=jax.ShapeDtypeStruct((z, y, x), jnp.float32),
+        out_shape=_out_struct((z, y, x), jnp.float32, f),
         grid=(z // tz, y // ty, x // tx),
         in_specs=[
             pl.BlockSpec(tile, lambda i, j, k: (i, j, k), memory_space=pltpu.VMEM)
@@ -364,7 +382,7 @@ def apply_remap_pallas(
 
     return pl.pallas_call(
         partial(_apply_kernel, cap),
-        out_shape=jax.ShapeDtypeStruct((z, y, x), jnp.int32),
+        out_shape=_out_struct((z, y, x), jnp.int32, old_tbl, new_tbl, labels),
         grid=(gz, gy, gx),
         in_specs=[
             pl.BlockSpec((1, 1, cap), tbl_map, memory_space=pltpu.VMEM),

@@ -566,8 +566,11 @@ def dt_watershed_tiled(
 
     valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
     fg = (boundaries < threshold) & valid
+    # "xla" must stay Mosaic-free end-to-end; other modes let the EDT pick
+    # its own fast path ("pallas" lacks an interpret plumb, so not forwarded)
     dist = distance_transform_squared(
-        fg, sampling=sampling, max_distance=dt_max_distance
+        fg, sampling=sampling, max_distance=dt_max_distance,
+        impl="xla" if impl == "xla" else "auto",
     )
     if sigma_seeds > 0:
         dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
@@ -634,8 +637,11 @@ def dt_watershed_seeded_tiled(
     n = int(np.prod(boundaries.shape))
     valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
     fg = (boundaries < threshold) & valid
+    # "xla" must stay Mosaic-free end-to-end; other modes let the EDT pick
+    # its own fast path ("pallas" lacks an interpret plumb, so not forwarded)
     dist = distance_transform_squared(
-        fg, sampling=sampling, max_distance=dt_max_distance
+        fg, sampling=sampling, max_distance=dt_max_distance,
+        impl="xla" if impl == "xla" else "auto",
     )
     if sigma_seeds > 0:
         dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)

@@ -179,9 +179,14 @@ def sharded_label_components(
     if n_shards == 1:
         # no cross-shard faces exist: per-shard labels are already global.
         # This also keeps the single-chip benchmark free of the (empty)
-        # pair/merge machinery.
+        # pair/merge machinery.  The overflow flag still needs its pmax over
+        # the (size-1) sharded axes: the flag is promised replicated, and
+        # shard_map's vma check rejects an sp-varying scalar against P().
         if return_overflow:
-            return glob, overflow
+            ov = overflow.astype(jnp.int32)
+            for _, name, _ in axes:
+                ov = lax.pmax(ov, name)
+            return glob, ov > 0
         return glob
 
     # 2. cross-shard equivalences per sharded axis
@@ -267,5 +272,8 @@ def distributed_connected_components(
         mesh=mesh,
         in_specs=P(*names),
         out_specs=(P(*names), P()) if return_overflow else P(*names),
+        # see make_ws_ccl_step: Pallas in-kernel vma propagation is broken on
+        # this JAX version; only the static replication check is disabled
+        check_vma=False,
     )
     return fn(mask)

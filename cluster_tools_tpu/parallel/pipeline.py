@@ -185,10 +185,18 @@ def make_ws_ccl_step(
         max_labels_per_shard=max_labels_per_shard,
         impl=impl,
     )
+    # check_vma=False: the per-shard body runs Pallas kernels whose in-kernel
+    # loop carries mix ref loads (vma-tagged) with constants (untagged), and
+    # this JAX version's vma propagation drops the tag across concatenate
+    # inside pallas tracing — the static check then rejects a correct
+    # program ("carry input {V:sp} vs output" on the EDT cascade).  The
+    # collectives (ppermute halo, all_gather merge, psum stats) are
+    # unaffected; only the static replication *check* is off.
     sharded = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=P(dp_axis, sp_axis),
         out_specs=(P(dp_axis, sp_axis), P(dp_axis, sp_axis), P(), P()),
+        check_vma=False,
     )
     return jax.jit(sharded)
