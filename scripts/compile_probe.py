@@ -1,0 +1,140 @@
+"""Bisect TPU compile time of the fused-step components at bench scale.
+
+Usage: python scripts/compile_probe.py <target> [extent] [halo]
+
+Each invocation compiles ONE component at the (padded) bench shape and
+prints the compile wall-clock.  Run each target in its own capped
+subprocess: a wedged remote compile hangs the process, so the caller must
+enforce the timeout (e.g. ``timeout 300 python scripts/compile_probe.py edt``).
+
+Targets: edt, ccl, ccl_doubling, ws_seeded, dt_ws, fused, synth
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    ),
+)
+
+_T0 = time.monotonic()
+
+
+def log(msg: str) -> None:
+    print(f"[probe +{time.monotonic() - _T0:.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    target = sys.argv[1] if len(sys.argv) > 1 else "edt"
+    extent = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    halo = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the session sitecustomize force-updates jax_platforms to axon;
+        # honor an explicit CPU request (tunnel-down testing)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    log(f"backend: {jax.devices()}")
+    z = extent + 2 * halo
+    shape = (z, extent, extent)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def synth(key):
+        v = jax.random.uniform(key, shape, jnp.float32)
+        for axis in range(3):
+            for _ in range(2):
+                v = (v + jnp.roll(v, 1, axis) + jnp.roll(v, -1, axis)) / 3.0
+        return v
+
+    t0 = time.monotonic()
+    vol = synth(key)
+    float(vol.ravel()[0])
+    log(f"synth {shape}: {time.monotonic() - t0:.1f}s")
+    if target == "synth":
+        return
+
+    threshold = 0.45
+    impl = os.environ.get("CT_PROBE_IMPL", "pallas")
+    if target == "edt":
+        from cluster_tools_tpu.ops.edt import distance_transform_squared
+
+        fn = jax.jit(
+            lambda v: distance_transform_squared(
+                v < threshold, max_distance=float(halo), impl=impl
+            )
+        )
+    elif target in ("ccl", "ccl_doubling"):
+        from cluster_tools_tpu.ops.tile_ccl import label_components_tiled
+
+        fn = jax.jit(
+            lambda v: label_components_tiled(
+                v < threshold, impl=impl,
+                doubling=(target == "ccl_doubling"),
+            )[0]
+        )
+    elif target == "ws_seeded":
+        from cluster_tools_tpu.ops.tile_ws import seeded_watershed_tiled
+
+        def fn_(v):
+            seeds = (v < 0.1).astype(jnp.int32)
+            return seeded_watershed_tiled(v, seeds, impl=impl)[0]
+
+        fn = jax.jit(fn_)
+    elif target == "dt_ws":
+        from cluster_tools_tpu.ops.tile_ws import dt_watershed_tiled
+
+        fn = jax.jit(
+            lambda v: dt_watershed_tiled(
+                v, threshold=threshold, dt_max_distance=float(halo),
+                min_seed_distance=2.0, impl=impl,
+            )[0]
+        )
+    elif target == "fused":
+        import numpy as np
+
+        from cluster_tools_tpu.parallel.pipeline import make_ws_ccl_step
+
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+        step = make_ws_ccl_step(
+            mesh, halo=halo, threshold=threshold, dt_max_distance=float(halo),
+            min_seed_distance=2.0, impl=os.environ.get("CT_PROBE_IMPL", "auto"),
+        )
+        inner = vol[halo:-halo] if halo else vol
+        fn = lambda v: step(v[None])  # noqa: E731
+        vol = inner
+    else:
+        raise SystemExit(f"unknown target {target!r}")
+
+    log(f"compiling+running {target} at {vol.shape}")
+    t0 = time.monotonic()
+    out = fn(vol)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    _ = leaf.ravel()[0].item() if leaf.ndim else leaf.item()
+    t_first = time.monotonic() - t0
+    t0 = time.monotonic()
+    out = fn(vol)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    _ = leaf.ravel()[0].item() if leaf.ndim else leaf.item()
+    t_second = time.monotonic() - t0
+    log(f"{target}: first (compile+run) {t_first:.1f}s, second (run) {t_second:.2f}s")
+    print(f"PROBE {target} extent={extent} halo={halo} "
+          f"first={t_first:.1f} second={t_second:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -170,6 +170,30 @@ def test_ws_ccl_step_shapes_and_consistency(rng):
     assert bool(overflow3)
 
 
+@pytest.mark.parametrize("impl", ["auto", "legacy"])
+def test_ws_ccl_step_single_device_mesh(rng, impl):
+    """The 1x1 (dp, sp) mesh — the single-chip benchmark topology.
+
+    Regression: with ``sp_size == 1`` the distributed CCL's early return
+    skipped the overflow-flag reduction, leaving it sp-varying against a
+    replicated out_spec — every impl failed to trace.  The multi-device
+    tests can't see this because their axes are > 1.
+    """
+    mesh = make_mesh(1, axis_names=("dp", "sp"), devices=backend_devices("local"))
+    vol = rng.random((1, 24, 16, 16)).astype(np.float32)
+    step = make_ws_ccl_step(
+        mesh, halo=2, threshold=0.5, dt_max_distance=2.0, impl=impl
+    )
+    ws, cc, n_fg, overflow = jax.block_until_ready(step(vol))
+    cc = np.asarray(cc)
+    assert int(n_fg) == int((cc > 0).sum())
+    assert not bool(overflow)
+    expected, _ = ndimage.label(
+        vol[0] < 0.5, structure=ndimage.generate_binary_structure(3, 1)
+    )
+    assert_labels_equivalent(cc[0], expected)
+
+
 def test_graft_entry_single_chip():
     import __graft_entry__ as g
 
