@@ -55,25 +55,74 @@ ShardAxis = Tuple[int, str, int]
 
 
 def _boundary_pairs(
-    glob: jnp.ndarray, axis: int, axis_name: str, axis_size: int
+    glob: jnp.ndarray, axes: Sequence[ShardAxis], connectivity: int
 ) -> jnp.ndarray:
-    """Label-equivalence pairs across the low boundary of this shard.
+    """Label-equivalence pairs across every shard boundary of this shard.
 
-    Pairs up this shard's first slab along ``axis`` with the previous rank's
-    last slab (face connectivity, as the reference's ``block_faces`` task).
-    Invalid slots are (-1, -1), which the union-find treats as no-ops — the
-    pair list has static shape ``(face_area, 2)``.
+    Generalizes the reference's ``block_faces`` scan to the mesh: for each
+    unordered neighbor-shard direction over the sharded axes (first nonzero
+    -1, so every shard pair is emitted exactly once; faces at connectivity
+    1, plus edge-/corner-adjacent shards at higher connectivity), the
+    neighbor's boundary slab arrives by composing one ``ppermute`` per
+    crossed axis, and in-slab diagonal adjacency is enumerated as shifted
+    views with at most ``connectivity`` total differing coordinates (scipy
+    semantics).  Invalid slots are (-1, -1), which the union-find treats as
+    no-ops — the pair list has a static shape.
     """
-    mine = lax.slice_in_dim(glob, 0, 1, axis=axis).ravel()
-    theirs = neighbor_face(glob, axis, axis_name, axis_size, direction=-1).ravel()
-    valid = (mine > 0) & (theirs > 0)
-    return jnp.stack(
-        [
-            jnp.where(valid, theirs, jnp.int32(-1)),
-            jnp.where(valid, mine, jnp.int32(-1)),
-        ],
-        axis=1,
-    )
+    from itertools import product as iproduct
+
+    from ..ops.ccl import _neighbor_offsets, _shift
+
+    shard_ax = [a for a, _, _ in axes]
+    meta = {a: (name, size) for a, name, size in axes}
+    out = []
+    # the kernel's half-neighborhood, negated: directions whose first nonzero
+    # is -1, i.e. each shard receives from its lower-ranked neighbors so every
+    # unordered shard pair is emitted exactly once
+    for d_combo in (
+        tuple(-v for v in d) for d in _neighbor_offsets(len(shard_ax), connectivity)
+    ):
+        theirs = glob
+        mine = glob
+        for a, dv in zip(shard_ax, d_combo):
+            if dv == 0:
+                continue
+            name, size = meta[a]
+            # ppermute composes: after the first crossing the slab is
+            # 1-thick along that axis and the next crossing slices it along
+            # its own axis — shards beyond the grid edge contribute 0s
+            theirs = neighbor_face(theirs, a, name, size, direction=dv)
+            if dv == -1:
+                mine = lax.slice_in_dim(mine, 0, 1, axis=a)
+            else:
+                mine = lax.slice_in_dim(
+                    mine, mine.shape[a] - 1, mine.shape[a], axis=a
+                )
+        crossing = set(a for a, dv in zip(shard_ax, d_combo) if dv)
+        budget = connectivity - len(crossing)
+        free = [a for a in range(glob.ndim) if a not in crossing]
+        for s_combo in iproduct((-1, 0, 1), repeat=len(free)):
+            if sum(1 for v in s_combo if v) > budget:
+                continue
+            th = theirs
+            for a, sv in zip(free, s_combo):
+                if sv:
+                    # th[p] = theirs[p + sv] along axis a; voxels shifted in
+                    # from outside the slab are 0 (background, never pair)
+                    th = _shift(th, -sv, a, 0)
+            m = mine.ravel()
+            t = th.ravel()
+            valid = (m > 0) & (t > 0)
+            out.append(
+                jnp.stack(
+                    [
+                        jnp.where(valid, t, jnp.int32(-1)),
+                        jnp.where(valid, m, jnp.int32(-1)),
+                    ],
+                    axis=1,
+                )
+            )
+    return jnp.concatenate(out, axis=0)
 
 
 def _norm_shard_axes(
@@ -118,18 +167,17 @@ def sharded_label_components(
     ``return_overflow`` also returns a replicated bool that is True when any
     shard exceeded the compaction capacity (labels are then unreliable).
 
-    Cross-shard stitching uses face connectivity, so ``connectivity`` must be
-    1 (same restriction as the blockwise ``block_faces`` task).
+    Cross-shard stitching matches the in-shard neighborhood at any
+    ``connectivity`` (scipy semantics): faces at 1, plus diagonal adjacency
+    across face-, edge- and corner-adjacent shards at 2/3.
 
     ``impl``: per-shard CCL kernel — "legacy" (ops.ccl hook/compress),
     "tiled"/"pallas"/"xla"/"auto" (the two-level ops.tile_ccl machinery; on
     3-D shards with connectivity 1 this is the TPU fast path, and its
     capacity overflow is folded into the returned overflow flag).
     """
-    if connectivity != 1:
-        raise NotImplementedError(
-            "cross-shard stitching supports connectivity=1 only"
-        )
+    if not 1 <= connectivity <= mask.ndim:
+        raise ValueError(f"connectivity must be in [1, {mask.ndim}]")
     axes = _norm_shard_axes(axis_name, axis_size, shard_axis, shard_axes)
     shape = mask.shape
     n_slab = int(np.prod(shape))
@@ -189,10 +237,8 @@ def sharded_label_components(
             return glob, ov > 0
         return glob
 
-    # 2. cross-shard equivalences per sharded axis
-    pairs = jnp.concatenate(
-        [_boundary_pairs(glob, a, name, size) for a, name, size in axes], axis=0
-    )
+    # 2. cross-shard equivalences (faces; diagonals too at connectivity>1)
+    pairs = _boundary_pairs(glob, axes, connectivity)
     # 3. all_gather over every sharded mesh axis, then a replicated solve
     all_pairs = pairs
     for _, name, _ in axes:

@@ -19,7 +19,8 @@ same):
 
     BlockComponents   (mesh-batched)  per-block CCL -> global labels + uniques
     MergeLabels       (driver)        merge per-block uniques -> dense table
-    BlockFaces        (host IO pool)  adjacent-face scan -> equivalence pairs
+    BlockFaces        (host IO pool)  boundary scan (faces, plus edges and
+                                      corners at connectivity>1) -> pairs
     MergeAssignments  (device)        union-find -> assignment table
     Write             (host IO pool)  apply assignment blockwise
 """
@@ -98,6 +99,10 @@ class BlockComponentsBase(BaseTask):
         threshold = cfg.get("threshold")
         mode = cfg.get("threshold_mode", "greater")
         connectivity = int(cfg.get("connectivity", 1))
+        if not 1 <= connectivity <= len(shape):
+            # fail in pass 1, before any blocks burn time with an empty or
+            # nonsense neighborhood
+            raise ValueError(f"connectivity must be in [1, {len(shape)}]")
         keyed = bool(cfg.get("keyed", False))
         mask_ds = None
         if cfg.get("mask_path"):
@@ -207,68 +212,107 @@ class MergeLabelsTPU(MergeLabelsBase):
     target = "tpu"
 
 
-class BlockFacesBase(BaseTask):
-    """Pass 2: scan adjacent block faces for label equivalences.
+def _shifted_views(a: np.ndarray, b: np.ndarray, shifts) -> tuple:
+    """Views pairing ``a[p]`` with ``b[p + shifts]`` (per free axis)."""
+    sl_a, sl_b = [], []
+    for sh, n in zip(shifts, a.shape):
+        if sh == 1:
+            sl_a.append(slice(0, n - 1))
+            sl_b.append(slice(1, n))
+        elif sh == -1:
+            sl_a.append(slice(1, n))
+            sl_b.append(slice(0, n - 1))
+        else:
+            sl_a.append(slice(None))
+            sl_b.append(slice(None))
+    return a[tuple(sl_a)], b[tuple(sl_b)]
 
-    For every block and axis, reads the two 1-voxel slabs on either side of
-    the block's upper face and emits (label_a, label_b) pairs where both are
-    foreground (face-connectivity merge, as in the reference).  Host-side:
-    thin-slab IO is bandwidth-bound, not compute.
+
+class BlockFacesBase(BaseTask):
+    """Pass 2: scan adjacent block boundaries for label equivalences.
+
+    For every block and every unordered neighbor direction (faces at
+    connectivity 1; faces, edges, and corners at higher connectivity), reads
+    the 1-voxel slabs on either side of the shared boundary and emits
+    (label_a, label_b) pairs for every in-range voxel offset with at most
+    ``connectivity`` differing coordinates — the blockwise completion of the
+    per-block CCL's neighborhood (scipy semantics).  Host-side: thin-slab IO
+    is bandwidth-bound, not compute.
     """
 
     task_name = "block_faces"
 
     def run_impl(self):
+        from itertools import product
+
+        from ..ops.ccl import _neighbor_offsets
+
         cfg = self.get_config()
-        if int(cfg.get("connectivity", 1)) != 1:
-            # diagonal adjacency across block faces (and edge/corner-adjacent
-            # blocks) is not stitched yet; refuse rather than silently split
-            # components at block boundaries
-            raise NotImplementedError(
-                "blockwise stitching currently supports connectivity=1 only"
-            )
+        connectivity = int(cfg.get("connectivity", 1))
         keyed = bool(cfg.get("keyed", False))
         inp_ds = (
             file_reader(cfg["input_path"])[cfg["input_key"]] if keyed else None
         )
         ds = file_reader(cfg["output_path"])[cfg["output_key"]]
         shape = ds.shape
+        ndim = len(shape)
         block_shape = tuple(cfg["block_shape"])
         blocking = Blocking(shape, block_shape)
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
         roi_set = set(block_ids)
+        if not 1 <= connectivity <= ndim:
+            raise ValueError(f"connectivity must be in [1, {ndim}]")
+        # the kernel's half-neighborhood doubles as the unordered
+        # block-direction list (each adjacent block pair scanned once);
+        # {-1,0,1} offsets make sum(|o|) == nnz, so the budgets coincide
+        directions = _neighbor_offsets(ndim, connectivity)
+
+        def slab_bbs(block, d):
+            """(our-side bb, neighbor-side bb) of the shared boundary."""
+            bb_a, bb_b = [], []
+            for a, o in enumerate(d):
+                b, e = block.begin[a], block.end[a]
+                if o == 1:
+                    bb_a.append(slice(e - 1, e))
+                    bb_b.append(slice(e, e + 1))
+                elif o == -1:
+                    bb_a.append(slice(b, b + 1))
+                    bb_b.append(slice(b - 1, b))
+                else:
+                    bb_a.append(slice(b, e))
+                    bb_b.append(slice(b, e))
+            return tuple(bb_a), tuple(bb_b)
 
         def process(block_id: int):
             block = blocking.get_block(block_id)
             pairs = []
-            for axis in range(len(shape)):
-                nbr = blocking.neighbor_id(block_id, axis, 1)
+            for d in directions:
+                nbr = blocking.neighbor_id_offset(block_id, d)
                 if nbr is None or nbr not in roi_set:
                     continue
-                face = block.end[axis]
-                bb_lo = tuple(
-                    slice(face - 1, face) if a == axis else slice(b, e)
-                    for a, (b, e) in enumerate(zip(block.begin, block.end))
-                )
-                bb_hi = tuple(
-                    slice(face, face + 1) if a == axis else slice(b, e)
-                    for a, (b, e) in enumerate(zip(block.begin, block.end))
-                )
-                lo = ds[bb_lo].ravel()
-                hi = ds[bb_hi].ravel()
-                both = (lo > 0) & (hi > 0)
+                bb_a, bb_b = slab_bbs(block, d)
+                crossing = tuple(a for a in range(ndim) if d[a] != 0)
+                A = np.asarray(ds[bb_a]).squeeze(axis=crossing)
+                B = np.asarray(ds[bb_b]).squeeze(axis=crossing)
                 if keyed:
-                    # CC-on-segmentation: only merge across the face where
-                    # the ORIGINAL segment label matches
-                    both &= (
-                        np.asarray(inp_ds[bb_lo]).ravel()
-                        == np.asarray(inp_ds[bb_hi]).ravel()
-                    )
-                if both.any():
-                    p = np.stack([lo[both], hi[both]], axis=1)
-                    pairs.append(np.unique(p, axis=0))
+                    ka = np.asarray(inp_ds[bb_a]).squeeze(axis=crossing)
+                    kb = np.asarray(inp_ds[bb_b]).squeeze(axis=crossing)
+                free_budget = connectivity - len(crossing)
+                for s in product((-1, 0, 1), repeat=ndim - len(crossing)):
+                    if sum(1 for o in s if o) > free_budget:
+                        continue
+                    av, bv = _shifted_views(A, B, s)
+                    both = (av > 0) & (bv > 0)
+                    if keyed:
+                        # CC-on-segmentation: only merge across the boundary
+                        # where the ORIGINAL segment label matches
+                        kav, kbv = _shifted_views(ka, kb, s)
+                        both &= kav == kbv
+                    if both.any():
+                        p = np.stack([av[both], bv[both]], axis=1)
+                        pairs.append(np.unique(p, axis=0))
             result = (
                 np.concatenate(pairs)
                 if pairs

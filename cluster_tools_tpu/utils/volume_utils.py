@@ -116,6 +116,22 @@ class Blocking:
             return None
         return self.grid_position_to_id(pos)
 
+    def neighbor_id_offset(
+        self, block_id: int, offset: Sequence[int]
+    ) -> Optional[int]:
+        """Grid neighbor at a per-axis offset (diagonals included), or None.
+
+        The general form of :meth:`neighbor_id` needed for connectivity>1
+        stitching, where edge-/corner-adjacent blocks also share label
+        equivalences.
+        """
+        pos = [
+            p + int(o) for p, o in zip(self.block_grid_position(block_id), offset)
+        ]
+        if any(not 0 <= p < g for p, g in zip(pos, self.grid_shape)):
+            return None
+        return self.grid_position_to_id(pos)
+
     def __repr__(self) -> str:  # pragma: no cover
         return (
             f"Blocking(shape={self.shape}, block_shape={self.block_shape}, "

@@ -25,7 +25,14 @@ def workspace(tmp_path):
     return tmp_folder, config_dir, str(tmp_path)
 
 
-def _run_cc(workspace, mask, target="local", block_shape=None, threshold=None):
+def _run_cc(
+    workspace,
+    mask,
+    target="local",
+    block_shape=None,
+    threshold=None,
+    connectivity=None,
+):
     tmp_folder, config_dir, root = workspace
     path = os.path.join(root, "data.zarr")
     f = file_reader(path)
@@ -43,6 +50,8 @@ def _run_cc(workspace, mask, target="local", block_shape=None, threshold=None):
         params["block_shape"] = list(block_shape)
     if threshold is not None:
         params["threshold"] = threshold
+    if connectivity is not None:
+        params["connectivity"] = connectivity
     wf = ConnectedComponentsWorkflow(
         tmp_folder=tmp_folder,
         config_dir=config_dir,
@@ -59,6 +68,35 @@ def test_cc_workflow_vs_scipy(workspace, rng):
     got = _run_cc(workspace, mask)
     want, _ = ndi.label(mask, structure=ndi.generate_binary_structure(3, 1))
     assert_labels_equivalent(got, want)
+
+
+@pytest.mark.parametrize("connectivity", [2, 3])
+def test_cc_workflow_full_connectivity_vs_scipy(workspace, rng, connectivity):
+    """Diagonal adjacency must stitch across faces, edges, AND corners."""
+    mask = random_blobs(rng, (64, 64, 64), p=0.2)
+    got = _run_cc(workspace, mask, connectivity=connectivity)
+    want, _ = ndi.label(
+        mask, structure=ndi.generate_binary_structure(3, connectivity)
+    )
+    assert_labels_equivalent(got, want)
+
+
+def test_cc_workflow_corner_touching_blocks(workspace):
+    # two voxels touching ONLY at the corner shared by 8 blocks: one
+    # component at connectivity 3, two at connectivity 1
+    mask = np.zeros((64, 64, 64), bool)
+    mask[31, 31, 31] = True
+    mask[32, 32, 32] = True
+    got3 = _run_cc(workspace, mask, connectivity=3)
+    assert got3[31, 31, 31] == got3[32, 32, 32] != 0
+
+
+def test_cc_workflow_corner_touching_blocks_conn1(workspace):
+    mask = np.zeros((64, 64, 64), bool)
+    mask[31, 31, 31] = True
+    mask[32, 32, 32] = True
+    got1 = _run_cc(workspace, mask, connectivity=1)
+    assert got1[31, 31, 31] != got1[32, 32, 32]
 
 
 def test_cc_workflow_components_span_blocks(workspace):

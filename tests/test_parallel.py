@@ -96,6 +96,54 @@ def test_distributed_ccl_two_axis_sharding(rng):
     assert_labels_equivalent(labels, expected)
 
 
+@pytest.mark.parametrize("connectivity", [2, 3])
+def test_distributed_ccl_full_connectivity(rng, connectivity):
+    """Diagonal adjacency must stitch across the shard cuts too."""
+    mesh = _mesh(("sp",))
+    sp = mesh_axis_sizes(mesh)["sp"]
+    shape = (sp * 6, 20, 20)
+    mask = random_blobs(rng, shape, p=0.25)
+    labels = np.asarray(
+        distributed_connected_components(
+            mask, mesh, sp_axis="sp", connectivity=connectivity
+        )
+    )
+    expected, _ = ndimage.label(
+        mask, structure=ndimage.generate_binary_structure(3, connectivity)
+    )
+    assert_labels_equivalent(labels, expected)
+
+
+def test_distributed_ccl_two_axis_diagonal_shards(rng):
+    """Connectivity 3 on a 2-axis decomposition: voxels meeting only at the
+    corner shared by four diagonal shards must merge."""
+    mesh = _mesh(("spz", "spy"))
+    sizes = mesh_axis_sizes(mesh)
+    sz, sy = sizes["spz"], sizes["spy"]
+    shape = (sz * 4, sy * 4, 8)
+    # two voxels diagonal across BOTH shard cuts (shards (0,0) and (1,1))
+    mask = np.zeros(shape, bool)
+    mask[3, 3, 2] = True
+    mask[4, 4, 3] = True
+    labels = np.asarray(
+        distributed_connected_components(
+            mask, mesh, sp_axis=("spz", "spy"), connectivity=3
+        )
+    )
+    assert labels[3, 3, 2] == labels[4, 4, 3] != 0
+    # and a random oracle check across the same decomposition
+    mask = random_blobs(rng, shape, p=0.25)
+    labels = np.asarray(
+        distributed_connected_components(
+            mask, mesh, sp_axis=("spz", "spy"), connectivity=3
+        )
+    )
+    expected, _ = ndimage.label(
+        mask, structure=ndimage.generate_binary_structure(3, 3)
+    )
+    assert_labels_equivalent(labels, expected)
+
+
 def test_distributed_ccl_compacted_labels(rng):
     # per-shard compaction: same result, label space capped at shards*cap
     mesh = _mesh(("sp",))
