@@ -1,0 +1,148 @@
+"""Import PyTorch checkpoints into the flax model zoo.
+
+The reference's inference task executes user-supplied *PyTorch* models
+(SURVEY.md §2a "inference", §2b "PyTorch (+CUDA)"); a user switching to this
+framework arrives with torch-trained weights.  This module converts a torch
+``state_dict`` whose architecture mirrors one of our flax models (same
+layers in the same order — the "I trained the same U-Net in torch" case)
+into the flax parameter tree, so the TPU inference path runs the trained
+network directly.
+
+Matching is positional: both frameworks register parameters in module
+application/definition order, so the flattened torch tensors are converted
+one-for-one onto the flattened flax leaves, with layout rules per kind:
+
+- ``Conv3d.weight``      (O, I, kD, kH, kW) -> kernel (kD, kH, kW, I, O)
+- ``ConvTranspose3d.weight`` (I, O, kD, kH, kW) -> kernel
+  (kD, kH, kW, I, O), spatial axes FLIPPED (torch's transposed conv is the
+  gradient of a correlation; ``lax.conv_transpose`` does not mirror —
+  verified numerically in ``tests/test_inference.py``)
+- ``GroupNorm.weight``/``.bias`` -> ``scale``/``bias``
+- ``Conv*.bias`` -> ``bias``
+
+A shape/kind mismatch raises with the full remaining-leaf diff rather than
+producing silently-wrong weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten_flax(params: Mapping, prefix: Tuple[str, ...] = ()) -> list:
+    """(path, leaf) pairs in insertion (module-application) order."""
+    out = []
+    for k, v in params.items():
+        if isinstance(v, Mapping):
+            out.extend(_flatten_flax(v, prefix + (str(k),)))
+        else:
+            out.append((prefix + (str(k),), v))
+    return out
+
+
+def _unflatten(flat: Dict[Tuple[str, ...], Any]) -> Dict:
+    tree: Dict = {}
+    for path, leaf in flat.items():
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = leaf
+    return tree
+
+
+def _convert_leaf(path, flax_leaf, torch_key: str, tensor: np.ndarray):
+    """Convert one torch tensor to the layout of one flax leaf, or raise."""
+    kind = path[-1]
+    want = tuple(flax_leaf.shape)
+    if kind == "kernel" and tensor.ndim == 5:
+        if "ConvTranspose" in path[-2]:
+            # (I, O, kD, kH, kW) -> (kD, kH, kW, I, O), mirrored spatially
+            conv = np.ascontiguousarray(
+                tensor.transpose(2, 3, 4, 0, 1)[::-1, ::-1, ::-1]
+            )
+        else:
+            # (O, I, kD, kH, kW) -> (kD, kH, kW, I, O)
+            conv = tensor.transpose(2, 3, 4, 1, 0)
+        if conv.shape != want:
+            raise ValueError(
+                f"flax {'/'.join(path)} wants {want}, torch {torch_key!r} "
+                f"converts to {conv.shape}"
+            )
+        return conv
+    if kind in ("scale", "bias") and tensor.ndim == 1:
+        if tuple(tensor.shape) != want:
+            raise ValueError(
+                f"flax {'/'.join(path)} wants {want}, torch {torch_key!r} "
+                f"has {tuple(tensor.shape)}"
+            )
+        return tensor
+    raise ValueError(
+        f"cannot map torch {torch_key!r} (shape {tuple(tensor.shape)}) onto "
+        f"flax {'/'.join(path)} (shape {want})"
+    )
+
+
+def torch_state_dict_to_flax(
+    state_dict: Mapping[str, Any], model, sample_shape
+) -> Dict:
+    """Convert a torch ``state_dict`` to ``model``'s flax variables.
+
+    ``model`` is a flax module (e.g. :class:`~.unet.UNet3D`); ``sample_shape``
+    an input shape used to initialize the parameter template.  The torch
+    architecture must mirror the flax one layer-for-layer in order.
+    """
+    template = model.init(
+        jax.random.PRNGKey(0), jnp.zeros(sample_shape, jnp.float32)
+    )
+    flax_leaves = _flatten_flax(template["params"])
+    def to_array(v) -> np.ndarray:
+        # .detach() first: state_dicts saved with keep_vars=True (or from
+        # named_parameters()) hold requires_grad tensors that np.asarray
+        # refuses to convert directly
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+    torch_items = [
+        (k, arr)
+        for k, v in state_dict.items()
+        if "num_batches_tracked" not in k
+        for arr in (to_array(v),)
+        if arr.ndim >= 1
+    ]
+    if len(torch_items) != len(flax_leaves):
+        fpaths = ["/".join(p) for p, _ in flax_leaves]
+        tkeys = [k for k, _ in torch_items]
+        raise ValueError(
+            f"parameter count mismatch: flax has {len(flax_leaves)} leaves, "
+            f"torch has {len(torch_items)} tensors.\nflax: {fpaths}\n"
+            f"torch: {tkeys}"
+        )
+    flat = {}
+    for (path, leaf), (tkey, tensor) in zip(flax_leaves, torch_items):
+        flat[("params",) + path] = jnp.asarray(
+            _convert_leaf(path, leaf, tkey, tensor), dtype=leaf.dtype
+        )
+    return _unflatten(flat)
+
+
+def load_torch_checkpoint(path: str, model, sample_shape) -> Dict:
+    """Load a ``.pt``/``.pth`` torch checkpoint file into flax variables.
+
+    Accepts a raw ``state_dict`` or the common wrapper dicts
+    (``{"state_dict": ...}`` / ``{"model_state_dict": ...}``).
+    """
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    for key in ("state_dict", "model_state_dict", "model"):
+        if isinstance(obj, dict) and key in obj and isinstance(obj[key], dict):
+            obj = obj[key]
+            break
+    if not isinstance(obj, dict):
+        raise ValueError(
+            f"{path!r} does not contain a state_dict (got {type(obj).__name__})"
+        )
+    return torch_state_dict_to_flax(obj, model, sample_shape)

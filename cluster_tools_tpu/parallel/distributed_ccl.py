@@ -15,9 +15,10 @@ over several mesh axes — and the whole merge is three collectives:
    axis, then a *replicated* pointer-jumping union-find over the compressed
    boundary-label table, and a local relabel through it.
 
-The union-find domain is only the labels that touch a shard boundary (at
-most ``2 * S * total_face_area``), never the full label space — so the
-replicated solve stays small regardless of volume size.
+The union-find domain is only the labels that touch a shard boundary
+(O(shard-boundary area), times the small shifted-view multiplicity at
+connectivity>1), never the full label space — so the replicated solve stays
+small regardless of volume size.
 
 Label-space ceilings: by default a shard's labels are globalized as
 ``flat_index + rank * n_slab`` (int32), which overflows once

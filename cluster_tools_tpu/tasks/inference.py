@@ -31,10 +31,15 @@ from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader, pad_bl
 
 
 def load_checkpoint(path: str, model, sample_shape):
-    """Load flax params: ``.msgpack`` (flax.serialization) or ``.npz``
-    (flat '/'-joined keys)."""
+    """Load params: ``.msgpack`` (flax.serialization), ``.npz`` (flat
+    '/'-joined keys), or ``.pt``/``.pth`` (torch state_dict, converted —
+    see :mod:`cluster_tools_tpu.models.torch_import`)."""
     import flax
 
+    if path.endswith((".pt", ".pth")):
+        from ..models.torch_import import load_torch_checkpoint
+
+        return load_torch_checkpoint(path, model, sample_shape)
     template = model.init(
         jax.random.PRNGKey(0), jnp.zeros(sample_shape, jnp.float32)
     )

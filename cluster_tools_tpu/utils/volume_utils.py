@@ -110,11 +110,9 @@ class Blocking:
 
     def neighbor_id(self, block_id: int, axis: int, direction: int) -> Optional[int]:
         """Grid neighbor of ``block_id`` along ``axis`` (+1/-1), or None at the edge."""
-        pos = list(self.block_grid_position(block_id))
-        pos[axis] += direction
-        if not 0 <= pos[axis] < self.grid_shape[axis]:
-            return None
-        return self.grid_position_to_id(pos)
+        offset = [0] * len(self.shape)
+        offset[axis] = direction
+        return self.neighbor_id_offset(block_id, offset)
 
     def neighbor_id_offset(
         self, block_id: int, offset: Sequence[int]

@@ -329,3 +329,109 @@ def test_ilp_eigenvalue_features_channels(rng):
     )
     feats = np.asarray(ilp_feature_bank(x, sel))
     assert feats.shape == (8, 12, 16, 1 + 3 + 3)
+
+
+def _torch_unet3d(in_ch, out_channels, base_features, depth):
+    """Torch twin of models.UNet3D: same layers, same application order.
+
+    The order of parameter REGISTRATION mirrors the flax module-application
+    order, which is what the positional torch->flax converter relies on.
+    GELU uses the tanh approximation (flax's default).
+    """
+    import torch.nn as tnn
+
+    act = tnn.GELU(approximate="tanh")
+
+    def conv_block(cin, cout):
+        return tnn.Sequential(
+            tnn.Conv3d(cin, cout, 3, padding=1),
+            tnn.GroupNorm(min(8, cout), cout),
+            act,
+            tnn.Conv3d(cout, cout, 3, padding=1),
+            tnn.GroupNorm(min(8, cout), cout),
+            act,
+        )
+
+    layers = []
+    feats = base_features
+    cin = in_ch
+    for _ in range(depth):
+        layers.append(conv_block(cin, feats))
+        layers.append(tnn.Conv3d(feats, feats * 2, 2, stride=2))
+        cin = feats * 2
+        feats *= 2
+    layers.append(conv_block(cin, feats))
+    for _ in range(depth):
+        feats //= 2
+        layers.append(tnn.ConvTranspose3d(feats * 2, feats, 2, stride=2))
+        layers.append(conv_block(feats * 2, feats))
+    layers.append(tnn.Conv3d(feats, out_channels, 1))
+
+    class TorchUNet3D(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layers = tnn.ModuleList(layers)
+
+        def forward(self, x):
+            i = 0
+            skips = []
+            for _ in range(depth):
+                x = self.layers[i](x); i += 1
+                skips.append(x)
+                x = self.layers[i](x); i += 1
+            x = self.layers[i](x); i += 1
+            for skip in reversed(skips):
+                x = self.layers[i](x); i += 1
+                import torch
+
+                x = torch.cat([x, skip], dim=1)
+                x = self.layers[i](x); i += 1
+            return self.layers[i](x)
+
+    return TorchUNet3D()
+
+
+def test_torch_checkpoint_import_numerical_parity(tmp_path, rng):
+    """A torch-trained twin U-Net, imported, must agree numerically on TPU
+    layout (the reference runs torch models directly; SURVEY.md §2a
+    'inference')."""
+    import torch
+
+    from cluster_tools_tpu.models import UNet3D
+    from cluster_tools_tpu.tasks.inference import load_checkpoint
+
+    torch.manual_seed(0)
+    net = _torch_unet3d(in_ch=1, out_channels=2, base_features=4, depth=2)
+    path = str(tmp_path / "model.pt")
+    torch.save({"state_dict": net.state_dict()}, path)
+
+    model = UNet3D(
+        out_channels=2, base_features=4, depth=2, dtype=jnp.float32
+    )
+    sample = (1, 16, 16, 16, 1)
+    variables = load_checkpoint(path, model, sample)
+
+    x = rng.random(sample).astype(np.float32)
+    got = np.asarray(model.apply(variables, jnp.asarray(x)))
+    with torch.no_grad():
+        want = (
+            net(torch.from_numpy(x.transpose(0, 4, 1, 2, 3)))
+            .numpy()
+            .transpose(0, 2, 3, 4, 1)
+        )
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_torch_import_rejects_mismatched_architecture(tmp_path):
+    import torch
+
+    from cluster_tools_tpu.models import UNet3D
+    from cluster_tools_tpu.models.torch_import import load_torch_checkpoint
+
+    net = _torch_unet3d(in_ch=1, out_channels=2, base_features=4, depth=1)
+    path = str(tmp_path / "model.pt")
+    torch.save(net.state_dict(), path)
+    model = UNet3D(out_channels=2, base_features=4, depth=2)
+    with pytest.raises(ValueError, match="mismatch"):
+        load_torch_checkpoint(path, model, (1, 16, 16, 16, 1))
