@@ -26,32 +26,11 @@ producing silently-wrong weights.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Mapping, Tuple
+from typing import Any, Dict, Mapping
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-
-def _flatten_flax(params: Mapping, prefix: Tuple[str, ...] = ()) -> list:
-    """(path, leaf) pairs in insertion (module-application) order."""
-    out = []
-    for k, v in params.items():
-        if isinstance(v, Mapping):
-            out.extend(_flatten_flax(v, prefix + (str(k),)))
-        else:
-            out.append((prefix + (str(k),), v))
-    return out
-
-
-def _unflatten(flat: Dict[Tuple[str, ...], Any]) -> Dict:
-    tree: Dict = {}
-    for path, leaf in flat.items():
-        node = tree
-        for k in path[:-1]:
-            node = node.setdefault(k, {})
-        node[path[-1]] = leaf
-    return tree
 
 
 def _convert_leaf(path, flax_leaf, torch_key: str, tensor: np.ndarray):
@@ -95,10 +74,15 @@ def torch_state_dict_to_flax(
     an input shape used to initialize the parameter template.  The torch
     architecture must mirror the flax one layer-for-layer in order.
     """
+    import flax.traverse_util as tu
+
     template = model.init(
         jax.random.PRNGKey(0), jnp.zeros(sample_shape, jnp.float32)
     )
-    flax_leaves = _flatten_flax(template["params"])
+    # flatten_dict preserves dict insertion order == module-application
+    # order, the property positional matching relies on (same machinery as
+    # tasks/inference.py's npz checkpoints)
+    flax_leaves = list(tu.flatten_dict(template["params"]).items())
     def to_array(v) -> np.ndarray:
         # .detach() first: state_dicts saved with keep_vars=True (or from
         # named_parameters()) hold requires_grad tensors that np.asarray
@@ -125,7 +109,7 @@ def torch_state_dict_to_flax(
         flat[("params",) + path] = jnp.asarray(
             _convert_leaf(path, leaf, tkey, tensor), dtype=leaf.dtype
         )
-    return _unflatten(flat)
+    return tu.unflatten_dict(flat)
 
 
 def load_torch_checkpoint(path: str, model, sample_shape) -> Dict:

@@ -59,6 +59,11 @@ class _WsTaskBase(BaseTask):
             "min_seed_distance": 0.0,
             "sampling": None,
             "size_filter": 0,
+            # mean-boundary threshold for in-block fragment agglomeration
+            # after the flood (reference: watershed/agglomerate.py); None
+            # disables.  Fragments whose contact's size-weighted mean
+            # boundary value is below the threshold merge (average linkage).
+            "agglomerate_threshold": None,
             "two_d": False,
             "connectivity": 1,
             "halo": [4, 4, 4],
@@ -121,6 +126,38 @@ class _WsTaskBase(BaseTask):
             dt_max_distance=float(dt_max),
         )
 
+    @staticmethod
+    def _agglomerate_block(lab: np.ndarray, bnd: np.ndarray, threshold: float):
+        """In-block average-linkage merge of WS fragments (reference:
+        ``watershed/agglomerate.py``): fragments whose contact's
+        size-weighted mean boundary value is below ``threshold`` fuse.
+
+        Runs on the padded-outer labels so halo context participates, like
+        the reference's in-block agglomeration.  Isolated fragments (no RAG
+        edge) keep distinct ids.  Single-pass blocks only: two-pass labels
+        carry immutable external seed ids that must not merge blockwise.
+        """
+        from ..ops.agglomeration import average_agglomeration
+        from ..ops.rag import block_rag
+
+        lab = np.ascontiguousarray(lab)
+        uv, sizes, feats = block_rag(lab.astype(np.uint64), bnd)
+        if len(uv) == 0:
+            return lab
+        nodes = np.unique(uv).astype(np.int64)
+        remap = np.zeros(int(nodes.max()) + 1, np.int64)
+        remap[nodes] = np.arange(len(nodes))
+        merged = average_agglomeration(
+            len(nodes), remap[uv.astype(np.int64)], feats[:, 0], sizes, threshold
+        )
+        all_labels = np.unique(lab[lab > 0]).astype(np.int64)
+        table = np.zeros(int(all_labels.max()) + 1, lab.dtype)
+        table[nodes] = (merged + 1).astype(lab.dtype)
+        iso = np.setdiff1d(all_labels, nodes, assume_unique=True)
+        k = int(merged.max()) + 1 if len(merged) else 0
+        table[iso] = (np.arange(len(iso)) + k + 1).astype(lab.dtype)
+        return table[lab]
+
     def _store_labels(self, out, block, raw, n_outer, size_dtype=np.uint64):
         """Crop inner region from the padded-outer labels and globalize."""
         inner = raw[block.inner_in_outer_bb]
@@ -171,11 +208,26 @@ class WatershedBase(_WsTaskBase):
         kp = self._kernel_params(cfg)
         two_d = bool(cfg.get("two_d", False))
         size_filter = int(cfg.get("size_filter") or 0)
+        agg_thr = cfg.get("agglomerate_threshold")
+        if agg_thr is not None and cfg.get("pass_parity") is not None:
+            # pass one of the checkerboard: its labels seed pass two, which
+            # cannot agglomerate (see TwoPassWatershedBase) — mixing would
+            # desynchronize the shared label space
+            raise NotImplementedError(
+                "agglomerate_threshold is not supported with pass_parity "
+                "(two-pass checkerboard)"
+            )
+        # boundary blocks stashed between load and store for the host-side
+        # agglomeration (unique keys; dict ops are GIL-atomic across the IO
+        # threads)
+        bnd_stash = {}
 
         def load(block):
             data = inp[block.outer_bb].astype(np.float32)
             # pad with 1.0 (pure boundary) so basins don't leak off-volume
             data = pad_block_to(data, outer, constant_values=1.0)
+            if agg_thr is not None:
+                bnd_stash[block.block_id] = data
             if mask_ds is not None:
                 m = mask_ds[block.outer_bb] > 0
                 m = pad_block_to(m, outer)
@@ -218,7 +270,12 @@ class WatershedBase(_WsTaskBase):
                     "capacity; labels may be under-merged (raise the caps "
                     "or use impl=legacy)"
                 )
-            self._store_labels(out, block, np.asarray(lab), n_outer)
+            lab = np.asarray(lab)
+            if agg_thr is not None:
+                lab = self._agglomerate_block(
+                    lab, bnd_stash.pop(block.block_id), float(agg_thr)
+                )
+            self._store_labels(out, block, lab, n_outer)
 
         executor = BlockwiseExecutor(
             target=self.target,
@@ -272,6 +329,14 @@ class TwoPassWatershedBase(_WsTaskBase):
         ) = self._setup()
         if all(h == 0 for h in halo):
             raise ValueError("two-pass watershed requires a nonzero halo")
+        if cfg.get("agglomerate_threshold") is not None:
+            # pass-two labels carry immutable external seed ids from pass
+            # one; merging them blockwise would desynchronize the shared
+            # label space — agglomerate on the single-pass task instead
+            raise NotImplementedError(
+                "agglomerate_threshold is not supported with the two-pass "
+                "watershed"
+            )
         if cfg.get("two_d"):
             # pass-one blocks would be segmented per-slice and pass-two in
             # 3-D: refuse the inconsistent hybrid instead of producing it
@@ -402,6 +467,13 @@ class WatershedWorkflow(WorkflowBase):
             # two-pass task would refuse anyway (see TwoPassWatershedBase)
             raise NotImplementedError(
                 "two_d=True is not supported with two_pass=True"
+            )
+        if two_pass and p.get("agglomerate_threshold") is not None:
+            # same altitude as the two_d guard: refuse before pass one runs
+            # (and checkpoints) agglomerated even blocks that pass two would
+            # then mix with un-agglomerated labels
+            raise NotImplementedError(
+                "agglomerate_threshold is not supported with two_pass=True"
             )
         common = dict(
             tmp_folder=self.tmp_folder,

@@ -34,6 +34,7 @@ def _boundary_volume(rng, shape=(32, 32, 32)):
 
 def _run_ws(workspace, vol, two_pass, **params):
     tmp_folder, config_dir, root = workspace
+    out_key = params.pop("output_key", "labels")
     path = os.path.join(root, "ws.zarr")
     f = file_reader(path)
     ds = f.require_dataset(
@@ -48,7 +49,7 @@ def _run_ws(workspace, vol, two_pass, **params):
         input_path=path,
         input_key="boundaries",
         output_path=path,
-        output_key="labels",
+        output_key=out_key,
         block_shape=[16, 16, 16],
         halo=[4, 4, 4],
         two_pass=two_pass,
@@ -56,7 +57,7 @@ def _run_ws(workspace, vol, two_pass, **params):
         **params,
     )
     assert build([wf])
-    return np.asarray(file_reader(path)["labels"][:])
+    return np.asarray(file_reader(path)[out_key][:])
 
 
 def test_single_pass_labels_everything(rng, workspace):
@@ -171,3 +172,47 @@ def test_ws_task_config_respects_explicit_dt_cap(workspace, rng):
     cfg["dt_max_distance"] = 12.5
     kp = WatershedBase.__new__(WatershedBase)._kernel_params(cfg)
     assert kp["dt_max_distance"] == 12.5
+
+
+def test_agglomerate_threshold_merges_fragments(rng, workspace):
+    """reference watershed/agglomerate.py: in-block average-linkage merge of
+    fragments under the mean-boundary threshold."""
+    vol = _boundary_volume(rng)
+    plain = _run_ws(workspace, vol, two_pass=False)
+    merged = _run_ws(
+        workspace, vol, two_pass=False, agglomerate_threshold=0.9,
+        output_key="labels_agg",
+    )
+    n_plain = len(np.unique(plain[plain > 0]))
+    n_merged = len(np.unique(merged[merged > 0]))
+    assert 0 < n_merged < n_plain, (n_merged, n_plain)
+    assert (merged > 0).all()
+    # a conservative threshold must merge nothing
+    same = _run_ws(
+        workspace, vol, two_pass=False, agglomerate_threshold=0.0,
+        output_key="labels_noop",
+    )
+    assert len(np.unique(same[same > 0])) == n_plain
+
+
+def test_agglomerate_threshold_refused_for_two_pass(workspace):
+    """The workflow must refuse BEFORE pass one runs (and checkpoints)
+    agglomerated even blocks that pass two would then mix with
+    un-agglomerated labels."""
+    from cluster_tools_tpu.tasks.watershed import WatershedWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    wf = WatershedWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path="x.zarr",
+        input_key="b",
+        output_path="x.zarr",
+        output_key="labels",
+        two_pass=True,
+        agglomerate_threshold=0.5,
+    )
+    with pytest.raises(NotImplementedError, match="not supported"):
+        wf.requires()
