@@ -98,25 +98,63 @@ def _dt_squared_impl(
     return jnp.minimum(f, _BIG)
 
 
+def _pad_to_mosaic_tiles(f: jnp.ndarray):
+    """Pad a 3-D array up to the Mosaic (8, 8, 128) tile multiples with
+    +BIG (pad values never win a min).  Returns (padded, original_shape)."""
+    z, y, x = f.shape
+    zp = -(-z // 8) * 8
+    yp = -(-y // 8) * 8
+    xp = -(-x // 128) * 128
+    if (zp, yp, xp) != (z, y, x):
+        f = jnp.pad(
+            f, ((0, zp - z), (0, yp - y), (0, xp - x)), constant_values=_BIG
+        )
+    return f, (z, y, x)
+
+
+def _pallas_axis_cascade(
+    f: jnp.ndarray, axis: int, w: float, radius: int, interpret: bool = False
+) -> jnp.ndarray:
+    """One VMEM erosion cascade along ``axis`` (padded lanes cropped after)."""
+    from .pallas_kernels import edt_cascade_pallas
+
+    f, (z, y, x) = _pad_to_mosaic_tiles(f)
+    f = edt_cascade_pallas(f, axis, radius, w, float(_BIG), interpret=interpret)
+    return f[:z, :y, :x]
+
+
+def edt_axis_pass(
+    f: jnp.ndarray, axis: int, w: float, radius: int, impl: str = "auto"
+) -> jnp.ndarray:
+    """One separable min-plus (parabolic erosion) pass along ``axis``.
+
+    Public building block for composed transforms — in particular the
+    mesh-distributed exact EDT, which reshards the volume between per-axis
+    passes (:mod:`cluster_tools_tpu.parallel.distributed_edt`).  ``w`` is
+    the squared per-axis voxel size; ``radius`` caps the pass (values up to
+    the cap exact).
+    """
+    radius = min(int(radius), f.shape[axis] - 1)
+    if radius <= 0:
+        return f
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas" and f.ndim == 3:
+        return _pallas_axis_cascade(f, axis, float(w), radius)
+    return _edt_1d_axis(f, axis, float(w), radius)
+
+
 def _dt_squared_pallas(
     f: jnp.ndarray,
     sampling: Tuple[float, ...],
     radii: Tuple[int, ...],
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Per-axis VMEM erosion cascades; pads to tile multiples with +BIG
-    (pad values never win a min, and padded lanes are cropped after)."""
+    """Per-axis VMEM erosion cascades, one shared pad across all three axes
+    (see :func:`_pad_to_mosaic_tiles`)."""
     from .pallas_kernels import edt_cascade_pallas
 
-    z, y, x = f.shape
-    zp = -(-z // 8) * 8
-    yp = -(-y // 8) * 8
-    xp = -(-x // 128) * 128
-    padded = (zp, yp, xp) != (z, y, x)
-    if padded:
-        f = jnp.pad(
-            f, ((0, zp - z), (0, yp - y), (0, xp - x)), constant_values=_BIG
-        )
+    f, (z, y, x) = _pad_to_mosaic_tiles(f)
     for axis in range(3):
         r = min(radii[axis], f.shape[axis] - 1)
         if r > 0:
@@ -124,9 +162,7 @@ def _dt_squared_pallas(
                 f, axis, r, float(sampling[axis]) ** 2, float(_BIG),
                 interpret=interpret,
             )
-    if padded:
-        f = f[:z, :y, :x]
-    return jnp.minimum(f, _BIG)
+    return jnp.minimum(f[:z, :y, :x], _BIG)
 
 
 def _norm_sampling(ndim: int, sampling) -> Tuple[float, ...]:

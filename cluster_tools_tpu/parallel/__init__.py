@@ -20,6 +20,10 @@ same decomposition is expressed as sharded axes of a ``jax.sharding.Mesh``:
 
 from .mesh import make_mesh, mesh_axis_sizes
 from .reshard import reshard_axis, transpose_sharding
+from .distributed_edt import (
+    distributed_distance_transform,
+    sharded_distance_transform_squared,
+)
 from .halo import exchange_halo, crop_halo, neighbor_face
 from .distributed_ccl import (
     sharded_label_components,

@@ -1,0 +1,143 @@
+"""Exact Euclidean distance transform of a mesh-sharded volume.
+
+The reference's EDT was strictly per-block (vigra inside ``_ws_block``,
+SURVEY.md §2a "watershed"): distances saturate at the halo scale, because a
+block cannot see background beyond its own read window.  On a mesh the
+limitation disappears: the separable min-plus passes commute, so the sharded
+axis's pass simply runs *after* an ICI all-to-all that makes that axis fully
+resident (:mod:`.reshard` — the sequence-parallel layout-flip pattern), and
+every pass operates at full global extent.  Two all-to-alls total; every
+pass is the same dense erosion cascade the single-device transform uses
+(``ops/edt.py``), Mosaic-accelerated on TPU.
+
+This gives the *exact* global EDT — something the reference could not
+compute blockwise at all — while keeping per-device memory at one shard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.edt import _BIG, _norm_sampling, edt_axis_pass
+from .reshard import reshard_axis
+
+
+def sharded_distance_transform_squared(
+    mask: jnp.ndarray,
+    axis_name: str,
+    axis_size: int,
+    sharded_axis: int = 0,
+    sampling: Optional[Sequence[float]] = None,
+    max_distance: Optional[float] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Squared EDT inside ``shard_map``; ``mask`` is the local shard.
+
+    The volume is globally sharded along ``sharded_axis``; the result has
+    the same sharding.  All distances are globally exact (up to
+    ``max_distance``, if given).  The reshard target is the last axis other
+    than ``sharded_axis``, whose local extent must be divisible by
+    ``axis_size``.
+    """
+    ndim = mask.ndim
+    sampling = _norm_sampling(ndim, sampling)
+    shard = int(sharded_axis) % ndim
+    resident = max(a for a in range(ndim) if a != shard)
+    if mask.shape[resident] % axis_size:
+        raise ValueError(
+            f"reshard axis {resident} extent {mask.shape[resident]} not "
+            f"divisible by mesh axis size {axis_size}"
+        )
+    global_extent = {
+        a: mask.shape[a] * (axis_size if a == shard else 1) for a in range(ndim)
+    }
+    if max_distance is None:
+        radii = {a: global_extent[a] - 1 for a in range(ndim)}
+    else:
+        radii = {
+            a: int(np.ceil(float(max_distance) / sampling[a])) for a in range(ndim)
+        }
+
+    f = jnp.where(mask, _BIG, jnp.float32(0.0))
+    # passes along the already-resident axes
+    for a in range(ndim):
+        if a != shard:
+            f = edt_axis_pass(f, a, sampling[a] ** 2, radii[a], impl=impl)
+    # flip the sharded axis resident (one ICI all-to-all), run its pass at
+    # full global extent, flip back
+    f = reshard_axis(f, axis_name, from_axis=shard, to_axis=resident)
+    f = edt_axis_pass(f, shard, sampling[shard] ** 2, radii[shard], impl=impl)
+    f = reshard_axis(f, axis_name, from_axis=resident, to_axis=shard)
+    return jnp.minimum(f, _BIG)
+
+
+def distributed_distance_transform(
+    mask,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    sharded_axis: int = 0,
+    sampling: Optional[Sequence[float]] = None,
+    max_distance: Optional[float] = None,
+    impl: str = "auto",
+):
+    """Whole-volume wrapper: exact EDT of a volume sharded over ``sp_axis``.
+
+    Returns the (non-squared) distance with the input's sharding.  Unlike
+    the per-block transform, distances do NOT saturate at any halo — the
+    sharded axis's pass runs at full extent after an all-to-all reshard.
+    ``sampling`` may be a scalar, list, tuple, or array (normalized here,
+    BEFORE the jit boundary — it is a static argument underneath).
+    """
+    if sampling is not None:
+        sampling = tuple(float(s) for s in np.atleast_1d(sampling))
+    return _distributed_distance_transform(
+        mask, mesh, sp_axis, sharded_axis, sampling,
+        None if max_distance is None else float(max_distance), impl,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "sp_axis", "sharded_axis", "sampling", "max_distance", "impl",
+    ),
+)
+def _distributed_distance_transform(
+    mask,
+    mesh: Mesh,
+    sp_axis: str,
+    sharded_axis: int,
+    sampling: Optional[Tuple[float, ...]],
+    max_distance: Optional[float],
+    impl: str,
+):
+    from .mesh import mesh_axis_sizes
+
+    n = mesh_axis_sizes(mesh)[sp_axis]
+    spec = [None] * mask.ndim
+    spec[int(sharded_axis) % mask.ndim] = sp_axis
+
+    fn = jax.shard_map(
+        partial(
+            sharded_distance_transform_squared,
+            axis_name=sp_axis,
+            axis_size=n,
+            sharded_axis=sharded_axis,
+            sampling=sampling,
+            max_distance=max_distance,
+            impl=impl,
+        ),
+        mesh=mesh,
+        in_specs=P(*spec),
+        out_specs=P(*spec),
+        # Pallas EDT cascades may run inside (see make_ws_ccl_step: in-kernel
+        # vma propagation is broken on this JAX version; check only)
+        check_vma=False,
+    )
+    return jnp.sqrt(fn(mask))
