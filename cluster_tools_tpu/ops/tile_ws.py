@@ -539,6 +539,7 @@ def dt_watershed_tiled(
     min_seed_distance: float = 0.0,
     sampling: Optional[Tuple[float, ...]] = None,
     mask: Optional[jnp.ndarray] = None,
+    dist: Optional[jnp.ndarray] = None,
     dt_max_distance: Optional[float] = None,
     impl: str = "auto",
     tile: Optional[Tuple[int, int, int]] = None,
@@ -558,6 +559,10 @@ def dt_watershed_tiled(
     seed CCL and the flood running on the tiled kernels.  3-D only,
     connectivity 1.  Returns ``(labels, overflow)``; labels are
     ``seed_rep + 1`` flat-index based, 0 outside mask/unreached.
+
+    ``dist``: optional precomputed *squared* distances (e.g. the mesh-exact
+    transform from :mod:`cluster_tools_tpu.parallel.distributed_edt`); when
+    given, the internal EDT (and ``dt_max_distance``) is skipped.
     """
     from .edt import distance_transform_squared
     from .filters import gaussian_smooth
@@ -566,12 +571,19 @@ def dt_watershed_tiled(
 
     valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
     fg = (boundaries < threshold) & valid
-    # "xla" must stay Mosaic-free end-to-end; other modes let the EDT pick
-    # its own fast path ("pallas" lacks an interpret plumb, so not forwarded)
-    dist = distance_transform_squared(
-        fg, sampling=sampling, max_distance=dt_max_distance,
-        impl="xla" if impl == "xla" else "auto",
-    )
+    if dist is None:
+        # "xla" must stay Mosaic-free end-to-end; other modes let the EDT
+        # pick its own fast path ("pallas" lacks an interpret plumb, so not
+        # forwarded)
+        dist = distance_transform_squared(
+            fg, sampling=sampling, max_distance=dt_max_distance,
+            impl="xla" if impl == "xla" else "auto",
+        )
+    else:
+        # caller-supplied squared distances (e.g. the mesh-exact transform
+        # from parallel.distributed_edt); zero them outside the foreground
+        # so seed maxima stay inside basins
+        dist = jnp.where(fg, dist.astype(jnp.float32), 0.0)
     if sigma_seeds > 0:
         dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
     maxima = (

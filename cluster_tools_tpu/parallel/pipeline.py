@@ -48,6 +48,7 @@ def _ws_ccl_shard(
     min_seed_distance: float,
     max_labels_per_shard: Optional[int],
     impl: str,
+    exact_edt: bool,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-device body: local shard is (local_batch, z_slab, y, x)."""
     local_b = boundaries.shape[0]
@@ -73,9 +74,33 @@ def _ws_ccl_shard(
             from ..ops.tile_ws import dt_watershed_tiled
 
             tiled_impl = "xla" if impl == "tiled" else impl
+            dist_pad = None
+            if exact_edt:
+                # globally exact squared EDT (all-to-all reshard per axis
+                # pass, distributed_edt) instead of the halo-capped
+                # per-shard transform; halo-exchange the distances so the
+                # padded watershed window sees them too (fill 0 = the
+                # outside-volume border is background, matching the
+                # boundary fill of 1.0 above)
+                from .distributed_edt import sharded_distance_transform_squared
+
+                dist_sq = sharded_distance_transform_squared(
+                    vol < threshold,
+                    axis_name=sp_axis,
+                    axis_size=sp_size,
+                    # keep the documented dt_max_distance contract: caps
+                    # stay capped (exactness here means exact ACROSS shard
+                    # cuts, not uncapped); None = truly global radii
+                    max_distance=dt_max_distance,
+                    impl="xla" if impl in ("xla", "tiled") else "auto",
+                )
+                dist_pad = exchange_halo(
+                    dist_sq, halo, 0, sp_axis, sp_size, fill=0.0
+                )
             ws, ws_over = dt_watershed_tiled(
                 padded,
                 threshold=threshold,
+                dist=dist_pad,
                 dt_max_distance=dt_max_distance,
                 min_seed_distance=min_seed_distance,
                 impl=tiled_impl,
@@ -155,6 +180,7 @@ def make_ws_ccl_step(
     min_seed_distance: float = 0.0,
     max_labels_per_shard: Optional[int] = None,
     impl: str = "auto",
+    exact_edt: bool = False,
 ):
     """Compile the fused step for ``mesh``.
 
@@ -170,7 +196,21 @@ def make_ws_ccl_step(
     machinery, Mosaic on TPU / portable XLA elsewhere — the fast path),
     "pallas"/"xla"/"tiled" to force a tiled variant, or "legacy" (round-2
     dense fixpoint kernels).
+
+    ``exact_edt``: seed the watershed from the *globally exact* EDT
+    (mesh-distributed, all-to-all reshard per axis pass) instead of the
+    halo-capped per-shard transform — no halo saturation artifacts in the
+    seeds.  Requires the tiled kernels (not "legacy") and x-extent divisible
+    by the ``sp`` axis size.
     """
+    if exact_edt and (impl == "legacy" or connectivity != 1):
+        # the legacy dense-fixpoint branch never reads the flag — refuse
+        # rather than silently hand back the halo-capped seeds the caller
+        # opted out of
+        raise ValueError(
+            "exact_edt requires the tiled kernels (impl != 'legacy') and "
+            "connectivity=1"
+        )
     sizes = mesh_axis_sizes(mesh)
     body = partial(
         _ws_ccl_shard,
@@ -184,6 +224,7 @@ def make_ws_ccl_step(
         min_seed_distance=min_seed_distance,
         max_labels_per_shard=max_labels_per_shard,
         impl=impl,
+        exact_edt=exact_edt,
     )
     # check_vma=False: the per-shard body runs Pallas kernels whose in-kernel
     # loop carries mix ref loads (vma-tagged) with constants (untagged), and

@@ -313,3 +313,23 @@ def test_distributed_edt_capped(rng):
     exact = want <= cap
     np.testing.assert_allclose(got[exact], want[exact], rtol=1e-5, atol=1e-4)
     assert (got[~exact] >= cap - 1e-4).all()
+
+
+def test_ws_ccl_step_exact_edt(rng):
+    """exact_edt=True: the fused step seeds from the mesh-exact EDT; the
+    merged-CC side and consistency invariants must be unaffected."""
+    mesh = _mesh(("dp", "sp"))
+    sizes = mesh_axis_sizes(mesh)
+    dp, sp = sizes["dp"], sizes["sp"]
+    b, z, y, x = dp, sp * 8, 16, 8 * sp  # x divisible by sp for the reshard
+    vol = rng.random((b, z, y, x)).astype(np.float32)
+    step = make_ws_ccl_step(mesh, halo=2, threshold=0.5, exact_edt=True)
+    ws, cc, n_fg, overflow = jax.block_until_ready(step(vol))
+    ws, cc = np.asarray(ws), np.asarray(cc)
+    assert not bool(overflow)
+    assert (ws.shape == vol.shape) and int(n_fg) == int((cc > 0).sum())
+    for i in range(b):
+        expected, _ = ndimage.label(
+            vol[i] < 0.5, structure=ndimage.generate_binary_structure(3, 1)
+        )
+        assert_labels_equivalent(cc[i], expected)

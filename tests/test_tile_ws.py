@@ -161,3 +161,24 @@ def test_dt_watershed_seeded_tiled_external_encoding(rng):
     right = lab[:, :, 68:]
     assert (right <= n).all() and (right >= 0).all()
     assert (right > 0).any()
+
+
+def test_dt_watershed_tiled_precomputed_dist_identity(rng):
+    """dist= plumb: supplying the same capped EDT the function would compute
+    internally must give the identical segmentation."""
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.ops.edt import distance_transform_squared
+    from cluster_tools_tpu.ops.tile_ws import dt_watershed_tiled
+
+    vol = rng.random((24, 16, 16)).astype(np.float32)
+    fg = jnp.asarray(vol < 0.5)
+    dist = distance_transform_squared(fg, max_distance=4.0)
+    internal, ovf1 = dt_watershed_tiled(
+        jnp.asarray(vol), threshold=0.5, dt_max_distance=4.0, impl="xla"
+    )
+    supplied, ovf2 = dt_watershed_tiled(
+        jnp.asarray(vol), threshold=0.5, dist=dist, impl="xla"
+    )
+    np.testing.assert_array_equal(np.asarray(internal), np.asarray(supplied))
+    assert bool(ovf1) == bool(ovf2) is False
