@@ -17,7 +17,7 @@ compute blockwise at all — while keeping per-device memory at one shard.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -30,32 +30,36 @@ from .reshard import reshard_axis
 
 def sharded_distance_transform_squared(
     mask: jnp.ndarray,
-    axis_name: str,
-    axis_size: int,
+    *,
+    axis_name: Optional[str] = None,
+    axis_size: Optional[int] = None,
     sharded_axis: int = 0,
+    shard_axes: Optional[Sequence[Tuple[int, str, int]]] = None,
     sampling: Optional[Sequence[float]] = None,
     max_distance: Optional[float] = None,
     impl: str = "auto",
 ) -> jnp.ndarray:
     """Squared EDT inside ``shard_map``; ``mask`` is the local shard.
 
-    The volume is globally sharded along ``sharded_axis``; the result has
-    the same sharding.  All distances are globally exact (up to
-    ``max_distance``, if given).  The reshard target is the last axis other
-    than ``sharded_axis``, whose local extent must be divisible by
-    ``axis_size``.
+    Single-axis (slab) sharding: pass ``axis_name``/``axis_size``
+    (+ ``sharded_axis``).  Multi-axis decomposition: pass ``shard_axes`` as
+    a sequence of ``(array_axis, mesh_axis_name, mesh_axis_size)``, as in
+    :func:`~.distributed_ccl.sharded_label_components`.  The result keeps
+    the input sharding, and all distances are globally exact (up to
+    ``max_distance``, if given): each sharded axis's pass runs at full
+    extent after an all-to-all flips its sharding onto the reshard target —
+    the last non-sharded array axis, or the last *other* sharded axis in a
+    fully decomposed volume.  The target's local extent must be divisible by
+    every flipped mesh-axis size.
     """
+    from .distributed_ccl import _norm_shard_axes
+
+    axes = _norm_shard_axes(axis_name, axis_size, sharded_axis, shard_axes)
     ndim = mask.ndim
     sampling = _norm_sampling(ndim, sampling)
-    shard = int(sharded_axis) % ndim
-    resident = max(a for a in range(ndim) if a != shard)
-    if mask.shape[resident] % axis_size:
-        raise ValueError(
-            f"reshard axis {resident} extent {mask.shape[resident]} not "
-            f"divisible by mesh axis size {axis_size}"
-        )
+    sharded = {a: (name, n) for a, name, n in axes}
     global_extent = {
-        a: mask.shape[a] * (axis_size if a == shard else 1) for a in range(ndim)
+        a: mask.shape[a] * sharded.get(a, (None, 1))[1] for a in range(ndim)
     }
     if max_distance is None:
         radii = {a: global_extent[a] - 1 for a in range(ndim)}
@@ -65,22 +69,35 @@ def sharded_distance_transform_squared(
         }
 
     f = jnp.where(mask, _BIG, jnp.float32(0.0))
-    # passes along the already-resident axes
+    # passes along the already-resident axes (no communication)
     for a in range(ndim):
-        if a != shard:
+        if a not in sharded:
             f = edt_axis_pass(f, a, sampling[a] ** 2, radii[a], impl=impl)
-    # flip the sharded axis resident (one ICI all-to-all), run its pass at
-    # full global extent, flip back
-    f = reshard_axis(f, axis_name, from_axis=shard, to_axis=resident)
-    f = edt_axis_pass(f, shard, sampling[shard] ** 2, radii[shard], impl=impl)
-    f = reshard_axis(f, axis_name, from_axis=resident, to_axis=shard)
+    # each sharded axis: flip it resident (one ICI all-to-all), run its pass
+    # at full global extent, flip back.  The flip target may itself be
+    # sharded by ANOTHER mesh axis — the all_to_all then just splits the
+    # target's local extent further, which stays correct as long as it
+    # divides evenly.
+    for a, name, n in axes:
+        # prefer an UNSHARDED flip target (no extra divisibility constraint);
+        # only a fully decomposed volume falls back to another sharded axis
+        free = [x for x in range(ndim) if x != a and x not in sharded]
+        resident = max(free) if free else max(x for x in range(ndim) if x != a)
+        if f.shape[resident] % n:
+            raise ValueError(
+                f"reshard axis {resident} local extent {f.shape[resident]} "
+                f"not divisible by mesh axis {name!r} size {n}"
+            )
+        f = reshard_axis(f, name, from_axis=a, to_axis=resident)
+        f = edt_axis_pass(f, a, sampling[a] ** 2, radii[a], impl=impl)
+        f = reshard_axis(f, name, from_axis=resident, to_axis=a)
     return jnp.minimum(f, _BIG)
 
 
 def distributed_distance_transform(
     mask,
     mesh: Mesh,
-    sp_axis: str = "sp",
+    sp_axis: Union[str, Sequence[str]] = "sp",
     sharded_axis: int = 0,
     sampling: Optional[Sequence[float]] = None,
     max_distance: Optional[float] = None,
@@ -88,16 +105,30 @@ def distributed_distance_transform(
 ):
     """Whole-volume wrapper: exact EDT of a volume sharded over ``sp_axis``.
 
-    Returns the (non-squared) distance with the input's sharding.  Unlike
-    the per-block transform, distances do NOT saturate at any halo — the
+    ``sp_axis`` may be one mesh axis name (volume sharded along
+    ``sharded_axis``) or a sequence of names (leading array axes sharded
+    over the respective mesh axes — a 2-D/3-D spatial decomposition, as in
+    :func:`~.distributed_ccl.distributed_connected_components`).  Returns
+    the (non-squared) distance with the input's sharding.  Unlike the
+    per-block transform, distances do NOT saturate at any halo — every
     sharded axis's pass runs at full extent after an all-to-all reshard.
     ``sampling`` may be a scalar, list, tuple, or array (normalized here,
     BEFORE the jit boundary — it is a static argument underneath).
     """
     if sampling is not None:
         sampling = tuple(float(s) for s in np.atleast_1d(sampling))
+    names = (sp_axis,) if isinstance(sp_axis, str) else tuple(sp_axis)
+    if isinstance(sp_axis, str):
+        array_axes = (int(sharded_axis) % mask.ndim,)
+    else:
+        if sharded_axis != 0:
+            raise ValueError(
+                "sharded_axis only applies to single-axis sharding; a "
+                "sequence sp_axis shards the leading array axes"
+            )
+        array_axes = tuple(range(len(names)))
     return _distributed_distance_transform(
-        mask, mesh, sp_axis, sharded_axis, sampling,
+        mask, mesh, names, array_axes, sampling,
         None if max_distance is None else float(max_distance), impl,
     )
 
@@ -105,30 +136,32 @@ def distributed_distance_transform(
 @partial(
     jax.jit,
     static_argnames=(
-        "mesh", "sp_axis", "sharded_axis", "sampling", "max_distance", "impl",
+        "mesh", "names", "array_axes", "sampling", "max_distance", "impl",
     ),
 )
 def _distributed_distance_transform(
     mask,
     mesh: Mesh,
-    sp_axis: str,
-    sharded_axis: int,
+    names: Tuple[str, ...],
+    array_axes: Tuple[int, ...],
     sampling: Optional[Tuple[float, ...]],
     max_distance: Optional[float],
     impl: str,
 ):
     from .mesh import mesh_axis_sizes
 
-    n = mesh_axis_sizes(mesh)[sp_axis]
+    sizes = mesh_axis_sizes(mesh)
+    shard_axes = tuple(
+        (a, name, sizes[name]) for a, name in zip(array_axes, names)
+    )
     spec = [None] * mask.ndim
-    spec[int(sharded_axis) % mask.ndim] = sp_axis
+    for a, name in zip(array_axes, names):
+        spec[a] = name
 
     fn = jax.shard_map(
         partial(
             sharded_distance_transform_squared,
-            axis_name=sp_axis,
-            axis_size=n,
-            sharded_axis=sharded_axis,
+            shard_axes=shard_axes,
             sampling=sampling,
             max_distance=max_distance,
             impl=impl,

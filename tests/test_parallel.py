@@ -333,3 +333,23 @@ def test_ws_ccl_step_exact_edt(rng):
             vol[i] < 0.5, structure=ndimage.generate_binary_structure(3, 1)
         )
         assert_labels_equivalent(cc[i], expected)
+
+
+def test_distributed_edt_two_axis_decomposition(rng):
+    """Exact EDT on a (2, 4) spatial decomposition: both sharded axes'
+    passes run at full extent via chained reshards."""
+    from cluster_tools_tpu.parallel import distributed_distance_transform
+
+    mesh = _mesh(("spz", "spy"))
+    sizes = mesh_axis_sizes(mesh)
+    sz, sy = sizes["spz"], sizes["spy"]
+    shape = (sz * 4, sy * 4, 8 * sz * sy)
+    mask = rng.random(shape) < 0.95
+    mask[0, 0, 0] = False
+    got = np.asarray(
+        distributed_distance_transform(
+            mask, mesh, sp_axis=("spz", "spy"), sampling=(2.0, 1.0, 1.0)
+        )
+    )
+    want = ndimage.distance_transform_edt(mask, sampling=(2.0, 1.0, 1.0))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
