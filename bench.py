@@ -276,6 +276,9 @@ def main():
                 mesh, halo=halo, threshold=threshold,
                 dt_max_distance=float(halo),
                 min_seed_distance=min_seed_distance, impl=impl,
+                # config 3 is "to merged labels": fragments stitch across sp
+                # cuts by face consensus (free at sp=1 — no cuts exist)
+                stitch_ws_threshold=threshold,
             )
             log(f"config 3 (headline): compiling fused ws+ccl step (impl={impl})")
             out0 = candidate(vol)
