@@ -27,6 +27,7 @@ WORKFLOWS = {
     "connected_components": "cluster_tools_tpu.tasks.connected_components:ConnectedComponentsWorkflow",
     "thresholded_components": "cluster_tools_tpu.tasks.thresholded_components:ThresholdedComponentsWorkflow",
     "watershed": "cluster_tools_tpu.tasks.watershed:WatershedWorkflow",
+    "fused_segmentation": "cluster_tools_tpu.tasks.fused:FusedSegmentationWorkflow",
     "multicut": "cluster_tools_tpu.workflows:MulticutSegmentationWorkflow",
     "lifted_multicut": "cluster_tools_tpu.workflows:LiftedMulticutSegmentationWorkflow",
     "agglomerative_clustering": "cluster_tools_tpu.workflows:AgglomerativeClusteringWorkflow",

@@ -1,0 +1,160 @@
+"""The fused mesh-resident segmentation step as a task-library citizen.
+
+The blockwise watershed/CC task chains (SURVEY.md §3.2/§3.5) exist for
+volumes larger than device memory; when the working ROI *fits* in HBM, five
+tasks and thousands of chunk round-trips collapse into ONE compiled SPMD
+program — the same fused step the benchmark measures
+(:func:`cluster_tools_tpu.parallel.pipeline.make_ws_ccl_step`: halo exchange
+over ICI, per-shard DT watershed, cross-shard fragment stitch and
+union-find CC merge as collectives).  This task is the workflow-API bridge
+to that fast path: read the ROI, run the step over the device mesh, write
+``ws``/``cc`` labels back blockwise.
+
+The reference has no analogue — its runtime cannot express "one program
+over many nodes" at all; this is where the TPU-first redesign pays off
+directly through the same task/config machinery users already drive.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils.volume_utils import file_reader
+
+
+class FusedSegmentationBase(BaseTask):
+    """Whole-ROI fused watershed + merged CC on the device mesh.
+
+    Params: ``input_path/input_key`` (boundary map), ``output_path`` +
+    ``ws_key``/``cc_key`` (either may be omitted to skip that output).
+    Config: ``threshold``, ``halo``, ``dt_max_distance``,
+    ``min_seed_distance``, ``stitch_ws_threshold``, ``exact_edt``,
+    ``max_labels_per_shard``, ``impl`` — the fused-pipeline knobs.
+
+    The ROI must fit in device memory (sharded over the mesh); this task
+    refuses inputs whose z extent does not divide over the spatial axis.
+    """
+
+    task_name = "fused_segmentation"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "threshold": 0.25,
+            "halo": 4,
+            "dt_max_distance": None,
+            "min_seed_distance": 0.0,
+            "stitch_ws_threshold": None,
+            "exact_edt": False,
+            "max_labels_per_shard": None,
+            "impl": "auto",
+        }
+
+    def run_impl(self):
+        import jax
+
+        from ..parallel.mesh import make_mesh, mesh_axis_sizes
+        from ..parallel.pipeline import make_ws_ccl_step
+
+        cfg = self.get_config()
+        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        shape = inp.shape
+        roi_begin = tuple(cfg.get("roi_begin") or (0,) * len(shape))
+        roi_end = tuple(cfg.get("roi_end") or shape)
+        roi = tuple(slice(b, e) for b, e in zip(roi_begin, roi_end))
+        roi_shape = tuple(e - b for b, e in zip(roi_begin, roi_end))
+        if len(roi_shape) != 3:
+            raise ValueError(f"fused segmentation is 3-D only, got {roi_shape}")
+
+        # one ROI = batch of 1: every device goes to the spatial axis
+        n_dev = len(jax.devices())
+        mesh = make_mesh(axis_names=("dp", "sp"), grid=(1, n_dev))
+        sp = mesh_axis_sizes(mesh)["sp"]
+        if roi_shape[0] % sp:
+            raise ValueError(
+                f"ROI z extent {roi_shape[0]} does not divide over the "
+                f"spatial mesh axis (sp={sp})"
+            )
+
+        halo = int(np.max(cfg.get("halo") or 0))
+        dt_max = cfg.get("dt_max_distance")
+        if dt_max is None and halo and not cfg.get("exact_edt"):
+            # per-shard EDT is halo-capped by default (blockwise reference
+            # semantics); with exact_edt, None means truly global radii —
+            # the saturation exact_edt exists to remove must stay removable
+            dt_max = float(halo)
+        step = make_ws_ccl_step(
+            mesh,
+            halo=halo,
+            threshold=float(cfg["threshold"]),
+            dt_max_distance=dt_max,
+            min_seed_distance=float(cfg.get("min_seed_distance") or 0.0),
+            max_labels_per_shard=cfg.get("max_labels_per_shard"),
+            impl=str(cfg.get("impl", "auto")),
+            exact_edt=bool(cfg.get("exact_edt", False)),
+            stitch_ws_threshold=cfg.get("stitch_ws_threshold"),
+        )
+        self.logger.info(
+            f"fused step on mesh sp={sp}, roi {roi_shape}, halo={halo}"
+        )
+        vol = np.asarray(inp[roi]).astype(np.float32)
+        ws, cc, n_fg, overflow = jax.block_until_ready(step(vol[None]))
+        if bool(np.asarray(overflow)):
+            raise RuntimeError(
+                "fused step overflowed a label capacity; raise "
+                "max_labels_per_shard or use the blockwise task chain"
+            )
+
+        out_f = file_reader(cfg["output_path"])
+        block_shape = tuple(cfg["block_shape"])
+        written = {}
+        for key_cfg, data in (("ws_key", ws), ("cc_key", cc)):
+            key = cfg.get(key_cfg)
+            if not key:
+                continue
+            arr = np.asarray(data[0]).astype(np.uint64)
+            ds = out_f.require_dataset(
+                key, shape=shape, chunks=block_shape, dtype="uint64"
+            )
+            # the whole ROI is already host-resident: one sliced write
+            ds[roi] = arr
+            written[key] = int(arr.max())
+        return {
+            "n_foreground": int(np.asarray(n_fg)),
+            "mesh": {"dp": 1, "sp": sp},
+            "written": written,
+        }
+
+
+class FusedSegmentationLocal(FusedSegmentationBase):
+    target = "local"
+
+
+class FusedSegmentationTPU(FusedSegmentationBase):
+    target = "tpu"
+
+
+class FusedSegmentationWorkflow(WorkflowBase):
+    """One-task workflow wrapper so the CLI/registry can launch it."""
+
+    task_name = "fused_segmentation_workflow"
+
+    def requires(self):
+        from . import fused as fused_mod
+
+        return [
+            get_task_cls(fused_mod, "FusedSegmentation", self.target)(
+                tmp_folder=self.tmp_folder,
+                config_dir=self.config_dir,
+                max_jobs=self.max_jobs,
+                dependencies=self.dependencies,
+                **self.params,
+            )
+        ]
+
+    def run_impl(self):
+        return {}

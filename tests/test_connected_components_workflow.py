@@ -149,3 +149,38 @@ def test_cc_workflow_irregular_blocks(workspace, rng):
     got = _run_cc(workspace, mask, block_shape=(32, 32, 32))
     want, _ = ndi.label(mask)
     assert_labels_equivalent(got, want)
+
+
+def test_fused_segmentation_task_vs_scipy(workspace, rng):
+    """The fused mesh-resident step through the task/config API: one task,
+    whole ROI on the device mesh, labels written back blockwise."""
+    from cluster_tools_tpu.tasks.fused import FusedSegmentationLocal
+
+    tmp_folder, config_dir, root = workspace
+    path = os.path.join(root, "fused.zarr")
+    vol = ndi.gaussian_filter(rng.random((64, 32, 32)).astype(np.float32), 2)
+    vol = (vol - vol.min()) / (vol.max() - vol.min())
+    f = file_reader(path)
+    f.create_dataset(
+        "boundaries", shape=vol.shape, chunks=(32, 32, 32), dtype="float32"
+    )[...] = vol
+    t = FusedSegmentationLocal(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        input_path=path,
+        input_key="boundaries",
+        output_path=path,
+        ws_key="ws",
+        cc_key="cc",
+        threshold=0.6,
+        halo=4,
+        stitch_ws_threshold=0.6,
+        block_shape=[32, 32, 32],
+    )
+    assert build([t]), "fused task failed (see logs)"
+    r = file_reader(path, "r")
+    cc, ws = r["cc"][...], r["ws"][...]
+    want, _ = ndi.label(vol < 0.6, ndi.generate_binary_structure(3, 1))
+    assert_labels_equivalent(cc, want)
+    assert ws.shape == vol.shape and (ws[vol < 0.6] > 0).all()
