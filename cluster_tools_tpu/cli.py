@@ -86,15 +86,35 @@ def cmd_run(args) -> int:
 
 
 def cmd_configs(args) -> int:
+    import importlib
+    import inspect
+
+    from .runtime.task import BaseTask, WorkflowBase
+
     cls = _resolve(args.workflow)
     os.makedirs(args.out, exist_ok=True)
     get_config = getattr(cls, "get_config", None)
-    if get_config is None:
-        from .runtime.task import BaseTask
-
-        configs = {"global": BaseTask.default_global_config()}
-    else:
+    if get_config is not None and get_config is not BaseTask.get_config:
+        # workflow defines its own aggregator (workflows.py pattern); let
+        # real failures inside it propagate rather than silently falling
+        # back to an incomplete module scan
         configs = get_config()
+    else:
+        # task-module workflow: aggregate the defaults of every task family
+        # defined in the workflow's module (the reference pattern: one
+        # `<task_name>.config` per task).  ``task_name in vars(obj)``
+        # excludes abstract helpers that merely inherit BaseTask's name.
+        configs = {"global": BaseTask.default_global_config()}
+        mod = importlib.import_module(cls.__module__)
+        for obj in vars(mod).values():
+            if (
+                inspect.isclass(obj)
+                and issubclass(obj, BaseTask)
+                and not issubclass(obj, WorkflowBase)
+                and obj.__name__.endswith("Base")
+                and "task_name" in vars(obj)
+            ):
+                configs[obj.task_name] = obj.default_task_config()
     for name, cfg in configs.items():
         path = os.path.join(
             args.out, "global.config" if name == "global" else f"{name}.config"

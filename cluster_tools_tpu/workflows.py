@@ -235,6 +235,21 @@ class AgglomerativeClusteringWorkflow(WorkflowBase):
         return [write]
 
 
+    @staticmethod
+    def get_config() -> Dict[str, Dict[str, Any]]:
+        """Aggregated per-task default configs (reference pattern)."""
+        from .tasks import agglomerative_clustering as ac_mod
+
+        return {
+            "global": WorkflowBase.default_global_config(),
+            "watershed": ws_mod.WatershedBase.default_task_config(),
+            "initial_sub_graphs": graph_mod.InitialSubGraphsBase.default_task_config(),
+            "block_edge_features": feat_mod.BlockEdgeFeaturesBase.default_task_config(),
+            "agglomerative_clustering":
+                ac_mod.AgglomerativeClusteringBase.default_task_config(),
+        }
+
+
 class LiftedMulticutSegmentationWorkflow(WorkflowBase):
     """Lifted multicut segmentation (reference:
     ``LiftedMulticutSegmentationWorkflow``): the multicut chain plus a
@@ -358,3 +373,27 @@ class LiftedMulticutSegmentationWorkflow(WorkflowBase):
             **_pick(p, "block_shape"),
         )
         return [write]
+
+    @staticmethod
+    def get_config() -> Dict[str, Dict[str, Any]]:
+        """Aggregated per-task default configs (reference pattern)."""
+        from .tasks import lifted_features as lf_mod
+        from .tasks import lifted_multicut as lmc_mod
+        from .tasks import node_labels as nl_mod
+
+        return {
+            "global": WorkflowBase.default_global_config(),
+            "watershed": ws_mod.WatershedBase.default_task_config(),
+            "initial_sub_graphs": graph_mod.InitialSubGraphsBase.default_task_config(),
+            "block_edge_features": feat_mod.BlockEdgeFeaturesBase.default_task_config(),
+            "probs_to_costs": costs_mod.ProbsToCostsBase.default_task_config(),
+            "block_node_labels": nl_mod.BlockNodeLabelsBase.default_task_config(),
+            "sparse_lifted_neighborhood":
+                lf_mod.SparseLiftedNeighborhoodBase.default_task_config(),
+            "costs_from_node_labels":
+                lf_mod.CostsFromNodeLabelsBase.default_task_config(),
+            "solve_lifted_subproblems":
+                lmc_mod.SolveLiftedSubproblemsBase.default_task_config(),
+            "solve_lifted_global":
+                lmc_mod.SolveLiftedGlobalBase.default_task_config(),
+        }

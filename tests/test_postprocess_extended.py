@@ -238,3 +238,21 @@ def test_cli_configs(workspace):
     assert out.returncode == 0, out.stdout + out.stderr
     assert os.path.exists(os.path.join(out_dir, "global.config"))
     assert os.path.exists(os.path.join(out_dir, "watershed.config"))
+
+
+def test_cli_configs_every_workflow(workspace):
+    """configs must work for EVERY registered workflow — task-module
+    workflows (no aggregator get_config) aggregate their module's task
+    defaults (regression: the inherited instance method used to TypeError)."""
+    from cluster_tools_tpu.cli import WORKFLOWS, main
+
+    tmp_folder, config_dir, root = workspace
+    for wf in sorted(WORKFLOWS):
+        out_dir = os.path.join(root, f"cfg_{wf}")
+        assert main(["configs", wf, "--out", out_dir]) == 0, wf
+        files = os.listdir(out_dir)
+        assert "global.config" in files, wf
+        # every workflow exposes at least one editable task config, and the
+        # scan must not emit junk for abstract helper bases
+        assert len(files) >= 2, (wf, files)
+        assert "base.config" not in files, wf
