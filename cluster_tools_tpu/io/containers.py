@@ -124,7 +124,10 @@ class _ImmediateFuture:
         return self._v
 
 
-def _check_existing(key, have_shape, have_dtype, want_shape, want_dtype):
+def _check_existing(
+    key, have_shape, have_dtype, want_shape, want_dtype,
+    have_chunks=None, want_chunks=None,
+):
     if tuple(have_shape) != tuple(int(s) for s in want_shape) or np.dtype(
         have_dtype
     ) != np.dtype(want_dtype):
@@ -132,6 +135,23 @@ def _check_existing(key, have_shape, have_dtype, want_shape, want_dtype):
             f"dataset {key!r} exists with shape {tuple(have_shape)} / dtype "
             f"{np.dtype(have_dtype)}, requested {tuple(want_shape)} / "
             f"{np.dtype(want_dtype)}"
+        )
+    if have_chunks is None or want_chunks is None:
+        return
+    have_chunks = tuple(int(c) for c in have_chunks)
+    want_chunks = tuple(int(c) for c in want_chunks)
+    # race safety (SURVEY.md §5.2): parallel block writes are conflict-free
+    # only when every written block tiles whole chunks — i.e. the requested
+    # block grid is a per-axis integer multiple of the existing chunks.
+    # Finer-than-existing blocks would share chunks between writers.
+    if len(have_chunks) != len(want_chunks) or any(
+        w % h for w, h in zip(want_chunks, have_chunks)
+    ):
+        raise ValueError(
+            f"dataset {key!r} exists with chunks {have_chunks}, requested "
+            f"{want_chunks} — blocks must tile whole chunks (per-axis "
+            "integer multiples) for chunk-aligned parallel writes; use a "
+            "matching block_shape or a fresh dataset"
         )
 
 
@@ -222,14 +242,11 @@ class ZarrContainer:
             if not exist_ok:
                 raise
             store = self._open_store(key)
-            if tuple(store.shape) != tuple(shape) or (
-                np.dtype(store.dtype.numpy_dtype) != np.dtype(dtype)
-            ):
-                raise ValueError(
-                    f"dataset {key!r} exists with shape {tuple(store.shape)} / "
-                    f"dtype {store.dtype.numpy_dtype}, requested {tuple(shape)} / "
-                    f"{np.dtype(dtype)}"
-                )
+            _check_existing(
+                key, store.shape, store.dtype.numpy_dtype, shape, dtype,
+                have_chunks=store.chunk_layout.read_chunk.shape,
+                want_chunks=chunks,
+            )
         ds = Dataset(store, self._attrs_path(key))
         with self._lock:
             self._cache[key] = ds
@@ -320,7 +337,14 @@ class H5Container:
             if not exist_ok:
                 raise ValueError(f"dataset {key} exists")
             ds = self._f[key]
-            _check_existing(key, ds.shape, ds.dtype, shape, dtype)
+            _check_existing(
+                key, ds.shape, ds.dtype, shape, dtype,
+                have_chunks=ds.chunks,
+                want_chunks=(
+                    None if ds.chunks is None
+                    else tuple(int(min(c, s)) for c, s in zip(chunks, shape))
+                ),
+            )
             return _H5Dataset(ds)
         ds = self._f.create_dataset(
             key,
@@ -374,7 +398,10 @@ class MemoryContainer:
             if not exist_ok:
                 raise ValueError(f"dataset {key} exists")
             ds = self._data[key]
-            _check_existing(key, ds.shape, ds.dtype, shape, dtype)
+            _check_existing(
+                key, ds.shape, ds.dtype, shape, dtype,
+                have_chunks=ds.chunks, want_chunks=chunks,
+            )
             return ds
         ds = _MemDataset(np.full(tuple(shape), fill_value, dtype=dtype), tuple(chunks))
         self._data[key] = ds

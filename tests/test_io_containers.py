@@ -1,0 +1,48 @@
+"""Container-layer contracts: existing-dataset validation across backends
+(race safety, SURVEY.md §5.2: blocks must tile whole chunks)."""
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+
+def _zarr(tmp_path):
+    return file_reader(str(tmp_path / "c.zarr"))
+
+
+def _h5(tmp_path):
+    pytest.importorskip("h5py")
+    return file_reader(str(tmp_path / "c.h5"))
+
+
+def _mem(tmp_path):
+    return file_reader(f"memory://{tmp_path}/c")
+
+
+@pytest.mark.parametrize("opener", [_zarr, _h5, _mem])
+def test_require_dataset_chunk_contract(tmp_path, opener):
+    """Resume with identical or coarser (integer-multiple) blocks is safe;
+    finer-than-existing chunking would share chunks between parallel
+    writers and must be refused — on every backend."""
+    f = opener(tmp_path)
+    f.create_dataset("d", shape=(64, 64, 64), chunks=(16, 16, 16), dtype="uint8")
+    # identical chunking: fine
+    f.require_dataset("d", shape=(64, 64, 64), chunks=(16, 16, 16), dtype="uint8")
+    # coarser blocks tiling whole chunks: fine (each block covers 8 chunks)
+    f.require_dataset("d", shape=(64, 64, 64), chunks=(32, 32, 32), dtype="uint8")
+    # finer blocks: two writers per chunk -> refuse
+    with pytest.raises(ValueError, match="chunk"):
+        f.require_dataset("d", shape=(64, 64, 64), chunks=(8, 8, 8), dtype="uint8")
+    # non-multiple: refuse
+    with pytest.raises(ValueError, match="chunk"):
+        f.require_dataset("d", shape=(64, 64, 64), chunks=(24, 24, 24), dtype="uint8")
+
+
+def test_require_dataset_shape_dtype_mismatch(tmp_path):
+    f = _zarr(tmp_path)
+    f.create_dataset("d", shape=(32, 32, 32), chunks=(16, 16, 16), dtype="uint8")
+    with pytest.raises(ValueError, match="shape"):
+        f.require_dataset("d", shape=(16, 16, 16), chunks=(16, 16, 16), dtype="uint8")
+    with pytest.raises(ValueError, match="dtype|shape"):
+        f.require_dataset("d", shape=(32, 32, 32), chunks=(16, 16, 16), dtype="float32")
