@@ -124,6 +124,12 @@ class _ImmediateFuture:
         return self._v
 
 
+def _clamp_chunks(chunks, shape):
+    """Chunks capped at the dataset shape — the creation rule, reused by
+    existing-dataset validation so both paths compare like for like."""
+    return tuple(int(min(c, s)) for c, s in zip(chunks, shape))
+
+
 def _check_existing(
     key, have_shape, have_dtype, want_shape, want_dtype,
     have_chunks=None, want_chunks=None,
@@ -210,7 +216,7 @@ class ZarrContainer:
         if self.mode == "r":
             raise PermissionError(f"container {self.path} opened read-only")
         shape = [int(s) for s in shape]
-        chunks = [int(min(c, s)) for c, s in zip(chunks, shape)]
+        chunks = list(_clamp_chunks(chunks, shape))
         if self.is_n5:
             comp = {"type": compression if compression else "raw"}
             # the N5 spec stores dimensions fastest-varying-first (F-order);
@@ -340,16 +346,13 @@ class H5Container:
             _check_existing(
                 key, ds.shape, ds.dtype, shape, dtype,
                 have_chunks=ds.chunks,
-                want_chunks=(
-                    None if ds.chunks is None
-                    else tuple(int(min(c, s)) for c, s in zip(chunks, shape))
-                ),
+                want_chunks=_clamp_chunks(chunks, shape),
             )
             return _H5Dataset(ds)
         ds = self._f.create_dataset(
             key,
             shape=tuple(shape),
-            chunks=tuple(int(min(c, s)) for c, s in zip(chunks, shape)),
+            chunks=_clamp_chunks(chunks, shape),
             dtype=dtype,
             compression=compression,
             fillvalue=fill_value,

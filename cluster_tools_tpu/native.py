@@ -119,6 +119,7 @@ def _load() -> Optional[ctypes.CDLL]:
             f64p,
             f64p,
             f64p,
+            f64p,
         ]
         lib.ct_merge_edge_features.restype = ctypes.c_int64
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
@@ -225,15 +226,17 @@ def mutex_watershed(
 
 
 def merge_edge_features(parts, table: np.ndarray):
-    """Accumulate per-block (uv, feats[m, 4]) parts onto the lexsorted
-    ``table``: (weighted-mean sums, min, max, count sums) per table row, or
-    None when the library is unavailable.  ``parts`` iterates (uv, feats)."""
+    """Accumulate per-block (uv, feats[m, 5]) parts onto the lexsorted
+    ``table``: (weighted-mean sums, sums of squares, min, max, count sums)
+    per table row, or None when the library is unavailable.  ``parts``
+    iterates (uv, feats)."""
     lib = _load()
     if lib is None:
         return None
     table = np.ascontiguousarray(np.asarray(table).reshape(-1, 2), np.uint64)
     k = len(table)
     wsums = np.zeros(k, np.float64)
+    sqsums = np.zeros(k, np.float64)
     mins = np.full(k, np.inf)
     maxs = np.full(k, -np.inf)
     counts = np.zeros(k, np.float64)
@@ -241,8 +244,15 @@ def merge_edge_features(parts, table: np.ndarray):
         if len(uv) == 0:
             continue
         uv = np.ascontiguousarray(np.asarray(uv).reshape(-1, 2), np.uint64)
-        feats = np.ascontiguousarray(np.asarray(feats, np.float64)).reshape(-1, 4)
+        feats = np.asarray(feats, np.float64)
+        if feats.ndim != 2 or feats.shape[1] != 5:
+            raise ValueError(
+                f"edge-feature block has {feats.shape} columns, expected "
+                "(m, 5) (mean, min, max, count, variance) — regenerate "
+                "per-block features written by an older format"
+            )
+        feats = np.ascontiguousarray(feats)
         lib.ct_merge_edge_features(
-            uv, feats, len(uv), table, k, wsums, mins, maxs, counts
+            uv, feats, len(uv), table, k, wsums, sqsums, mins, maxs, counts
         )
-    return wsums, mins, maxs, counts
+    return wsums, sqsums, mins, maxs, counts

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 # per-edge accumulated statistics, in column order
-FEATURE_NAMES = ("mean", "min", "max", "count")
+FEATURE_NAMES = ("mean", "min", "max", "count", "variance")
 
 
 @partial(jax.jit, static_argnames=("axis", "with_values"))
@@ -83,7 +83,9 @@ def device_edge_aggregate(
     same sort-compact machinery as ops/tile_ccl.
 
     ``seg``: int32 labels (0 = background) — callers with uint64 global ids
-    densify first.  Returns ``(lo, hi, count, vsum, vmin, vmax, n_edges)``
+    densify first.  Returns ``(lo, hi, count, vsum, vsumsq, vmin, vmax,
+    shift, n_edges)`` — ``vsumsq`` is the second moment about ``shift``
+    (the global value mean; see the in-body comment)
     with static length ``edge_cap`` (slots past ``n_edges`` hold lo=hi=0);
     ``n_edges > edge_cap`` means overflow (results truncated).
     """
@@ -132,6 +134,17 @@ def device_edge_aggregate(
         vsum = jax.ops.segment_sum(
             jnp.where(valid, val, 0.0), sid, num_segments=edge_cap + 1
         )[:-1]
+        # second moment about the GLOBAL value mean, not zero: for values
+        # clustered away from 0 (8-bit intensities, probabilities near 1)
+        # E[x^2] - mean^2 in float32 is catastrophic cancellation — shifting
+        # makes both accumulated terms proportional to the spread instead
+        shift = jnp.sum(jnp.where(valid, val, 0.0)) / jnp.maximum(
+            jnp.sum(valid.astype(jnp.float32)), 1.0
+        )
+        d = val - shift
+        vsumsq = jax.ops.segment_sum(
+            jnp.where(valid, d * d, 0.0), sid, num_segments=edge_cap + 1
+        )[:-1]
         vmin = jax.ops.segment_min(
             jnp.where(valid, val, jnp.float32(np.inf)), sid,
             num_segments=edge_cap + 1,
@@ -141,8 +154,9 @@ def device_edge_aggregate(
             num_segments=edge_cap + 1,
         )[:-1]
     else:
-        vsum = vmin = vmax = jnp.zeros((edge_cap,), jnp.float32)
-    return out_lo, out_hi, count, vsum, vmin, vmax, n_edges
+        shift = jnp.float32(0.0)
+        vsum = vsumsq = vmin = vmax = jnp.zeros((edge_cap,), jnp.float32)
+    return out_lo, out_hi, count, vsum, vsumsq, vmin, vmax, shift, n_edges
 
 
 def block_rag(
@@ -162,7 +176,7 @@ def block_rag(
     - ``uv``     uint64 [m, 2], lexsorted, ``uv[:, 0] < uv[:, 1]``, label 0
       (background / ignore) excluded,
     - ``sizes``  int64 [m], number of voxel-face contacts per edge,
-    - ``feats``  float32 [m, 4] per-edge (mean, min, max, count) of the
+    - ``feats``  float32 [m, 5] per-edge (mean, min, max, count, variance) of the
       boundary values, or None.
 
     3-D blocks dedup on device (:func:`device_edge_aggregate` — one sort +
@@ -216,12 +230,16 @@ def _block_rag_host(
     m = len(uv)
     s = np.zeros(m, np.float64)
     np.add.at(s, inv, v)
+    sq = np.zeros(m, np.float64)
+    np.add.at(sq, inv, v * v)
     mn = np.full(m, np.inf)
     np.minimum.at(mn, inv, v)
     mx = np.full(m, -np.inf)
     np.maximum.at(mx, inv, v)
+    mean = s / sizes
+    var = np.maximum(sq / sizes - mean * mean, 0.0)
     feats = np.stack(
-        [s / sizes, mn, mx, sizes.astype(np.float64)], axis=1
+        [mean, mn, mx, sizes.astype(np.float64), var], axis=1
     ).astype(np.float32)
     return uv, sizes.astype(np.int64), feats
 
@@ -250,7 +268,8 @@ def _block_rag_device(
 
     cap = 1 << 14
     while True:
-        lo, hi, count, vsum, vmin, vmax, n_edges = device_edge_aggregate(
+        (lo, hi, count, vsum, vsumsq, vmin, vmax, shift,
+         n_edges) = device_edge_aggregate(
             jnp.asarray(dense), vals_j, cap, with_values=with_values,
             inner_shape=tuple(inner),
         )
@@ -266,12 +285,19 @@ def _block_rag_device(
     if not with_values:
         return uv, sizes, None
     s = np.asarray(vsum[:n], np.float64)
+    sq = np.asarray(vsumsq[:n], np.float64)
+    mean = s / np.maximum(sizes, 1)
+    # sq is the second moment about the global shift c:
+    # var = E[(x-c)^2] - (mean-c)^2
+    c = float(shift)
+    var = np.maximum(sq / np.maximum(sizes, 1) - (mean - c) ** 2, 0.0)
     feats = np.stack(
         [
-            s / np.maximum(sizes, 1),
+            mean,
             np.asarray(vmin[:n], np.float64),
             np.asarray(vmax[:n], np.float64),
             sizes.astype(np.float64),
+            var,
         ],
         axis=1,
     ).astype(np.float32)
@@ -301,7 +327,9 @@ def merge_feature_lists(uv_global: np.ndarray, parts) -> np.ndarray:
 
     ``parts`` iterates ``(uv, feats)`` with feats columns
     :data:`FEATURE_NAMES`.  Mean is count-weighted; min/max are reduced;
-    counts are summed.  Edges absent from all parts get zeros.
+    counts are summed; variance merges exactly through the law of total
+    variance (sum of squares is additive).  Edges absent from all parts get
+    zeros.
     """
     m = len(uv_global)
 
@@ -309,29 +337,41 @@ def merge_feature_lists(uv_global: np.ndarray, parts) -> np.ndarray:
 
     merged = native.merge_edge_features(parts, uv_global)
     if merged is not None:
-        s, mn, mx, cnt = merged
+        s, sq, mn, mx, cnt = merged
     else:
         s = np.zeros(m, np.float64)
+        sq = np.zeros(m, np.float64)
         mn = np.full(m, np.inf)
         mx = np.full(m, -np.inf)
         cnt = np.zeros(m, np.float64)
         for uv, feats in parts:
             if len(uv) == 0:
                 continue
+            feats = np.asarray(feats)
+            if feats.ndim != 2 or feats.shape[1] != len(FEATURE_NAMES):
+                raise ValueError(
+                    f"edge-feature block has shape {feats.shape}, expected "
+                    f"(m, {len(FEATURE_NAMES)}) {FEATURE_NAMES} — regenerate "
+                    "per-block features written by an older format"
+                )
             ids = find_edge_ids(uv_global, uv)
             ok = ids >= 0
             ids = ids[ok]
             f = feats[ok].astype(np.float64)
             np.add.at(s, ids, f[:, 0] * f[:, 3])
+            # E[x^2] * n = (var + mean^2) * n  — additive across blocks
+            np.add.at(sq, ids, (f[:, 4] + f[:, 0] ** 2) * f[:, 3])
             np.minimum.at(mn, ids, f[:, 1])
             np.maximum.at(mx, ids, f[:, 2])
             np.add.at(cnt, ids, f[:, 3])
     has = cnt > 0
     mean = np.zeros(m, np.float64)
     mean[has] = s[has] / cnt[has]
+    var = np.zeros(m, np.float64)
+    var[has] = np.maximum(sq[has] / cnt[has] - mean[has] ** 2, 0.0)
     mn[~has] = 0.0
     mx[~has] = 0.0
-    return np.stack([mean, mn, mx, cnt], axis=1).astype(np.float32)
+    return np.stack([mean, mn, mx, cnt, var], axis=1).astype(np.float32)
 
 
 def find_edge_ids(uv_sorted: np.ndarray, uv_query: np.ndarray) -> np.ndarray:

@@ -39,6 +39,14 @@ class CopyVolumeBase(BaseTask):
         in_shape = inp.shape[1:] if channel is not None else inp.shape
         block_shape = tuple(cfg["block_shape"])
         out_chunks = tuple(cfg.get("out_chunks") or block_shape)
+        if any(b % c for b, c in zip(block_shape, out_chunks)):
+            # race safety (SURVEY.md §5.2): parallel block writes must tile
+            # whole output chunks — the container guard can only compare the
+            # requested chunks, not the write grid, so enforce it here
+            raise ValueError(
+                f"block_shape {block_shape} must be a per-axis multiple of "
+                f"out_chunks {out_chunks} for chunk-aligned parallel writes"
+            )
         dtype = cfg.get("dtype") or str(inp.dtype)
         scale, offset = cfg.get("scale_factor"), cfg.get("offset")
         roi_begin, roi_end = cfg.get("roi_begin"), cfg.get("roi_end")

@@ -10,7 +10,7 @@ jitted RAG kernel (:func:`..ops.rag.block_rag` with values), and the merge is
 Artifacts (in ``tmp_folder/graph``, next to the graph):
 
     features_block_<id>.npz  {uv, feats}     per-block edge features
-    features.npy             float32 [m, 4]  (mean, min, max, count) per
+    features.npy             float32 [m, 5]  (mean, min, max, count, variance) per
                                              global edge, aligned with
                                              graph.npz's edge list
 """

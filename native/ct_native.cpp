@@ -124,15 +124,16 @@ int ct_greedy_additive(int64_t n_nodes, const int64_t* edges,
 }
 
 // Merge per-block edge features onto a global lexsorted edge table.
-// pairs: [m, 2] uint64 (lo, hi); feats: [m, 4] double rows
-// (mean, min, max, count); table: [k, 2] uint64 lexsorted unique edges.
-// Accumulates count-weighted mean sums, min of mins, max of maxs, and
-// count sums — the merge_feature_lists contract.  Returns the number of
-// pairs not found in the table.
+// pairs: [m, 2] uint64 (lo, hi); feats: [m, 5] double rows
+// (mean, min, max, count, variance); table: [k, 2] uint64 lexsorted unique
+// edges.  Accumulates count-weighted mean sums, additive sums of squares
+// ((var + mean^2) * count), min of mins, max of maxs, and count sums — the
+// merge_feature_lists contract.  Returns the number of pairs not found in
+// the table.
 int64_t ct_merge_edge_features(const uint64_t* pairs, const double* feats,
                                int64_t m, const uint64_t* table, int64_t k,
-                               double* wsums, double* mins, double* maxs,
-                               double* counts) {
+                               double* wsums, double* sqsums, double* mins,
+                               double* maxs, double* counts) {
   int64_t unmatched = 0;
   for (int64_t i = 0; i < m; ++i) {
     uint64_t lo = pairs[2 * i], hi = pairs[2 * i + 1];
@@ -149,9 +150,10 @@ int64_t ct_merge_edge_features(const uint64_t* pairs, const double* feats,
       ++unmatched;
       continue;
     }
-    double mean = feats[4 * i], mn = feats[4 * i + 1], mx = feats[4 * i + 2],
-           cnt = feats[4 * i + 3];
+    double mean = feats[5 * i], mn = feats[5 * i + 1], mx = feats[5 * i + 2],
+           cnt = feats[5 * i + 3], var = feats[5 * i + 4];
     wsums[a] += mean * cnt;
+    sqsums[a] += (var + mean * mean) * cnt;
     if (mn < mins[a]) mins[a] = mn;
     if (mx > maxs[a]) maxs[a] = mx;
     counts[a] += cnt;

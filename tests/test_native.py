@@ -94,6 +94,7 @@ def test_merge_edge_features_matches_python():
                 rng.random(take.sum()),
                 rng.random(take.sum()) + 1,
                 rng.integers(1, 20, take.sum()).astype(float),
+                rng.random(take.sum()) * 0.1,
             ],
             axis=1,
         ).astype(np.float32)

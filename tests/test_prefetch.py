@@ -135,4 +135,3 @@ def test_prefetcher_none_item_is_a_real_item():
     got = list(BlockPrefetcher(read, [1, None, 2], depth=2))
     assert [i for i, _ in got] == [1, None, 2]
     assert seen == [1, None, 2]
-

@@ -36,11 +36,17 @@ def rag_oracle(seg, values=None):
         return uv, sizes, None
     feats = np.array(
         [
-            [np.mean(acc[tuple(k)]), np.min(acc[tuple(k)]), np.max(acc[tuple(k)]), len(acc[tuple(k)])]
+            [
+                np.mean(acc[tuple(k)]),
+                np.min(acc[tuple(k)]),
+                np.max(acc[tuple(k)]),
+                len(acc[tuple(k)]),
+                np.var(acc[tuple(k)]),
+            ]
             for k in uv
         ],
         dtype=np.float32,
-    ).reshape(-1, 4)
+    ).reshape(-1, 5)
     return uv, sizes, feats
 
 
@@ -246,3 +252,16 @@ def test_device_rag_overflow_regrows(rng):
     uv_h, sz_h, _ = _block_rag_host(seg, None, seg.shape)
     np.testing.assert_array_equal(uv_d, uv_h)
     np.testing.assert_array_equal(sz_d, sz_h)
+
+
+def test_device_variance_large_mean_values(rng):
+    """float32 E[x^2]-mean^2 is catastrophic cancellation for values with
+    large mean and tiny spread (8-bit intensities ~200); the shifted second
+    moment must stay accurate."""
+    seg = (rng.integers(0, 2, (24, 24, 24)) + 1).astype(np.uint64)
+    vals = (200.0 + rng.random((24, 24, 24))).astype(np.float32)
+    uv, sizes, feats = block_rag(seg, values=vals)
+    uv_o, sizes_o, feats_o = rag_oracle(seg, vals.astype(np.float64))
+    np.testing.assert_array_equal(uv, uv_o)
+    # true variance is O(0.1); demand 1% relative accuracy
+    np.testing.assert_allclose(feats[:, 4], feats_o[:, 4], rtol=1e-2)
