@@ -256,3 +256,43 @@ def test_cli_configs_every_workflow(workspace):
         # scan must not emit junk for abstract helper bases
         assert len(files) >= 2, (wf, files)
         assert "base.config" not in files, wf
+
+
+def test_cc_on_segmentation_full_connectivity(workspace, rng):
+    """Keyed CC at connectivity 3: same-segment voxels touching only
+    diagonally (incl. across block corners) stay one part; different
+    segments never merge."""
+    from cluster_tools_tpu.tasks.postprocess import (
+        ConnectedComponentsOnSegmentationWorkflow,
+    )
+
+    tmp_folder, config_dir, root = workspace
+    shape = (32, 32, 32)
+    seg = rng.integers(0, 3, shape).astype(np.uint64)
+    path = _dataset(root, "segc3", seg)
+    wf = ConnectedComponentsOnSegmentationWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="segc3",
+        output_path=path,
+        output_key="cc",
+        connectivity=3,
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    got = file_reader(path, "r")["cc"][...]
+    # oracle: label each segment id separately with the full neighborhood
+    out = np.zeros_like(seg)
+    nxt = 1
+    st = ndi.generate_binary_structure(3, 3)
+    for k in np.unique(seg):
+        if k == 0:
+            continue
+        cc, n = ndi.label(seg == k, structure=st)
+        for c in range(1, n + 1):
+            out[cc == c] = nxt
+            nxt += 1
+    assert_labels_equivalent(got, out)
