@@ -17,8 +17,6 @@ directly through the same task/config machinery users already drive.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
