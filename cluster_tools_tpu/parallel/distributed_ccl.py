@@ -55,6 +55,32 @@ _INT32_MAX = np.int32(np.iinfo(np.int32).max)  # numpy: no backend init at impor
 ShardAxis = Tuple[int, str, int]
 
 
+def linearized_shard_rank(axes: Sequence[ShardAxis]) -> jnp.ndarray:
+    """This device's rank over the sharded axes, first listed axis slowest.
+
+    THE label-globalization convention: every site that builds or merges
+    ``rank * span + local`` labels (sharded_label_components, the fused
+    pipeline's watershed globalization and stitch) must use this one
+    function, or label bases silently drift apart.  Inside ``shard_map``
+    only.
+    """
+    rank = jnp.int32(0)
+    for _, name, size in axes:
+        rank = rank * jnp.int32(size) + lax.axis_index(name).astype(jnp.int32)
+    return rank
+
+
+def sp_axes_for_mesh(mesh: Mesh, sp_axis) -> Tuple[ShardAxis, ...]:
+    """Normalize a mesh-axis name or sequence of names to ``ShardAxis``
+    triples over the leading array axes (the whole-volume-wrapper calling
+    convention shared by the distributed CCL, EDT, and fused pipeline)."""
+    from .mesh import mesh_axis_sizes
+
+    sizes = mesh_axis_sizes(mesh)
+    names = (sp_axis,) if isinstance(sp_axis, str) else tuple(sp_axis)
+    return tuple((i, name, sizes[name]) for i, name in enumerate(names))
+
+
 def _boundary_pairs(
     glob: jnp.ndarray, axes: Sequence[ShardAxis], connectivity: int
 ) -> jnp.ndarray:
@@ -184,10 +210,7 @@ def sharded_label_components(
     n_slab = int(np.prod(shape))
     n_shards = int(np.prod([s for _, _, s in axes]))
 
-    # linearized shard rank, first listed axis slowest
-    rank = jnp.int32(0)
-    for _, name, size in axes:
-        rank = rank * jnp.int32(size) + lax.axis_index(name).astype(jnp.int32)
+    rank = linearized_shard_rank(axes)
 
     # 1. per-shard CCL; globalize so labels are unique across shards
     use_tiled = impl != "legacy" and mask.ndim == 3 and connectivity == 1
@@ -320,13 +343,8 @@ def distributed_connected_components(
     exceeded ``max_labels_per_shard`` (labels are then unreliable — re-run
     with a bigger cap or more shards).
     """
-    from .mesh import mesh_axis_sizes
-
-    sizes = mesh_axis_sizes(mesh)
     names = [sp_axis] if isinstance(sp_axis, str) else list(sp_axis)
-    shard_axes = tuple(
-        (i, name, sizes[name]) for i, name in enumerate(names)
-    )
+    shard_axes = sp_axes_for_mesh(mesh, sp_axis)
     fn = jax.shard_map(
         partial(
             sharded_label_components,

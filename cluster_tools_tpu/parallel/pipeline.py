@@ -4,14 +4,17 @@ The reference's north-star workload (BASELINE.json) is: blockwise
 distance-transform watershed + connected components, with the two-pass
 union-find label merge, end-to-end to globally merged labels.  In the
 reference that was five luigi tasks and thousands of filesystem round-trips;
-here it is **one compiled SPMD program** over a ``(dp, sp)`` mesh:
+here it is **one compiled SPMD program** over a ``(dp, sp...)`` mesh:
 
 - ``dp`` shards a batch of independent volumes (block batches),
-- ``sp`` shards each volume into contiguous z-slabs,
-- halo exchange (``ppermute`` over ICI) replaces overlapping FS reads,
-- the fused DT-watershed kernel runs per slab,
-- the thresholded foreground is labeled with globally consistent components
-  via the distributed union-find merge (``all_gather`` + pointer jumping),
+- one or more spatial axes shard each volume into slabs (z) or a full
+  2-D/3-D spatial decomposition (z × y × x) — the teravoxel layout,
+- halo exchange (``ppermute`` over ICI, one per sharded axis — corners fill
+  correctly because each exchange forwards the previously received halo),
+- the fused DT-watershed kernel runs per shard,
+- watershed fragments stitch across every cut by face consensus, and the
+  thresholded foreground is labeled with globally consistent components via
+  the distributed union-find merge (``all_gather`` + pointer jumping),
 - a ``psum`` over the whole mesh yields global statistics.
 
 This module is what ``__graft_entry__.dryrun_multichip`` compiles and runs.
@@ -20,7 +23,7 @@ This module is what ``__graft_entry__.dryrun_multichip`` compiles and runs.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +33,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.ccl import _match_vma, relabel_consecutive
 from ..ops.watershed import distance_transform_watershed
-from .distributed_ccl import merge_labels_by_pairs, sharded_label_components
+from .distributed_ccl import (
+    ShardAxis,
+    linearized_shard_rank,
+    merge_labels_by_pairs,
+    sharded_label_components,
+    sp_axes_for_mesh,
+)
 from .halo import crop_halo, exchange_halo, neighbor_face
 from .mesh import mesh_axis_sizes
 
@@ -38,46 +47,48 @@ from .mesh import mesh_axis_sizes
 def _stitch_ws_fragments(
     ws: jnp.ndarray,
     vol: jnp.ndarray,
-    sp_axis: str,
-    sp_size: int,
+    axes: Sequence[ShardAxis],
     rank: jnp.ndarray,
     span: int,
     threshold: float,
 ) -> jnp.ndarray:
-    """Merge watershed fragments across the sharded cut by face consensus.
+    """Merge watershed fragments across every sharded cut by face consensus.
 
     The device-resident form of the reference's two-pass/stitching semantics
     (SURVEY.md §3.5, ``stitching``): two fragments facing each other across
-    the shard boundary merge when the boundary evidence at their contact is
+    a shard boundary merge when the boundary evidence at their contact is
     weak — ``max`` of the two sides' boundary values below ``threshold``.
     The equivalences ride the same gather + union-find + remap tail as the
     distributed CCL merge.
     """
-    mine_l = lax.slice_in_dim(ws, 0, 1, axis=0).ravel()
-    theirs_l = neighbor_face(ws, 0, sp_axis, sp_size, direction=-1).ravel()
-    mine_b = lax.slice_in_dim(vol, 0, 1, axis=0).ravel()
-    theirs_b = neighbor_face(
-        vol, 0, sp_axis, sp_size, direction=-1, fill=1.0
-    ).ravel()
-    val = jnp.maximum(mine_b, theirs_b)
-    ok = (mine_l > 0) & (theirs_l > 0) & (val < threshold)
-    pairs = jnp.stack(
-        [
-            jnp.where(ok, theirs_l, jnp.int32(-1)),
-            jnp.where(ok, mine_l, jnp.int32(-1)),
-        ],
-        axis=1,
-    )
+    pairs = []
+    for a, name, size in axes:
+        mine_l = lax.slice_in_dim(ws, 0, 1, axis=a).ravel()
+        theirs_l = neighbor_face(ws, a, name, size, direction=-1).ravel()
+        mine_b = lax.slice_in_dim(vol, 0, 1, axis=a).ravel()
+        theirs_b = neighbor_face(
+            vol, a, name, size, direction=-1, fill=1.0
+        ).ravel()
+        val = jnp.maximum(mine_b, theirs_b)
+        ok = (mine_l > 0) & (theirs_l > 0) & (val < threshold)
+        pairs.append(
+            jnp.stack(
+                [
+                    jnp.where(ok, theirs_l, jnp.int32(-1)),
+                    jnp.where(ok, mine_l, jnp.int32(-1)),
+                ],
+                axis=1,
+            )
+        )
     return merge_labels_by_pairs(
-        ws, pairs, ((0, sp_axis, sp_size),), rank, span
+        ws, jnp.concatenate(pairs, axis=0), axes, rank, span
     )
 
 
 def _ws_ccl_shard(
     boundaries: jnp.ndarray,
     *,
-    sp_axis: str,
-    sp_size: int,
+    sp_axes: Tuple[ShardAxis, ...],
     dp_axis: str,
     halo: int,
     threshold: float,
@@ -89,16 +100,31 @@ def _ws_ccl_shard(
     exact_edt: bool,
     stitch_ws_threshold: Optional[float],
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Per-device body: local shard is (local_batch, z_slab, y, x)."""
+    """Per-device body: local shard is ``(local_batch,) + local_volume``.
+
+    ``sp_axes`` holds ``(volume_axis, mesh_axis_name, mesh_axis_size)`` per
+    sharded spatial axis (volume axes count WITHOUT the batch axis).
+    """
     local_b = boundaries.shape[0]
-    rank = lax.axis_index(sp_axis).astype(jnp.int32)
+    n_shards = int(np.prod([s for _, _, s in sp_axes]))
+    rank = linearized_shard_rank(sp_axes)
     # the tiled (two-level VMEM) kernels are 3-D/connectivity-1 only; the
-    # legacy dense fixpoint covers the rest
-    tiled_ok = impl != "legacy" and connectivity == 1
+    # legacy dense fixpoint covers the rest (2-D volumes included)
+    tiled_ok = (
+        impl != "legacy" and connectivity == 1 and boundaries.ndim - 1 == 3
+    )
+
+    def exchange_all(x, fill):
+        # one ppermute per sharded axis; later exchanges forward the halos
+        # received by earlier ones, so diagonal (corner) regions arrive with
+        # the correct neighbor-of-neighbor data
+        for a, name, size in sp_axes:
+            x = exchange_halo(x, halo, a, name, size, fill=fill)
+        return x
 
     ws_out = []
     cc_out = []
-    # per-shard ws-compaction overflow (varies over dp x sp); cc overflow
+    # per-shard ws-compaction overflow (varies over the mesh); cc overflow
     # arrives already sp-reduced from sharded_label_components
     ws_overflow = _match_vma(jnp.zeros((), jnp.int32), boundaries)
     cc_overflow = None
@@ -106,9 +132,9 @@ def _ws_ccl_shard(
     # body run once per volume on every rank in lockstep
     for b in range(local_b):
         vol = boundaries[b]
-        # halo exchange along the sharded z axis; border fill = 1.0 (pure
-        # boundary) so basins never leak out of the volume
-        padded = exchange_halo(vol, halo, 0, sp_axis, sp_size, fill=1.0)
+        # border fill = 1.0 (pure boundary) so basins never leak out of the
+        # volume
+        padded = exchange_all(vol, fill=1.0)
         if tiled_ok:
             from ..ops.tile_ws import dt_watershed_tiled
 
@@ -125,17 +151,14 @@ def _ws_ccl_shard(
 
                 dist_sq = sharded_distance_transform_squared(
                     vol < threshold,
-                    axis_name=sp_axis,
-                    axis_size=sp_size,
+                    shard_axes=sp_axes,
                     # keep the documented dt_max_distance contract: caps
                     # stay capped (exactness here means exact ACROSS shard
                     # cuts, not uncapped); None = truly global radii
                     max_distance=dt_max_distance,
                     impl="xla" if impl in ("xla", "tiled") else "auto",
                 )
-                dist_pad = exchange_halo(
-                    dist_sq, halo, 0, sp_axis, sp_size, fill=0.0
-                )
+                dist_pad = exchange_all(dist_sq, fill=0.0)
             ws, ws_over = dt_watershed_tiled(
                 padded,
                 threshold=threshold,
@@ -153,17 +176,18 @@ def _ws_ccl_shard(
                 connectivity=connectivity,
                 dt_max_distance=dt_max_distance,
             )
-        ws = crop_halo(ws, halo, 0)
-        # globalize watershed fragment ids by slab rank; with a compaction
+        for a, _, _ in sp_axes:
+            ws = crop_halo(ws, halo, a)
+        # globalize watershed fragment ids by shard rank; with a compaction
         # cap, fragment ids are densified first so the label space is
-        # sp_size * cap instead of sp_size * padded_voxels (the int32
+        # n_shards * cap instead of n_shards * padded_voxels (the int32
         # ceiling that blocked teravoxel volumes)
         n_pad = int(np.prod(padded.shape))
         if max_labels_per_shard is not None:
             cap = int(max_labels_per_shard)
-            if sp_size * (cap + 1) >= 2**31:
+            if n_shards * (cap + 1) >= 2**31:
                 raise ValueError(
-                    f"{sp_size} shards x {cap} ws fragments overflow int32"
+                    f"{n_shards} shards x {cap} ws fragments overflow int32"
                 )
             ws, n_frag = relabel_consecutive(ws, max_labels=cap)
             ws_overflow = jnp.maximum(
@@ -172,20 +196,19 @@ def _ws_ccl_shard(
             ws = jnp.where(ws > 0, ws + rank * jnp.int32(cap + 1), 0)
             ws_span = cap + 1
         else:
-            if sp_size * n_pad >= 2**31:
+            if n_shards * n_pad >= 2**31:
                 raise ValueError(
-                    f"{sp_size} shards of {n_pad} padded voxels overflow int32 "
-                    "labels; pass max_labels_per_shard"
+                    f"{n_shards} shards of {n_pad} padded voxels overflow "
+                    "int32 labels; pass max_labels_per_shard"
                 )
             ws = jnp.where(ws > 0, ws + rank * jnp.int32(n_pad), 0)
             ws_span = n_pad
-        if stitch_ws_threshold is not None and sp_size > 1:
+        if stitch_ws_threshold is not None and n_shards > 1:
             # cross-shard fragment merge: the "stitch" of BASELINE config 3,
-            # device-resident (skipped at sp=1 — no cuts exist, and the
+            # device-resident (skipped at 1 shard — no cuts exist, and the
             # relabel table would be pure overhead)
             ws = _stitch_ws_fragments(
-                ws, vol, sp_axis, sp_size, rank, ws_span,
-                float(stitch_ws_threshold),
+                ws, vol, sp_axes, rank, ws_span, float(stitch_ws_threshold)
             )
         ws_out.append(ws)
 
@@ -193,8 +216,7 @@ def _ws_ccl_shard(
         # two-pass union-find merge as ICI collectives
         cc, cc_over = sharded_label_components(
             vol < threshold,
-            axis_name=sp_axis,
-            axis_size=sp_size,
+            shard_axes=sp_axes,
             connectivity=connectivity,
             max_labels_per_shard=max_labels_per_shard,
             return_overflow=True,
@@ -208,12 +230,18 @@ def _ws_ccl_shard(
 
     ws_lab = jnp.stack(ws_out)
     cc_lab = jnp.stack(cc_out)
-    # global foreground voxel count over the full mesh (dp and sp)
-    n_fg = lax.psum(
-        lax.psum(jnp.sum(cc_lab > 0), sp_axis), dp_axis
-    )
+    # global foreground voxel count over the full mesh (dp and all sp axes).
+    # Summed in float32: an int32 psum would wrap past 2**31 global
+    # foreground voxels (the teravoxel layouts this step supports); f32 is
+    # exact below 2**24 per shard and ~1e-7 relative beyond
+    n_fg = jnp.sum(cc_lab > 0).astype(jnp.float32)
+    for _, name, _ in sp_axes:
+        n_fg = lax.psum(n_fg, name)
+    n_fg = lax.psum(n_fg, dp_axis)
     # mesh-wide label-compaction overflow flag (always False w/o compaction)
-    overflow = jnp.maximum(lax.pmax(ws_overflow, sp_axis), cc_overflow)
+    for _, name, _ in sp_axes:
+        ws_overflow = lax.pmax(ws_overflow, name)
+    overflow = jnp.maximum(ws_overflow, cc_overflow)
     overflow = lax.pmax(overflow, dp_axis) > 0
     return ws_lab, cc_lab, n_fg, overflow
 
@@ -224,7 +252,7 @@ def make_ws_ccl_step(
     threshold: float = 0.3,
     connectivity: int = 1,
     dp_axis: str = "dp",
-    sp_axis: str = "sp",
+    sp_axis: Union[str, Sequence[str]] = "sp",
     dt_max_distance: Optional[float] = None,
     min_seed_distance: float = 0.0,
     max_labels_per_shard: Optional[int] = None,
@@ -235,12 +263,17 @@ def make_ws_ccl_step(
     """Compile the fused step for ``mesh``.
 
     Returns a jitted function ``step(boundaries)`` taking a float32 batch of
-    volumes ``(B, Z, Y, X)`` with ``B % dp == 0`` and ``Z % sp == 0``; the
-    batch axis is sharded over ``dp``, the z axis over ``sp``.  Output:
+    volumes ``(B,) + volume`` with ``B % dp == 0``; the batch axis is
+    sharded over ``dp``.  ``sp_axis`` may be one mesh axis name (the
+    volume's z axis sharded in slabs) or a sequence of names (the leading
+    volume axes sharded over the respective mesh axes — a full 2-D/3-D
+    spatial decomposition; each sharded extent must divide).  Output:
     ``(ws_labels, cc_labels, n_foreground, overflow)`` with labels sharded
-    like the input and the scalars replicated; ``overflow`` is True when any
-    shard exceeded ``max_labels_per_shard``, a tiled-kernel capacity, or a
-    compaction cap (labels unreliable — raise the cap or add shards).
+    like the input and the scalars replicated; ``n_foreground`` is float32
+    (exact below 2**24 per shard; an int32 count would wrap past 2**31
+    global foreground voxels); ``overflow`` is True when any shard exceeded
+    ``max_labels_per_shard``, a tiled-kernel capacity, or a compaction cap
+    (labels unreliable — raise the cap or add shards).
 
     ``impl`` selects the per-shard kernels: "auto" (two-level VMEM tile
     machinery, Mosaic on TPU / portable XLA elsewhere — the fast path),
@@ -250,14 +283,15 @@ def make_ws_ccl_step(
     ``exact_edt``: seed the watershed from the *globally exact* EDT
     (mesh-distributed, all-to-all reshard per axis pass) instead of the
     halo-capped per-shard transform — no halo saturation artifacts in the
-    seeds.  Requires the tiled kernels (not "legacy") and x-extent divisible
-    by the ``sp`` axis size.
+    seeds.  Requires the tiled kernels (not "legacy") and connectivity=1;
+    the reshard target's local extent must divide by each sharded mesh-axis
+    size.
 
     ``stitch_ws_threshold``: when set, watershed fragments facing each other
-    across the ``sp`` cuts merge where the boundary evidence at the contact
-    is below the threshold (face consensus — the device-resident form of
-    the reference's two-pass/stitching step), so the returned ``ws_labels``
-    are globally merged rather than per-shard.
+    across the spatial cuts merge where the boundary evidence at the
+    contact is below the threshold (face consensus — the device-resident
+    form of the reference's two-pass/stitching step), so the returned
+    ``ws_labels`` are globally merged rather than per-shard.
     """
     if exact_edt and (impl == "legacy" or connectivity != 1):
         # the legacy dense-fixpoint branch never reads the flag — refuse
@@ -267,11 +301,11 @@ def make_ws_ccl_step(
             "exact_edt requires the tiled kernels (impl != 'legacy') and "
             "connectivity=1"
         )
-    sizes = mesh_axis_sizes(mesh)
+    names = (sp_axis,) if isinstance(sp_axis, str) else tuple(sp_axis)
+    sp_axes = sp_axes_for_mesh(mesh, sp_axis)
     body = partial(
         _ws_ccl_shard,
-        sp_axis=sp_axis,
-        sp_size=sizes[sp_axis],
+        sp_axes=sp_axes,
         dp_axis=dp_axis,
         halo=halo,
         threshold=threshold,
@@ -290,11 +324,12 @@ def make_ws_ccl_step(
     # program ("carry input {V:sp} vs output" on the EDT cascade).  The
     # collectives (ppermute halo, all_gather merge, psum stats) are
     # unaffected; only the static replication *check* is off.
+    spec = P(dp_axis, *names)
     sharded = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=P(dp_axis, sp_axis),
-        out_specs=(P(dp_axis, sp_axis), P(dp_axis, sp_axis), P(), P()),
+        in_specs=spec,
+        out_specs=(spec, spec, P(), P()),
         check_vma=False,
     )
     return jax.jit(sharded)

@@ -419,3 +419,61 @@ def test_ws_ccl_step_stitched_with_compaction(rng):
                 & (np.maximum(vol[i, s * slab - 1], vol[i, s * slab]) < 0.5)
             )
             assert (lo[weak] == hi[weak]).all()
+
+
+def test_ws_ccl_step_two_axis_decomposition(rng):
+    """The fused step on a (dp, spz, spy) mesh — a full 2-D spatial
+    decomposition of each volume, with stitched watershed fragments and
+    merged CC labels consistent across BOTH families of cuts."""
+    mesh = _mesh(("dp", "spz", "spy"))
+    sizes = mesh_axis_sizes(mesh)
+    dp, sz, sy = sizes["dp"], sizes["spz"], sizes["spy"]
+    b, z, y, x = dp, sz * 8, sy * 8, 8 * sz * sy  # x divides for exact_edt
+    vol = rng.random((b, z, y, x)).astype(np.float32)
+    step = make_ws_ccl_step(
+        mesh, halo=2, threshold=0.5, sp_axis=("spz", "spy"),
+        stitch_ws_threshold=0.5, max_labels_per_shard=4096,
+    )
+    ws, cc, n_fg, overflow = jax.block_until_ready(step(vol))
+    ws, cc = np.asarray(ws), np.asarray(cc)
+    assert not bool(overflow)
+    assert int(n_fg) == int((cc > 0).sum())
+    for i in range(b):
+        expected, _ = ndimage.label(
+            vol[i] < 0.5, structure=ndimage.generate_binary_structure(3, 1)
+        )
+        assert_labels_equivalent(cc[i], expected)
+    # stitched ws: weak-evidence contacts agree across both cut families
+    for i in range(b):
+        for s in range(1, sz):
+            lo, hi = ws[i, s * (z // sz) - 1], ws[i, s * (z // sz)]
+            weak = (
+                (lo > 0) & (hi > 0)
+                & (np.maximum(
+                    vol[i, s * (z // sz) - 1], vol[i, s * (z // sz)]
+                ) < 0.5)
+            )
+            assert (lo[weak] == hi[weak]).all(), "z-cut stitch broken"
+        for s in range(1, sy):
+            lo, hi = ws[i, :, s * (y // sy) - 1], ws[i, :, s * (y // sy)]
+            weak = (
+                (lo > 0) & (hi > 0)
+                & (np.maximum(
+                    vol[i, :, s * (y // sy) - 1], vol[i, :, s * (y // sy)]
+                ) < 0.5)
+            )
+            assert (lo[weak] == hi[weak]).all(), "y-cut stitch broken"
+
+
+def test_ws_ccl_step_two_axis_exact_edt(rng):
+    mesh = _mesh(("dp", "spz", "spy"))
+    sizes = mesh_axis_sizes(mesh)
+    dp, sz, sy = sizes["dp"], sizes["spz"], sizes["spy"]
+    b, z, y, x = dp, sz * 8, sy * 8, 8 * sz * sy
+    vol = rng.random((b, z, y, x)).astype(np.float32)
+    step = make_ws_ccl_step(
+        mesh, halo=2, threshold=0.5, sp_axis=("spz", "spy"), exact_edt=True,
+    )
+    ws, cc, n_fg, overflow = jax.block_until_ready(step(vol))
+    assert not bool(overflow)
+    assert int(n_fg) == int((np.asarray(cc) > 0).sum())
