@@ -30,10 +30,13 @@ class FusedSegmentationBase(BaseTask):
     ``ws_key``/``cc_key`` (either may be omitted to skip that output).
     Config: ``threshold``, ``halo``, ``dt_max_distance``,
     ``min_seed_distance``, ``stitch_ws_threshold``, ``exact_edt``,
-    ``max_labels_per_shard``, ``impl`` — the fused-pipeline knobs.
+    ``max_labels_per_shard``, ``impl``, ``decomposition`` — the
+    fused-pipeline knobs; ``decomposition="grid"`` shards the ROI over z
+    AND y instead of z-slabs.
 
     The ROI must fit in device memory (sharded over the mesh); this task
-    refuses inputs whose z extent does not divide over the spatial axis.
+    refuses inputs whose sharded extents (z; plus y for "grid") do not
+    divide over the spatial mesh axes.
     """
 
     task_name = "fused_segmentation"
@@ -50,12 +53,15 @@ class FusedSegmentationBase(BaseTask):
             "exact_edt": False,
             "max_labels_per_shard": None,
             "impl": "auto",
+            # "slab" shards z only; "grid" factors the devices over z AND y
+            # (the 2-axis spatial decomposition) — both extents must divide
+            "decomposition": "slab",
         }
 
     def run_impl(self):
         import jax
 
-        from ..parallel.mesh import make_mesh, mesh_axis_sizes
+        from ..parallel.mesh import make_mesh
         from ..parallel.pipeline import make_ws_ccl_step
 
         cfg = self.get_config()
@@ -68,14 +74,34 @@ class FusedSegmentationBase(BaseTask):
         if len(roi_shape) != 3:
             raise ValueError(f"fused segmentation is 3-D only, got {roi_shape}")
 
-        # one ROI = batch of 1: every device goes to the spatial axis
+        # one ROI = batch of 1: every device goes to the spatial axes
         n_dev = len(jax.devices())
-        mesh = make_mesh(axis_names=("dp", "sp"), grid=(1, n_dev))
-        sp = mesh_axis_sizes(mesh)["sp"]
-        if roi_shape[0] % sp:
+        decomposition = str(cfg.get("decomposition", "slab"))
+        if decomposition == "grid" and n_dev > 1:
+            # factor devices over z and y, z getting the larger share
+            sy = next(
+                d for d in range(int(n_dev**0.5), 0, -1) if n_dev % d == 0
+            )
+            sz = n_dev // sy
+            mesh = make_mesh(
+                axis_names=("dp", "spz", "spy"), grid=(1, sz, sy)
+            )
+            sp_axis = ("spz", "spy")
+            divides = (roi_shape[0] % sz == 0) and (roi_shape[1] % sy == 0)
+            sp_desc = f"spz={sz} spy={sy}"
+        elif decomposition in ("slab", "grid"):
+            mesh = make_mesh(axis_names=("dp", "sp"), grid=(1, n_dev))
+            sp_axis = "sp"
+            divides = roi_shape[0] % n_dev == 0
+            sp_desc = f"sp={n_dev}"
+        else:
             raise ValueError(
-                f"ROI z extent {roi_shape[0]} does not divide over the "
-                f"spatial mesh axis (sp={sp})"
+                f"decomposition must be 'slab' or 'grid', got {decomposition!r}"
+            )
+        if not divides:
+            raise ValueError(
+                f"ROI extents {roi_shape} do not divide over the spatial "
+                f"mesh axes ({sp_desc})"
             )
 
         halo = int(np.max(cfg.get("halo") or 0))
@@ -89,6 +115,7 @@ class FusedSegmentationBase(BaseTask):
             mesh,
             halo=halo,
             threshold=float(cfg["threshold"]),
+            sp_axis=sp_axis,
             dt_max_distance=dt_max,
             min_seed_distance=float(cfg.get("min_seed_distance") or 0.0),
             max_labels_per_shard=cfg.get("max_labels_per_shard"),
@@ -97,7 +124,7 @@ class FusedSegmentationBase(BaseTask):
             stitch_ws_threshold=cfg.get("stitch_ws_threshold"),
         )
         self.logger.info(
-            f"fused step on mesh sp={sp}, roi {roi_shape}, halo={halo}"
+            f"fused step on mesh {sp_desc}, roi {roi_shape}, halo={halo}"
         )
         vol = np.asarray(inp[roi]).astype(np.float32)
         ws, cc, n_fg, overflow = jax.block_until_ready(step(vol[None]))
@@ -123,7 +150,7 @@ class FusedSegmentationBase(BaseTask):
             written[key] = int(arr.max())
         return {
             "n_foreground": int(np.asarray(n_fg)),
-            "mesh": {"dp": 1, "sp": sp},
+            "mesh": sp_desc,
             "written": written,
         }
 

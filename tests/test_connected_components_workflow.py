@@ -184,3 +184,34 @@ def test_fused_segmentation_task_vs_scipy(workspace, rng):
     want, _ = ndi.label(vol < 0.6, ndi.generate_binary_structure(3, 1))
     assert_labels_equivalent(cc, want)
     assert ws.shape == vol.shape and (ws[vol < 0.6] > 0).all()
+
+
+def test_fused_segmentation_grid_decomposition(workspace, rng):
+    """decomposition='grid': the fused task shards the ROI over z AND y."""
+    from cluster_tools_tpu.tasks.fused import FusedSegmentationLocal
+
+    tmp_folder, config_dir, root = workspace
+    path = os.path.join(root, "fusedg.zarr")
+    vol = ndi.gaussian_filter(rng.random((32, 32, 32)).astype(np.float32), 2)
+    vol = (vol - vol.min()) / (vol.max() - vol.min())
+    f = file_reader(path)
+    f.create_dataset(
+        "boundaries", shape=vol.shape, chunks=(16, 16, 16), dtype="float32"
+    )[...] = vol
+    t = FusedSegmentationLocal(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        input_path=path,
+        input_key="boundaries",
+        output_path=path,
+        cc_key="cc",
+        threshold=0.6,
+        halo=2,
+        decomposition="grid",
+        block_shape=[16, 16, 16],
+    )
+    assert build([t]), "fused grid task failed (see logs)"
+    cc = file_reader(path, "r")["cc"][...]
+    want, _ = ndi.label(vol < 0.6, ndi.generate_binary_structure(3, 1))
+    assert_labels_equivalent(cc, want)
