@@ -215,3 +215,25 @@ def test_fused_segmentation_grid_decomposition(workspace, rng):
     cc = file_reader(path, "r")["cc"][...]
     want, _ = ndi.label(vol < 0.6, ndi.generate_binary_structure(3, 1))
     assert_labels_equivalent(cc, want)
+
+
+def test_fused_segmentation_resume_noop(workspace, rng):
+    """Rerunning a completed fused task is a no-op (success target)."""
+    from cluster_tools_tpu.tasks.fused import FusedSegmentationLocal
+
+    tmp_folder, config_dir, root = workspace
+    path = os.path.join(root, "fusedr.zarr")
+    vol = rng.random((16, 16, 16)).astype(np.float32)
+    f = file_reader(path)
+    f.create_dataset(
+        "b", shape=vol.shape, chunks=(16, 16, 16), dtype="float32"
+    )[...] = vol
+    kw = dict(
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        input_path=path, input_key="b", output_path=path, cc_key="cc",
+        threshold=0.5, halo=2, block_shape=[16, 16, 16],
+    )
+    assert build([FusedSegmentationLocal(**kw)])
+    first = file_reader(path, "r")["cc"][...]
+    assert build([FusedSegmentationLocal(**kw)])  # resumed: target exists
+    np.testing.assert_array_equal(first, file_reader(path, "r")["cc"][...])
