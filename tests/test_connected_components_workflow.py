@@ -237,3 +237,13 @@ def test_fused_segmentation_resume_noop(workspace, rng):
     first = file_reader(path, "r")["cc"][...]
     assert build([FusedSegmentationLocal(**kw)])  # resumed: target exists
     np.testing.assert_array_equal(first, file_reader(path, "r")["cc"][...])
+
+
+def test_cc_workflow_2d_volume(workspace, rng):
+    """Rank-generic path: a plain 2-D image through the full task chain."""
+    import scipy.ndimage as ndi2
+
+    mask = ndi2.gaussian_filter(rng.random((96, 96)), 2) > 0.5
+    got = _run_cc(workspace, mask, block_shape=(32, 32))
+    want, _ = ndi.label(mask)
+    assert_labels_equivalent(got, want)
