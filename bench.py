@@ -309,8 +309,16 @@ def main():
 
     # secondary sections are individually shielded: a fault in any of them
     # (the tunnel has crashed mid-session before) must not cost the headline
-    # JSON line
+    # JSON line.  They are also skipped wholesale past the soft deadline —
+    # if the orchestrator's rung cap fires mid-secondary, the whole rung
+    # (headline included) is lost, so guaranteeing the JSON beats coverage.
+    soft_deadline = float(os.environ.get("CT_BENCH_SOFT_DEADLINE", "1e18"))
+
     def _shielded(name, fn, default=None):
+        if time.monotonic() - _T0 > soft_deadline:
+            log(f"{name} SKIPPED: past soft deadline "
+                f"({soft_deadline:.0f}s); emitting headline JSON first")
+            return default
         try:
             return fn()
         except Exception as e:  # pragma: no cover - hardware-dependent
@@ -538,7 +546,13 @@ def orchestrate() -> None:
             log(f"orchestrator: skip impl={impl}, no budget ({remaining:.0f}s left)")
             continue
         log(f"orchestrator: impl={impl}, cap {tmo:.0f}s")
-        env = dict(os.environ, CT_BENCH_IMPL=impl)
+        env = dict(
+            os.environ,
+            CT_BENCH_IMPL=impl,
+            # leave ~25% of the rung for the baseline + JSON emit: the
+            # secondaries stop starting past this point
+            CT_BENCH_SOFT_DEADLINE=str(max(60.0, tmo * 0.75)),
+        )
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
             stdout=subprocess.PIPE,
