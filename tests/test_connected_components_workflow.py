@@ -247,3 +247,33 @@ def test_cc_workflow_2d_volume(workspace, rng):
     got = _run_cc(workspace, mask, block_shape=(32, 32))
     want, _ = ndi.label(mask)
     assert_labels_equivalent(got, want)
+
+
+def test_fused_and_blockwise_cc_agree(workspace, rng):
+    """Framework-level invariant: the mesh-resident fused step and the
+    5-task blockwise chain compute the SAME connected components."""
+    from cluster_tools_tpu.tasks.fused import FusedSegmentationLocal
+
+    tmp_folder, config_dir, root = workspace
+    vol = ndi.gaussian_filter(rng.random((64, 32, 32)).astype(np.float32), 2)
+    vol = (vol - vol.min()) / (vol.max() - vol.min())
+    path = os.path.join(root, "x.zarr")
+    f = file_reader(path)
+    f.create_dataset(
+        "b", shape=vol.shape, chunks=(32, 32, 32), dtype="float32"
+    )[...] = vol
+    t = FusedSegmentationLocal(
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        input_path=path, input_key="b", output_path=path, cc_key="cc_fused",
+        threshold=0.6, halo=2, block_shape=[32, 32, 32],
+    )
+    assert build([t])
+    wf = ConnectedComponentsWorkflow(
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        target="local", input_path=path, input_key="b",
+        output_path=path, output_key="cc_block",
+        threshold=0.6, threshold_mode="less", block_shape=[32, 32, 32],
+    )
+    assert build([wf])
+    r = file_reader(path, "r")
+    assert_labels_equivalent(r["cc_fused"][...], r["cc_block"][...])
