@@ -72,7 +72,11 @@ def _probe_accelerator(timeout: float) -> str | None:
     """
     code = (
         "import jax\n"
+        "import jax.numpy as jnp\n"
         "plats = sorted({d.platform for d in jax.devices()})\n"
+        # a REAL computation with a d2h fetch: a half-up tunnel lists its\n
+        # devices but wedges on compute — that state must fall back to CPU\n
+        "assert float(jnp.arange(8.0).sum()) == 28.0\n"
         "print('PROBE_RESULT:' + ','.join(plats), flush=True)\n"
     )
     log(f"probing accelerator backend in subprocess (timeout {timeout:.0f}s)")
