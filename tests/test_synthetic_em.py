@@ -112,6 +112,19 @@ def _run_e2e(workspace, two_d: bool):
     return measures, np.asarray(file_reader(path)["seg"][:]), gt, mask
 
 
+def _evaluate_seg(tmp_folder, config_dir, path):
+    ev = EvaluationWorkflow(
+        tmp_folder=os.path.join(tmp_folder, "eval"),
+        config_dir=config_dir, max_jobs=2, target="local",
+        input_path=path, input_key="seg",
+        labels_path=path, labels_key="gt",
+        block_shape=[8, 32, 32],
+    )
+    assert build([ev])
+    with open(os.path.join(tmp_folder, "eval", "evaluation.json")) as fh:
+        return json.load(fh)
+
+
 def test_multicut_on_synthetic_em_3d(workspace):
     measures, seg, gt, mask = _run_e2e(workspace, two_d=False)
     # quality against exact GT: VI well under 1 bit total, adapted-RAND
@@ -125,5 +138,52 @@ def test_multicut_on_synthetic_em_2d_mode(workspace):
     measures, seg, gt, mask = _run_e2e(workspace, two_d=True)
     # per-slice watershed (the reference's anisotropic mode) still recovers
     # the objects after agglomeration, to a looser bound
+    assert measures["vi_split"] + measures["vi_merge"] < 1.5, measures
+    assert measures["adapted_rand_error"] < 0.25, measures
+
+
+def test_multicut_on_fused_fragments(workspace):
+    """The fused fast path composes with the flagship chain: stitched fused
+    watershed fragments feed MulticutSegmentationWorkflow(skip_ws=True) and
+    the result stays within the quality envelope."""
+    from cluster_tools_tpu.tasks.fused import FusedSegmentationLocal
+
+    tmp_folder, config_dir, root = workspace
+    shape = (24, 96, 96)
+    boundaries, gt, _ = synthetic_em_volume(
+        shape=shape, n_objects=5, sampling=(40.0, 4.0, 4.0),
+        boundary_width=2.0, smooth=0.3, noise=0.03, seed=7,
+    )
+    # no mask here: the fused step's mask plumbing is exercised at the ops
+    # level; this test covers composition with the flagship chain
+    path = os.path.join(root, "emf.zarr")
+    f = file_reader(path)
+    f.create_dataset("boundaries", shape=shape, chunks=(8, 32, 32),
+                     dtype="float32")[...] = boundaries
+    f.create_dataset("gt", shape=shape, chunks=(8, 32, 32),
+                     dtype="uint64")[...] = gt
+
+    fused = FusedSegmentationLocal(
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        input_path=path, input_key="boundaries",
+        output_path=path, ws_key="sv",
+        threshold=0.5, halo=2, min_seed_distance=2.0,
+        stitch_ws_threshold=0.5, max_labels_per_shard=8192,
+        block_shape=[8, 32, 32],
+    )
+    assert build([fused])
+
+    wf = MulticutSegmentationWorkflow(
+        tmp_folder=tmp_folder, config_dir=config_dir, max_jobs=2,
+        target="local",
+        input_path=path, input_key="boundaries",
+        ws_path=path, ws_key="sv", skip_ws=True,
+        output_path=path, output_key="seg",
+        block_shape=[8, 32, 32],
+        beta=0.5, n_scales=1, agglomerator="greedy-additive",
+    )
+    assert build([wf])
+
+    measures = _evaluate_seg(tmp_folder, config_dir, path)
     assert measures["vi_split"] + measures["vi_merge"] < 1.5, measures
     assert measures["adapted_rand_error"] < 0.25, measures
