@@ -227,16 +227,17 @@ def mutex_watershed(
 
 def merge_edge_features(parts, table: np.ndarray):
     """Accumulate per-block (uv, feats[m, 5]) parts onto the lexsorted
-    ``table``: (weighted-mean sums, sums of squares, min, max, count sums)
-    per table row, or None when the library is unavailable.  ``parts``
-    iterates (uv, feats)."""
+    ``table``: (running count-weighted mean, running M2 = var * n, min,
+    max, count sums) per table row — the streaming Chan combine, stable
+    for large-mean data — or None when the library is unavailable.
+    ``parts`` iterates (uv, feats)."""
     lib = _load()
     if lib is None:
         return None
     table = np.ascontiguousarray(np.asarray(table).reshape(-1, 2), np.uint64)
     k = len(table)
-    wsums = np.zeros(k, np.float64)
-    sqsums = np.zeros(k, np.float64)
+    means = np.zeros(k, np.float64)
+    m2s = np.zeros(k, np.float64)
     mins = np.full(k, np.inf)
     maxs = np.full(k, -np.inf)
     counts = np.zeros(k, np.float64)
@@ -253,6 +254,6 @@ def merge_edge_features(parts, table: np.ndarray):
             )
         feats = np.ascontiguousarray(feats)
         lib.ct_merge_edge_features(
-            uv, feats, len(uv), table, k, wsums, sqsums, mins, maxs, counts
+            uv, feats, len(uv), table, k, means, m2s, mins, maxs, counts
         )
-    return wsums, sqsums, mins, maxs, counts
+    return means, m2s, mins, maxs, counts

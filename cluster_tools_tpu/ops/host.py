@@ -1,0 +1,88 @@
+"""Host (scipy) per-block kernels — the reference's per-job compute path.
+
+The reference framework runs its per-block compute as single-core scipy /
+vigra calls inside cluster jobs (SURVEY.md §2a watershed +
+connected_components per-job kernels).  On a machine without an
+accelerator the device-shaped tiled/XLA kernels of this framework pay
+virtual-mesh serialization for no benefit, so the same capability is
+shipped as plain scipy, selectable with ``impl="host"`` in the watershed
+task and used by ``bench.py``'s cpu-smoke headline.
+
+These functions are the semantic (not bit-exact) host twins of
+:func:`..ops.tile_ws.dt_watershed_tiled` /
+:func:`..ops.tile_ccl.label_components_tiled`: thresholded foreground,
+Euclidean distance transform, EDT-maxima seeds, seeded watershed, and a
+connected-components pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def host_label_components(mask: np.ndarray) -> np.ndarray:
+    """Connected components of a boolean mask (scipy, connectivity 1)."""
+    from scipy import ndimage
+
+    lab, _ = ndimage.label(mask)
+    return lab.astype(np.int32)
+
+
+def host_dt_watershed(
+    vol: np.ndarray,
+    threshold: float,
+    dt_max_distance: Optional[float] = None,
+    min_seed_distance: float = 0.0,
+    mask: Optional[np.ndarray] = None,
+    sampling: Optional[Tuple[float, ...]] = None,
+) -> np.ndarray:
+    """Distance-transform watershed of a boundary map, scipy single-core.
+
+    Foreground is ``vol < threshold`` (low boundary evidence), seeds are
+    EDT local maxima at least ``min_seed_distance`` from the boundary;
+    fragments grow by :func:`scipy.ndimage.watershed_ift` on the quantized
+    boundary map.  ``sampling`` is the per-axis voxel size (anisotropy), as
+    scipy's.  ``dt_max_distance`` clips the transform to mirror the device
+    kernels' capped EDT — including its trade-off: interiors thicker than
+    2x the cap saturate into one plateau whose maxima fuse into a single
+    seed (see tasks/watershed._kernel_params), so the cap is NOT
+    seed-neutral, it is seed-*consistent* with the device path.
+    """
+    from scipy import ndimage
+
+    fg = vol < threshold
+    if mask is not None:
+        fg &= mask
+    dist = ndimage.distance_transform_edt(fg, sampling=sampling)
+    if dt_max_distance is not None:
+        dist = np.minimum(dist, float(dt_max_distance))
+    maxima = (ndimage.maximum_filter(dist, size=3) == dist) & fg
+    if min_seed_distance > 0:
+        maxima &= dist >= min_seed_distance
+    seeds, _ = ndimage.label(maxima)
+    hmap = np.clip(vol * 255, 0, 255).astype(np.uint8)
+    ws = ndimage.watershed_ift(hmap, seeds.astype(np.int32))
+    ws[~fg] = 0
+    return ws
+
+
+def host_ws_ccl(
+    vol: np.ndarray,
+    threshold: float,
+    dt_max_distance: Optional[float] = None,
+    min_seed_distance: float = 0.0,
+    sampling: Optional[Tuple[float, ...]] = None,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """The fused-step equivalent on host: ``(ws, cc, n_foreground)``."""
+    fg = vol < threshold
+    ws = host_dt_watershed(
+        vol,
+        threshold,
+        dt_max_distance=dt_max_distance,
+        min_seed_distance=min_seed_distance,
+        sampling=sampling,
+    )
+    cc = host_label_components(fg)
+    return ws, cc, int(fg.sum())

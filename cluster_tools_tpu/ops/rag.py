@@ -327,9 +327,11 @@ def merge_feature_lists(uv_global: np.ndarray, parts) -> np.ndarray:
 
     ``parts`` iterates ``(uv, feats)`` with feats columns
     :data:`FEATURE_NAMES`.  Mean is count-weighted; min/max are reduced;
-    counts are summed; variance merges exactly through the law of total
-    variance (sum of squares is additive).  Edges absent from all parts get
-    zeros.
+    counts are summed; variance merges through the streaming (Chan)
+    parallel combine — running mean + second moment about it — which stays
+    accurate for large-mean data where the naive E[x^2] - mean^2
+    reconstruction cancels catastrophically.  Edges absent from all parts
+    get zeros.
     """
     m = len(uv_global)
 
@@ -337,10 +339,10 @@ def merge_feature_lists(uv_global: np.ndarray, parts) -> np.ndarray:
 
     merged = native.merge_edge_features(parts, uv_global)
     if merged is not None:
-        s, sq, mn, mx, cnt = merged
+        mean, m2, mn, mx, cnt = merged
     else:
-        s = np.zeros(m, np.float64)
-        sq = np.zeros(m, np.float64)
+        mean = np.zeros(m, np.float64)
+        m2 = np.zeros(m, np.float64)
         mn = np.full(m, np.inf)
         mx = np.full(m, -np.inf)
         cnt = np.zeros(m, np.float64)
@@ -358,17 +360,31 @@ def merge_feature_lists(uv_global: np.ndarray, parts) -> np.ndarray:
             ok = ids >= 0
             ids = ids[ok]
             f = feats[ok].astype(np.float64)
-            np.add.at(s, ids, f[:, 0] * f[:, 3])
-            # E[x^2] * n = (var + mean^2) * n  — additive across blocks
-            np.add.at(sq, ids, (f[:, 4] + f[:, 0] ** 2) * f[:, 3])
+            nb = f[:, 3]
+            pos = nb > 0
+            ids, f, nb = ids[pos], f[pos], nb[pos]
+            # the streaming combine below uses fancy-index updates, which
+            # are last-write-wins on duplicate ids — enforce the per-part
+            # uniqueness every producer (np.unique output) guarantees
+            # rather than corrupt counts silently
+            if len(ids) != len(np.unique(ids)):
+                raise ValueError(
+                    "edge-feature part contains duplicate edge rows — "
+                    "merge duplicates (np.unique per block) before "
+                    "merge_feature_lists"
+                )
+            na = cnt[ids]
+            ntot = na + nb
+            delta = f[:, 0] - mean[ids]
+            mean[ids] += delta * nb / ntot
+            m2[ids] += f[:, 4] * nb + delta * delta * na * nb / ntot
             np.minimum.at(mn, ids, f[:, 1])
             np.maximum.at(mx, ids, f[:, 2])
-            np.add.at(cnt, ids, f[:, 3])
+            cnt[ids] = ntot
     has = cnt > 0
-    mean = np.zeros(m, np.float64)
-    mean[has] = s[has] / cnt[has]
     var = np.zeros(m, np.float64)
-    var[has] = np.maximum(sq[has] / cnt[has] - mean[has] ** 2, 0.0)
+    var[has] = np.maximum(m2[has] / cnt[has], 0.0)
+    mean = np.where(has, mean, 0.0)
     mn[~has] = 0.0
     mx[~has] = 0.0
     return np.stack([mean, mn, mx, cnt, var], axis=1).astype(np.float32)

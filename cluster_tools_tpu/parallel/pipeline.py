@@ -113,6 +113,14 @@ def _ws_ccl_shard(
     tiled_ok = (
         impl != "legacy" and connectivity == 1 and boundaries.ndim - 1 == 3
     )
+    if exact_edt and not tiled_ok:
+        # make_ws_ccl_step rejects legacy/connectivity mismatches up front,
+        # but the volume rank is only known here — refuse rather than hand
+        # back halo-capped seeds the caller opted out of
+        raise ValueError(
+            "exact_edt requires the tiled kernels, which are 3-D only "
+            f"(got a {boundaries.ndim - 1}-D volume)"
+        )
 
     def exchange_all(x, fill):
         # one ppermute per sharded axis; later exchanges forward the halos

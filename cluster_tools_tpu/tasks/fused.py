@@ -149,7 +149,10 @@ class FusedSegmentationBase(BaseTask):
             ds[roi] = arr
             written[key] = int(arr.max())
         return {
-            "n_foreground": int(np.asarray(n_fg)),
+            # float32 psum: exact below 2**24 per shard; round-to-nearest
+            # (not truncate) so a 1-ulp-low representation can't report
+            # off-by-one.  Counts past 2**24 are approximate by design.
+            "n_foreground": int(round(float(np.asarray(n_fg)))),
             "mesh": sp_desc,
             "written": written,
         }

@@ -118,6 +118,227 @@ def train_pixel_classifier(
 
 
 # ---------------------------------------------------------------------------
+# vigra RandomForest ingestion: the serialized classifier inside an .ilp
+# (reference capability: predict from an existing trained project without
+# retraining; SURVEY.md §2a "ilastik").  The blob is plain HDF5 in vigra's
+# RF serialization: per tree an int32 ``topology_`` (header
+# ``[column_count, class_count]``, root at offset 2; interior threshold
+# nodes are ``[type, param_addr, left_addr, right_addr, column]`` with
+# ``parameters_[param_addr + 1]`` the split threshold; leaves carry the
+# 0x40000000 tag and ``parameters_[param_addr + 1 : + 1 + K]`` the class
+# histogram) and a float64 ``parameters_``.  Prediction is the standard RF
+# ensemble: per-leaf histogram normalized to a distribution, averaged over
+# all trees of all ``Forest*`` groups (ilastik trains several small
+# forests in parallel lanes and concatenates them).
+#
+# The evaluator is TPU-shaped: trees are densified into fixed-size node
+# tables and every voxel walks root->leaf in a fixed-depth gather loop
+# (``lax.fori_loop``) — no data-dependent control flow, so the whole
+# featurize+forest block stays one fused XLA program.
+# ---------------------------------------------------------------------------
+
+_VIGRA_LEAF_TAG = 0x40000000
+_VIGRA_THRESHOLD_NODE = 0  # the only interior node type ilastik produces
+
+
+def parse_vigra_forest(group) -> dict:
+    """Parse one vigra RandomForest HDF5 group into dense node tables.
+
+    Returns numpy arrays (n_trees padded to the widest tree):
+    ``feature`` [T, N] int32, ``threshold`` [T, N] float32, ``children``
+    [T, N, 2] int32 (self-loop on leaves), ``leaf_probs`` [T, N, K]
+    float32 (normalized; zero rows on interior/padding nodes), ``is_leaf``
+    [T, N] bool, plus ``class_count``/``column_count``/``depth``.
+    Raises ``ValueError`` on layouts that are not a vigra RandomForest
+    serialization and on node types other than threshold splits /
+    const-prob leaves.
+    """
+    try:
+        ext = group["_ext_param"]
+    except KeyError:
+        raise ValueError(
+            f"{group.name}: no _ext_param subgroup — present but not a "
+            "vigra RandomForest serialization (a different classifier "
+            "backend?)"
+        ) from None
+    class_count = int(np.asarray(ext["class_count_"]).ravel()[0])
+    column_count = int(np.asarray(ext["column_count_"]).ravel()[0])
+    tree_keys = sorted(
+        (k for k in group.keys() if k.startswith("Tree_")),
+        key=lambda k: int(k.split("_")[-1]),
+    )
+    if not tree_keys:
+        raise ValueError("vigra forest group has no Tree_* entries")
+    trees = []
+    for tk in tree_keys:
+        try:
+            topo = np.asarray(group[tk]["topology_"]).ravel().astype(np.int64)
+            par = np.asarray(group[tk]["parameters_"]).ravel().astype(np.float64)
+        except KeyError:
+            raise ValueError(
+                f"{group.name}/{tk}: missing topology_/parameters_ — not a "
+                "vigra RandomForest tree serialization"
+            ) from None
+        if topo[0] != column_count or topo[1] != class_count:
+            raise ValueError(
+                f"{tk}: topology header {topo[:2].tolist()} does not match "
+                f"_ext_param (columns={column_count}, classes={class_count})"
+            )
+        # walk addresses -> dense node ids
+        addr2id: dict = {}
+        order = []
+        stack = [2]
+        while stack:
+            a = int(stack.pop())
+            if a in addr2id:
+                continue
+            addr2id[a] = len(order)
+            order.append(a)
+            t = int(topo[a])
+            if not (t & _VIGRA_LEAF_TAG):
+                if t != _VIGRA_THRESHOLD_NODE:
+                    raise ValueError(
+                        f"{tk}: unsupported vigra node type {t} at {a} "
+                        "(only threshold splits + const-prob leaves)"
+                    )
+                stack.append(int(topo[a + 3]))
+                stack.append(int(topo[a + 2]))
+        n = len(order)
+        feat = np.zeros(n, np.int32)
+        thr = np.zeros(n, np.float32)
+        child = np.zeros((n, 2), np.int32)
+        leafp = np.zeros((n, class_count), np.float32)
+        leaf = np.zeros(n, bool)
+        for a in order:
+            i = addr2id[a]
+            t = int(topo[a])
+            pa = int(topo[a + 1])
+            if t & _VIGRA_LEAF_TAG:
+                leaf[i] = True
+                child[i] = (i, i)  # self-loop: extra gather steps are no-ops
+                h = par[pa + 1 : pa + 1 + class_count]
+                s = h.sum()
+                leafp[i] = (h / s if s > 0 else np.full(class_count, 1.0 / class_count))
+            else:
+                feat[i] = int(topo[a + 4])
+                thr[i] = par[pa + 1]
+                child[i] = (addr2id[int(topo[a + 2])], addr2id[int(topo[a + 3])])
+        trees.append((feat, thr, child, leafp, leaf))
+    width = max(len(t[0]) for t in trees)
+
+    def pad(arr, fill=0):
+        out = np.full((len(trees), width) + arr[0].shape[1:], fill, arr[0].dtype)
+        for i, a in enumerate(arr):
+            out[i, : len(a)] = a
+        return out
+
+    feature = pad([t[0] for t in trees])
+    threshold = pad([t[1] for t in trees])
+    children = pad([t[2] for t in trees])
+    leaf_probs = pad([t[3] for t in trees])
+    is_leaf = pad([t[4] for t in trees], fill=True)
+    # depth bound for the fixed-length walk: longest root->leaf path
+    depth = 0
+    for feat, thr, child, leafp, leaf in trees:
+        d = np.zeros(len(feat), np.int32)
+        for i in range(len(feat)):  # ids are in DFS order: parents first
+            if not leaf[i]:
+                d[child[i, 0]] = d[child[i, 1]] = d[i] + 1
+        depth = max(depth, int(d.max()) if len(d) else 0)
+    return {
+        "feature": feature,
+        "threshold": threshold,
+        "children": children,
+        "leaf_probs": leaf_probs,
+        "is_leaf": is_leaf,
+        "class_count": class_count,
+        "column_count": column_count,
+        "depth": depth,
+    }
+
+
+def load_ilp_forest(path: str) -> dict:
+    """Load + concatenate every ``ClassifierForests/Forest*`` in an .ilp.
+
+    Returns the dense node tables of :func:`parse_vigra_forest` with all
+    lanes' trees stacked (ilastik's parallel-lane ensemble).  Raises
+    ``KeyError`` when the project carries no serialized classifier.
+    """
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        grp = f["PixelClassification/ClassifierForests"]
+        forests = [
+            parse_vigra_forest(grp[k])
+            for k in sorted(grp.keys())
+            if k.startswith("Forest")
+        ]
+    if not forests:
+        raise KeyError(f"{path}: ClassifierForests holds no Forest* groups")
+    k0 = forests[0]
+    for fo in forests[1:]:
+        if (
+            fo["class_count"] != k0["class_count"]
+            or fo["column_count"] != k0["column_count"]
+        ):
+            raise ValueError("inconsistent class/column counts across lanes")
+    width = max(f_["feature"].shape[1] for f_ in forests)
+
+    def cat(key, fill=0):
+        parts = []
+        for fo in forests:
+            a = fo[key]
+            if a.shape[1] < width:
+                pad_shape = (a.shape[0], width - a.shape[1]) + a.shape[2:]
+                a = np.concatenate(
+                    [a, np.full(pad_shape, fill, a.dtype)], axis=1
+                )
+            parts.append(a)
+        return np.concatenate(parts, axis=0)
+
+    return {
+        "feature": cat("feature"),
+        "threshold": cat("threshold"),
+        "children": cat("children"),
+        "leaf_probs": cat("leaf_probs"),
+        "is_leaf": cat("is_leaf", fill=True),
+        "class_count": k0["class_count"],
+        "column_count": k0["column_count"],
+        "depth": max(f_["depth"] for f_ in forests),
+    }
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def forest_predict_proba(
+    feature: jnp.ndarray,
+    threshold: jnp.ndarray,
+    children: jnp.ndarray,
+    leaf_probs: jnp.ndarray,
+    X: jnp.ndarray,
+    depth: int,
+) -> jnp.ndarray:
+    """Ensemble class probabilities, [n, K], for features ``X`` [n, F].
+
+    Fixed-depth descent: every sample takes exactly ``depth`` gather steps
+    per tree (leaves self-loop), vmapped over trees — static shapes, no
+    per-sample control flow, so XLA fuses it with the filter bank.
+    """
+
+    def one_tree(feat_t, thr_t, child_t, probs_t):
+        def body(_, idx):
+            go_right = X[jnp.arange(X.shape[0]), feat_t[idx]] >= thr_t[idx]
+            return child_t[idx, go_right.astype(jnp.int32)]
+
+        idx = jax.lax.fori_loop(
+            0, depth, body, jnp.zeros(X.shape[0], jnp.int32)
+        )
+        return probs_t[idx]
+
+    per_tree = jax.vmap(one_tree)(feature, threshold, children, leaf_probs)
+    return per_tree.mean(axis=0)
+
+
+# ---------------------------------------------------------------------------
 # ilastik .ilp project ingestion (reference capability: execute an existing
 # ilastik pixel-classification project; SURVEY.md §2a "ilastik")
 # ---------------------------------------------------------------------------
@@ -161,6 +382,14 @@ def _ilp_single_feature(x: jnp.ndarray, fid: str, sigma: float) -> jnp.ndarray:
     raise ValueError(f"unsupported ilastik feature id {fid!r}")
 
 
+def ilp_feature_channels(selections) -> int:
+    """Total feature-bank column count for (feature_id, sigma) selections —
+    the single owner of the per-feature channel rule (eigenvalue features
+    contribute 3 channels, everything else 1; must match
+    :func:`_ilp_single_feature`)."""
+    return sum(3 if fid.endswith("Eigenvalues") else 1 for fid, _ in selections)
+
+
 @partial(jax.jit, static_argnames=("selections",))
 def ilp_feature_bank(
     x: jnp.ndarray, selections: Tuple[Tuple[str, float], ...]
@@ -184,6 +413,31 @@ def _parse_block_slice(s: str) -> Tuple[slice, ...]:
     return tuple(out)
 
 
+def _load_ilp_selections(f) -> Tuple[Tuple[str, float], ...]:
+    """(feature_id, sigma) pairs from an open .ilp's ``FeatureSelections``
+    (ids x scales masked by ``SelectionMatrix``), in ilastik's feature-major
+    order — the column order both the forest and the retrained classifier
+    rely on.  Raises on unsupported feature ids."""
+    fs = f["FeatureSelections"]
+    ids = [
+        i.decode() if isinstance(i, bytes) else str(i)
+        for i in fs["FeatureIds"][:]
+    ]
+    scales = [float(s) for s in fs["Scales"][:]]
+    matrix = np.asarray(fs["SelectionMatrix"][:], bool)
+    selections = []
+    for fi, fid in enumerate(ids):
+        for si, sig in enumerate(scales):
+            if matrix[fi, si]:
+                if fid not in ILP_SUPPORTED_FEATURES:
+                    raise ValueError(
+                        f"ilastik feature {fid!r} is not supported "
+                        f"(supported: {ILP_SUPPORTED_FEATURES})"
+                    )
+                selections.append((fid, sig))
+    return tuple(selections)
+
+
 def load_ilp_project(path: str):
     """Parse an ilastik pixel-classification project (.ilp h5 file).
 
@@ -194,32 +448,15 @@ def load_ilp_project(path: str):
     - ``label_blocks``: list of (slices, uint8 labels) sparse annotation
       blocks from ``PixelClassification/LabelSets`` (0 = unlabeled).
 
-    The classifier itself is re-fit from the project's own annotations: the
-    serialized forest blob is a vigra RandomForest binary whose undocumented
-    topology layout we refuse to guess at; the annotations plus feature
-    selections reproduce the project's behavior with the native classifier.
-    A project without label sets therefore cannot be ingested.
+    This is the *retraining* path (project annotations -> native
+    classifier); :func:`import_ilp` prefers the serialized vigra forest
+    (:func:`load_ilp_forest`), which predicts without labels or raw data.
+    A project without either a forest or label sets cannot be ingested.
     """
     import h5py
 
     with h5py.File(path, "r") as f:
-        fs = f["FeatureSelections"]
-        ids = [
-            i.decode() if isinstance(i, bytes) else str(i)
-            for i in fs["FeatureIds"][:]
-        ]
-        scales = [float(s) for s in fs["Scales"][:]]
-        matrix = np.asarray(fs["SelectionMatrix"][:], bool)
-        selections = []
-        for fi, fid in enumerate(ids):
-            for si, sig in enumerate(scales):
-                if matrix[fi, si]:
-                    if fid not in ILP_SUPPORTED_FEATURES:
-                        raise ValueError(
-                            f"ilastik feature {fid!r} is not supported "
-                            f"(supported: {ILP_SUPPORTED_FEATURES})"
-                        )
-                    selections.append((fid, sig))
+        selections = _load_ilp_selections(f)
         label_blocks = []
         ls = f.get("PixelClassification/LabelSets")
         if ls is not None:
@@ -239,9 +476,9 @@ def load_ilp_project(path: str):
                     label_blocks.append((sl, data))
     if not label_blocks:
         raise ValueError(
-            f"{path}: no label annotations found — the serialized vigra "
-            "forest alone cannot be executed; re-save the project with its "
-            "training labels included"
+            f"{path}: no label annotations to re-train from — if the "
+            "project carries a trained classifier, ingest it directly with "
+            "import_ilp/load_ilp_forest instead of this retraining path"
         )
     return tuple(selections), label_blocks
 
@@ -282,6 +519,68 @@ def train_from_ilp(
     return W.shape[1]
 
 
+def import_ilp(
+    ilp_path: str,
+    checkpoint_path: str,
+    raw: "np.ndarray | None" = None,
+    n_steps: int = 300,
+    lr: float = 0.5,
+    seed: int = 0,
+) -> int:
+    """Ingest an .ilp for prediction; returns the class count.
+
+    Prefers the project's own trained vigra forest (exact reproduction of
+    its predictions, no raw volume needed); falls back to re-fitting the
+    native classifier from the project's annotations when no serialized
+    classifier exists (then ``raw`` is required).  Either way the written
+    npz checkpoint drives :class:`IlastikPredictionBase` unchanged.
+    """
+    import h5py
+
+    # retrain only when the project genuinely carries NO serialized
+    # classifier; a PRESENT but unparseable forest (non-vigra backend,
+    # unknown node type, header mismatch, inconsistent lanes) raises
+    # through as ValueError — silently retraining over it would hide the
+    # diagnostic and change predictions
+    with h5py.File(ilp_path, "r") as f:
+        grp = f.get("PixelClassification/ClassifierForests")
+        has_classifier = grp is not None and any(
+            k.startswith("Forest") for k in grp.keys()
+        )
+    forest = load_ilp_forest(ilp_path) if has_classifier else None
+    if forest is not None:
+        with h5py.File(ilp_path, "r") as f:
+            selections = _load_ilp_selections(f)
+        n_feat = ilp_feature_channels(selections)
+        if n_feat != forest["column_count"]:
+            raise ValueError(
+                f"forest expects {forest['column_count']} feature columns "
+                f"but the project's selections produce {n_feat} — the .ilp "
+                "was saved mid-edit; re-train or re-save it"
+            )
+        np.savez(
+            checkpoint_path,
+            W=np.zeros((0, 0), np.float32),
+            b=np.zeros(0, np.float32),
+            sigmas=np.zeros(0, np.float32),
+            ilp_features=np.array([f"{fid}:{s}" for fid, s in selections]),
+            rf_feature=forest["feature"],
+            rf_threshold=forest["threshold"],
+            rf_children=forest["children"],
+            rf_leaf_probs=forest["leaf_probs"],
+            rf_depth=np.int32(forest["depth"]),
+        )
+        return int(forest["class_count"])
+    if raw is None:
+        raise ValueError(
+            f"{ilp_path}: no serialized classifier and no raw volume given "
+            "— pass raw= to re-fit from the project's annotations"
+        )
+    return train_from_ilp(
+        ilp_path, raw, checkpoint_path, n_steps=n_steps, lr=lr, seed=seed
+    )
+
+
 class IlastikPredictionBase(BaseTask):
     """Blockwise pixel-classification prediction (reference:
     ``IlastikPredictionBase``).
@@ -308,6 +607,7 @@ class IlastikPredictionBase(BaseTask):
         shape = inp.shape
         block_shape = tuple(cfg["block_shape"])
         halo = tuple(cfg.get("halo") or [0] * len(shape))
+        forest = None
         with np.load(cfg["checkpoint_path"]) as f:
             W, b = jnp.asarray(f["W"]), jnp.asarray(f["b"])
             sigmas = tuple(float(s) for s in f["sigmas"])
@@ -317,7 +617,17 @@ class IlastikPredictionBase(BaseTask):
                     (s.rsplit(":", 1)[0], float(s.rsplit(":", 1)[1]))
                     for s in f["ilp_features"].tolist()
                 )
-        n_classes = W.shape[1]
+            if "rf_feature" in f:
+                forest = {
+                    "feature": jnp.asarray(f["rf_feature"]),
+                    "threshold": jnp.asarray(f["rf_threshold"]),
+                    "children": jnp.asarray(f["rf_children"]),
+                    "leaf_probs": jnp.asarray(f["rf_leaf_probs"]),
+                    "depth": int(f["rf_depth"]),
+                }
+        n_classes = (
+            forest["leaf_probs"].shape[-1] if forest is not None else W.shape[1]
+        )
 
         out = file_reader(cfg["output_path"]).require_dataset(
             cfg["output_key"],
@@ -342,8 +652,15 @@ class IlastikPredictionBase(BaseTask):
                 feats = ilp_feature_bank(x, selections)
             else:
                 feats = feature_bank(x, sigmas)
-            logits = feats @ W + b
-            probs = jax.nn.softmax(logits, axis=-1)
+            if forest is not None:
+                flat = feats.reshape(-1, feats.shape[-1])
+                probs = forest_predict_proba(
+                    forest["feature"], forest["threshold"],
+                    forest["children"], forest["leaf_probs"],
+                    flat, forest["depth"],
+                ).reshape(feats.shape[:-1] + (n_classes,))
+            else:
+                probs = jax.nn.softmax(feats @ W + b, axis=-1)
             return jnp.moveaxis(probs, -1, 0)
 
         def store(block, raw):

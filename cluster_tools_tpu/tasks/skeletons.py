@@ -2,10 +2,12 @@
 
 Re-design of the reference's ``cluster_tools/skeletons/`` (SURVEY.md §2a:
 blockwise skeletonization + swc/n5 export, via elf/skan).  The rebuild
-derives skeletons from the medial-axis structure the framework already
-computes on device:
+derives skeletons from medial-axis structure instead of voxel thinning.
+Objects are skeletonized per bounding-box crop on the host (scipy EDT —
+crops are small and irregular, a poor fit for the device's fixed-shape
+EDT cascade):
 
-1. per object: Euclidean distance transform (the device EDT kernel),
+1. per object: Euclidean distance transform of the bbox crop (host scipy),
 2. medial nodes = EDT local maxima inside the object,
 3. topology = minimum spanning tree over the medial nodes (edge weight =
    euclidean distance, edges only between nodes within ``link_radius``),

@@ -277,18 +277,61 @@ class WatershedBase(_WsTaskBase):
                 )
             self._store_labels(out, block, lab, n_outer)
 
-        executor = BlockwiseExecutor(
-            target=self.target,
-            device_batch=int(cfg.get("device_batch", 1)),
-            io_threads=max(1, self.max_jobs),
-        )
-        executor.map_blocks(
-            kernel,
-            todo,
-            load,
-            store,
-            on_block_done=lambda b: self.log_block_success(b.block_id),
-        )
+        if impl == "host":
+            # reference-style per-job scipy compute (ops/host.py): no
+            # device, no jit — the executor's vmap+jit contract does not
+            # apply, so run the blocks on a thread pool (scipy EDT /
+            # watershed_ift release the GIL, so max_jobs threads really
+            # overlap compute as well as IO)
+            if two_d:
+                raise NotImplementedError("impl='host' is 3-D only")
+            if size_filter > 0 or agg_thr is not None:
+                raise NotImplementedError(
+                    "impl='host' does not support size_filter / "
+                    "agglomerate_threshold — use the device impls"
+                )
+            # params the host kernel has no twin for must fail, not drift
+            if float(kp.get("sigma_seeds") or 0.0) > 0:
+                raise NotImplementedError(
+                    "impl='host' does not support sigma_seeds"
+                )
+            if int(kp.get("connectivity", 1)) != 1:
+                raise NotImplementedError(
+                    "impl='host' supports connectivity=1 only"
+                )
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ..ops.host import host_dt_watershed
+
+            def _host_block(block):
+                b, m = load(block)
+                lab = host_dt_watershed(
+                    b,
+                    threshold=float(kp["threshold"]),
+                    dt_max_distance=kp.get("dt_max_distance"),
+                    min_seed_distance=float(kp.get("min_seed_distance", 0.0)),
+                    mask=m,
+                    sampling=kp.get("sampling"),
+                )
+                store(block, (lab, False))
+                self.log_block_success(block.block_id)
+
+            with ThreadPoolExecutor(max(1, self.max_jobs)) as pool:
+                # list() propagates the first worker exception
+                list(pool.map(_host_block, todo))
+        else:
+            executor = BlockwiseExecutor(
+                target=self.target,
+                device_batch=int(cfg.get("device_batch", 1)),
+                io_threads=max(1, self.max_jobs),
+            )
+            executor.map_blocks(
+                kernel,
+                todo,
+                load,
+                store,
+                on_block_done=lambda b: self.log_block_success(b.block_id),
+            )
         return {
             "n_blocks": len(block_ids),
             "n_outer": n_outer,
@@ -389,6 +432,14 @@ class TwoPassWatershedBase(_WsTaskBase):
             return data, dense, m
 
         impl = str(cfg.get("impl", "auto"))
+        if impl == "host":
+            # pass one would run scipy while this pass runs the seeded
+            # device kernel — two different flood semantics stitched into
+            # one label space.  Refuse the hybrid (same policy as two_d).
+            raise NotImplementedError(
+                "impl='host' is not supported for two-pass watershed — the "
+                "seeded continuation only exists as a device kernel"
+            )
         use_tiled = impl != "legacy" and int(kp.get("connectivity", 1)) == 1
 
         def kernel(b, ext, m):

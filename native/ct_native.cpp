@@ -126,13 +126,14 @@ int ct_greedy_additive(int64_t n_nodes, const int64_t* edges,
 // Merge per-block edge features onto a global lexsorted edge table.
 // pairs: [m, 2] uint64 (lo, hi); feats: [m, 5] double rows
 // (mean, min, max, count, variance); table: [k, 2] uint64 lexsorted unique
-// edges.  Accumulates count-weighted mean sums, additive sums of squares
-// ((var + mean^2) * count), min of mins, max of maxs, and count sums — the
-// merge_feature_lists contract.  Returns the number of pairs not found in
-// the table.
+// edges.  Returns the number of pairs not found in the table.
+// Streaming (Chan) combine: `means` carries the running count-weighted
+// mean, `m2s` the running second moment about it (var * n).  Avoids
+// reconstructing E[x^2] = var + mean^2, whose float cancellation loses
+// several digits of merged variance for large-mean data.
 int64_t ct_merge_edge_features(const uint64_t* pairs, const double* feats,
                                int64_t m, const uint64_t* table, int64_t k,
-                               double* wsums, double* sqsums, double* mins,
+                               double* means, double* m2s, double* mins,
                                double* maxs, double* counts) {
   int64_t unmatched = 0;
   for (int64_t i = 0; i < m; ++i) {
@@ -152,11 +153,14 @@ int64_t ct_merge_edge_features(const uint64_t* pairs, const double* feats,
     }
     double mean = feats[5 * i], mn = feats[5 * i + 1], mx = feats[5 * i + 2],
            cnt = feats[5 * i + 3], var = feats[5 * i + 4];
-    wsums[a] += mean * cnt;
-    sqsums[a] += (var + mean * mean) * cnt;
+    if (cnt <= 0) continue;
+    double na = counts[a], ntot = na + cnt;
+    double delta = mean - means[a];
+    means[a] += delta * cnt / ntot;
+    m2s[a] += var * cnt + delta * delta * na * cnt / ntot;
     if (mn < mins[a]) mins[a] = mn;
     if (mx > maxs[a]) maxs[a] = mx;
-    counts[a] += cnt;
+    counts[a] = ntot;
   }
   return unmatched;
 }

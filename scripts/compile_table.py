@@ -1,0 +1,92 @@
+"""Compile-time-vs-size table for the fused-step kernels (VERDICT r3 #2).
+
+Times TRACE+LOWER and BACKEND COMPILE separately (AOT: ``jit(...).lower()``
+then ``.compile()``) for one target at one extent, so the scaling of
+compile cost with volume size can be attributed: if it grows with the
+tile-grid size the kernels are effectively unrolling per tile; if it is
+size-stable the 512^3 tunnel wedge is a backend/transport problem, not a
+program-structure problem.
+
+Usage: python scripts/compile_table.py <target> <extent> [halo]
+    targets: ccl, dt_ws, fused (CT_PROBE_IMPL selects pallas/xla/auto)
+Run each invocation in its own capped subprocess (a wedged remote compile
+hangs rather than raising); sweep with scripts/run_compile_table.sh.
+
+Prints one line: ``TABLE target=<t> extent=<e> impl=<i> backend=<b>
+trace_lower=<s> compile=<s>``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    target = sys.argv[1]
+    extent = int(sys.argv[2])
+    halo = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # sitecustomize force-pins axon; honor an explicit CPU request
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    backend = jax.devices()[0].platform
+    impl = os.environ.get("CT_PROBE_IMPL", "auto")
+    threshold = 0.45
+    shape = (extent, extent, extent)
+    spec = jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    if target == "ccl":
+        from cluster_tools_tpu.ops.tile_ccl import label_components_tiled
+
+        fn = jax.jit(
+            lambda v: label_components_tiled(v < threshold, impl=impl)[0]
+        )
+    elif target == "dt_ws":
+        from cluster_tools_tpu.ops.tile_ws import dt_watershed_tiled
+
+        fn = jax.jit(
+            lambda v: dt_watershed_tiled(
+                v, threshold=threshold, dt_max_distance=float(halo),
+                min_seed_distance=2.0, impl=impl,
+            )[0]
+        )
+    elif target == "fused":
+        import numpy as np
+
+        from jax.sharding import Mesh
+
+        from cluster_tools_tpu.parallel.pipeline import make_ws_ccl_step
+
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+        step = make_ws_ccl_step(
+            mesh, halo=halo, threshold=threshold,
+            dt_max_distance=float(halo), min_seed_distance=2.0, impl=impl,
+            stitch_ws_threshold=threshold,
+        )
+        fn = jax.jit(lambda v: step(v[None]))
+    else:
+        raise SystemExit(f"unknown target {target!r}")
+
+    t0 = time.monotonic()
+    lowered = fn.lower(spec)
+    t_lower = time.monotonic() - t0
+    t0 = time.monotonic()
+    lowered.compile()
+    t_compile = time.monotonic() - t0
+    print(
+        f"TABLE target={target} extent={extent} impl={impl} "
+        f"backend={backend} trace_lower={t_lower:.1f} compile={t_compile:.1f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -265,3 +265,36 @@ def test_device_variance_large_mean_values(rng):
     np.testing.assert_array_equal(uv, uv_o)
     # true variance is O(0.1); demand 1% relative accuracy
     np.testing.assert_allclose(feats[:, 4], feats_o[:, 4], rtol=1e-2)
+
+
+@pytest.mark.parametrize("native_path", [True, False])
+def test_merge_variance_large_mean(rng, native_path, monkeypatch):
+    """Cross-block variance merge must not reconstruct E[x^2] from float32
+    per-block means (catastrophic cancellation for intensities ~200): the
+    streaming Chan combine keeps merged variance to ~1% for var ~0.08.
+    Covers both the native and the numpy fallback merge paths."""
+    if not native_path:
+        from cluster_tools_tpu import native
+
+        monkeypatch.setattr(native, "merge_edge_features", lambda *a: None)
+    seg = (rng.integers(0, 3, (24, 24, 24)) + 1).astype(np.uint64)
+    vals = (200.0 + 0.5 * rng.random((24, 24, 24))).astype(np.float32)
+    bs = (12, 12, 12)
+    parts, fparts = [], []
+    for z in range(0, 24, 12):
+        for y in range(0, 24, 12):
+            for x in range(0, 24, 12):
+                bb = tuple(
+                    slice(b, min(b + s + 1, 24)) for b, s in zip((z, y, x), bs)
+                )
+                uv, sizes, feats = block_rag(
+                    seg[bb], values=vals[bb], inner_shape=bs
+                )
+                parts.append((uv, sizes))
+                fparts.append((uv, feats))
+    uv_m, _ = merge_edge_lists(parts)
+    feats_m = merge_feature_lists(uv_m, fparts)
+    uv_o, _, feats_o = rag_oracle(seg, vals.astype(np.float64))
+    np.testing.assert_array_equal(uv_m, uv_o)
+    np.testing.assert_allclose(feats_m[:, 0], feats_o[:, 0], rtol=1e-5)
+    np.testing.assert_allclose(feats_m[:, 4], feats_o[:, 4], rtol=1e-2)

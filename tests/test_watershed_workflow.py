@@ -216,3 +216,86 @@ def test_agglomerate_threshold_refused_for_two_pass(workspace):
     )
     with pytest.raises(NotImplementedError, match="not supported"):
         wf.requires()
+
+
+def test_host_impl_runs_reference_style_pipeline(rng, workspace):
+    """impl='host' (ops/host.py, the reference's per-job scipy compute) is a
+    real selectable path: foreground fragments exist, background stays 0,
+    and the CC twin matches scipy exactly."""
+    from cluster_tools_tpu.ops.host import host_ws_ccl
+
+    vol = _boundary_volume(rng)
+    labels = _run_ws(workspace, vol, two_pass=False, impl="host")
+    fg = vol < 0.5
+    assert labels.shape == vol.shape
+    assert (labels[~fg] == 0).all()
+    assert (labels[fg] > 0).mean() > 0.95  # watershed_ift floods foreground
+
+    ws, cc, n_fg = host_ws_ccl(vol, 0.5, dt_max_distance=4.0)
+    assert n_fg == int(fg.sum())
+    want, n_want = ndi.label(fg)
+    got_ids = np.unique(cc[fg])
+    assert len(got_ids) == n_want
+    # component partition identical (relabel-invariant comparison)
+    first = {g: want[cc == g][0] for g in got_ids}
+    for g, w in first.items():
+        assert (want[cc == g] == w).all()
+
+
+def test_host_impl_refuses_unsupported_combinations(workspace, rng):
+    """size_filter has no host twin: the task must fail loudly (build()
+    returns False), not silently skip the filter."""
+    tmp_folder, config_dir, root = workspace
+    vol = _boundary_volume(rng)
+    path = os.path.join(root, "ws.zarr")
+    f = file_reader(path)
+    ds = f.require_dataset(
+        "boundaries", shape=vol.shape, chunks=(16, 16, 16), dtype="float32"
+    )
+    ds[...] = vol
+    wf = WatershedWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="boundaries",
+        output_path=path,
+        output_key="sf",
+        block_shape=[16, 16, 16],
+        halo=[4, 4, 4],
+        two_pass=False,
+        threshold=0.5,
+        impl="host",
+        size_filter=10,
+    )
+    assert not build([wf])
+
+
+def test_host_impl_refused_for_two_pass(workspace, rng):
+    """Two-pass needs the seeded device kernel for pass two; a scipy pass
+    one + device pass two hybrid must not be stitched silently."""
+    tmp_folder, config_dir, root = workspace
+    vol = _boundary_volume(rng)
+    path = os.path.join(root, "ws.zarr")
+    f = file_reader(path)
+    ds = f.require_dataset(
+        "boundaries", shape=vol.shape, chunks=(16, 16, 16), dtype="float32"
+    )
+    ds[...] = vol
+    wf = WatershedWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="boundaries",
+        output_path=path,
+        output_key="tp",
+        block_shape=[16, 16, 16],
+        halo=[4, 4, 4],
+        two_pass=True,
+        threshold=0.5,
+        impl="host",
+    )
+    assert not build([wf])
