@@ -65,6 +65,125 @@ def _convert_leaf(path, flax_leaf, torch_key: str, tensor: np.ndarray):
     )
 
 
+def _to_array(v) -> np.ndarray:
+    # .detach() first: state_dicts saved with keep_vars=True (or from
+    # named_parameters()) hold requires_grad tensors that np.asarray
+    # refuses to convert directly
+    return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+
+def infer_unet_config(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Deduce the UNet3D hyperparameters from a torch ``state_dict`` alone.
+
+    A user arriving with their own torch-trained U-Net should not have to
+    reverse-engineer ``base_features``/``depth``/``norm`` by hand
+    (SURVEY.md §2a inference: the reference loads an arbitrary user model
+    per job).  The U-Net family's tensor census is rigid enough to invert:
+
+    - 5-D conv tensors: per level a 2-conv block + 1 downsample, a 2-conv
+      bottom, per level 1 transpose + a 2-conv block, and 1 output head
+      = ``6 * depth + 3``  ->  depth.
+    - first conv weight ``(O, I, k, k, k)``: O = base_features,
+      I = in_channels; last conv weight: O = out_channels.
+    - 1-D tensors: one bias per conv without norm (``6 depth + 3``), plus a
+      GroupNorm scale+bias pair per block conv (``+ 4 (2 depth + 1)``)
+      with norm.
+
+    Returns kwargs for :class:`~.unet.UNet3D` (plus ``in_channels``, which
+    flax infers from the input and the caller uses for the sample shape).
+    Raises ``ValueError`` naming the offending tensor when the census does
+    not fit the family.
+    """
+    items = [
+        (k, a)
+        for k, v in state_dict.items()
+        if "num_batches_tracked" not in k
+        for a in (_to_array(v),)
+    ]
+    conv5 = [(k, a) for k, a in items if a.ndim == 5]
+    one_d = [(k, a) for k, a in items if a.ndim == 1]
+    other = [
+        (k, a) for k, a in items if a.ndim not in (1, 5) and a.ndim >= 1
+    ]
+    if other:
+        k, a = other[0]
+        raise ValueError(
+            f"state_dict tensor {k!r} has shape {tuple(a.shape)} — not part "
+            "of the 3-D U-Net family (expected 5-D conv kernels and 1-D "
+            "bias/norm vectors)"
+        )
+    if not conv5:
+        raise ValueError(
+            "state_dict holds no 5-D tensors — not a 3-D conv net"
+        )
+    n5 = len(conv5)
+    if n5 < 3 or (n5 - 3) % 6:
+        raise ValueError(
+            f"{n5} conv tensors does not fit the U-Net census 6*depth + 3 "
+            f"(first conv tensor: {conv5[0][0]!r})"
+        )
+    depth = (n5 - 3) // 6
+    base_features = int(conv5[0][1].shape[0])
+    in_channels = int(conv5[0][1].shape[1])
+    out_channels = int(conv5[-1][1].shape[0])
+    n1 = len(one_d)
+    if n1 == n5:
+        norm = None
+    elif n1 == n5 + 4 * (2 * depth + 1):
+        norm = "group"
+    else:
+        raise ValueError(
+            f"{n1} 1-D tensors fits neither norm=None ({n5}) nor "
+            f"norm='group' ({n5 + 4 * (2 * depth + 1)}) for depth={depth} "
+            f"(first 1-D tensor: {one_d[0][0] if one_d else None!r})"
+        )
+    return {
+        "out_channels": out_channels,
+        "base_features": base_features,
+        "depth": depth,
+        "norm": norm,
+        "in_channels": in_channels,
+    }
+
+
+def import_torch_unet(path_or_state_dict, **overrides):
+    """Torch U-Net checkpoint -> ``(flax_model, variables)``, config-free.
+
+    Infers the architecture with :func:`infer_unet_config`, instantiates
+    the flax :class:`~.unet.UNet3D` twin, and converts the weights.  This
+    is the "bring your own trained U-Net" entry point; for a state_dict
+    that does NOT mirror the family, the census error (or the first
+    unmappable tensor from the positional converter) says which tensor
+    broke the match.  ``overrides`` go to the UNet3D constructor (e.g.
+    ``dtype=jnp.float32`` for bit-closer parity checks).
+
+    Caveat the shapes cannot encode: GroupNorm *group counts*.  The twin
+    uses ``min(8, channels)`` groups; a checkpoint trained with a
+    different grouping imports cleanly but normalizes differently —
+    validate imported models against a reference forward pass.
+    """
+    import os
+
+    if isinstance(path_or_state_dict, (str, bytes, os.PathLike)):
+        import torch
+
+        obj = torch.load(
+            path_or_state_dict, map_location="cpu", weights_only=True
+        )
+        obj = _unwrap_state_dict(obj, path_or_state_dict)
+    else:
+        obj = path_or_state_dict
+    cfg = infer_unet_config(obj)
+    in_channels = cfg.pop("in_channels")
+    cfg.update(overrides)
+    from .unet import UNet3D
+
+    model = UNet3D(**cfg)
+    mult = 2 ** cfg["depth"]
+    sample = (1, mult, mult, mult, in_channels)
+    return model, torch_state_dict_to_flax(obj, model, sample)
+
+
 def torch_state_dict_to_flax(
     state_dict: Mapping[str, Any], model, sample_shape
 ) -> Dict:
@@ -83,26 +202,30 @@ def torch_state_dict_to_flax(
     # order, the property positional matching relies on (same machinery as
     # tasks/inference.py's npz checkpoints)
     flax_leaves = list(tu.flatten_dict(template["params"]).items())
-    def to_array(v) -> np.ndarray:
-        # .detach() first: state_dicts saved with keep_vars=True (or from
-        # named_parameters()) hold requires_grad tensors that np.asarray
-        # refuses to convert directly
-        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
-
     torch_items = [
         (k, arr)
         for k, v in state_dict.items()
         if "num_batches_tracked" not in k
-        for arr in (to_array(v),)
+        for arr in (_to_array(v),)
         if arr.ndim >= 1
     ]
     if len(torch_items) != len(flax_leaves):
+        # name the FIRST pair that fails to convert — that is where the
+        # architectures diverge; the full lists follow for context
+        first = None
+        for (path, leaf), (tkey, tensor) in zip(flax_leaves, torch_items):
+            try:
+                _convert_leaf(path, leaf, tkey, tensor)
+            except ValueError as e:
+                first = str(e)
+                break
         fpaths = ["/".join(p) for p, _ in flax_leaves]
         tkeys = [k for k, _ in torch_items]
         raise ValueError(
             f"parameter count mismatch: flax has {len(flax_leaves)} leaves, "
-            f"torch has {len(torch_items)} tensors.\nflax: {fpaths}\n"
-            f"torch: {tkeys}"
+            f"torch has {len(torch_items)} tensors.\nfirst unmappable "
+            f"tensor: {first or 'lists agree up to the shorter length'}\n"
+            f"flax: {fpaths}\ntorch: {tkeys}"
         )
     flat = {}
     for (path, leaf), (tkey, tensor) in zip(flax_leaves, torch_items):
@@ -121,12 +244,18 @@ def load_torch_checkpoint(path: str, model, sample_shape) -> Dict:
     import torch
 
     obj = torch.load(path, map_location="cpu", weights_only=True)
+    obj = _unwrap_state_dict(obj, path)
+    return torch_state_dict_to_flax(obj, model, sample_shape)
+
+
+def _unwrap_state_dict(obj, origin):
     for key in ("state_dict", "model_state_dict", "model"):
         if isinstance(obj, dict) and key in obj and isinstance(obj[key], dict):
             obj = obj[key]
             break
     if not isinstance(obj, dict):
         raise ValueError(
-            f"{path!r} does not contain a state_dict (got {type(obj).__name__})"
+            f"{origin!r} does not contain a state_dict "
+            f"(got {type(obj).__name__})"
         )
-    return torch_state_dict_to_flax(obj, model, sample_shape)
+    return obj

@@ -31,10 +31,75 @@ is penalized.
 
 from __future__ import annotations
 
+import hashlib
 import heapq
+import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+from zipfile import BadZipFile
+
+
+class SolverCheckpoint:
+    """Preemption-safe intermediate state for long solves (SURVEY.md §5.3).
+
+    The reference's resume grain is task/block; a long global solve dying
+    mid-run lost everything.  This persists the partition after every KL
+    outer sweep (atomic tmp+rename, like the block markers), fingerprinted
+    by the problem's (edges, costs) bytes so a stale checkpoint from a
+    different reduced problem can never seed a resume.
+    """
+
+    def __init__(self, path: str, edges: np.ndarray, costs: np.ndarray):
+        self.path = path
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(edges).tobytes())
+        h.update(np.ascontiguousarray(costs).tobytes())
+        self.problem_key = h.hexdigest()
+
+    def load(self) -> Optional[Tuple[np.ndarray, int]]:
+        """(labels, next_sweep) from a matching checkpoint, else None."""
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with np.load(self.path, allow_pickle=False) as f:
+                if str(f["problem_key"]) != self.problem_key:
+                    return None
+                return f["labels"].astype(np.int64), int(f["sweep"])
+        except (OSError, ValueError, KeyError, BadZipFile):
+            # torn write from a crash mid-save: ignore, solve from scratch
+            return None
+
+    def save(self, labels: np.ndarray, sweep: int, energy: float) -> None:
+        self._sweep_temps()  # a kill inside a prior save orphans its temp
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        np.savez(
+            tmp,
+            labels=np.asarray(labels, np.int64),
+            sweep=np.int64(sweep),
+            energy=np.float64(energy),
+            problem_key=self.problem_key,
+        )
+        # np.savez appends .npz to names without it
+        if not tmp.endswith(".npz") and os.path.exists(tmp + ".npz"):
+            tmp = tmp + ".npz"
+        os.replace(tmp, self.path)
+
+    def clear(self) -> None:
+        self._sweep_temps()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def _sweep_temps(self) -> None:
+        import glob
+
+        for stale in glob.glob(f"{self.path}.*.tmp*"):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
 
 
 def multicut_energy(
@@ -267,6 +332,7 @@ def kernighan_lin(
     init_labels: np.ndarray | None = None,
     max_outer: int = 20,
     epsilon: float = 1e-9,
+    checkpoint: Optional[SolverCheckpoint] = None,
 ) -> np.ndarray:
     """Kernighan-Lin for multicut (Keuper et al.'s KLj scheme).
 
@@ -276,27 +342,66 @@ def kernighan_lin(
     rolled back to its best prefix — and considers joining the pair
     outright.  Iterates until a full sweep yields no improvement.  Energy is
     monotonically non-increasing from the initial partition.
+
+    With ``checkpoint``, the solve becomes preemption-safe: the partition
+    persists after the GAEC init and after EVERY outer sweep (one sweep per
+    solver call), and a killed run resumes from the last persisted sweep —
+    identical sweep sequence, identical result.  ``checkpoint.clear()`` is
+    the caller's responsibility on success (the task layer owns artifact
+    lifecycle).
     """
     edges = np.asarray(edges, dtype=np.int64)
     costs = np.asarray(costs, dtype=np.float64)
-    labels = (
-        greedy_additive(n_nodes, edges, costs)
-        if init_labels is None
-        else np.asarray(init_labels, dtype=np.int64).copy()
-    )
+    start_sweep = 0
+    resumed = checkpoint.load() if checkpoint is not None else None
+    if resumed is not None:
+        labels, start_sweep = resumed
+        labels = labels.copy()
+    else:
+        labels = (
+            greedy_additive(n_nodes, edges, costs)
+            if init_labels is None
+            else np.asarray(init_labels, dtype=np.int64).copy()
+        )
     if len(edges) == 0:
         return _relabel_consecutive(labels)
 
     from .. import native
 
-    refined = native.kernighan_lin(
-        n_nodes, edges, costs, labels, max_outer=max_outer, epsilon=epsilon
-    )
-    if refined is not None:
-        return _relabel_consecutive(refined)
-    return _kernighan_lin_python(
-        n_nodes, edges, costs, labels, max_outer, epsilon
-    )
+    if checkpoint is None:
+        refined = native.kernighan_lin(
+            n_nodes, edges, costs, labels, max_outer=max_outer,
+            epsilon=epsilon,
+        )
+        if refined is not None:
+            return _relabel_consecutive(refined)
+        return _kernighan_lin_python(
+            n_nodes, edges, costs, labels, max_outer, epsilon
+        )
+
+    # checkpointed mode: one outer sweep per call, persist between sweeps.
+    # Each call recomputes partition pairs from the current labels — exactly
+    # what the fused outer loop does — so the sweep sequence (and result)
+    # matches an uninterrupted checkpointed run after any kill+resume.
+    prev_e = multicut_energy(edges, costs, labels)
+    if resumed is None:
+        checkpoint.save(labels, 0, prev_e)
+    for sweep in range(start_sweep, max_outer):
+        refined = native.kernighan_lin(
+            n_nodes, edges, costs, labels.copy(), max_outer=1,
+            epsilon=epsilon,
+        )
+        if refined is None:
+            refined = _kernighan_lin_python(
+                n_nodes, edges, costs, labels.copy(), 1, epsilon
+            )
+        e = multicut_energy(edges, costs, refined)
+        labels = np.asarray(refined, np.int64)
+        checkpoint.save(labels, sweep + 1, e)
+        if prev_e - e <= epsilon:
+            break
+        prev_e = e
+    return _relabel_consecutive(labels)
 
 
 def _kernighan_lin_python(

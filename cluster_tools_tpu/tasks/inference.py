@@ -95,7 +95,23 @@ class InferenceBase(BaseTask):
 
         model_cfg: Dict[str, Any] = dict(cfg.get("model") or {})
         model_name = model_cfg.pop("name", "unet3d")
-        model = get_model(model_name, **model_cfg)
+        ckpt = cfg.get("checkpoint_path")
+        variables = None
+        if model_name == "auto":
+            # "bring your own torch U-Net": architecture inferred from the
+            # checkpoint's tensor census, no hand-written model config
+            if not ckpt:
+                raise ValueError(
+                    "model name 'auto' infers the architecture from a "
+                    "torch checkpoint — set checkpoint_path to a .pt/.pth"
+                )
+            from ..models.torch_import import import_torch_unet
+
+            # remaining model-config keys override the inferred
+            # architecture (e.g. dtype, norm)
+            model, variables = import_torch_unet(ckpt, **model_cfg)
+        else:
+            model = get_model(model_name, **model_cfg)
         out_channels = getattr(model, "out_channels", 1)
         depth = getattr(model, "depth", 0)
         mult = 2 ** int(depth)
@@ -105,8 +121,9 @@ class InferenceBase(BaseTask):
             _round_up(b + 2 * h, mult) for b, h in zip(block_shape, halo)
         )
         sample = (1,) + outer + (1,)
-        ckpt = cfg.get("checkpoint_path")
-        if ckpt:
+        if variables is not None:
+            pass  # imported together with the model above
+        elif ckpt:
             variables = load_checkpoint(ckpt, model, sample)
         else:
             self.logger.info("no checkpoint_path: using random init (smoke mode)")

@@ -259,11 +259,30 @@ class SolveGlobalBase(BaseTask):
         edges, costs, node_labeling = _load_problem(self.tmp_folder, scale)
         n_nodes = int(node_labeling.max()) + 1 if len(node_labeling) else 0
 
+        # preemption safety (SURVEY.md §5.3): checkpoint-capable solvers
+        # persist their partition every outer sweep; a killed run resumes
+        # mid-solve instead of restarting the global solve from scratch
+        ckpt = None
+        solver_kw = {}
+        if getattr(solver, "supports_checkpoint", False) and len(edges):
+            from ..ops.multicut import SolverCheckpoint
+
+            ckpt = SolverCheckpoint(
+                os.path.join(
+                    mc_dir(self.tmp_folder), f"solve_global_s{scale}.ckpt.npz"
+                ),
+                edges,
+                costs,
+            )
+            solver_kw["checkpoint"] = ckpt
+
         labels = (
-            solver(n_nodes, edges, costs)
+            solver(n_nodes, edges, costs, **solver_kw)
             if len(edges)
             else np.zeros(n_nodes, np.int64)
         )
+        if ckpt is not None:
+            ckpt.clear()
         final = labels[node_labeling]  # original dense node -> segment
         nodes_table, _, edges0, _ = load_global_graph(self.tmp_folder)
         energy = multicut_energy(

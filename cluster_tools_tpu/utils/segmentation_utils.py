@@ -41,6 +41,12 @@ def _solve_node_moves(n_nodes, edges, costs, **kw):
     return greedy_node_moves(n_nodes, edges, costs, **kw)
 
 
+# solvers that take a SolverCheckpoint (ops.multicut.SolverCheckpoint) and
+# persist their partition between outer sweeps — the task layer passes one
+# for the global solve so preemption resumes mid-solve (SURVEY.md §5.3)
+_solve_kl.supports_checkpoint = True
+
+
 key_to_agglomerator = {
     "greedy-additive": _solve_greedy,
     "kernighan-lin": _solve_kl,

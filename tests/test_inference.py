@@ -611,3 +611,102 @@ def test_torch_import_rejects_mismatched_architecture(tmp_path):
     model = UNet3D(out_channels=2, base_features=4, depth=2)
     with pytest.raises(ValueError, match="mismatch"):
         load_torch_checkpoint(path, model, (1, 16, 16, 16, 1))
+
+
+def test_import_torch_unet_infers_architecture(tmp_path, rng):
+    """VERDICT r3 #9: a user's own differently-sized torch U-Net imports
+    with NO hand-written model config — architecture (base_features, depth,
+    out_channels, norm) is inferred from the checkpoint's tensor census —
+    and agrees numerically with the torch forward."""
+    import torch
+
+    from cluster_tools_tpu.models.torch_import import (
+        import_torch_unet,
+        infer_unet_config,
+    )
+
+    torch.manual_seed(1)
+    # non-default everything: 3 channels in, 5 out, 8 base features, depth 3
+    # (features stay divisible by the min(8, c) GroupNorm grouping)
+    net = _torch_unet3d(in_ch=3, out_channels=5, base_features=8, depth=3)
+    path = str(tmp_path / "user_model.pt")
+    torch.save({"model_state_dict": net.state_dict()}, path)
+
+    cfg = infer_unet_config(net.state_dict())
+    assert cfg == {
+        "out_channels": 5, "base_features": 8, "depth": 3,
+        "norm": "group", "in_channels": 3,
+    }
+
+    model, variables = import_torch_unet(path, dtype=jnp.float32)
+    assert model.depth == 3 and model.out_channels == 5
+
+    x = rng.random((1, 16, 16, 16, 3)).astype(np.float32)
+    got = np.asarray(model.apply(variables, jnp.asarray(x)))
+    with torch.no_grad():
+        want = (
+            net(torch.from_numpy(x.transpose(0, 4, 1, 2, 3)))
+            .numpy()
+            .transpose(0, 2, 3, 4, 1)
+        )
+    np.testing.assert_allclose(got, want, atol=3e-4, rtol=3e-4)
+
+
+def test_infer_unet_config_names_offending_tensor():
+    """A non-family state_dict must be refused naming the tensor that
+    breaks the census, not with a bare count."""
+    import torch
+
+    from cluster_tools_tpu.models.torch_import import infer_unet_config
+
+    with pytest.raises(ValueError, match="lin.weight"):
+        infer_unet_config({"lin.weight": torch.zeros(4, 4)})
+    # census mismatch: a lone conv pair is not 6*depth+3
+    with pytest.raises(ValueError, match="census|conv tensors"):
+        infer_unet_config({
+            "c1.weight": torch.zeros(4, 1, 3, 3, 3),
+            "c1.bias": torch.zeros(4),
+            "c2.weight": torch.zeros(4, 4, 3, 3, 3),
+            "c2.bias": torch.zeros(4),
+        })
+
+
+def test_inference_task_auto_model_from_torch_checkpoint(workspace, rng):
+    """model={'name': 'auto'}: the blockwise inference task runs a torch
+    checkpoint end-to-end with the architecture inferred, no model config."""
+    import torch
+
+    from cluster_tools_tpu.tasks.inference import InferenceWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    torch.manual_seed(2)
+    net = _torch_unet3d(in_ch=1, out_channels=2, base_features=4, depth=1)
+    ckpt = os.path.join(root, "user.pt")
+    torch.save(net.state_dict(), ckpt)
+
+    shape = (32, 32, 32)
+    raw = rng.random(shape).astype(np.float32)
+    path = os.path.join(root, "auto_data.zarr")
+    f = file_reader(path)
+    f.require_dataset("raw", shape=shape, chunks=(16, 16, 16), dtype="float32")[
+        ...
+    ] = raw
+    wf = InferenceWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key="pred",
+        checkpoint_path=ckpt,
+        model={"name": "auto"},
+        halo=[8, 8, 8],
+        normalize_range=[0.0, 1.0],
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    pred = file_reader(path, "r")["pred"][...]
+    assert pred.shape == (2,) + shape
+    assert np.isfinite(pred).all()
