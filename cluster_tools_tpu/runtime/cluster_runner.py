@@ -1,0 +1,68 @@
+"""Remote entry point for cluster-target jobs (``python -m
+cluster_tools_tpu.runtime.cluster_runner <spec.json>``).
+
+Reconstructs the LOCAL variant of a task from the spec written by
+:mod:`.cluster`'s submitting wrapper and executes its ``run_impl`` on the
+node, writing ``{ok, result|error}`` to the spec's ``result_path``
+(atomic tmp+rename: the submitter polls for this file on the shared
+filesystem).  Block markers and per-task logs land in the shared
+``tmp_folder`` exactly as for a local run, so a preempted job resumes at
+the block grain when resubmitted.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+import traceback
+
+
+def main(spec_path: str) -> int:
+    with open(spec_path) as f:
+        spec = json.load(f)
+
+    # honor an explicit CPU pin before any task import touches jax: the
+    # env var alone is overridden by platform-pinning sitecustomize hooks
+    # (same pattern as bench.py / tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    result_path = spec["result_path"]
+
+    def emit(payload) -> None:
+        # numpy-aware serialization (same as SuccessTarget manifests) so
+        # manifest field types match target='local' exactly
+        from ..utils.task_utils import _default
+
+        tmp = f"{result_path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=_default)
+        os.replace(tmp, result_path)
+
+    try:
+        module = importlib.import_module(spec["module"])
+        cls = getattr(module, spec["cls"])
+        task = cls(
+            tmp_folder=spec["tmp_folder"],
+            config_dir=spec["config_dir"],
+            max_jobs=int(spec["max_jobs"]),
+            **spec["params"],
+        )
+        result = task.run_impl()
+        emit({"ok": True, "result": result})
+        return 0
+    except Exception as e:  # noqa: BLE001 - report ANY failure to the poller
+        emit({
+            "ok": False,
+            "error": f"{type(e).__name__}: {e}",
+            "traceback": traceback.format_exc(),
+        })
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

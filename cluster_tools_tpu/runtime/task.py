@@ -198,23 +198,34 @@ class DummyTask(BaseTask):
 
 
 _TARGET_SUFFIX = {"local": "Local", "tpu": "TPU"}
+_CLUSTER_TARGETS = ("slurm", "lsf")
+
+
+def _check_target(target: str) -> None:
+    if target not in _TARGET_SUFFIX and target not in _CLUSTER_TARGETS:
+        raise ValueError(
+            f"unknown target {target!r}, expected one of "
+            f"{sorted(_TARGET_SUFFIX) + list(_CLUSTER_TARGETS)}"
+        )
 
 
 def get_task_cls(module, base_name: str, target: str):
     """Resolve ``<Op><Target>`` in an op module (reference: ``WorkflowBase``'s
-    ``getattr(module, name + 'Local'/'Slurm'/'LSF')``)."""
-    if target in ("slurm", "lsf"):
-        raise NotImplementedError(
-            f"target={target!r}: this framework schedules onto the device mesh, "
-            "not a cluster scheduler; use target='local' or target='tpu'"
-        )
-    try:
-        suffix = _TARGET_SUFFIX[target]
-    except KeyError:
-        raise ValueError(
-            f"unknown target {target!r}, expected one of {sorted(_TARGET_SUFFIX)}"
-        )
-    return getattr(module, base_name + suffix)
+    ``getattr(module, name + 'Local'/'Slurm'/'LSF')``).
+
+    ``slurm``/``lsf`` targets are synthesized on demand: the task's Local
+    variant wrapped into a batch-submitting class (``runtime/cluster.py``)
+    — every task gains the cluster backends without per-module
+    boilerplate.  Compute-side workloads should still run on the mesh;
+    the cluster targets exist for ingest (SURVEY.md §7 L2' note).
+    """
+    _check_target(target)
+    if target in _CLUSTER_TARGETS:
+        from .cluster import make_cluster_task
+
+        local_cls = getattr(module, base_name + "Local")
+        return make_cluster_task(local_cls, target)
+    return getattr(module, base_name + _TARGET_SUFFIX[target])
 
 
 class WorkflowBase(BaseTask):
@@ -224,9 +235,7 @@ class WorkflowBase(BaseTask):
     task_name = "workflow"
 
     def __init__(self, *args, target: str = "local", **kwargs):
-        if target not in _TARGET_SUFFIX:
-            # raise the informative error from get_task_cls
-            get_task_cls(None, "", target)
+        _check_target(target)
         # set before super().__init__ so the uid hash sees the real target
         self.target = target
         super().__init__(*args, **kwargs)

@@ -92,3 +92,8 @@ def clean_up_for_retry(tmp_folder: str, task_name: str):
 
 def _now() -> str:
     return datetime.datetime.now().isoformat()
+
+
+def python_executable() -> str:
+    """Interpreter for re-executing framework entry points in batch jobs."""
+    return sys.executable

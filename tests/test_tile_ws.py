@@ -136,6 +136,27 @@ def test_overflow_flag(rng):
     assert bool(ovf)
 
 
+def test_sparse_seed_noise_fill_knobs(rng):
+    """Sparse seeds in a noise-heavy volume exceed the default fill
+    capacities (many small unseeded basins) — the overflow flag must say
+    so, and the public knobs (adj_cap, fill_rounds) must be enough to
+    complete the fill with every voxel labeled by a seed."""
+    height = rng.random((64, 64, 64)).astype(np.float32)
+    seeds = np.zeros((64, 64, 64), np.int32)
+    seeds[8, 8, 8] = 1
+    seeds[50, 50, 50] = 2
+    seg, ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla",
+        # measured at this size/seed: ~154k face voxels per axis, ~273k
+        # unique adjacencies, ~38k unseeded basins -> 2^19 caps fit
+        fill_cap=1 << 19, adj_cap=1 << 19, fill_rounds=32,
+    )
+    seg = np.asarray(seg)
+    assert not bool(ovf)
+    assert (seg > 0).all()
+    assert set(np.unique(seg)) == {1, 2}
+
+
 def test_dt_watershed_seeded_tiled_external_encoding(rng):
     """Two-pass mode: external seeds dominate their basins and come back
     with the +N offset; unseeded regions get internal flat-index fragments
