@@ -46,6 +46,8 @@ WORKFLOWS = {
     "node_labels": "cluster_tools_tpu.tasks.node_labels:NodeLabelWorkflow",
     "evaluation": "cluster_tools_tpu.tasks.evaluation:EvaluationWorkflow",
     "skeletons": "cluster_tools_tpu.tasks.skeletons:SkeletonWorkflow",
+    "meshes": "cluster_tools_tpu.tasks.meshes:MeshWorkflow",
+    "transformations": "cluster_tools_tpu.tasks.transformations:TransformationsWorkflow",
     "distances": "cluster_tools_tpu.tasks.distances:PairwiseDistanceWorkflow",
     "statistics": "cluster_tools_tpu.tasks.statistics:DataStatisticsWorkflow",
     "paintera_conversion": "cluster_tools_tpu.tasks.paintera:PainteraConversionWorkflow",
@@ -72,6 +74,18 @@ def cmd_run(args) -> int:
 
     with open(args.config) as f:
         cfg = json.load(f)
+    if cfg.get("target", "local") != "tpu":
+        # non-tpu targets must never initialize the accelerator backend:
+        # platform-pinning sitecustomize hooks (jax_platforms="axon,cpu")
+        # make the first jax.devices() call block on an unreachable chip
+        # even for pure-host work, and the env var alone cannot override
+        # them (see bench.py / tests/conftest.py for the same pattern)
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     cls = _resolve(args.workflow)
     wf = cls(
         tmp_folder=cfg["tmp_folder"],

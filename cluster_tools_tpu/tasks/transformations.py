@@ -1,0 +1,217 @@
+"""Blockwise affine volume transformation (reference:
+``cluster_tools/transformations/`` — SURVEY.md §2a tags it as a
+possibly-present extra; provided here so migrating users find it).
+
+Semantics follow ``scipy.ndimage.affine_transform`` exactly:
+``output[o] = input[matrix @ o + offset]`` — ``matrix`` (3x3) and
+``offset`` (3,) map OUTPUT coordinates to INPUT coordinates, ``order``
+in {0, 1} selects nearest/trilinear, out-of-volume samples read
+``fill_value``.
+
+TPU-first design: the trilinear resample is a device gather
+(``jax.scipy.ndimage.map_coordinates``, float32) over a fixed-size input
+buffer.  Each output block's input footprint is the affine image of the
+block box; its size is bounded by ``ceil(|matrix| @ block_shape) + 2``
+independent of block position, and edge blocks pad their coordinate
+array to the full block size, so every block shares ONE static signature
+and the device function compiles exactly once.  Dataset-boundary
+clipping pads the buffer with ``fill_value`` (``mode='constant'``
+semantics) — no per-block recompiles, no dynamic shapes.
+
+``order=0`` (nearest, the segmentation/label case) is instead an exact
+host gather in the ORIGINAL dtype: label ids survive at any integer
+width (a float32 device round-trip would silently merge ids above 2^24),
+and a pure gather is the one op the device is no better at.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+
+
+def _resample_fn(buf_shape, order, fill_value, target):
+    """Jitted (buffer, local_coords) -> samples, one compile per task.
+
+    Placement follows the task target via the canonical device policy
+    (``parallel.mesh.backend_devices``): ``tpu`` runs on the chip,
+    everything else (local / cluster nodes) on host CPU — a ``local``
+    task must never initialize the accelerator backend."""
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy import ndimage as jndi
+
+    from ..parallel.mesh import backend_devices
+
+    dev = backend_devices("tpu" if target == "tpu" else "local")[0]
+
+    @jax.jit
+    def run(buf, coords):
+        return jndi.map_coordinates(
+            buf, [coords[0], coords[1], coords[2]],
+            order=order, mode="constant", cval=fill_value,
+        )
+
+    def call(buf, coords):
+        return run(jax.device_put(buf, dev), jax.device_put(coords, dev))
+
+    return call
+
+
+class AffineTransformBase(BaseTask):
+    """Params: ``input_path/input_key``, ``output_path/output_key``,
+    ``matrix`` (3x3 nested list), ``offset`` (3,), ``out_shape``
+    (defaults to the input shape), ``order`` (0 nearest / 1 trilinear,
+    default 1), ``fill_value`` (default 0)."""
+
+    task_name = "affine_transform"
+
+    @staticmethod
+    def default_task_config():
+        return {
+            "threads_per_job": 1,
+            "order": 1,
+            "fill_value": 0,
+            "out_shape": None,
+        }
+
+    def run_impl(self):
+        cfg = self.get_config()
+        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        in_shape = tuple(inp.shape)
+        if len(in_shape) != 3:
+            raise ValueError(
+                f"affine_transform expects a 3-D volume, got {in_shape}"
+            )
+        matrix = np.asarray(cfg["matrix"], np.float64)
+        offset = np.asarray(cfg["offset"], np.float64)
+        if matrix.shape != (3, 3) or offset.shape != (3,):
+            raise ValueError(
+                "matrix must be 3x3 and offset length-3 (scipy "
+                f"affine_transform semantics); got {matrix.shape} / "
+                f"{offset.shape}"
+            )
+        order = int(cfg.get("order", 1))
+        if order not in (0, 1):
+            raise ValueError(f"order must be 0 or 1, got {order}")
+        fill_value = float(cfg.get("fill_value", 0))
+        out_shape = tuple(
+            int(s) for s in (cfg.get("out_shape") or in_shape)
+        )
+        block_shape = tuple(cfg["block_shape"])
+
+        out = file_reader(cfg["output_path"]).require_dataset(
+            cfg["output_key"], shape=out_shape, chunks=block_shape,
+            dtype=str(inp.dtype),
+        )
+        blocking = Blocking(out_shape, block_shape)
+        block_ids = blocks_in_volume(
+            out_shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
+        )
+
+        # static input-footprint bound: the affine image of a block box has
+        # per-axis extent <= |matrix| @ block_shape; +2 covers the floor/
+        # ceil stencil of trilinear sampling at both ends
+        buf_shape = tuple(
+            int(np.ceil(np.abs(matrix[i]) @ np.asarray(block_shape))) + 2
+            for i in range(3)
+        )
+        run = (
+            _resample_fn(buf_shape, order, fill_value, self.target)
+            if order == 1 else None
+        )
+        n_full = int(np.prod(block_shape))
+
+        def process(block_id):
+            bb = blocking.get_block(block_id).bb
+            # input coordinates of every output voxel in the block
+            grids = np.meshgrid(
+                *[np.arange(b.start, b.stop, dtype=np.float64) for b in bb],
+                indexing="ij",
+            )
+            out_coords = np.stack([g.ravel() for g in grids])
+            n_vox = out_coords.shape[1]
+            in_coords = matrix @ out_coords + offset[:, None]
+            lo = np.floor(in_coords.min(axis=1)).astype(np.int64)
+            local = in_coords - lo[:, None]
+            # scipy semantics: a coordinate outside [0, dim-1] yields pure
+            # cval — no partial blending into the outside region
+            outside = (
+                (in_coords < 0) | (in_coords > np.asarray(in_shape)[:, None] - 1)
+            ).any(axis=0)
+            out_block_shape = [b.stop - b.start for b in bb]
+
+            if order == 0:
+                # nearest-neighbor is a pure gather: do it on host in the
+                # ORIGINAL dtype — exact for any integer width (the float
+                # device path would silently round ids above 2^24), and a
+                # gather is the one op the device is no better at anyway
+                # scipy rounds half UP (floor(x + 0.5)); np.round would
+                # round half to even and disagree on every .5 coordinate
+                idx = np.floor(in_coords + 0.5).astype(np.int64)
+                np.clip(idx, 0, np.asarray(in_shape)[:, None] - 1, out=idx)
+                rd_lo, rd_hi = idx.min(axis=1), idx.max(axis=1) + 1
+                src = tuple(slice(a, b) for a, b in zip(rd_lo, rd_hi))
+                blockdata = np.asarray(inp[src])
+                samples = blockdata[tuple(idx - rd_lo[:, None])]
+                samples = np.where(
+                    outside, np.asarray(fill_value, inp.dtype), samples
+                ).reshape(out_block_shape)
+                out[bb] = samples.astype(inp.dtype)
+                return
+
+            # trilinear: device gather over the static fill-padded buffer
+            # (float32 on device — interpolated intensities, not ids)
+            read_lo = np.maximum(lo, 0)
+            read_hi = np.minimum(lo + np.asarray(buf_shape), in_shape)
+            buf = np.full(buf_shape, fill_value, dtype=np.float32)
+            if (read_hi > read_lo).all():
+                src = tuple(slice(a, b) for a, b in zip(read_lo, read_hi))
+                dst = tuple(
+                    slice(a - l, a - l + (b - a))
+                    for a, b, l in zip(read_lo, read_hi, lo)
+                )
+                buf[dst] = np.asarray(inp[src], np.float32)
+            if n_vox < n_full:
+                # pad edge blocks to the one static coords shape: a single
+                # compile serves every block (extra samples are cropped)
+                local = np.pad(local, ((0, 0), (0, n_full - n_vox)))
+            samples = np.asarray(
+                run(buf, local.astype(np.float32))
+            )[:n_vox].reshape(out_block_shape)
+            samples = np.where(outside.reshape(out_block_shape),
+                               fill_value, samples)
+            if np.issubdtype(inp.dtype, np.integer):
+                info = np.iinfo(inp.dtype)
+                samples = np.clip(np.round(samples), info.min, info.max)
+            out[bb] = samples.astype(inp.dtype)
+
+        n = self.host_block_map(block_ids, process)
+        return {"n_blocks": n, "out_shape": list(out_shape), "order": order}
+
+
+class AffineTransformLocal(AffineTransformBase):
+    target = "local"
+
+
+class AffineTransformTPU(AffineTransformBase):
+    target = "tpu"
+
+
+class TransformationsWorkflow(WorkflowBase):
+    task_name = "transformations_workflow"
+
+    def requires(self):
+        from . import transformations as tf_mod
+
+        return [
+            get_task_cls(tf_mod, "AffineTransform", self.target)(
+                tmp_folder=self.tmp_folder,
+                config_dir=self.config_dir,
+                max_jobs=self.max_jobs,
+                dependencies=self.dependencies,
+                **self.params,
+            )
+        ]

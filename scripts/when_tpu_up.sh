@@ -1,0 +1,37 @@
+#!/bin/bash
+# On-chip evidence battery, in priority order, for the moment the axon
+# tunnel recovers (it has come back only briefly before).  Each stage runs
+# in its own wall-clock-capped process so a re-wedge costs one stage, not
+# the battery.  Usage: scripts/when_tpu_up.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/tpu_battery.log}"
+say() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+say "=== TPU battery start ==="
+
+# 1. the north star: bench.py headline (self-capping, wedge-protected,
+#    writes the one-line JSON the driver records)
+say "stage 1: bench.py"
+timeout 5400 python bench.py >> "$LOG" 2>&1
+say "stage 1 exit: $?"
+
+# 2. Mosaic compile-time-vs-size table (the remaining wedge bisection)
+say "stage 2: compile table (pallas impl)"
+for t in ccl dt_ws fused; do
+  for e in 64 128 256 512; do
+    CT_PROBE_IMPL=pallas timeout 1500 python scripts/compile_table.py "$t" "$e" 32 >> "$LOG" 2>&1
+    say "  $t $e exit: $?"
+  done
+done
+
+# 3. per-kernel timing battery (quick first so partial recovery still
+#    yields numbers, then full scale)
+say "stage 3: tpu_measure quick"
+timeout 2400 python scripts/tpu_measure.py --quick >> "$LOG" 2>&1
+say "stage 3 quick exit: $?"
+say "stage 3: tpu_measure full"
+timeout 4800 python scripts/tpu_measure.py >> "$LOG" 2>&1
+say "stage 3 full exit: $?"
+
+say "=== TPU battery done — fold $LOG into docs/PERFORMANCE.md + BENCH json ==="

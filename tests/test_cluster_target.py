@@ -61,6 +61,71 @@ def fake_slurm(tmp_path, monkeypatch):
     return str(bindir)
 
 
+@pytest.fixture
+def fake_lsf(tmp_path, monkeypatch):
+    """Stub bsub/bjobs: bsub reads the script from stdin, launches it
+    detached, and prints 'Job <pid> is ...'; bjobs prints a RUN row while
+    the process lives and 'is not found' after."""
+    bindir = tmp_path / "fakebin_lsf"
+    bindir.mkdir()
+    _write_stub(
+        str(bindir / "bsub"),
+        "script=$(mktemp)\ncat > \"$script\"\n"
+        "out=/dev/null\n"
+        'prev=""\n'
+        'for a in "$@"; do if [ "$prev" = "-o" ]; then out="$a"; fi; '
+        'prev="$a"; done\n'
+        'JAX_PLATFORMS=cpu setsid bash "$script" > "$out" 2>&1 &\n'
+        'echo "Job <$!> is submitted to default queue."\n',
+    )
+    _write_stub(
+        str(bindir / "bjobs"),
+        'pid="${@: -1}"\n'
+        'if kill -0 "$pid" 2>/dev/null; then\n'
+        '  echo "$pid user RUN normal host1 host2 jobname"\n'
+        "else\n"
+        '  echo "Job <$pid> is not found" >&2\n'
+        "  exit 255\n"
+        "fi\n",
+    )
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return str(bindir)
+
+
+def test_threshold_task_on_lsf_target(rng, workspace, fake_lsf):
+    """The LSF trio member end-to-end: bsub takes the script on stdin,
+    bjobs liveness rows are parsed, 'is not found' means finished."""
+    from cluster_tools_tpu.tasks import thresholded_components as tc
+
+    tmp_folder, config_dir, root = workspace
+    data = rng.random((24, 24, 24)).astype(np.float32)
+    path = os.path.join(root, "cl_lsf.zarr")
+    f = file_reader(path)
+    f.require_dataset("raw", shape=data.shape, chunks=(16, 16, 16),
+                      dtype="float32")[...] = data
+
+    cls = get_task_cls(tc, "Threshold", "lsf")
+    assert cls.target == "lsf" and cls.__name__ == "ThresholdLSF"
+    t = cls(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        input_path=path,
+        input_key="raw",
+        output_path=path,
+        output_key="mask",
+        threshold=0.5,
+        block_shape=[16, 16, 16],
+        poll_interval_s=0.5,
+        submit_timeout_s=240,
+        result_grace_s=2.0,
+    )
+    assert build([t])
+    np.testing.assert_array_equal(
+        file_reader(path)["mask"][:], (data > 0.5).astype(np.uint8)
+    )
+
+
 def test_threshold_task_on_slurm_target(rng, workspace, fake_slurm):
     """A real task class runs via target='slurm': spec + sbatch script are
     written, the (stub) scheduler executes the runner remotely, the

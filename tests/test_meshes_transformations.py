@@ -1,0 +1,221 @@
+"""Meshes + affine-transformation task families, oracle-checked against
+numpy/scipy (SURVEY.md §2a possibly-present extras; §4 test strategy)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+from .helpers import random_blobs
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    tmp_folder = str(tmp_path / "tmp")
+    config_dir = str(tmp_path / "config")
+    os.makedirs(config_dir, exist_ok=True)
+    with open(os.path.join(config_dir, "global.config"), "w") as f:
+        json.dump({"block_shape": [16, 16, 16]}, f)
+    return tmp_folder, config_dir, str(tmp_path)
+
+
+def _dataset(root, name, data, chunks=(16, 16, 16)):
+    path = os.path.join(root, f"{name}.zarr")
+    f = file_reader(path)
+    ds = f.require_dataset(
+        name, shape=data.shape, chunks=chunks, dtype=str(data.dtype)
+    )
+    ds[...] = data
+    return path
+
+
+# ---------------------------------------------------------------- meshes
+
+
+def _edge_counts(faces):
+    e = np.concatenate(
+        [faces[:, [0, 1]], faces[:, [1, 2]], faces[:, [2, 0]]]
+    )
+    e = np.sort(e, axis=1)
+    _, counts = np.unique(e, axis=0, return_counts=True)
+    return counts
+
+
+def test_mesh_object_cube_exact():
+    """A 3x3x3 solid cube: 54 quads -> 108 triangles, 56 corner vertices,
+    watertight (every undirected edge on exactly 2 faces), and the signed
+    volume equals the voxel count (outward winding)."""
+    from cluster_tools_tpu.tasks.meshes import mesh_object, mesh_signed_volume
+
+    mask = np.ones((3, 3, 3), bool)
+    v, f = mesh_object(mask)
+    assert len(f) == 6 * 9 * 2
+    assert len(v) == 6 * 9 + 2  # cube surface corner count: 6n^2+2 for n=3
+    assert (_edge_counts(f) == 2).all()
+    assert mesh_signed_volume(v, f) == pytest.approx(27.0)
+
+
+def test_mesh_object_random_blob_volume_and_watertight(rng):
+    from cluster_tools_tpu.tasks.meshes import mesh_object, mesh_signed_volume
+
+    mask = ndi.binary_closing(
+        rng.random((12, 14, 10)) < 0.45, iterations=2
+    )
+    if not mask.any():
+        pytest.skip("degenerate draw")
+    v, f = mesh_object(mask, offset=(5, 7, 9))
+    assert (_edge_counts(f) == 2).all()
+    assert mesh_signed_volume(v, f) == pytest.approx(float(mask.sum()))
+    # offset applied
+    assert v[:, 0].min() >= 5 and v[:, 1].min() >= 7 and v[:, 2].min() >= 9
+
+
+def test_mesh_smoothing_keeps_topology_shrinks_volume():
+    from cluster_tools_tpu.tasks.meshes import mesh_object, mesh_signed_volume
+
+    mask = np.ones((4, 4, 4), bool)
+    v0, f0 = mesh_object(mask)
+    v1, f1 = mesh_object(mask, smoothing_iterations=5)
+    np.testing.assert_array_equal(f0, f1)  # connectivity untouched
+    assert (_edge_counts(f1) == 2).all()
+    # Laplacian relaxation pulls the cube toward a rounder, smaller body
+    assert 0.5 * 64 < mesh_signed_volume(v1, f1) < 64
+
+
+def test_mesh_workflow_end_to_end(rng, workspace):
+    from cluster_tools_tpu.tasks.meshes import MeshWorkflow, mesh_signed_volume
+
+    tmp_folder, config_dir, root = workspace
+    seg = ndi.label(random_blobs(rng, (32, 32, 32), p=0.3))[0].astype(np.uint64)
+    path = _dataset(root, "seg", seg)
+    wf = MeshWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="seg",
+        block_shape=[16, 16, 16],
+        export_obj=True,
+    )
+    assert build([wf])
+    mesh_d = os.path.join(tmp_folder, "meshes")
+    ids = [i for i in np.unique(seg) if i != 0]
+    for obj in ids:
+        with np.load(os.path.join(mesh_d, f"{int(obj)}.npz")) as f:
+            v, faces = f["vertices"], f["faces"]
+        # per-object CLOSED surface volume == voxel count (objects may be
+        # multi-component after blob overlap; volume is additive)
+        assert mesh_signed_volume(v, faces) == pytest.approx(
+            float((seg == obj).sum())
+        )
+        assert os.path.exists(os.path.join(mesh_d, f"{int(obj)}.obj"))
+
+
+# ------------------------------------------------------- transformations
+
+
+def _affine_case(rng, order, matrix, offset, shape=(24, 24, 24),
+                 fill=0.0, dtype=np.float32, out_shape=None):
+    data = (rng.random(shape) * 100).astype(dtype)
+    return data, ndi.affine_transform(
+        data.astype(np.float64), matrix, offset=offset, order=order,
+        mode="constant", cval=fill,
+        output_shape=out_shape or shape,
+    )
+
+
+def _run_affine(workspace, data, matrix, offset, order, fill=0.0,
+                out_shape=None):
+    from cluster_tools_tpu.tasks.transformations import TransformationsWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    path = _dataset(root, "vol", data)
+    wf = TransformationsWorkflow(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        target="local",
+        input_path=path,
+        input_key="vol",
+        output_path=path,
+        output_key="warped",
+        matrix=[list(map(float, r)) for r in matrix],
+        offset=[float(o) for o in offset],
+        order=order,
+        fill_value=fill,
+        out_shape=list(out_shape) if out_shape else None,
+        block_shape=[16, 16, 16],
+    )
+    assert build([wf])
+    return file_reader(path)["warped"][:]
+
+
+def test_affine_identity_roundtrip(rng, workspace):
+    data = (rng.random((24, 24, 24)) * 100).astype(np.float32)
+    got = _run_affine(workspace, data, np.eye(3), np.zeros(3), order=1)
+    np.testing.assert_allclose(got, data, rtol=1e-5, atol=1e-4)
+
+
+def test_affine_matches_scipy_order1(rng, workspace):
+    """Rotation+scale+translation vs scipy.ndimage.affine_transform."""
+    th = 0.3
+    rot = np.array(
+        [[1, 0, 0],
+         [0, np.cos(th), -np.sin(th)],
+         [0, np.sin(th), np.cos(th)]]
+    ) * 1.1
+    offset = np.array([1.5, -2.0, 3.25])
+    data, want = _affine_case(rng, 1, rot, offset)
+    got = _run_affine(workspace, data, rot, offset, order=1)
+    np.testing.assert_allclose(got, want.astype(np.float32), atol=1e-3)
+
+
+def test_affine_matches_scipy_order0_labels(rng, workspace):
+    """Nearest-neighbor on integer labels: exact match, labels preserved."""
+    matrix = np.diag([0.5, 0.5, 0.5])
+    offset = np.array([2.0, 0.0, -1.0])
+    data = rng.integers(0, 7, size=(24, 24, 24)).astype(np.uint32)
+    want = ndi.affine_transform(
+        data, matrix, offset=offset, order=0, mode="constant", cval=0
+    )
+    got = _run_affine(workspace, data, matrix, offset, order=0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_affine_order0_preserves_huge_label_ids(rng, workspace):
+    """Nearest-neighbor must be exact for label ids above 2^24 (where a
+    float32 round-trip silently merges ids) — the host-gather path."""
+    matrix = np.diag([0.9, 1.0, 1.1])
+    offset = np.array([0.4, -0.6, 1.1])
+    base = np.uint64(1 << 24)
+    data = (
+        rng.integers(1, 1000, size=(24, 24, 24)).astype(np.uint64) + base
+    )
+    want = ndi.affine_transform(
+        data, matrix, offset=offset, order=0, mode="constant", cval=0
+    )
+    got = _run_affine(workspace, data, matrix, offset, order=0)
+    np.testing.assert_array_equal(got, want)
+    assert got.max() > base  # the big ids actually flowed through
+
+
+def test_affine_fill_value_and_out_shape(rng, workspace):
+    """Translation pushing past the volume edge reads fill_value; the
+    output grid can differ from the input grid."""
+    matrix = np.eye(3)
+    offset = np.array([-20.0, 0.0, 0.0])  # out[0] samples in[-20]: outside
+    data, want = _affine_case(
+        rng, 1, matrix, offset, fill=7.5, out_shape=(32, 24, 24)
+    )
+    got = _run_affine(
+        workspace, data, matrix, offset, order=1, fill=7.5,
+        out_shape=(32, 24, 24),
+    )
+    assert got.shape == (32, 24, 24)
+    np.testing.assert_allclose(got, want.astype(np.float32), atol=1e-3)
