@@ -37,8 +37,12 @@ def host_dt_watershed(
     min_seed_distance: float = 0.0,
     mask: Optional[np.ndarray] = None,
     sampling: Optional[Tuple[float, ...]] = None,
+    fg: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Distance-transform watershed of a boundary map, scipy single-core.
+
+    ``fg`` lets the caller pass an already-thresholded foreground (the
+    fused host pipeline thresholds once for ws + CC + count).
 
     Foreground is ``vol < threshold`` (low boundary evidence), seeds are
     EDT local maxima at least ``min_seed_distance`` from the boundary;
@@ -52,9 +56,10 @@ def host_dt_watershed(
     """
     from scipy import ndimage
 
-    fg = vol < threshold
+    if fg is None:
+        fg = vol < threshold
     if mask is not None:
-        fg &= mask
+        fg = fg & mask
     dist = ndimage.distance_transform_edt(fg, sampling=sampling)
     if dt_max_distance is not None:
         dist = np.minimum(dist, float(dt_max_distance))
@@ -83,6 +88,7 @@ def host_ws_ccl(
         dt_max_distance=dt_max_distance,
         min_seed_distance=min_seed_distance,
         sampling=sampling,
+        fg=fg,
     )
     cc = host_label_components(fg)
     return ws, cc, int(fg.sum())

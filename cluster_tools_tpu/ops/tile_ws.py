@@ -366,31 +366,47 @@ def fill_unseeded_basins(
         return changed & (it < max_rounds)
 
     eid = jnp.arange(a.shape[0], dtype=jnp.int32)
+    # composite weight (saddle, edge_id): globally distinct and seen
+    # identically from both endpoints, so the min-edge graph is a forest
+    # plus 2-cycles only (the classic Boruvka distinct-weight argument) —
+    # ties on raw saddle height cannot form longer hook cycles.  The
+    # lexicographic min per root is computed as TWO int32 scatter-mins
+    # (saddle, then edge-id among saddle ties) instead of a 4-array sort:
+    # a full sort is ~10x the cost of a gather/scatter pass on the TPU
+    # (docs/PERFORMANCE.md "Where the time goes"), so each Boruvka round
+    # drops from sort-bound to a handful of gather-class passes.
 
     def round_body(s):
         P, _, it = s
         ra = P[da]
         rb = P[db]
         alive = (ra != rb) & (~edge_pad)
-        # orient every edge both ways; only negative-valued roots hook.
-        # Composite weight (saddle, edge_id) is globally distinct and seen
-        # identically from both endpoints, so the min-edge graph is a forest
-        # plus 2-cycles only (the classic Boruvka distinct-weight argument) —
-        # ties on raw saddle height cannot form longer hook cycles.
-        keys = jnp.concatenate([ra, rb])
-        partners = jnp.concatenate([rb, ra])
-        sk = jnp.concatenate([hk, hk])
-        ek = jnp.concatenate([eid, eid])
-        live = jnp.concatenate([alive, alive]) & (
-            jnp.concatenate([uniq[ra], uniq[rb]]) <= -2
-        )
-        keys = jnp.where(live, keys, jnp.int32(BIG))
-        keys, _, _, partners = lax.sort((keys, sk, ek, partners), num_keys=3)
-        first = (keys != _shift1(keys, 0, BIG)) & (keys < BIG)
+        # orient every edge both ways; only negative-valued roots hook
+        live_a = alive & (uniq[ra] <= -2)
+        live_b = alive & (uniq[rb] <= -2)
         np_ = P.shape[0]
+        # init with int32 max, NOT BIG: sortable keys of saddles >= 2.0
+        # exceed 2^30 and must still win the scatter-min
+        i32max = jnp.iinfo(jnp.int32).max
+        best_h = jnp.full((np_,), jnp.int32(i32max))
+        best_h = best_h.at[jnp.where(live_a, ra, np_)].min(hk, mode="drop")
+        best_h = best_h.at[jnp.where(live_b, rb, np_)].min(hk, mode="drop")
+        tie_a = live_a & (best_h[ra] == hk)
+        tie_b = live_b & (best_h[rb] == hk)
+        best_e = jnp.full((np_,), jnp.int32(i32max))
+        best_e = best_e.at[jnp.where(tie_a, ra, np_)].min(eid, mode="drop")
+        best_e = best_e.at[jnp.where(tie_b, rb, np_)].min(eid, mode="drop")
+        # per root exactly one (edge, side) attains the lexicographic min —
+        # except the two sides of ONE edge when both its roots pick it,
+        # which is precisely the 2-cycle the break below resolves
+        win_a = tie_a & (best_e[ra] == eid)
+        win_b = tie_b & (best_e[rb] == eid)
         parent2 = jnp.arange(np_, dtype=jnp.int32)
-        parent2 = parent2.at[jnp.where(first, keys, np_)].set(
-            jnp.where(first, partners, 0), mode="drop"
+        parent2 = parent2.at[jnp.where(win_a, ra, np_)].set(
+            jnp.where(win_a, rb, 0), mode="drop"
+        )
+        parent2 = parent2.at[jnp.where(win_b, rb, np_)].set(
+            jnp.where(win_b, ra, 0), mode="drop"
         )
         # break 2-cycles: the lower id stays a root
         pp = parent2[parent2]
