@@ -117,6 +117,55 @@ def test_mesh_workflow_end_to_end(rng, workspace):
         assert os.path.exists(os.path.join(mesh_d, f"{int(obj)}.obj"))
 
 
+def test_derived_artifacts_capstone_on_synthetic_em(workspace):
+    """The post-segmentation product chain on EM-shaped anisotropic
+    objects: segmentation -> morphology -> meshes + skeletons, with the
+    mesh volume integrity check against per-object voxel counts and the
+    skeletons staying inside their objects' bounding boxes."""
+    from cluster_tools_tpu.utils.synthetic import synthetic_em_volume
+    from cluster_tools_tpu.tasks.meshes import MeshWorkflow, mesh_signed_volume
+    from cluster_tools_tpu.tasks.skeletons import SkeletonWorkflow
+
+    tmp_folder, config_dir, root = workspace
+    shape = (16, 48, 48)
+    _, gt, mask = synthetic_em_volume(
+        shape=shape, n_objects=4, sampling=(40.0, 4.0, 4.0), seed=11
+    )
+    seg = (gt * mask).astype(np.uint64)
+    path = _dataset(root, "seg", seg, chunks=(8, 16, 16))
+
+    common = dict(
+        config_dir=config_dir, max_jobs=2, target="local",
+        input_path=path, input_key="seg", block_shape=[8, 16, 16],
+    )
+    assert build([MeshWorkflow(tmp_folder=tmp_folder, export_obj=True,
+                               **common)])
+    assert build([SkeletonWorkflow(tmp_folder=tmp_folder, export_swc=True,
+                                   sampling=[40.0, 4.0, 4.0],
+                                   link_radius=80.0, **common)])
+
+    ids = [int(i) for i in np.unique(seg) if i != 0]
+    assert ids
+    for obj in ids:
+        with np.load(os.path.join(tmp_folder, "meshes", f"{obj}.npz")) as f:
+            v, faces = f["vertices"], f["faces"]
+        assert mesh_signed_volume(v, faces) == pytest.approx(
+            float((seg == obj).sum())
+        )
+        with np.load(os.path.join(tmp_folder, "skeletons", f"{obj}.npz")) as f:
+            nodes = f["nodes"]
+        assert len(nodes)
+        zyx = np.argwhere(seg == obj)
+        lo, hi = zyx.min(axis=0), zyx.max(axis=0)
+        # node coords come from argwhere on the crop: exactly within the
+        # bbox — no slack, so a +/-1 pad/offset regression fails here
+        assert (nodes[:, :3] >= lo).all() and (nodes[:, :3] <= hi).all()
+        assert (nodes[:, 3] > 0).all()  # medial radii (physical units)
+        assert os.path.exists(
+            os.path.join(tmp_folder, "skeletons", f"{obj}.swc")
+        )
+
+
 # ------------------------------------------------------- transformations
 
 
