@@ -308,6 +308,7 @@ def fill_unseeded_basins(
     h = height.astype(jnp.float32)
     evs_a, evs_b, evs_h = [], [], []
     overflow = _match_vma(jnp.zeros((), jnp.int32), labels)
+    n_total = _match_vma(jnp.zeros((), jnp.int32), labels)
     for axis in range(3):
         na = labels.shape[axis]
         a = lax.slice_in_dim(labels, 0, na - 1, axis=axis)
@@ -322,6 +323,7 @@ def fill_unseeded_basins(
         )
         (pa, pb, ph), kept = _compact(keep, (a, b, saddle), fill_cap, BIG)
         overflow = jnp.maximum(overflow, (kept > fill_cap).astype(jnp.int32))
+        n_total = n_total + jnp.minimum(kept, fill_cap)
         evs_a.append(pa)
         evs_b.append(pb)
         evs_h.append(ph)
@@ -329,11 +331,9 @@ def fill_unseeded_basins(
     b = jnp.concatenate(evs_b)
     hk = jnp.concatenate(evs_h)
 
-    # dedup to unique (a, b) adjacencies with their min saddle: ascending
-    # sort puts each pair's lowest saddle first and the BIG padding last.
-    # Default capacity must stay OBJECT-scale at every volume size or the
-    # restructure buys nothing — ``labels.size // 128`` keeps it ~6x below
-    # the raw 3*fill_cap buffer at 512³ (1.05M vs 6.3M) while the
+    # Default adjacency capacity must stay OBJECT-scale at every volume
+    # size or the dedup buys nothing — ``labels.size // 128`` keeps it ~6x
+    # below the raw 3*fill_cap buffer at 512³ (1.05M vs 6.3M) while the
     # DEFAULT_ADJ_CAP floor covers pure-noise small volumes (~size/27
     # basins, a few adjacencies each).  Overflow is flagged; a pure-noise
     # large shard should raise adj_cap explicitly.
@@ -341,6 +341,50 @@ def fill_unseeded_basins(
         adj_cap = min(
             3 * fill_cap, max(DEFAULT_ADJ_CAP, labels.size // 128)
         )
+
+    # Capacity tiering: every sort below runs at its STATIC buffer size,
+    # so a realistic seeded volume (few unseeded basins) would pay the
+    # full 3*fill_cap dedup sort for a buffer that is ~all padding.  When
+    # the runtime face count fits 1/16 of the buffer, compact the real
+    # entries to that small size and run the ENTIRE dedup+Boruvka machine
+    # on it (a lax.cond — one branch executes).  The small tier cannot
+    # itself overflow: its adjacency capacity equals its input capacity
+    # and dedup only shrinks.
+    small_n = min(adj_cap, max(3 * 16384, a.shape[0] // 16))
+    m2_out = 2 * adj_cap
+
+    def _small(args):
+        aa, bb, hh = args
+        (ca, cb, ch), _ = _compact(aa < BIG, (aa, bb, hh), small_n, BIG)
+        ev, ef, ovf = _fill_core(ca, cb, ch, small_n, max_rounds, labels)
+        pad = m2_out - ev.shape[0]
+        return (
+            jnp.pad(ev, (0, pad), constant_values=BIG),
+            jnp.pad(ef, (0, pad), constant_values=BIG),
+            ovf,
+        )
+
+    def _big(args):
+        aa, bb, hh = args
+        return _fill_core(aa, bb, hh, adj_cap, max_rounds, labels)
+
+    edge_vals, edge_finals, core_overflow = lax.cond(
+        n_total <= small_n, _small, _big, (a, b, hk)
+    )
+    overflow = jnp.maximum(overflow, core_overflow)
+    return edge_vals, edge_finals, overflow > 0
+
+
+def _fill_core(a, b, hk, adj_cap, max_rounds, vma_like):
+    """Dedup + dense ids + Boruvka rounds over one capacity tier.
+
+    Returns ``(edge_vals, edge_finals, overflow_int32)`` with outputs
+    sized ``2 * adj_cap``; ``vma_like`` carries the shard_map varying-axes
+    signature for freshly created arrays.
+    """
+    overflow = _match_vma(jnp.zeros((), jnp.int32), vma_like)
+    # dedup to unique (a, b) adjacencies with their min saddle: ascending
+    # sort puts each pair's lowest saddle first and the BIG padding last
     sa, sb, sh = lax.sort((a, b, hk), num_keys=3)
     first = (sa != _shift1(sa, 0, BIG)) | (sb != _shift1(sb, 0, BIG))
     keep_adj = first & (sa < BIG)
@@ -359,7 +403,7 @@ def fill_unseeded_basins(
     da, db = dense[: a.shape[0]], dense[a.shape[0]:]
     edge_pad = a >= BIG
 
-    parent = _match_vma(jnp.arange(m2, dtype=jnp.int32), labels)
+    parent = _match_vma(jnp.arange(m2, dtype=jnp.int32), vma_like)
 
     def round_cond(s):
         _, changed, it = s
@@ -430,7 +474,7 @@ def fill_unseeded_basins(
     # remap for every unseeded endpoint value
     edge_vals = uniq
     edge_finals = jnp.where(uniq <= -2, final_of, uniq)
-    return edge_vals, edge_finals, overflow > 0
+    return edge_vals, edge_finals, overflow
 
 
 @partial(
