@@ -132,6 +132,42 @@ def _compact(
     return tuple(out), n_kept
 
 
+def run_capacity_tiered(arrays, n_total, big_cap, core, n_padded,
+                        max_rounds, vma_like):
+    """Run ``core(*arrays, cap, max_rounds, vma_like)`` at 1/16 capacity
+    when the runtime entry count allows.
+
+    Every sort inside a merge core runs at its STATIC buffer size, so a
+    typical volume (real entries ≪ capacity) would sort ~all padding.
+    When ``n_total`` fits the small tier, the real entries are compacted
+    (``BIG`` marks padding) and the ENTIRE core runs at that size; its
+    capacity-proportional outputs (the first ``n_padded`` of the returned
+    tuple) are padded back to the big-tier sizes with ``BIG``.  The small
+    tier cannot overflow: its capacity equals its input capacity and
+    dedup only shrinks.  Used by :func:`merge_face_pairs` and
+    :func:`~cluster_tools_tpu.ops.tile_ws.fill_unseeded_basins` — retune
+    the 1/16 threshold in ONE place.
+    """
+    small_n = min(big_cap, max(3 * 16384, arrays[0].shape[0] // 16))
+
+    def _small(args):
+        compacted, _ = _compact(args[0] < BIG, args, small_n, BIG)
+        out = core(*compacted, small_n, max_rounds, vma_like)
+        padded = tuple(
+            jnp.pad(
+                x, (0, (x.shape[0] // small_n) * big_cap - x.shape[0]),
+                constant_values=BIG,
+            )
+            for x in out[:n_padded]
+        )
+        return padded + out[n_padded:]
+
+    def _big(args):
+        return core(*args, big_cap, max_rounds, vma_like)
+
+    return lax.cond(n_total <= small_n, _small, _big, tuple(arrays))
+
+
 def _face_pairs_axis(
     labels: jnp.ndarray, tile: Tuple[int, int, int], axis: int, pair_cap: int
 ):
@@ -179,15 +215,29 @@ def merge_face_pairs(
     """
     pair_lists = []
     overflow = _match_vma(jnp.zeros((), jnp.int32), labels)
+    n_total = _match_vma(jnp.zeros((), jnp.int32), labels)
     for axis in range(3):
         (pa, pb), kept = _face_pairs_axis(labels, tile, axis, pair_cap)
         pair_lists.append((pa, pb))
         overflow = jnp.maximum(overflow, (kept > pair_cap).astype(jnp.int32))
+        n_total = n_total + jnp.minimum(kept, pair_cap)
     # the concat inherits the labels' varying-manual-axes type even when every
     # axis had a single tile (all-constant empty pair lists) — required for
     # the while_loop carries below under shard_map
     a = _match_vma(jnp.concatenate([p[0] for p in pair_lists]), labels)
     b = _match_vma(jnp.concatenate([p[1] for p in pair_lists]), labels)
+
+    ea, eb, root_a, root_b, n_edges, core_ovf = run_capacity_tiered(
+        (a, b), n_total, edge_cap, _merge_core, 4, max_rounds, labels
+    )
+    overflow = jnp.maximum(overflow, core_ovf)
+    return ea, eb, root_a, root_b, n_edges, overflow > 0
+
+
+def _merge_core(a, b, edge_cap, max_rounds, vma_like):
+    """Dedup + dense-id union-find over one capacity tier; outputs sized
+    ``edge_cap`` (``BIG``-padded), overflow as int32."""
+    overflow = _match_vma(jnp.zeros((), jnp.int32), vma_like)
     # value-dedup: one small sort, duplicates & padding end up adjacent/last
     a, b = lax.sort((a, b), num_keys=2)
     dup = (a == _shift1(a, 0, -1)) & (b == _shift1(b, 0, -1))
@@ -208,7 +258,7 @@ def merge_face_pairs(
     dense = jnp.zeros((m2,), jnp.int32).at[sslots].set(rank)
     da, db = dense[:edge_cap], dense[edge_cap:]
 
-    parent = _match_vma(jnp.arange(m2, dtype=jnp.int32), labels)
+    parent = _match_vma(jnp.arange(m2, dtype=jnp.int32), vma_like)
 
     def cond(s):
         _, changed, it = s
@@ -238,7 +288,7 @@ def merge_face_pairs(
     root_b = uniq[parent[db]]
     root_a = jnp.where(ea < BIG, root_a, jnp.int32(BIG))
     root_b = jnp.where(eb < BIG, root_b, jnp.int32(BIG))
-    return ea, eb, root_a, root_b, n_edges, overflow > 0
+    return ea, eb, root_a, root_b, n_edges, overflow
 
 
 def _tile_id_of(v: jnp.ndarray, shape, tile) -> jnp.ndarray:

@@ -57,6 +57,7 @@ from .tile_ccl import (
     _tile_for,
     _tile_id_of,
     build_remap_tables,
+    run_capacity_tiered,
 )
 
 _BIGF = np.float32(3e38)
@@ -342,34 +343,13 @@ def fill_unseeded_basins(
             3 * fill_cap, max(DEFAULT_ADJ_CAP, labels.size // 128)
         )
 
-    # Capacity tiering: every sort below runs at its STATIC buffer size,
-    # so a realistic seeded volume (few unseeded basins) would pay the
-    # full 3*fill_cap dedup sort for a buffer that is ~all padding.  When
-    # the runtime face count fits 1/16 of the buffer, compact the real
-    # entries to that small size and run the ENTIRE dedup+Boruvka machine
-    # on it (a lax.cond — one branch executes).  The small tier cannot
-    # itself overflow: its adjacency capacity equals its input capacity
-    # and dedup only shrinks.
-    small_n = min(adj_cap, max(3 * 16384, a.shape[0] // 16))
-    m2_out = 2 * adj_cap
-
-    def _small(args):
-        aa, bb, hh = args
-        (ca, cb, ch), _ = _compact(aa < BIG, (aa, bb, hh), small_n, BIG)
-        ev, ef, ovf = _fill_core(ca, cb, ch, small_n, max_rounds, labels)
-        pad = m2_out - ev.shape[0]
-        return (
-            jnp.pad(ev, (0, pad), constant_values=BIG),
-            jnp.pad(ef, (0, pad), constant_values=BIG),
-            ovf,
-        )
-
-    def _big(args):
-        aa, bb, hh = args
-        return _fill_core(aa, bb, hh, adj_cap, max_rounds, labels)
-
-    edge_vals, edge_finals, core_overflow = lax.cond(
-        n_total <= small_n, _small, _big, (a, b, hk)
+    # Capacity tiering: a realistic seeded volume (few unseeded basins)
+    # would pay the full 3*fill_cap dedup sort on ~all padding — the
+    # common case runs the whole dedup+Boruvka machine at 1/16 size
+    # (rationale + the shared threshold live in
+    # tile_ccl.run_capacity_tiered).
+    edge_vals, edge_finals, core_overflow = run_capacity_tiered(
+        (a, b, hk), n_total, adj_cap, _fill_core, 2, max_rounds, labels
     )
     overflow = jnp.maximum(overflow, core_overflow)
     return edge_vals, edge_finals, overflow > 0
