@@ -145,8 +145,10 @@ def run_capacity_tiered(arrays, n_total, big_cap, core, n_padded,
     tuple) are padded back to the big-tier sizes with ``BIG``.  The small
     tier cannot overflow: its capacity equals its input capacity and
     dedup only shrinks.  Used by :func:`merge_face_pairs` and
-    :func:`~cluster_tools_tpu.ops.tile_ws.fill_unseeded_basins` — retune
-    the 1/16 threshold in ONE place.
+    :func:`~cluster_tools_tpu.ops.tile_ws.fill_unseeded_basins`;
+    ``tile_ws.chase_exits`` carries a slot-aligned variant of the same
+    1/16 tier inline (it must scatter results back, not tail-pad) —
+    retune the ratio in both places together.
     """
     small_n = min(big_cap, max(3 * 16384, arrays[0].shape[0] // 16))
 

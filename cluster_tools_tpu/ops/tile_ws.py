@@ -246,28 +246,58 @@ def chase_exits(values: jnp.ndarray, codes: jnp.ndarray, max_hops: int = 256):
     (>0), 0, or the unseeded terminal code of its basin), and a flag that is
     True when a chain exceeded ``max_hops`` (finals then hold intermediate
     codes — callers must fold this into their overflow report).
+
+    Per hop the chase gathers ``codes``-many volume entries, and ``codes``
+    is a STATIC capacity buffer — so like the merge cores this tiers: when
+    the runtime active-code count fits 1/16 of the buffer, the chain loop
+    runs on the compacted codes and the finals scatter back to their
+    original slots (identical results — each chain is chased
+    independently).
     """
     n = values.size
     flat = values.ravel()
-    active0 = codes <= -2
-    g = jnp.where(active0, -codes - 2, 0)
-    val = jnp.where(active0, flat[jnp.clip(g, 0, n - 1)], codes)
 
-    def cond(s):
-        _, _, moved, hops = s
-        return moved & (hops < max_hops)
+    def _core(c):
+        active0 = c <= -2
+        g = jnp.where(active0, -c - 2, 0)
+        val = jnp.where(active0, flat[jnp.clip(g, 0, n - 1)], c)
 
-    def body(s):
-        g, val, _, hops = s
-        active = (val <= -2) & (val != -g - 2)
-        g2 = jnp.where(active, -val - 2, g)
-        val2 = jnp.where(active, flat[jnp.clip(g2, 0, n - 1)], val)
-        return g2, val2, jnp.any(active), hops + 1
+        def cond(s):
+            _, _, moved, hops = s
+            return moved & (hops < max_hops)
 
-    g, val, moved, _ = lax.while_loop(
-        cond, body, (g, val, _true_like(g), jnp.int32(0))
-    )
-    return jnp.where(active0, val, codes), moved
+        def body(s):
+            g, val, _, hops = s
+            active = (val <= -2) & (val != -g - 2)
+            g2 = jnp.where(active, -val - 2, g)
+            val2 = jnp.where(active, flat[jnp.clip(g2, 0, n - 1)], val)
+            return g2, val2, jnp.any(active), hops + 1
+
+        g, val, moved, _ = lax.while_loop(
+            cond, body, (g, val, _true_like(g), jnp.int32(0))
+        )
+        return jnp.where(active0, val, c), moved
+
+    # tier selection mirrors tile_ccl.run_capacity_tiered (same 1/16
+    # ratio — retune together) but needs a slot-aligned scatter-back
+    # instead of the helper's tail-padding, and a 1x floor (the input is
+    # one buffer, not a 3-axis concat)
+    cap = codes.shape[0]
+    small_n = max(16384, cap // 16)
+    if small_n >= cap:
+        return _core(codes)
+
+    def _small(c):
+        (pc, slots), _ = _compact(
+            c <= -2, (c, jnp.arange(cap, dtype=jnp.int32)), small_n, BIG
+        )
+        fin_s, moved = _core(pc)
+        # non-active codes map to themselves; padded slots (BIG) drop
+        out = c.at[slots].set(fin_s, mode="drop")
+        return out, moved
+
+    n_active = (codes <= -2).sum()
+    return lax.cond(n_active <= small_n, _small, _core, codes)
 
 
 def _resolve_codes_gather(values: jnp.ndarray, codes, finals) -> jnp.ndarray:

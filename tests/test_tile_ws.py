@@ -136,6 +136,46 @@ def test_overflow_flag(rng):
     assert bool(ovf)
 
 
+def test_chase_exits_small_tier_matches_oracle(rng):
+    """The chase's small tier (compact -> chase -> scatter-back) only
+    engages for capacity buffers > 16*16384, which no workflow test
+    reaches — drive it directly against a numpy chain-following oracle."""
+    from cluster_tools_tpu.ops.tile_ws import BIG, chase_exits
+
+    n = 4096
+    values = np.zeros(n, np.int32)
+    # deterministic ACYCLIC chains: indices below 3584 point 512 ahead
+    # (<= 8 hops to a terminal), the top 512 hold labels (>0) or 0
+    for g in range(3584):
+        values[g] = -(g + 512 + 2)
+    for g in range(3584, n):
+        values[g] = 0 if g % 3 == 0 else (g % 97) + 1
+    cap = 16 * 16384 + 1024  # force small_n < cap -> tiered path
+    n_active = 512  # << small_n -> the small tier is taken
+    rng_ = np.random.default_rng(0)
+    codes = np.full(cap, BIG, np.int32)
+    codes[:n_active] = -(rng_.integers(0, n, size=n_active) + 2)
+
+    import jax.numpy as jnp
+
+    finals, unconverged = chase_exits(
+        jnp.asarray(values.reshape(16, 16, 16)), jnp.asarray(codes)
+    )
+    finals = np.asarray(finals)
+    assert not bool(unconverged)
+
+    def oracle(code):
+        val = values[-code - 2]
+        while val <= -2:
+            val = values[-val - 2]
+        return val
+
+    for i in range(n_active):
+        assert finals[i] == oracle(codes[i]), i
+    # padding and non-active slots unchanged
+    np.testing.assert_array_equal(finals[n_active:], codes[n_active:])
+
+
 def test_sparse_seed_noise_fill_knobs(rng):
     """Sparse seeds in a noise-heavy volume exceed the default fill
     capacities (many small unseeded basins) — the overflow flag must say
