@@ -145,7 +145,8 @@ def run_capacity_tiered(arrays, n_total, big_cap, core, n_padded,
     tuple) are padded back to the big-tier sizes with ``BIG``.  The small
     tier cannot overflow: its capacity equals its input capacity and
     dedup only shrinks.  Used by :func:`merge_face_pairs` and
-    :func:`~cluster_tools_tpu.ops.tile_ws.fill_unseeded_basins`;
+    ``tile_ws``'s :func:`~cluster_tools_tpu.ops.tile_ws.fill_unseeded_basins`
+    and :func:`~cluster_tools_tpu.ops.tile_ws.collect_negative_values`;
     ``tile_ws.chase_exits`` carries a slot-aligned variant of the same
     1/16 tier inline (it must scatter results back, not tail-pad) —
     retune the ratio in both places together.

@@ -185,6 +185,7 @@ def collect_negative_values(
     """
     vs, ts = [], []
     overflow = _match_vma(jnp.zeros((), jnp.int32), values)
+    n_total = overflow
     for axis in range(3):
         for side in (0, 1):
             sl, tid = _strip_entries(values, tile, axis, side)
@@ -195,16 +196,27 @@ def collect_negative_values(
             keep = neg & ((sl != prev) | (tid != prev_t))
             (v, t_), kept = _compact(keep, (sl, tid), cap, BIG)
             overflow = jnp.maximum(overflow, (kept > cap).astype(jnp.int32))
+            n_total = n_total + jnp.minimum(kept, cap)
             vs.append(v)
             ts.append(t_)
     v = jnp.concatenate(vs)
     t_ = jnp.concatenate(ts)
+    # the value-dedup sort runs at the static 6*cap concat size — tier it
+    # like the merge cores (shared rationale in run_capacity_tiered)
+    cv, ct, n_kept = run_capacity_tiered(
+        (v, t_), n_total, cap, _collect_core, 2, 0, values
+    )
+    overflow = jnp.maximum(overflow, (n_kept > cap).astype(jnp.int32))
+    return cv, ct, overflow > 0
+
+
+def _collect_core(v, t_, cap, _max_rounds, _vma_like):
+    """Sort-dedup one (value, tile) tier; outputs sized ``cap``."""
     v, t_ = lax.sort((v, t_), num_keys=2)
     dup = (v == _shift1(v, 0, BIG)) & (t_ == _shift1(t_, 0, BIG))
     keep = (~dup) & (v < BIG)
     (cv, ct), n_kept = _compact(keep, (v, t_), cap, BIG)
-    overflow = jnp.maximum(overflow, (n_kept > cap).astype(jnp.int32))
-    return cv, ct, overflow > 0
+    return cv, ct, n_kept
 
 
 def value_join(
