@@ -244,7 +244,11 @@ def sharded_label_components(
                 f"{n_shards} shards x {cap} labels still overflow int32"
             )
         local = jnp.where(raw == n_slab, 0, raw + 1).astype(jnp.int32)
-        dense, n_fg = relabel_consecutive(local, max_labels=cap)
+        # labels are slab flat indices + 1: pass the true value span so the
+        # bitmap fast path engages (the default infers from labels.size)
+        dense, n_fg = relabel_consecutive(
+            local, max_labels=cap, value_bound=n_slab
+        )
         overflow = overflow | (n_fg > cap)
         glob = jnp.where(dense > 0, dense + rank * jnp.int32(cap + 1), 0)
 

@@ -197,7 +197,12 @@ def _ws_ccl_shard(
                 raise ValueError(
                     f"{n_shards} shards x {cap} ws fragments overflow int32"
                 )
-            ws, n_frag = relabel_consecutive(ws, max_labels=cap)
+            # ws fragment ids are PADDED-volume flat indices (+1), which
+            # exceed the halo-cropped labels.size — pass the padded span
+            # or the bitmap fast path silently never engages here
+            ws, n_frag = relabel_consecutive(
+                ws, max_labels=cap, value_bound=n_pad + 1
+            )
             ws_overflow = jnp.maximum(
                 ws_overflow, (n_frag > cap).astype(jnp.int32)
             )

@@ -59,6 +59,7 @@ def test_ccl_batch(rng):
 
 def test_relabel_consecutive():
     labels = jnp.asarray(np.array([[0, 5, 5], [9, 0, 123], [9, 5, 0]], np.int32))
+    # 123 > labels.size: exercises the sort fallback branch
     dense, n = relabel_consecutive(labels, max_labels=10)
     dense = np.asarray(dense)
     assert int(n) == 3
@@ -66,3 +67,24 @@ def test_relabel_consecutive():
     assert (dense == 0).sum() == 3
     # order-preserving
     assert dense[0, 1] == 1 and dense[1, 0] == 2 and dense[1, 2] == 3
+
+
+def test_relabel_consecutive_bitmap_matches_sort(rng):
+    """The bitmap fast path (values within the domain bound) must agree
+    with the sort fallback exactly — same dense ids, same count; and
+    value_bound must re-enable the fast path for padded-domain labels."""
+    lab = rng.integers(0, 24**3, size=(24, 24, 24)).astype(np.int32)
+    lab[rng.random(lab.shape) < 0.4] = 0
+    fast, n1 = relabel_consecutive(jnp.asarray(lab), max_labels=1 << 15)
+    # shift into a domain above labels.size -> sort branch; dense result
+    # must be identical (relabeling is order-preserving either way)
+    shifted = np.where(lab > 0, lab + 20_000_000, 0).astype(np.int32)
+    slow, n2 = relabel_consecutive(jnp.asarray(shifted), max_labels=1 << 15)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+    assert int(n1) == int(n2) == len(np.unique(lab[lab > 0]))
+    # padded-domain labels + value_bound: fast path, same answer
+    vb, n3 = relabel_consecutive(
+        jnp.asarray(shifted), max_labels=1 << 15, value_bound=24**3 + 20_000_001
+    )
+    np.testing.assert_array_equal(np.asarray(vb), np.asarray(fast))
+    assert int(n3) == int(n1)
