@@ -146,10 +146,12 @@ def run_capacity_tiered(arrays, n_total, big_cap, core, n_padded,
     tier cannot overflow: its capacity equals its input capacity and
     dedup only shrinks.  Used by :func:`merge_face_pairs` and
     ``tile_ws``'s :func:`~cluster_tools_tpu.ops.tile_ws.fill_unseeded_basins`
-    and :func:`~cluster_tools_tpu.ops.tile_ws.collect_negative_values`;
-    ``tile_ws.chase_exits`` carries a slot-aligned variant of the same
-    1/16 tier inline (it must scatter results back, not tail-pad) —
-    retune the ratio in both places together.
+    and :func:`~cluster_tools_tpu.ops.tile_ws.collect_negative_values`.
+    Inline variants of the same 1/16 tier (they need slot-aligned
+    scatter-back or shape-independent outputs rather than tail-padding)
+    live in :func:`build_remap_tables` (this module),
+    ``tile_ws.chase_exits``, and ``tile_ws.value_join`` — retune the
+    ratio in ALL of these together.
     """
     small_n = min(big_cap, max(3 * 16384, arrays[0].shape[0] // 16))
 
@@ -317,7 +319,30 @@ def build_remap_tables(
     entry); duplicates of (tile, old) collapse to one slot.  Returns
     ``(old_tbl, new_tbl, overflow)`` with tables shaped
     ``(n_tiles, table_cap)``; unused slots hold -1.
+
+    The sort runs at the static input size; table shapes don't depend on
+    it, so the usual 1/16 capacity tier applies with no scatter-back —
+    entries are just compacted first when the live count fits.
     """
+    n_in = tile_ids.shape[0]
+    small_n = max(16384, n_in // 16)
+    if small_n < n_in:
+        n_live = (tile_ids < BIG).sum()
+
+        def _small(args):
+            compacted, _ = _compact(args[0] < BIG, args, small_n, BIG)
+            return _remap_tables_core(*compacted, n_tiles, table_cap)
+
+        def _big(args):
+            return _remap_tables_core(*args, n_tiles, table_cap)
+
+        return lax.cond(
+            n_live <= small_n, _small, _big, (tile_ids, old_vals, new_vals)
+        )
+    return _remap_tables_core(tile_ids, old_vals, new_vals, n_tiles, table_cap)
+
+
+def _remap_tables_core(tile_ids, old_vals, new_vals, n_tiles, table_cap):
     tid, v, r = lax.sort((tile_ids, old_vals, new_vals), num_keys=2)
     dup = (tid == _shift1(tid, 0, -1)) & (v == _shift1(v, 0, -1))
     valid = (tid < BIG) & (~dup)

@@ -226,7 +226,41 @@ def value_join(
 
     Sort-based join — ``searchsorted`` lowers to a binary-search gather chain
     that measured ~50x slower than a sort at these sizes on TPU.
+
+    Both operands are static-capacity buffers (``BIG``-padded), so the
+    usual 1/16 tier applies, slot-aligned like ``chase_exits``: when the
+    live counts fit, both sides compact, the join runs small, and results
+    scatter back to their query slots (absent/padded queries keep their
+    identity mapping either way).
     """
+    nq = query_vals.shape[0]
+    nt = table_vals.shape[0]
+    small_q = max(16384, nq // 16)
+    small_t = max(16384, nt // 16)
+    if small_q < nq and small_t < nt:
+        n_q = (query_vals < BIG).sum()
+        n_t = (table_vals < BIG).sum()
+
+        def _small(args):
+            qv, tv, tf = args
+            (cq, slots), _ = _compact(
+                qv < BIG, (qv, jnp.arange(nq, dtype=jnp.int32)), small_q, BIG
+            )
+            (ctv, ctf), _ = _compact(tv < BIG, (tv, tf), small_t, BIG)
+            res = _value_join_core(cq, ctv, ctf)
+            return qv.at[slots].set(res, mode="drop")
+
+        def _big(args):
+            return _value_join_core(*args)
+
+        return lax.cond(
+            (n_q <= small_q) & (n_t <= small_t), _small, _big,
+            (query_vals, table_vals, table_finals),
+        )
+    return _value_join_core(query_vals, table_vals, table_finals)
+
+
+def _value_join_core(query_vals, table_vals, table_finals):
     nq = query_vals.shape[0]
     nt = table_vals.shape[0]
     keys = jnp.concatenate([table_vals, query_vals])

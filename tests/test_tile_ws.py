@@ -176,6 +176,39 @@ def test_chase_exits_small_tier_matches_oracle(rng):
     np.testing.assert_array_equal(finals[n_active:], codes[n_active:])
 
 
+def test_value_join_small_tier_matches_core(rng):
+    """value_join's tiered path (compact both sides -> join -> scatter
+    back) only engages above 16*16384 capacities — drive it directly
+    against the untiered core."""
+    from cluster_tools_tpu.ops.tile_ws import (
+        BIG, _value_join_core, value_join,
+    )
+
+    cap = 16 * 16384 + 1024
+    rng_ = np.random.default_rng(1)
+    table = np.full(cap, BIG, np.int32)
+    finals = np.full(cap, BIG, np.int32)
+    n_t = 300
+    tv = -(rng_.choice(5000, size=n_t, replace=False).astype(np.int32) + 2)
+    table[:n_t] = np.sort(tv)
+    finals[:n_t] = rng_.integers(1, 100, size=n_t)
+    queries = np.full(cap, BIG, np.int32)
+    n_q = 500  # half hit the table, half miss
+    queries[:n_q] = -(rng_.integers(0, 10000, size=n_q).astype(np.int32) + 2)
+
+    import jax.numpy as jnp
+
+    got = np.asarray(value_join(
+        jnp.asarray(queries), jnp.asarray(table), jnp.asarray(finals)))
+    want = np.asarray(_value_join_core(
+        jnp.asarray(queries), jnp.asarray(table), jnp.asarray(finals)))
+    np.testing.assert_array_equal(got, want)
+    # semantic spot-check: hits map to finals, misses to themselves
+    lut = {int(v): int(f) for v, f in zip(table[:n_t], finals[:n_t])}
+    for i in range(n_q):
+        assert got[i] == lut.get(int(queries[i]), int(queries[i])), i
+
+
 def test_sparse_seed_noise_fill_knobs(rng):
     """Sparse seeds in a noise-heavy volume exceed the default fill
     capacities (many small unseeded basins) — the overflow flag must say
