@@ -371,16 +371,15 @@ def fill_unseeded_basins(
     code -> final label, 0 if unreachable) for every unseeded basin seen on
     a boundary, for the caller to apply.
 
-    Cost structure (r4): face-voxel collection keeps the generous
-    ``fill_cap`` (noise robustness), but the Boruvka rounds run on the
-    *deduplicated basin adjacency list* — one up-front sort reduces
-    ``(a, b)`` face voxels to unique pairs with their min saddle, capacity
-    ``adj_cap`` (object-scale: unique unseeded-basin adjacencies, NOT face
-    voxels).  Before the dedup the rounds sorted ``2 * 3 * fill_cap``
-    entries each — ~16 multi-million-element sorts per fill; measured 35 s
-    of a 38 s seeded watershed at 128³ on the 1-core host and the projected
-    on-chip bottleneck at 512³.  Overflowing ``adj_cap`` raises the
-    overflow flag like every other capacity.
+    Cost structure (r4, full story in docs/PERFORMANCE.md): face-voxel
+    collection keeps the generous ``fill_cap`` (noise robustness); the
+    Boruvka rounds run on the *deduplicated basin adjacency list*
+    (``adj_cap``, object-scale) with each round's min-edge selection as
+    two int32 scatter-mins rather than a sort; and the whole
+    dedup+rounds machine is capacity-tiered (``run_capacity_tiered``) so
+    the common few-unseeded-basins case executes at 1/16 size.
+    Overflowing ``adj_cap`` raises the overflow flag like every other
+    capacity.
     """
     h = height.astype(jnp.float32)
     evs_a, evs_b, evs_h = [], [], []
