@@ -46,6 +46,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.ccl import _match_vma, label_components, relabel_consecutive
+from ..ops.tile_ccl import _compact, _shift1
 from ..ops.unionfind import union_find
 from .halo import neighbor_face
 
@@ -288,44 +289,85 @@ def merge_labels_by_pairs(
     axes: Sequence[ShardAxis],
     rank: jnp.ndarray,
     span: int,
+    pair_cap: Optional[int] = None,
 ) -> jnp.ndarray:
     """Merge globalized per-shard labels through cross-shard equivalences.
 
     The replicated tail of the two-pass merge, shared by the distributed CCL
-    and the fused pipeline's watershed-fragment stitch: ``all_gather`` the
-    fixed-capacity ``pairs`` (invalid slots (-1, -1)) over every sharded
-    mesh axis, compress the (sparse) boundary labels into a dense table,
-    pointer-jump the union-find, and relabel the local shard through it.
+    and the fused pipeline's watershed-fragment stitch: dedup the pair list,
+    ``all_gather`` it over every sharded mesh axis, compress the (sparse)
+    boundary labels into a dense table, pointer-jump the union-find, and
+    relabel the local shard through it.
+
+    ``pairs`` arrives FACE-sized — one row per contact voxel, invalid slots
+    (-1, -1) — but unique label equivalences are object-scale, so each
+    shard sorts and dedups to ``pair_cap`` (default
+    ``max(16384, rows/8)`` — below the floor the dedup is skipped
+    entirely) BEFORE the collective: the ICI payload and the replicated unique/union-find tail
+    shrink by the dedup factor.  Correctness never depends on the cap: a
+    ``pmax``-replicated unique count selects a full-size fallback branch
+    when ANY shard's dedup would not fit (the predicate must agree across
+    shards — both branches contain the ``all_gather``).
 
     ``glob`` must be globalized as ``rank * span + local`` with local labels
     in ``1..span``.  The final gather is one direct table lookup per voxel —
     a ``searchsorted`` over the full shard would binary-search-gather per
     element (measured ~50x slower on TPU).
     """
-    all_pairs = pairs
+    n_in = int(pairs.shape[0])
+    if pair_cap is None:
+        pair_cap = max(16384, n_in // 8)
+
+    def _tail(shard_pairs):
+        all_pairs = shard_pairs
+        for _, name, _ in axes:
+            all_pairs = lax.all_gather(all_pairs, name).reshape(-1, 2)
+        # compress the (sparse) boundary labels into a dense table
+        cap = int(all_pairs.shape[0]) * 2
+        flat = all_pairs.ravel()
+        flat = jnp.where(flat < 0, _INT32_MAX, flat)
+        keys = jnp.unique(flat, size=cap, fill_value=_INT32_MAX)
+        dense = jnp.searchsorted(
+            keys, jnp.maximum(all_pairs, 0)
+        ).astype(jnp.int32)
+        dense = jnp.where(all_pairs < 0, jnp.int32(-1), dense)
+        parent = union_find(dense, cap)
+        # keys are sorted ascending, so the min dense root is the min label
+        rep = keys[parent]
+
+        base = rank * jnp.int32(span)
+        table = _match_vma(jnp.arange(span + 1, dtype=jnp.int32), glob) + base
+        loc = keys - base  # position of each boundary label if it is ours
+        mine = (keys != _INT32_MAX) & (loc >= 1) & (loc <= span)
+        table = table.at[jnp.where(mine, loc, span + 1)].set(
+            rep, mode="drop"
+        )
+        idx = jnp.clip(glob - base, 0, span)
+        return jnp.where(glob > 0, table[idx], 0)
+
+    if pair_cap >= n_in:
+        return _tail(pairs)
+
+    # per-shard dedup: sort, keep first of each run, compact to pair_cap
+    a = jnp.where(pairs[:, 0] < 0, _INT32_MAX, pairs[:, 0])
+    b = jnp.where(pairs[:, 0] < 0, _INT32_MAX, pairs[:, 1])
+    a, b = lax.sort((a, b), num_keys=2)
+    keep = (
+        (a != _shift1(a, 0, -1)) | (b != _shift1(b, 0, -1))
+    ) & (a != _INT32_MAX)
+    (ca, cb), n_kept = _compact(keep, (a, b), pair_cap, -1)
+    deduped = jnp.stack([ca, cb], axis=1)
+    # the branch predicate must agree on EVERY shard (both branches carry
+    # the all_gather): replicate the worst-case unique count first
+    n_max = n_kept
     for _, name, _ in axes:
-        all_pairs = lax.all_gather(all_pairs, name).reshape(-1, 2)
-
-    # compress the (sparse) boundary labels into a dense table
-    cap = int(all_pairs.shape[0]) * 2
-    flat = all_pairs.ravel()
-    flat = jnp.where(flat < 0, _INT32_MAX, flat)
-    keys = jnp.unique(flat, size=cap, fill_value=_INT32_MAX)
-    dense = jnp.searchsorted(keys, jnp.maximum(all_pairs, 0)).astype(jnp.int32)
-    dense = jnp.where(all_pairs < 0, jnp.int32(-1), dense)
-    parent = union_find(dense, cap)
-    # keys are sorted ascending, so the min dense root is the min label
-    rep = keys[parent]
-
-    base = rank * jnp.int32(span)
-    table = _match_vma(jnp.arange(span + 1, dtype=jnp.int32), glob) + base
-    loc = keys - base  # position of each boundary label if it is ours
-    mine = (keys != _INT32_MAX) & (loc >= 1) & (loc <= span)
-    table = table.at[jnp.where(mine, loc, span + 1)].set(
-        rep, mode="drop"
+        n_max = lax.pmax(n_max, name)
+    return lax.cond(
+        n_max <= pair_cap,
+        lambda _: _tail(deduped),
+        lambda _: _tail(pairs),
+        operand=None,
     )
-    idx = jnp.clip(glob - base, 0, span)
-    return jnp.where(glob > 0, table[idx], 0)
 
 
 def distributed_connected_components(
