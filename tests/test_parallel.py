@@ -68,6 +68,53 @@ def test_distributed_ccl_vs_scipy(rng):
     assert_labels_equivalent(labels, expected)
 
 
+def test_distributed_ccl_pair_dedup_and_fallback(rng):
+    """merge_labels_by_pairs' pre-collective dedup only engages above its
+    16384-row floor, which the small workflow tests never reach — drive a
+    face large enough for the dedup branch, and force the full-size
+    fallback with a tiny pair_cap; both must match scipy exactly."""
+    import cluster_tools_tpu.parallel.distributed_ccl as dc
+
+    mesh = _mesh(("sp",))
+    sp = mesh_axis_sizes(mesh)["sp"]
+    # face = 136*136 = 18496 > 16384: the dedup branch compiles AND runs
+    shape = (sp * 4, 136, 136)
+    mask = random_blobs(rng, shape, p=0.45)
+    expected, _ = ndimage.label(
+        mask, structure=ndimage.generate_binary_structure(3, 1)
+    )
+
+    labels = np.asarray(
+        distributed_connected_components(mask, mesh, sp_axis="sp")
+    )
+    assert_labels_equivalent(labels, expected)
+
+    # force the fallback: a tiny cap makes n_max exceed it on any
+    # non-trivial mask, so the pmax-agreed full-size branch must run.
+    # Different shape than above so a cached trace of the unpatched
+    # function cannot serve the call.
+    shape_fb = (sp * 4, 140, 140)
+    mask_fb = random_blobs(rng, shape_fb, p=0.45)
+    expected_fb, _ = ndimage.label(
+        mask_fb, structure=ndimage.generate_binary_structure(3, 1)
+    )
+    orig = dc.merge_labels_by_pairs
+
+    def tiny_cap(glob, pairs, axes, rank, span, pair_cap=None):
+        # unique cross-face pairs for this mask measure ~50-70 per shard:
+        # a cap of 16 guarantees n_max > pair_cap and the fallback runs
+        return orig(glob, pairs, axes, rank, span, pair_cap=16)
+
+    dc.merge_labels_by_pairs = tiny_cap
+    try:
+        labels_fb = np.asarray(
+            distributed_connected_components(mask_fb, mesh, sp_axis="sp")
+        )
+    finally:
+        dc.merge_labels_by_pairs = orig
+    assert_labels_equivalent(labels_fb, expected_fb)
+
+
 def test_distributed_ccl_component_spanning_all_shards():
     mesh = _mesh(("sp",))
     sp = mesh_axis_sizes(mesh)["sp"]
