@@ -22,7 +22,9 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
 
 
 def main() -> None:
@@ -38,6 +40,16 @@ def main() -> None:
     import jax.numpy as jnp
 
     backend = jax.devices()[0].platform
+    if backend in ("tpu", "axon"):
+        # share bench.py's persistent compile cache, gated on the RESOLVED
+        # backend (not the env var — an axon plugin that registers but falls
+        # back to cpu must not pollute the cache with XLA:CPU entries): a
+        # capped probe that finishes a long Mosaic backend compile leaves
+        # the executable behind, so the next bench rung at the same shape
+        # starts timing within seconds instead of re-paying the compile
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache")
+        )
     impl = os.environ.get("CT_PROBE_IMPL", "auto")
     threshold = 0.45
     shape = (extent, extent, extent)
@@ -66,12 +78,16 @@ def main() -> None:
         from cluster_tools_tpu.parallel.pipeline import make_ws_ccl_step
 
         mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
-        step = make_ws_ccl_step(
+        # lower the step itself on bench.py's exact batched spec so the
+        # persistent-cache entry this probe leaves behind is the one the
+        # bench headline rung will look up (an extra wrapping jit would
+        # change the HLO hash and miss)
+        fn = make_ws_ccl_step(
             mesh, halo=halo, threshold=threshold,
             dt_max_distance=float(halo), min_seed_distance=2.0, impl=impl,
             stitch_ws_threshold=threshold,
         )
-        fn = jax.jit(lambda v: step(v[None]))
+        spec = jax.ShapeDtypeStruct((1,) + shape, jnp.float32)
     else:
         raise SystemExit(f"unknown target {target!r}")
 
