@@ -364,23 +364,19 @@ def main():
     # printing a provisional line after each) banks on-chip evidence no
     # matter what the fused compile does.
     t_cc = t_ws = None
+    configs_impl = None
     impl_env = os.environ.get("CT_BENCH_IMPL")
     if on_accel and impl_env != "legacy":
         # the legacy rung is the guaranteed-completion last resort: it must
         # reach its (small, always-compiling) fused program without risking
         # a tiled-kernel wedge first, so it skips the pre-pass
-        pre_impl = impl_env or "auto"
+        pre_impl = configs_impl = impl_env or "auto"
 
         def _config1_pre():
+            # pre_impl is never "legacy" here (the legacy rung skips the
+            # pre-pass), so this is always the tiled path
             fg3 = (vol < threshold)[0]
-            if pre_impl == "legacy":
-                from cluster_tools_tpu.ops.ccl import label_components
-
-                cc1 = jax.jit(lambda m: (label_components(m), False))
-            else:
-                cc1 = jax.jit(
-                    lambda m: label_components_tiled(m, impl=pre_impl)
-                )
+            cc1 = jax.jit(lambda m: label_components_tiled(m, impl=pre_impl))
             t_cc, (_, cc_ovf) = _timeit(
                 "config 1: tiled CCL on binary mask", cc1, fg3
             )
@@ -396,28 +392,12 @@ def main():
             )
 
         def _config2_pre():
-            if pre_impl == "legacy":
-                from cluster_tools_tpu.ops.watershed import (
-                    distance_transform_watershed,
+            ws1 = jax.jit(
+                lambda b: dt_watershed_tiled(
+                    b, threshold=threshold, dt_max_distance=float(halo),
+                    min_seed_distance=min_seed_distance, impl=pre_impl,
                 )
-
-                ws1 = jax.jit(
-                    lambda b: (
-                        distance_transform_watershed(
-                            b, threshold=threshold,
-                            min_seed_distance=min_seed_distance,
-                            dt_max_distance=float(halo),
-                        ),
-                        False,
-                    )
-                )
-            else:
-                ws1 = jax.jit(
-                    lambda b: dt_watershed_tiled(
-                        b, threshold=threshold, dt_max_distance=float(halo),
-                        min_seed_distance=min_seed_distance, impl=pre_impl,
-                    )
-                )
+            )
             t_ws, (_, ws_ovf) = _timeit(
                 "config 2: fused DT watershed", ws1, vol[0]
             )
@@ -512,6 +492,8 @@ def main():
     # Mosaic path hung/failed and the ladder fell to xla/legacy, re-trying
     # Mosaic here would wedge the whole run
     sub_impl = "xla" if headline_impl in ("xla", "legacy") else "auto"
+    if configs_impl is None:
+        configs_impl = "legacy" if headline_impl == "legacy" else sub_impl
 
     # ---- configs 1/2: measured in the on-accel pre-pass above; on the cpu
     # smoke (no pre-pass) they run here, after the headline, with the impl
@@ -713,6 +695,10 @@ def main():
         "best_run_seconds": round(t_fused, 3),
         "stages_ms": stages_ms,
         "configs": {
+            # configs 1/2 provenance: the pre-pass measures them with its
+            # own impl BEFORE the headline ladder resolves, which can
+            # differ from the headline's impl on a direct (non-rung) run
+            "configs_impl": configs_impl,
             "cc_binary_512": None if t_cc is None else {
                 "seconds": round(t_cc, 3),
                 "voxels_per_sec": round(vol[0].size / t_cc, 1),
@@ -847,15 +833,26 @@ def orchestrate() -> None:
                 )
                 return
             # component-only provisional (configs 1/2 measured, fused not):
-            # keep the fastest as fallback but let the remaining rungs try
-            # for a complete fused headline
-            try:
-                better = best_partial is None or (
-                    json.loads(line).get("value") or 0
-                ) > (json.loads(best_partial).get("value") or 0)
-            except ValueError:
-                better = best_partial is None
-            if better:
+            # keep the most-complete one (ws+cc carries strictly more
+            # evidence than ccl-only; the two kinds' values are not
+            # comparable since ccl-only omits t_ws), value-tiebreak within
+            # a kind; remaining rungs still try for a complete fused line
+            _rank = {
+                "provisional_ws_plus_cc_sequential": 2,
+                "provisional_ccl_only": 1,
+            }
+
+            def _key(ln):
+                try:
+                    d = json.loads(ln)
+                except ValueError:
+                    return (0, 0.0)
+                return (
+                    _rank.get(d.get("headline_path"), 0),
+                    d.get("value") or 0.0,
+                )
+
+            if best_partial is None or _key(line) > _key(best_partial):
                 best_partial = line
             log(
                 f"orchestrator: impl={impl} left a component-only "

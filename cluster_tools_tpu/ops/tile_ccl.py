@@ -34,6 +34,7 @@ helpers).  Overflow of any capacity is reported, never silently wrong.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -132,8 +133,33 @@ def _compact(
     return tuple(out), n_kept
 
 
+def tier_mode() -> str:
+    """Capacity-tier compile mode, from ``CT_TIER_MODE``.
+
+    - ``cond`` (default): both tiers compiled, selected at runtime by
+      ``lax.cond`` — exact for any input.
+    - ``big``: only the full-capacity tier is compiled.  Exact for any
+      input; gives up the small tier's runtime win.
+    - ``small``: only the 1/16 tier is compiled.  Exact whenever the live
+      count fits the small tier (the common case the tier exists for);
+      inputs that don't fit are truncated and reported through the site's
+      overflow channel, never silently.
+
+    ``big``/``small`` exist to shrink the compiled program: every tiered
+    site otherwise duplicates a sort-heavy merge core into both branches
+    of its cond (~24% of the fused step's HLO), which matters on backends
+    where compile time, not runtime, is the binding constraint.
+    """
+    mode = os.environ.get("CT_TIER_MODE", "cond")
+    if mode not in ("cond", "big", "small"):
+        raise ValueError(
+            f"CT_TIER_MODE must be cond/big/small, got {mode!r}"
+        )
+    return mode
+
+
 def run_capacity_tiered(arrays, n_total, big_cap, core, n_padded,
-                        max_rounds, vma_like):
+                        max_rounds, vma_like, trunc_fold=None):
     """Run ``core(*arrays, cap, max_rounds, vma_like)`` at 1/16 capacity
     when the runtime entry count allows.
 
@@ -152,8 +178,15 @@ def run_capacity_tiered(arrays, n_total, big_cap, core, n_padded,
     live in :func:`build_remap_tables` (this module),
     ``tile_ws.chase_exits``, and ``tile_ws.value_join`` — retune the
     ratio in ALL of these together.
+
+    :func:`tier_mode` selects which tiers are compiled.  In ``small``
+    mode an input that doesn't fit is truncated and the truncation is
+    folded into the output's LAST element (``max`` against an int32 flag
+    by default; pass ``trunc_fold(last, trunc_int32)`` when the last
+    element is a count rather than a flag).
     """
     small_n = min(big_cap, max(3 * 16384, arrays[0].shape[0] // 16))
+    mode = tier_mode()
 
     def _small(args):
         compacted, _ = _compact(args[0] < BIG, args, small_n, BIG)
@@ -170,6 +203,16 @@ def run_capacity_tiered(arrays, n_total, big_cap, core, n_padded,
     def _big(args):
         return core(*args, big_cap, max_rounds, vma_like)
 
+    if mode == "big" or small_n >= big_cap:
+        return _big(tuple(arrays))
+    if mode == "small":
+        out = _small(tuple(arrays))
+        trunc = (n_total > small_n).astype(jnp.int32)
+        last = (
+            trunc_fold(out[-1], trunc) if trunc_fold is not None
+            else jnp.maximum(out[-1], trunc)
+        )
+        return out[:-1] + (last,)
     return lax.cond(n_total <= small_n, _small, _big, tuple(arrays))
 
 
@@ -326,7 +369,8 @@ def build_remap_tables(
     """
     n_in = tile_ids.shape[0]
     small_n = max(16384, n_in // 16)
-    if small_n < n_in:
+    mode = tier_mode()
+    if small_n < n_in and mode != "big":
         n_live = (tile_ids < BIG).sum()
 
         def _small(args):
@@ -335,6 +379,12 @@ def build_remap_tables(
 
         def _big(args):
             return _remap_tables_core(*args, n_tiles, table_cap)
+
+        if mode == "small":
+            old_tbl, new_tbl, overflow = _small(
+                (tile_ids, old_vals, new_vals)
+            )
+            return old_tbl, new_tbl, overflow | (n_live > small_n)
 
         return lax.cond(
             n_live <= small_n, _small, _big, (tile_ids, old_vals, new_vals)

@@ -58,6 +58,7 @@ from .tile_ccl import (
     _tile_id_of,
     build_remap_tables,
     run_capacity_tiered,
+    tier_mode,
 )
 
 _BIGF = np.float32(3e38)
@@ -204,7 +205,10 @@ def collect_negative_values(
     # the value-dedup sort runs at the static 6*cap concat size — tier it
     # like the merge cores (shared rationale in run_capacity_tiered)
     cv, ct, n_kept = run_capacity_tiered(
-        (v, t_), n_total, cap, _collect_core, 2, 0, values
+        (v, t_), n_total, cap, _collect_core, 2, 0, values,
+        # last output is a COUNT checked against ``cap`` by the caller:
+        # in small tier_mode a truncated input must read as overflowing
+        trunc_fold=lambda n, trunc: jnp.where(trunc > 0, cap + 1, n),
     )
     overflow = jnp.maximum(overflow, (n_kept > cap).astype(jnp.int32))
     return cv, ct, overflow > 0
@@ -237,6 +241,11 @@ def value_join(
     nt = table_vals.shape[0]
     small_q = max(16384, nq // 16)
     small_t = max(16384, nt // 16)
+    # tier_mode "small" keeps the cond here: value_join returns no
+    # overflow channel, so a truncated table would lose mappings silently
+    # — the cond's big branch is the only safe fallback
+    if tier_mode() == "big":
+        return _value_join_core(query_vals, table_vals, table_finals)
     if small_q < nq and small_t < nt:
         n_q = (query_vals < BIG).sum()
         n_t = (table_vals < BIG).sum()
@@ -330,7 +339,8 @@ def chase_exits(values: jnp.ndarray, codes: jnp.ndarray, max_hops: int = 256):
     # one buffer, not a 3-axis concat)
     cap = codes.shape[0]
     small_n = max(16384, cap // 16)
-    if small_n >= cap:
+    mode = tier_mode()
+    if small_n >= cap or mode == "big":
         return _core(codes)
 
     def _small(c):
@@ -343,6 +353,11 @@ def chase_exits(values: jnp.ndarray, codes: jnp.ndarray, max_hops: int = 256):
         return out, moved
 
     n_active = (codes <= -2).sum()
+    if mode == "small":
+        fin, moved = _small(codes)
+        # truncated chains were never chased: report through the
+        # documented unconverged channel (callers fold into overflow)
+        return fin, moved | (n_active > small_n)
     return lax.cond(n_active <= small_n, _small, _core, codes)
 
 

@@ -70,6 +70,8 @@ while true; do
   if probe | grep -q PROBE_OK; then
     say "TUNNEL UP"
     cache_exp
+    say "launching battery v2"
+    bash scripts/when_tpu_up2.sh "${LOG%.log}_battery.log" >> "$LOG" 2>&1
     say "watcher exiting after recovery battery (relaunch to keep watching)"
     exit 0
   fi

@@ -1,0 +1,92 @@
+#!/bin/bash
+# On-chip evidence battery, round-4 second edition.  Lessons encoded:
+#
+# - SIGKILLing an in-flight remote compile appears to wedge the tunnel
+#   for a long time: caps here are GENEROUS and stages run smallest-first
+#   so a cap is only ever hit on a program whose smaller sibling already
+#   compiled (i.e. a genuine wedge, not a slow compile).
+# - The persistent compile cache (.jax_cache) is enabled for every stage:
+#   any compile that completes once is free for every later stage and for
+#   the driver's own bench run.
+# - Between stages a tiny probe checks tunnel health; when unhealthy the
+#   battery WAITS (up to ~30 min) instead of burning caps.
+#
+# Usage: scripts/when_tpu_up2.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/tpu_battery2.log}"
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+say() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+probe() {
+  timeout 120 python -c '
+import jax
+assert jax.devices()[0].platform in ("tpu", "axon")
+import jax.numpy as jnp
+assert float(jnp.arange(8.0).sum()) == 28.0
+print("PROBE_OK", flush=True)' 2>/dev/null | grep -q PROBE_OK
+}
+
+wait_healthy() {
+  for _ in $(seq 1 15); do
+    if probe; then return 0; fi
+    say "tunnel unhealthy; waiting 120s"
+    sleep 120
+  done
+  say "tunnel stayed unhealthy ~30min"
+  return 1
+}
+
+say "=== battery v2 start ==="
+wait_healthy || exit 1
+
+# stage 1: smallest known-good program — proves compiles work at all
+say "stage 1: compile_table ccl 64 (pallas)"
+CT_PROBE_IMPL=pallas timeout 900 python scripts/compile_table.py ccl 64 32 >> "$LOG" 2>&1
+say "stage 1 exit: $?"
+wait_healthy || exit 1
+
+# stage 2: full fused program structure at the smallest grid.  impl=auto
+# == pallas on TPU, and matches what bench's auto rung lowers.
+say "stage 2: compile_table fused 64 (auto)"
+CT_PROBE_IMPL=auto timeout 1800 python scripts/compile_table.py fused 64 32 >> "$LOG" 2>&1
+say "stage 2 exit: $?"
+wait_healthy || exit 1
+
+# stage 3: the money shot — fused at bench scale, very generous cap.
+# A completed compile here is CACHED for the bench rung below and for
+# the driver's own end-of-round run.
+say "stage 3: compile_table fused 512 (auto), cap 45min"
+CT_PROBE_IMPL=auto timeout 2700 python scripts/compile_table.py fused 512 32 >> "$LOG" 2>&1
+say "stage 3 exit: $?"
+wait_healthy || exit 1
+
+# stage 4: the bench itself.  With stage 3 cached the auto rung compiles
+# in seconds; without it the pre-pass still banks configs 1/2 + salvage.
+say "stage 4: bench.py (budget 3600, auto cap 1500)"
+CT_BENCH_BUDGET=3600 CT_BENCH_CAP_AUTO=1500 CT_BENCH_CAP_XLA=900 \
+  timeout 4200 python bench.py >> "$LOG" 2>&1
+say "stage 4 exit: $?"
+wait_healthy || exit 1
+
+# stage 5: per-kernel timings (quick first; full includes tile sweeps)
+say "stage 5: tpu_measure quick"
+timeout 2400 python scripts/tpu_measure.py --quick >> "$LOG" 2>&1
+say "stage 5 quick exit: $?"
+wait_healthy || exit 1
+say "stage 5: tpu_measure full"
+timeout 4800 python scripts/tpu_measure.py >> "$LOG" 2>&1
+say "stage 5 full exit: $?"
+wait_healthy || true
+
+# stage 6: remaining compile-table rows (the r3 verdict's table)
+say "stage 6: compile table sweep"
+for t in ccl dt_ws; do
+  for e in 128 256 512; do
+    CT_PROBE_IMPL=pallas timeout 1800 python scripts/compile_table.py "$t" "$e" 32 >> "$LOG" 2>&1
+    say "  $t $e exit: $?"
+    wait_healthy || break 2
+  done
+done
+say "=== battery v2 done — fold $LOG into docs/PERFORMANCE.md + BENCH json ==="

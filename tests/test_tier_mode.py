@@ -1,0 +1,133 @@
+"""CT_TIER_MODE static capacity-tier modes (ops.tile_ccl.tier_mode).
+
+The default "cond" compiles both tiers behind ``lax.cond``; "big" and
+"small" compile exactly one — a compile-size lever for backends where
+compile time is the binding constraint (SURVEY.md §7 hard part 1; the
+512^3 fused-step remote compile).  Contract under test:
+
+- "big" is exact for any input (it IS the pre-tiering program);
+- "small" is exact whenever the live counts fit the small tier, and
+  reports truncation through the overflow channel — never silently —
+  when they don't.
+
+``CT_TIER_MODE`` is read at trace time, so each mode switch clears the
+jit caches.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.tile_ccl import label_components_tiled
+from cluster_tools_tpu.ops.tile_ws import seeded_watershed_tiled
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _with_mode(monkeypatch, mode):
+    monkeypatch.setenv("CT_TIER_MODE", mode)
+    # tier_mode() is a trace-time constant: cached traces from other
+    # modes must not be reused
+    jax.clear_caches()
+
+
+def _dense_seed_case(rng):
+    # smooth, object-scale height seeded at every local minimum: no
+    # unseeded basins — the small tier's exactness domain (raw noise with
+    # sparse seeds is the opposite regime, covered by the truncation test)
+    from cluster_tools_tpu.ops.watershed import local_maxima
+
+    shape = (24, 24, 130)
+    height = rng.random(shape).astype(np.float32)
+    for axis in range(3):
+        for _ in range(2):
+            height = (
+                height
+                + np.roll(height, 1, axis)
+                + np.roll(height, -1, axis)
+            ) / 3.0
+    minima = np.asarray(local_maxima(jnp.asarray(-height)))
+    seeds = np.zeros(shape, np.int32)
+    seeds[minima] = np.arange(1, int(minima.sum()) + 1)
+    return height, seeds
+
+
+def test_big_mode_matches_cond(rng, monkeypatch):
+    height, seeds = _dense_seed_case(rng)
+    _with_mode(monkeypatch, "cond")
+    ref, ref_ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    ref, ref_ovf = np.asarray(ref), bool(ref_ovf)
+    _with_mode(monkeypatch, "big")
+    got, ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    assert bool(ovf) == ref_ovf
+    np.testing.assert_array_equal(np.asarray(got), ref)
+    jax.clear_caches()
+
+
+def test_small_mode_exact_when_fits(rng, monkeypatch):
+    height, seeds = _dense_seed_case(rng)
+    _with_mode(monkeypatch, "cond")
+    ref, ref_ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    ref, ref_ovf = np.asarray(ref), bool(ref_ovf)
+    assert not ref_ovf
+    _with_mode(monkeypatch, "small")
+    got, ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    assert not bool(ovf)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+    jax.clear_caches()
+
+
+def test_small_mode_flags_truncation(rng, monkeypatch):
+    # two seeds in pure noise: the unseeded-basin fill sees ~1.3e5 face
+    # voxels, beyond the small tier — small mode must FLAG, not silently
+    # truncate (cond mode handles this via its big branch, no overflow)
+    shape = (24, 24, 130)
+    height = rng.random(shape).astype(np.float32)
+    seeds = np.zeros(shape, np.int32)
+    seeds[4, 4, 10] = 1
+    seeds[20, 20, 100] = 2
+    _with_mode(monkeypatch, "small")
+    _, ovf = seeded_watershed_tiled(
+        jnp.asarray(height), jnp.asarray(seeds), impl="xla"
+    )
+    assert bool(ovf)
+    jax.clear_caches()
+
+
+def test_ccl_modes_agree(rng, monkeypatch):
+    mask = rng.random((48, 48, 48)) < 0.3
+    _with_mode(monkeypatch, "cond")
+    ref, ref_ovf = label_components_tiled(jnp.asarray(mask), impl="xla")
+    ref = np.asarray(ref)
+    assert not bool(ref_ovf)
+    for mode in ("big", "small"):
+        _with_mode(monkeypatch, mode)
+        got, ovf = label_components_tiled(jnp.asarray(mask), impl="xla")
+        assert not bool(ovf), mode
+        np.testing.assert_array_equal(np.asarray(got), ref, err_msg=mode)
+    jax.clear_caches()
+
+
+def test_tier_mode_validation(monkeypatch):
+    from cluster_tools_tpu.ops.tile_ccl import tier_mode
+
+    monkeypatch.setenv("CT_TIER_MODE", "bogus")
+    with pytest.raises(ValueError):
+        tier_mode()
+    monkeypatch.delenv("CT_TIER_MODE")
+    assert tier_mode() == "cond"
