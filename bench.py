@@ -203,6 +203,13 @@ def main():
         _force_cpu_platform(8)
     else:
         os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+        # bench choice: sparse seed-plateau labeling (exact below ~6% maxima
+        # density — the bench volume measures ~1.4%; any truncation lands in
+        # the JSON's overflow flag).  Drops the largest single contributor
+        # to the fused step's remote-compile cost AND a full tiled-CCL pass
+        # at runtime.  compile_table.py sets the same default so its
+        # persistent-cache entries match this program.
+        os.environ.setdefault("CT_SEED_CCL", "sparse")
 
     import jax
     import jax.numpy as jnp

@@ -432,6 +432,82 @@ def resolve_labels_gather(
     return jnp.where(flat >= BIG, jnp.int32(BIG), out).reshape(labels.shape)
 
 
+@partial(jax.jit, static_argnames=("cap",))
+def label_components_sparse(mask: jnp.ndarray, cap: Optional[int] = None):
+    """Connected components (connectivity 1) of a SPARSE 3-D mask.
+
+    Output shape of :func:`label_components_tiled` — int32 labels holding
+    a per-component representative flat index, ``mask.size`` for
+    background — but the representative is the component's minimum flat
+    index in ARRAY order, where the tiled labeler picks the minimum in
+    its padded/tiled order: the two agree for components contained in one
+    tile and may differ (same partition, different id) for tile-spanning
+    components.  Callers treat these ids as opaque distinct tokens
+    (relabel/offset downstream), so the modes are interchangeable as
+    segmentations, not as raw id values.
+
+    Cost scales with the POPCOUNT capacity ``cap`` (default
+    ``max(3*16384, size/16)``), not with the tile grid: set voxels are
+    compacted, a 3-axis adjacency is built in compacted-slot space via
+    the dense rank array (one gather per axis — no sorts anywhere), and
+    the slot-space union-find resolves in one
+    :func:`~cluster_tools_tpu.ops.unionfind.union_find` while-loop.
+
+    Built for the watershed's seed-plateau labeling (maxima measure ~1.4%
+    of the bench volume at ``min_seed_distance=2``): the full tiled CCL
+    machinery is ~1.4k HLO lines and was the largest single contributor
+    to the fused step's remote-compile cost; this is ~1/10 the program.
+    Returns ``(labels, overflow)`` — overflow True when set voxels exceed
+    ``cap`` (labels then unreliable; raise ``cap``).
+    """
+    if mask.ndim != 3:
+        raise ValueError("label_components_sparse expects a 3-D mask")
+    from .unionfind import union_find
+
+    z, y, x = mask.shape
+    n = z * y * x
+    if n >= BIG:
+        raise ValueError(f"volume {mask.shape} has >= 2**30 voxels; shard it")
+    if cap is None:
+        cap = min(n, max(3 * 16384, n // 16))
+    flat = mask.ravel()
+    idx = _match_vma(jnp.arange(n, dtype=jnp.int32), mask)
+    (cidx,), n_live = _compact(flat, (idx,), cap, n)
+    overflow = n_live > cap
+    # dense rank: slot of any set voxel (the same cumsum _compact used)
+    rank = jnp.cumsum(flat.astype(jnp.int32)) - 1
+    pair_lists = []
+    slot_ids = _match_vma(jnp.arange(cap, dtype=jnp.int32), mask)
+    live = cidx < n
+    for step, bound_ok in (
+        (y * x, (cidx // (y * x)) + 1 < z),
+        (x, (cidx // x) % y + 1 < y),
+        (1, cidx % x + 1 < x),
+    ):
+        nb = jnp.clip(cidx + step, 0, n - 1)
+        ok = live & bound_ok & flat[nb]
+        # (slot, neighbor slot); invalid pairs become self-loop no-ops
+        pair_lists.append(
+            jnp.stack(
+                [
+                    jnp.where(ok, slot_ids, 0),
+                    jnp.where(ok, rank[nb], 0),
+                ],
+                axis=1,
+            )
+        )
+    parent = union_find(jnp.concatenate(pair_lists, axis=0), cap)
+    # representative flat index per slot; ascending compaction makes the
+    # min slot the min flat index
+    rep = cidx[parent]
+    out = jnp.full((n + 1,), jnp.int32(n))
+    out = _match_vma(out, mask)
+    out = out.at[jnp.where(live, cidx, n)].set(
+        jnp.where(live, rep, n), mode="drop"
+    )
+    return out[:n].reshape(mask.shape), overflow
+
+
 @partial(
     jax.jit,
     static_argnames=(

@@ -37,6 +37,7 @@ differs.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -696,12 +697,42 @@ def seeded_watershed_tiled(
     return out, overflow
 
 
+def _seed_ccl(maxima, seed_cap, *, impl, tile, pair_cap, edge_cap,
+              table_cap, interpret):
+    """Label seed plateaus: ``CT_SEED_CCL`` picks the program.
+
+    - ``tiled`` (default): the full two-level CCL machinery — exact for
+      any maxima density.
+    - ``sparse``: :func:`~.tile_ccl.label_components_sparse` — ~1/10 the
+      compiled program (the single biggest compile-size lever in the
+      fused step, see docs/PERFORMANCE.md "program-size analysis");
+      exact while maxima fit ``seed_cap`` (default volume/16 — bench-like
+      volumes measure ~1.4% at ``min_seed_distance=2``), overflow-flagged
+      beyond.
+
+    Like :func:`~.tile_ccl.tier_mode`, the env var is read at TRACE time.
+    """
+    mode = os.environ.get("CT_SEED_CCL", "tiled")
+    if mode == "sparse":
+        from .tile_ccl import label_components_sparse
+
+        return label_components_sparse(maxima, cap=seed_cap)
+    if mode != "tiled":
+        raise ValueError(f"CT_SEED_CCL must be tiled/sparse, got {mode!r}")
+    from .tile_ccl import label_components_tiled
+
+    return label_components_tiled(
+        maxima, impl=impl, tile=tile, pair_cap=pair_cap, edge_cap=edge_cap,
+        table_cap=table_cap, interpret=interpret,
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "threshold", "sigma_seeds", "min_seed_distance", "sampling",
         "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
-        "exit_cap", "fill_cap", "table_cap", "interpret",
+        "exit_cap", "fill_cap", "table_cap", "interpret", "seed_cap",
     ),
 )
 def dt_watershed_tiled(
@@ -721,6 +752,7 @@ def dt_watershed_tiled(
     fill_cap: Optional[int] = None,
     table_cap: int = DEFAULT_TABLE_CAP,
     interpret: bool = False,
+    seed_cap: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused distance-transform watershed on the two-level machinery.
 
@@ -738,7 +770,6 @@ def dt_watershed_tiled(
     """
     from .edt import distance_transform_squared
     from .filters import gaussian_smooth
-    from .tile_ccl import label_components_tiled
     from .watershed import local_maxima
 
     valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
@@ -763,9 +794,9 @@ def dt_watershed_tiled(
         & fg
         & (dist >= min_seed_distance * min_seed_distance)
     )
-    raw, seed_overflow = label_components_tiled(
-        maxima, impl=impl, tile=tile, pair_cap=pair_cap, edge_cap=edge_cap,
-        table_cap=table_cap, interpret=interpret,
+    raw, seed_overflow = _seed_ccl(
+        maxima, seed_cap, impl=impl, tile=tile, pair_cap=pair_cap,
+        edge_cap=edge_cap, table_cap=table_cap, interpret=interpret,
     )
     n = int(np.prod(boundaries.shape))
     seeds = jnp.where(raw == n, 0, raw + 1).astype(jnp.int32)
@@ -782,7 +813,7 @@ def dt_watershed_tiled(
     static_argnames=(
         "threshold", "sigma_seeds", "min_seed_distance", "sampling",
         "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
-        "exit_cap", "fill_cap", "table_cap", "interpret",
+        "exit_cap", "fill_cap", "table_cap", "interpret", "seed_cap",
     ),
 )
 def dt_watershed_seeded_tiled(
@@ -802,6 +833,7 @@ def dt_watershed_seeded_tiled(
     fill_cap: Optional[int] = None,
     table_cap: int = DEFAULT_TABLE_CAP,
     interpret: bool = False,
+    seed_cap: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Two-pass-mode DT watershed on the tiled machinery.
 
@@ -815,7 +847,6 @@ def dt_watershed_seeded_tiled(
     """
     from .edt import distance_transform_squared
     from .filters import gaussian_smooth
-    from .tile_ccl import label_components_tiled
     from .watershed import local_maxima
 
     n = int(np.prod(boundaries.shape))
@@ -834,9 +865,9 @@ def dt_watershed_seeded_tiled(
         & fg
         & (dist >= min_seed_distance * min_seed_distance)
     )
-    raw, seed_overflow = label_components_tiled(
-        maxima, impl=impl, tile=tile, pair_cap=pair_cap, edge_cap=edge_cap,
-        table_cap=table_cap, interpret=interpret,
+    raw, seed_overflow = _seed_ccl(
+        maxima, seed_cap, impl=impl, tile=tile, pair_cap=pair_cap,
+        edge_cap=edge_cap, table_cap=table_cap, interpret=interpret,
     )
     internal = jnp.where(raw == n, 0, raw + 1).astype(jnp.int32)
     ext = ext_seeds.astype(jnp.int32)

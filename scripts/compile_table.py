@@ -50,6 +50,9 @@ def main() -> None:
         jax.config.update(
             "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache")
         )
+        # must match bench.py's accel-run default or the cache entry this
+        # probe leaves behind is not the one the bench rung looks up
+        os.environ.setdefault("CT_SEED_CCL", "sparse")
     impl = os.environ.get("CT_PROBE_IMPL", "auto")
     threshold = 0.45
     shape = (extent, extent, extent)

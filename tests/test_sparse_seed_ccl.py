@@ -1,0 +1,149 @@
+"""label_components_sparse + the CT_SEED_CCL watershed seed switch.
+
+The sparse labeler exists to shrink the fused step's compiled program
+(docs/PERFORMANCE.md "program-size analysis"): seed maxima measure ~1.4%
+of the bench volume, so compacting them and union-finding in slot space
+replaces the ~1.4k-HLO-line tiled CCL machinery with ~1/10 the program.
+Contract: identical output convention to label_components_tiled
+(component-min flat index; ``size`` for background), overflow flag when
+the popcount exceeds ``cap``.
+"""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+import jax
+import jax.numpy as jnp
+
+from cluster_tools_tpu.ops.tile_ccl import (
+    label_components_sparse,
+    label_components_tiled,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _assert_matches_scipy(mask):
+    got, ovf = label_components_sparse(jnp.asarray(mask))
+    assert not bool(ovf)
+    got = np.asarray(got)
+    n = mask.size
+    ref, _ = ndimage.label(mask, structure=ndimage.generate_binary_structure(3, 1))
+    # same partition (bijective between label sets), background preserved
+    assert ((got == n) == ~mask).all()
+    for r in np.unique(ref[mask]):
+        ids = np.unique(got[ref == r])
+        assert len(ids) == 1, f"component {r} split into {ids}"
+    # distinct scipy components must get distinct sparse labels
+    reps = {}
+    for r in np.unique(ref[mask]):
+        rep = int(got[ref == r][0])
+        assert rep not in reps, "two components share a representative"
+        reps[rep] = r
+    # representative is the component's minimum flat index (the tiled
+    # labeler's convention, relied on by dt_watershed_tiled's +1 shift)
+    flat_ref = ref.ravel()
+    flat_got = got.ravel()
+    for rep, r in reps.items():
+        assert rep == int(np.flatnonzero(flat_ref == r).min())
+        assert flat_got[rep] == rep
+
+
+def test_sparse_matches_scipy_random(rng):
+    mask = rng.random((32, 48, 40)) < 0.02
+    _assert_matches_scipy(mask)
+
+
+def test_sparse_plateaus_and_borders(rng):
+    mask = np.zeros((24, 24, 40), bool)
+    mask[0, 0, :7] = True            # ridge along x at the corner
+    mask[5:8, 5:8, 5:8] = True       # cube plateau
+    mask[23, :, 39] = True           # edge line on the far border
+    mask[12, 12, 20] = True          # singleton
+    mask[12, 12, 22] = True          # near-but-separate singleton
+    _assert_matches_scipy(mask)
+
+
+def test_sparse_empty_and_full_small():
+    _assert_matches_scipy(np.zeros((8, 8, 16), bool))
+    # "sparse" on a full mask still correct when cap >= size
+    mask = np.ones((8, 8, 16), bool)
+    got, ovf = label_components_sparse(jnp.asarray(mask), cap=mask.size)
+    assert not bool(ovf)
+    assert (np.asarray(got) == 0).all()  # one component, min flat index 0
+
+
+def _assert_same_partition(a, b, mask):
+    """Same segmentation: a bijection between the two label sets on mask."""
+    a, b = np.asarray(a)[mask], np.asarray(b)[mask]
+    pairs = np.unique(np.stack([a, b], axis=1), axis=0)
+    assert len(np.unique(pairs[:, 0])) == len(pairs)
+    assert len(np.unique(pairs[:, 1])) == len(pairs)
+
+
+def test_sparse_matches_tiled_partition(rng):
+    # ids are only guaranteed to AGREE for single-tile components (the
+    # tiled labeler's representative is the min in padded/tiled order,
+    # the sparse one's the min in array order) — the partition must match
+    # exactly, including across tile boundaries
+    mask = np.asarray(rng.random((24, 48, 140)) < 0.05)
+    mask[10, :, 60:70] = True  # a component spanning the x tile boundary
+    sp, so = label_components_sparse(jnp.asarray(mask))
+    tl, to = label_components_tiled(jnp.asarray(mask), impl="xla")
+    assert not bool(so) and not bool(to)
+    _assert_same_partition(sp, tl, mask)
+    np.testing.assert_array_equal(np.asarray(sp) == mask.size,
+                                  np.asarray(tl) == mask.size)
+
+
+def test_sparse_overflow_flag(rng):
+    mask = rng.random((16, 16, 32)) < 0.5
+    got, ovf = label_components_sparse(jnp.asarray(mask), cap=64)
+    assert bool(ovf)
+
+
+def test_watershed_seed_mode_parity(rng, monkeypatch):
+    from cluster_tools_tpu.ops.tile_ws import dt_watershed_tiled
+
+    v = rng.random((32, 32, 64)).astype(np.float32)
+    for ax in range(3):
+        for _ in range(3):
+            v = (v + np.roll(v, 1, ax) + np.roll(v, -1, ax)) / 3.0
+    v = (v - v.min()) / (v.max() - v.min())
+
+    def run():
+        jax.clear_caches()
+        out, ovf = dt_watershed_tiled(
+            jnp.asarray(v), threshold=0.45, dt_max_distance=8.0,
+            min_seed_distance=2.0, impl="xla",
+        )
+        return np.asarray(out), bool(ovf)
+
+    monkeypatch.setenv("CT_SEED_CCL", "tiled")
+    ref, ref_ovf = run()
+    monkeypatch.setenv("CT_SEED_CCL", "sparse")
+    got, got_ovf = run()
+    assert got_ovf == ref_ovf
+    # seed ids may differ for tile-spanning plateaus (see
+    # test_sparse_matches_tiled_partition) — the SEGMENTATION must match
+    assert ((got > 0) == (ref > 0)).all()
+    _assert_same_partition(got, ref, ref > 0)
+    monkeypatch.delenv("CT_SEED_CCL")
+    jax.clear_caches()
+
+
+def test_seed_mode_validation(monkeypatch):
+    from cluster_tools_tpu.ops.tile_ws import dt_watershed_tiled
+
+    monkeypatch.setenv("CT_SEED_CCL", "bogus")
+    jax.clear_caches()
+    with pytest.raises(ValueError):
+        dt_watershed_tiled(
+            jnp.zeros((8, 8, 16), jnp.float32), threshold=0.5, impl="xla"
+        )
+    monkeypatch.delenv("CT_SEED_CCL")
+    jax.clear_caches()
