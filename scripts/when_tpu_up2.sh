@@ -47,19 +47,33 @@ CT_PROBE_IMPL=pallas timeout 900 python scripts/compile_table.py ccl 64 32 >> "$
 say "stage 1 exit: $?"
 wait_healthy || exit 1
 
-# stage 2: full fused program structure at the smallest grid.  impl=auto
-# == pallas on TPU, and matches what bench's auto rung lowers.
-say "stage 2: compile_table fused 64 (auto)"
+# stage 2: small-grid siblings of every 512 program below (smallest-first
+# invariant: a 512 cap may only fire on a program whose 64 sibling
+# already compiled).  impl=auto == pallas on TPU, and matches what
+# bench's auto rung lowers.
+say "stage 2a: compile_table dt_ws 64 (auto)"
+CT_PROBE_IMPL=auto timeout 1500 python scripts/compile_table.py dt_ws 64 32 >> "$LOG" 2>&1
+say "stage 2a exit: $?"
+wait_healthy || exit 1
+say "stage 2b: compile_table fused 64 (auto)"
 CT_PROBE_IMPL=auto timeout 1800 python scripts/compile_table.py fused 64 32 >> "$LOG" 2>&1
-say "stage 2 exit: $?"
+say "stage 2b exit: $?"
 wait_healthy || exit 1
 
-# stage 3: the money shot — fused at bench scale, very generous cap.
-# A completed compile here is CACHED for the bench rung below and for
-# the driver's own end-of-round run.
-say "stage 3: compile_table fused 512 (auto), cap 45min"
+# stage 3: bench-scale compiles in the exact order bench's pre-pass runs
+# them — every completed compile is CACHED for the bench rung below and
+# for the driver's own end-of-round run, so even a partial sweep pays off
+say "stage 3a: compile_table ccl 512 (auto), cap 20min"
+CT_PROBE_IMPL=auto timeout 1200 python scripts/compile_table.py ccl 512 32 >> "$LOG" 2>&1
+say "stage 3a exit: $?"
+wait_healthy || exit 1
+say "stage 3b: compile_table dt_ws 512 (auto), cap 30min"
+CT_PROBE_IMPL=auto timeout 1800 python scripts/compile_table.py dt_ws 512 32 >> "$LOG" 2>&1
+say "stage 3b exit: $?"
+wait_healthy || exit 1
+say "stage 3c: compile_table fused 512 (auto), cap 45min"
 CT_PROBE_IMPL=auto timeout 2700 python scripts/compile_table.py fused 512 32 >> "$LOG" 2>&1
-say "stage 3 exit: $?"
+say "stage 3c exit: $?"
 wait_healthy || exit 1
 
 # stage 4: the bench itself.  With stage 3 cached the auto rung compiles
