@@ -374,6 +374,7 @@ def main():
     # matter what the fused compile does.
     t_cc = t_ws = None
     configs_impl = None
+    pre_state = {}
     impl_env = os.environ.get("CT_BENCH_IMPL")
     if on_accel and impl_env != "legacy":
         # the legacy rung is the guaranteed-completion last resort: it must
@@ -390,6 +391,7 @@ def main():
                 "config 1: tiled CCL on binary mask", cc1, fg3
             )
             log(f"config 1 overflow={bool(cc_ovf)}")
+            pre_state["cc_overflow"] = bool(cc_ovf)
             return t_cc
 
         t_cc = _shielded("config 1 (pre)", _config1_pre)
@@ -407,10 +409,14 @@ def main():
                     min_seed_distance=min_seed_distance, impl=pre_impl,
                 )
             )
-            t_ws, (_, ws_ovf) = _timeit(
+            t_ws, (ws_lab1, ws_ovf) = _timeit(
                 "config 2: fused DT watershed", ws1, vol[0]
             )
             log(f"config 2 overflow={bool(ws_ovf)}")
+            # keep the fragment labels: config 4 (RAG+multicut) runs on
+            # them when the fused step never materializes its own
+            pre_state["ws_labels"] = ws_lab1
+            pre_state["ws_overflow"] = bool(ws_ovf)
             return t_ws
 
         t_ws = _shielded("config 2 (pre)", _config2_pre)
@@ -465,30 +471,54 @@ def main():
             break
         except Exception as e:
             log(f"impl={impl} FAILED: {type(e).__name__}: {str(e)[:300]}")
-    if step is None:
+    headline_path = "device_fused_step"
+    if step is None and t_cc is not None and t_ws is not None:
+        # every fused impl raised, but the pre-pass measured both component
+        # programs: finish the run with the split headline (ws + cc
+        # sequential, device-resident — the fused step's compute content
+        # minus the single-shard-trivial merge) instead of dying and
+        # leaving only a salvaged provisional.  Honestly labeled.
+        log(
+            "every fused-step impl failed; headline falls back to the "
+            "split ws+cc programs"
+        )
+        t_fused = t_ws + t_cc
+        vps = vol[0].size / t_fused
+        headline_impl = configs_impl
+        headline_path = "split_programs_single_chip (fused compile failed)"
+        ws_lab = pre_state["ws_labels"][None]
+        # the split measurement is only as reliable as BOTH its halves
+        overflow = bool(pre_state.get("ws_overflow", False)) or bool(
+            pre_state.get("cc_overflow", False)
+        )
+    elif step is None:
         raise RuntimeError("every fused-step impl failed; see stderr")
-    profile_dir = os.environ.get("CT_BENCH_PROFILE")
-    if profile_dir:
-        # SURVEY.md §5.1: per-kernel traces on demand — view with
-        # tensorboard or xprof.  One profiled run after warmup.
-        log(f"profiling one step into {profile_dir}")
-        with jax.profiler.trace(profile_dir):
-            out0 = step(vol)
-            _sync(out0)
-    t_fused, out = _timeit("fused ws+ccl step", step, vol)
-    ws_lab, cc_lab, n_fg, overflow = out
-    n_fg = int(n_fg)
-    overflow = bool(overflow)
-    vps = vol.size / t_fused
-    log(
-        f"fused: {vps:,.0f} voxels/s, n_fg={n_fg}, overflow={overflow}"
-    )
+    else:
+        # the fused step materializes its own labels: release the pre-pass
+        # volume (~512MB HBM at bench scale) before the big program runs
+        pre_state.pop("ws_labels", None)
+        profile_dir = os.environ.get("CT_BENCH_PROFILE")
+        if profile_dir:
+            # SURVEY.md §5.1: per-kernel traces on demand — view with
+            # tensorboard or xprof.  One profiled run after warmup.
+            log(f"profiling one step into {profile_dir}")
+            with jax.profiler.trace(profile_dir):
+                out0 = step(vol)
+                _sync(out0)
+        t_fused, out = _timeit("fused ws+ccl step", step, vol)
+        ws_lab, cc_lab, n_fg, overflow = out
+        n_fg = int(n_fg)
+        overflow = bool(overflow)
+        vps = vol.size / t_fused
+        log(
+            f"fused: {vps:,.0f} voxels/s, n_fg={n_fg}, overflow={overflow}"
+        )
     # provisional headline line NOW (supersedes the pre-pass provisionals):
     # if a later section wedges and the rung is killed, the orchestrator
     # salvages stdout and the last JSON line still carries the measurement
     # (the complete line replaces it later)
     _provisional(
-        vps, "device_fused_step",
+        vps, headline_path,
         {"impl": headline_impl, "best_run_seconds": round(t_fused, 3)},
     )
 
@@ -625,7 +655,6 @@ def main():
     # (ops/host.py, the watershed task's impl="host" path), measured on the
     # full volume; the device-shaped number stays as configs.ws_ccl_fused.
     headline_vps = vps
-    headline_path = "device_fused_step"
     if not on_accel:
         from cluster_tools_tpu.ops.host import host_ws_ccl
 
@@ -728,6 +757,11 @@ def main():
             "ws_ccl_fused": {
                 "seconds": round(t_fused, 3),
                 "voxels_per_sec": round(vps, 1),
+                **(
+                    {"note": "split ws+cc sequential sum — the fused "
+                     "program itself never compiled (see headline_path)"}
+                    if headline_path.startswith("split_programs") else {}
+                ),
             },
             "rag_multicut_crop": rag_result,
             "exact_edt_global": None if t_exact_edt is None else {
@@ -832,9 +866,44 @@ def orchestrate() -> None:
             ln for ln in stdout.splitlines() if ln.startswith("{")
         ]
         if proc.returncode == 0 and json_lines:
-            print(json_lines[-1], flush=True)
-            log(f"orchestrator: impl={impl} succeeded")
-            return
+            try:
+                done_path = json.loads(json_lines[-1]).get(
+                    "headline_path", ""
+                )
+            except ValueError:
+                done_path = ""
+            if not str(done_path).startswith("split_programs"):
+                line = json_lines[-1]
+                # a complete split record from a FASTER impl beats a true
+                # fused number from the legacy kernels (the split is the
+                # shipped fast path minus a single-shard-trivial merge,
+                # honestly labeled; legacy is ~50x off the tiled kernels)
+                if impl == "legacy" and best_partial is not None:
+                    try:
+                        bp = json.loads(best_partial)
+                        this = json.loads(line)
+                        if str(bp.get("headline_path", "")).startswith(
+                            "split_programs"
+                        ) and (bp.get("value") or 0) > (
+                            this.get("value") or 0
+                        ):
+                            log(
+                                "orchestrator: emitting the faster split "
+                                "record over the legacy fused number"
+                            )
+                            line = best_partial
+                    except ValueError:
+                        pass
+                print(line, flush=True)
+                log(f"orchestrator: impl={impl} succeeded")
+                return
+            # the rung completed but its fused compile FAILED (split
+            # fallback headline): keep the complete record as the fallback
+            # and let the remaining impls try for a real fused number
+            log(
+                f"orchestrator: impl={impl} completed with a split "
+                "fallback headline; trying the next rung for a fused one"
+            )
         if json_lines:
             line = json_lines[-1]
             try:
@@ -856,6 +925,7 @@ def orchestrate() -> None:
             # comparable since ccl-only omits t_ws), value-tiebreak within
             # a kind; remaining rungs still try for a complete fused line
             _rank = {
+                "split_programs_single_chip (fused compile failed)": 3,
                 "provisional_ws_plus_cc_sequential": 2,
                 "provisional_ccl_only": 1,
             }
