@@ -733,6 +733,7 @@ def _seed_ccl(maxima, seed_cap, *, impl, tile, pair_cap, edge_cap,
         "threshold", "sigma_seeds", "min_seed_distance", "sampling",
         "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
         "exit_cap", "fill_cap", "table_cap", "interpret", "seed_cap",
+        "adj_cap", "fill_rounds",
     ),
 )
 def dt_watershed_tiled(
@@ -753,6 +754,8 @@ def dt_watershed_tiled(
     table_cap: int = DEFAULT_TABLE_CAP,
     interpret: bool = False,
     seed_cap: Optional[int] = None,
+    adj_cap: Optional[int] = None,
+    fill_rounds: int = 16,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused distance-transform watershed on the two-level machinery.
 
@@ -803,7 +806,7 @@ def dt_watershed_tiled(
     labels, ws_overflow = seeded_watershed_tiled(
         boundaries, seeds, mask=valid, impl=impl, tile=tile,
         exit_cap=exit_cap, fill_cap=fill_cap, table_cap=table_cap,
-        interpret=interpret,
+        interpret=interpret, adj_cap=adj_cap, fill_rounds=fill_rounds,
     )
     return labels, seed_overflow | ws_overflow
 
@@ -814,6 +817,7 @@ def dt_watershed_tiled(
         "threshold", "sigma_seeds", "min_seed_distance", "sampling",
         "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
         "exit_cap", "fill_cap", "table_cap", "interpret", "seed_cap",
+        "adj_cap", "fill_rounds",
     ),
 )
 def dt_watershed_seeded_tiled(
@@ -834,6 +838,8 @@ def dt_watershed_seeded_tiled(
     table_cap: int = DEFAULT_TABLE_CAP,
     interpret: bool = False,
     seed_cap: Optional[int] = None,
+    adj_cap: Optional[int] = None,
+    fill_rounds: int = 16,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Two-pass-mode DT watershed on the tiled machinery.
 
@@ -876,6 +882,6 @@ def dt_watershed_seeded_tiled(
     labels, ws_overflow = seeded_watershed_tiled(
         boundaries, seeds, mask=valid, impl=impl, tile=tile,
         exit_cap=exit_cap, fill_cap=fill_cap, table_cap=table_cap,
-        interpret=interpret,
+        interpret=interpret, adj_cap=adj_cap, fill_rounds=fill_rounds,
     )
     return labels, seed_overflow | ws_overflow

@@ -42,6 +42,18 @@ import jax
 import jax.numpy as jnp
 
 
+def _tiled_cap_knobs(cfg):
+    """Config-set capacity knobs for the tiled kernels (None = omit, the
+    ops-level defaults apply).  Only meaningful for ``impl != 'legacy'``;
+    raise the reported-overflow one, rerun the failed blocks."""
+    return {
+        k: int(cfg[k])
+        for k in ("exit_cap", "fill_cap", "adj_cap", "fill_rounds",
+                  "seed_cap", "table_cap")
+        if cfg.get(k) is not None
+    }
+
+
 def _outer_shape(block_shape, halo):
     return tuple(b + 2 * h for b, h in zip(block_shape, halo))
 
@@ -82,6 +94,18 @@ class _WsTaskBase(BaseTask):
             # mode and connectivity != 1 always use legacy.  Honored by both
             # the single-pass and the two-pass (externally seeded) tasks.
             "impl": "auto",
+            # tiled-kernel capacity knobs (None = the ops-level defaults;
+            # ignored by the legacy kernel).  Raise on overflow reports:
+            # exit/fill/adj govern the cross-tile exit and saddle-fill
+            # buffers, seed_cap the sparse seed labeler (CT_SEED_CCL),
+            # fill_rounds the Boruvka round count, table_cap the VMEM
+            # remap tables.
+            "exit_cap": None,
+            "fill_cap": None,
+            "adj_cap": None,
+            "fill_rounds": None,
+            "seed_cap": None,
+            "table_cap": None,
         }
 
     def _setup(self):
@@ -248,6 +272,7 @@ class WatershedBase(_WsTaskBase):
                 from ..ops.tile_ws import dt_watershed_tiled
 
                 tk = {k: v for k, v in kp.items() if k != "connectivity"}
+                tk.update(_tiled_cap_knobs(cfg))
                 lab, ovf = dt_watershed_tiled(b, mask=m, impl=impl, **tk)
             else:
                 lab = distance_transform_watershed(b, mask=m, two_d=two_d, **kp)
@@ -447,11 +472,13 @@ class TwoPassWatershedBase(_WsTaskBase):
                 from ..ops.tile_ws import dt_watershed_seeded_tiled
 
                 tk = {k: v for k, v in kp.items() if k != "connectivity"}
-                lab, _ovf = dt_watershed_seeded_tiled(
+                tk.update(_tiled_cap_knobs(cfg))
+                lab, ovf = dt_watershed_seeded_tiled(
                     b, ext, mask=m, impl=impl, **tk
                 )
             else:
                 lab = dt_watershed_seeded(b, ext, mask=m, **kp)
+                ovf = jnp.zeros((), bool)
             if size_filter > 0:
                 # external ids live in (N, 2N]; widen the size-count domain
                 lab = filter_small_segments(
@@ -461,9 +488,18 @@ class TwoPassWatershedBase(_WsTaskBase):
                     connectivity=kp["connectivity"],
                     max_label=2 * n_outer,
                 )
-            return lab
+            return lab, ovf
 
         def store(block, raw):
+            raw, ovf = raw
+            if bool(np.asarray(ovf)):
+                # same contract as the single-pass store: capacity
+                # truncation means under-merged labels — never silent
+                self.logger.warning(
+                    f"block {block.block_id} overflowed a tiled-watershed "
+                    "capacity; labels may be under-merged (raise the caps "
+                    "or use impl=legacy)"
+                )
             raw = np.asarray(raw)[block.inner_in_outer_bb]
             ext_labels = tables.pop(block.block_id)
             is_ext = raw > n_outer

@@ -299,3 +299,26 @@ def test_host_impl_refused_for_two_pass(workspace, rng):
         impl="host",
     )
     assert not build([wf])
+
+
+def test_capacity_knobs_reach_the_tiled_kernel(rng, workspace):
+    # a starved fill_rounds must surface as the task's loud overflow
+    # warning (in the per-task LOG FILE — the task logger doesn't
+    # propagate) — proving the config knob actually reaches the kernel
+    # (the round-4 regression was knobs silently unreachable from the
+    # task API).  Raw noise with a high min_seed_distance leaves many
+    # unseeded basins, so one Boruvka round cannot converge.
+    import glob
+
+    vol = rng.random((32, 32, 32)).astype(np.float32)
+    labels = _run_ws(
+        workspace, vol, two_pass=False, impl="xla",
+        min_seed_distance=2.0, fill_rounds=1,
+        output_key="labels_knobs",
+    )
+    assert labels.shape == vol.shape
+    tmp_folder = workspace[0]
+    logs = "".join(
+        open(p).read() for p in glob.glob(os.path.join(tmp_folder, "*.log"))
+    )
+    assert "overflowed" in logs
