@@ -49,7 +49,7 @@ def _tiled_cap_knobs(cfg):
     return {
         k: int(cfg[k])
         for k in ("exit_cap", "fill_cap", "adj_cap", "fill_rounds",
-                  "seed_cap", "table_cap")
+                  "seed_cap", "table_cap", "pair_cap", "edge_cap")
         if cfg.get(k) is not None
     }
 
@@ -99,13 +99,15 @@ class _WsTaskBase(BaseTask):
             # exit/fill/adj govern the cross-tile exit and saddle-fill
             # buffers, seed_cap the sparse seed labeler (CT_SEED_CCL),
             # fill_rounds the Boruvka round count, table_cap the VMEM
-            # remap tables.
+            # remap tables, pair/edge_cap the seed CCL's face merge.
             "exit_cap": None,
             "fill_cap": None,
             "adj_cap": None,
             "fill_rounds": None,
             "seed_cap": None,
             "table_cap": None,
+            "pair_cap": None,
+            "edge_cap": None,
         }
 
     def _setup(self):
@@ -490,11 +492,15 @@ class TwoPassWatershedBase(_WsTaskBase):
                 )
             return lab, ovf
 
+        overflow_blocks = []
+
         def store(block, raw):
             raw, ovf = raw
             if bool(np.asarray(ovf)):
                 # same contract as the single-pass store: capacity
-                # truncation means under-merged labels — never silent
+                # truncation means under-merged labels — never silent,
+                # and recorded so the blocks can be rerun programmatically
+                overflow_blocks.append(block.block_id)
                 self.logger.warning(
                     f"block {block.block_id} overflowed a tiled-watershed "
                     "capacity; labels may be under-merged (raise the caps "
@@ -526,7 +532,11 @@ class TwoPassWatershedBase(_WsTaskBase):
             store,
             on_block_done=lambda b: self.log_block_success(b.block_id),
         )
-        return {"n_blocks": len(block_ids), "n_outer": n_outer}
+        return {
+            "n_blocks": len(block_ids),
+            "n_outer": n_outer,
+            "overflow_blocks": overflow_blocks,
+        }
 
 
 class TwoPassWatershedLocal(TwoPassWatershedBase):

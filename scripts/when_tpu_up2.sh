@@ -73,12 +73,37 @@ say "stage 3b exit: $?"
 wait_healthy || exit 1
 say "stage 3c: compile_table fused 512 (auto), cap 45min"
 CT_PROBE_IMPL=auto timeout 2700 python scripts/compile_table.py fused 512 32 >> "$LOG" 2>&1
-say "stage 3c exit: $?"
+RC3C=$?
+say "stage 3c exit: $RC3C"
 wait_healthy || exit 1
+
+# stage 3d (only if 3c failed): the tier=big program is ~20% smaller
+# (capacity conds collapsed — exact, just without the small-tier runtime
+# win).  tier_mode shapes EVERY tiered program, so the bench can only use
+# this cache if ccl/dt_ws 512 are ALSO compiled under tier=big — the
+# cond-tier entries from 3a/3b would miss under the big-tier env.
+BENCH_TIER=""
+if [ "$RC3C" -ne 0 ]; then
+  say "stage 3d: compile_table fused 512 (auto, CT_TIER_MODE=big), cap 45min"
+  CT_TIER_MODE=big CT_PROBE_IMPL=auto timeout 2700 python scripts/compile_table.py fused 512 32 >> "$LOG" 2>&1
+  RC3D=$?
+  say "stage 3d exit: $RC3D"
+  wait_healthy || exit 1
+  if [ "$RC3D" -eq 0 ]; then
+    BENCH_TIER="big"
+    for t in ccl dt_ws; do
+      say "stage 3d+: compile_table $t 512 (auto, CT_TIER_MODE=big)"
+      CT_TIER_MODE=big CT_PROBE_IMPL=auto timeout 1800 python scripts/compile_table.py "$t" 512 32 >> "$LOG" 2>&1
+      say "stage 3d+ $t exit: $?"
+      wait_healthy || exit 1
+    done
+  fi
+fi
 
 # stage 4: the bench itself.  With stage 3 cached the auto rung compiles
 # in seconds; without it the pre-pass still banks configs 1/2 + salvage.
-say "stage 4: bench.py (budget 3600, auto cap 1500)"
+say "stage 4: bench.py (budget 3600, auto cap 1500, tier='${BENCH_TIER:-cond}')"
+CT_TIER_MODE="${BENCH_TIER:-cond}" \
 CT_BENCH_BUDGET=3600 CT_BENCH_CAP_AUTO=1500 CT_BENCH_CAP_XLA=900 \
   timeout 4200 python bench.py >> "$LOG" 2>&1
 say "stage 4 exit: $?"

@@ -310,15 +310,25 @@ def test_capacity_knobs_reach_the_tiled_kernel(rng, workspace):
     # unseeded basins, so one Boruvka round cannot converge.
     import glob
 
+    def all_logs():
+        return "".join(
+            open(p).read()
+            for p in glob.glob(os.path.join(workspace[0], "*.log"))
+        )
+
     vol = rng.random((32, 32, 32)).astype(np.float32)
+    # negative control: default caps on the same volume stay clean — so
+    # the overflow below can ONLY come from the knob reaching the kernel
+    labels = _run_ws(
+        workspace, vol, two_pass=False, impl="xla",
+        min_seed_distance=2.0, output_key="labels_ctrl",
+    )
+    assert labels.shape == vol.shape
+    assert "overflowed" not in all_logs()
     labels = _run_ws(
         workspace, vol, two_pass=False, impl="xla",
         min_seed_distance=2.0, fill_rounds=1,
         output_key="labels_knobs",
     )
     assert labels.shape == vol.shape
-    tmp_folder = workspace[0]
-    logs = "".join(
-        open(p).read() for p in glob.glob(os.path.join(tmp_folder, "*.log"))
-    )
-    assert "overflowed" in logs
+    assert "overflowed" in all_logs()
