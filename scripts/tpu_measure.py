@@ -7,10 +7,16 @@ scalar fetch (block_until_ready returns after enqueue on axon).
     python scripts/tpu_measure.py [--quick]
 """
 
+import os
 import sys
 import time
 
 sys.path.insert(0, ".")
+
+# match bench.py's accel-run choice so the timings describe the shipped
+# program (the tiled seed labeler can still be measured by exporting
+# CT_SEED_CCL=tiled)
+os.environ.setdefault("CT_SEED_CCL", "sparse")
 
 import jax
 import jax.numpy as jnp
@@ -55,8 +61,13 @@ def main():
     from cluster_tools_tpu.parallel.mesh import make_mesh
     from cluster_tools_tpu.parallel.pipeline import make_ws_ccl_step
 
-    side = 256 if quick else 512
+    side = int(os.environ.get("CT_MEASURE_SIDE", "256" if quick else "512"))
     halo = 32
+    # bench-matching kernel params, shared by every row below (drift here
+    # would silently decouple the seed-labeler comparison from the fused
+    # timings)
+    threshold = 0.45
+    msd = 2.0
 
     @jax.jit
     def synth(key):
@@ -70,7 +81,7 @@ def main():
     vol = synth(jax.random.PRNGKey(0))
     sync(vol)
     log(f"volume {vol.shape} ready")
-    fg = vol < 0.45
+    fg = vol < threshold
     sync(fg)
 
     # EDT: pallas vs xla
@@ -88,14 +99,35 @@ def main():
     if not quick:
         timeit("CCL tiled xla", lambda m: label_components_tiled(m, impl="xla"), fg)
 
-    # DT watershed fused, both impls
+    # DT watershed fused (seed labeler per CT_SEED_CCL, default sparse)
     timeit(
         "dt_ws tiled pallas",
         lambda b: dt_watershed_tiled(
-            b, threshold=0.45, dt_max_distance=float(halo),
-            min_seed_distance=2.0, impl="pallas",
+            b, threshold=threshold, dt_max_distance=float(halo),
+            min_seed_distance=msd, impl="pallas",
         ),
         vol,
+    )
+
+    # seed-labeler comparison at bench scale: the sparse labeler vs the
+    # full tiled machinery on the actual maxima mask
+    from cluster_tools_tpu.ops.edt import distance_transform_squared
+    from cluster_tools_tpu.ops.tile_ccl import label_components_sparse
+    from cluster_tools_tpu.ops.watershed import local_maxima
+
+    @jax.jit
+    def mk_maxima(b):
+        m = b < threshold
+        d = distance_transform_squared(m, max_distance=float(halo))
+        return local_maxima(d, 1) & m & (d >= msd * msd)
+
+    maxima = mk_maxima(vol)
+    sync(maxima)
+    timeit("seed CCL sparse", lambda m: label_components_sparse(m)[0], maxima)
+    timeit(
+        "seed CCL tiled pallas",
+        lambda m: label_components_tiled(m, impl="pallas")[0],
+        maxima,
     )
 
     # table-cap sensitivity on the watershed
@@ -103,8 +135,8 @@ def main():
         timeit(
             f"dt_ws pallas table_cap={cap}",
             lambda b, c=cap: dt_watershed_tiled(
-                b, threshold=0.45, dt_max_distance=float(halo),
-                min_seed_distance=2.0, impl="pallas", table_cap=c,
+                b, threshold=threshold, dt_max_distance=float(halo),
+                min_seed_distance=msd, impl="pallas", table_cap=c,
             ),
             vol,
             runs=2,
@@ -124,8 +156,8 @@ def main():
     volb = vol[None, halo:-halo]  # (1, side, side, side)
     for impl in ("auto", "legacy") if not quick else ("auto",):
         step = make_ws_ccl_step(
-            mesh, halo=halo, threshold=0.45, dt_max_distance=float(halo),
-            min_seed_distance=2.0, impl=impl,
+            mesh, halo=halo, threshold=threshold, dt_max_distance=float(halo),
+            min_seed_distance=msd, impl=impl,
         )
         t, out = timeit(f"fused step impl={impl}", step, volb, runs=3)
         if t:
