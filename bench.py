@@ -259,13 +259,18 @@ def main():
 
     # deterministic CREMI-like boundary map, synthesized ON DEVICE (see
     # module docstring: the tunnel cannot feed host arrays at benchmark rate)
+    # 12 box passes per axis give ~20-voxel objects — the scale of CREMI
+    # neurites at native resolution; the old 4 passes left ~5-voxel noise
+    # plateaus, an adversarial regime no EM volume exhibits (the capacity
+    # audit in docs/PERFORMANCE.md measured its basin-face load).  Recorded
+    # in the JSON as synth_box_passes.
+    synth_passes = int(os.environ.get("CT_BENCH_SYNTH_PASSES", "12"))
+
     @jax.jit
     def synth(key):
         v = jax.random.uniform(key, (batch, z, y, x), jnp.float32)
-        # 4 box passes per axis: object scale like a (downsampled) CREMI
-        # boundary map rather than voxel-scale noise plateaus
         for axis in range(1, 4):
-            for _ in range(4):
+            for _ in range(synth_passes):
                 v = (v + jnp.roll(v, 1, axis) + jnp.roll(v, -1, axis)) / 3.0
         lo, hi = v.min(), v.max()
         return (v - lo) / jnp.maximum(hi - lo, 1e-6)
@@ -734,6 +739,7 @@ def main():
         "mesh": {"dp": dp, "sp": sp},
         "collectives_measured": dp * sp > 1,
         "volume": list(vol.shape),
+        "synth_box_passes": synth_passes,
         "halo": halo,
         "overflow": overflow,
         "timing": "sync-by-scalar-fetch (block_until_ready does not block on axon)",

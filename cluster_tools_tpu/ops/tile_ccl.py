@@ -49,7 +49,12 @@ BIG = 2**30  # background sentinel during the padded/tiled phase
 
 DEFAULT_TILE = (16, 16, 128)
 DEFAULT_PAIR_CAP = 1 << 21
-DEFAULT_EDGE_CAP = 1 << 19
+# ceiling for unique merged face edges.  Was 1<<19: the measured pair load
+# on bench-like volumes is ~0.6% of voxels and size-constant, which
+# projects to ~1M at 512³ — over the old ceiling with no margin.  n//128
+# still rules below ~250M voxels, so behavior only changes at very large
+# single-shard volumes (docs/PERFORMANCE.md "512³ capacity audit").
+DEFAULT_EDGE_CAP = 1 << 21
 DEFAULT_TABLE_CAP = 64
 
 

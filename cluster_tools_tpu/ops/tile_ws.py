@@ -424,11 +424,12 @@ def fill_unseeded_basins(
     hk = jnp.concatenate(evs_h)
 
     # Default adjacency capacity must stay OBJECT-scale at every volume
-    # size or the dedup buys nothing — ``labels.size // 128`` keeps it ~6x
-    # below the raw 3*fill_cap buffer at 512³ (1.05M vs 6.3M) while the
-    # DEFAULT_ADJ_CAP floor covers pure-noise small volumes (~size/27
-    # basins, a few adjacencies each).  Overflow is flagged; a pure-noise
-    # large shard should raise adj_cap explicitly.
+    # size or the dedup buys nothing — ``labels.size // 128`` keeps it far
+    # below the raw 3*fill_cap candidate buffer (~48x at 512³ with the
+    # capacity-audit fill_cap of n/8: 1.2M unique adjacencies vs 50M raw
+    # face voxels) while the DEFAULT_ADJ_CAP floor covers pure-noise small
+    # volumes (~size/27 basins, a few adjacencies each).  Overflow is
+    # flagged; a pure-noise large shard should raise adj_cap explicitly.
     if adj_cap is None:
         adj_cap = min(
             3 * fill_cap, max(DEFAULT_ADJ_CAP, labels.size // 128)
@@ -597,13 +598,29 @@ def seeded_watershed_tiled(
     padded = (zp != z) or (yp != y) or (xp != x)
     if exit_cap is None:
         # n/3 >= the total strip voxel count for the default tile, so exits
-        # can never overflow below ~6M voxels; fill edges can reach ~n/2 in
-        # pure-noise/sparse-seed regimes, so fill uses divisor 1.  Above the
-        # absolute bounds both rely on realistic fragment density plus the
-        # overflow flag.
-        exit_cap = _auto_cap(zp * yp * xp, DEFAULT_EXIT_CAP, 3)
+        # can never overflow below ~6M voxels.  ABOVE that the loads keep
+        # scaling with the volume (measured on bench-like box-filtered
+        # noise, fractions size-constant 96³→160³ and smoothing-
+        # insensitive: exit candidates ~8% of voxels SUMMED over the six
+        # strip families — docs/PERFORMANCE.md "512³ capacity audit"), so
+        # the old 2^21 ceiling would truncate a 512³ run by ~6x.  The
+        # overflow check is PER FAMILY (each compact is capped separately);
+        # the largest family carries ~2.5% of voxels, so n/12 leaves ~3x
+        # per-family headroom up to the 2^24 ceiling (int32 buffers,
+        # ~600MB transient at 512³).  The ~8% total only picks the
+        # capacity TIER, never the flag.
+        n_pad = zp * yp * xp
+        exit_cap = min(
+            1 << 24, max(_auto_cap(n_pad, DEFAULT_EXIT_CAP, 3), n_pad // 12)
+        )
     if fill_cap is None:
-        fill_cap = _auto_cap(zp * yp * xp, DEFAULT_FILL_CAP, 1)
+        # fill edges can reach ~n/2 per axis in pure-noise/sparse-seed
+        # regimes (overflow-flagged); the proportional floor covers the
+        # measured ~9%-per-axis bench-like load with ~2.5x margin
+        n_pad = zp * yp * xp
+        fill_cap = min(
+            1 << 24, max(_auto_cap(n_pad, DEFAULT_FILL_CAP, 1), n_pad // 8)
+        )
     valid = jnp.ones(height.shape, bool) if mask is None else mask.astype(bool)
     h = height.astype(jnp.float32)
     s = seeds.astype(jnp.int32)

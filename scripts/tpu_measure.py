@@ -69,11 +69,15 @@ def main():
     threshold = 0.45
     msd = 2.0
 
+    # same knob and default as bench.py, so the per-kernel rows always
+    # describe the volume the bench actually ran
+    synth_passes = int(os.environ.get("CT_BENCH_SYNTH_PASSES", "12"))
+
     @jax.jit
     def synth(key):
         v = jax.random.uniform(key, (side + 2 * halo, side, side), jnp.float32)
         for axis in range(3):
-            for _ in range(4):
+            for _ in range(synth_passes):
                 v = (v + jnp.roll(v, 1, axis) + jnp.roll(v, -1, axis)) / 3.0
         lo, hi = v.min(), v.max()
         return (v - lo) / jnp.maximum(hi - lo, 1e-6)
