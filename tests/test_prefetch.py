@@ -56,7 +56,7 @@ def test_prefetcher_plain_values():
 def test_executor_overlaps_future_loads():
     """All of a batch's read futures must be in flight together: wall-clock
     stays far below the serialized per-block read time."""
-    read_delay = 0.08
+    read_delay = 0.15
     pool = ThreadPoolExecutor(16)
     blocking = Blocking((8, 8, 64), (8, 8, 8))
     blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
@@ -74,8 +74,20 @@ def test_executor_overlaps_future_loads():
         outs[block.block_id] = np.asarray(out)
 
     ex = BlockwiseExecutor(target="local", n_devices=4, device_batch=2)
+    # warm up backend init + executor/pool spin-up so the timed run
+    # measures IO overlap, not first-call overhead (the 0.6x margin flaked
+    # under machine load).  NOTE: map_blocks rebuilds its jit wrapper per
+    # call, so the kernel still retraces in the timed window — the shared
+    # kernel object maximizes what the in-process caches can reuse, and
+    # read_delay is sized so trace+compile stays well inside the margin.
+    kernel = lambda a: a + 1.0  # noqa: E731 — shared across both calls
+    ex.map_blocks(
+        kernel, blocks,
+        lambda b: (np.zeros((8, 8, 8), np.float32),),
+        lambda b, o: None,
+    )
     t0 = time.perf_counter()
-    ex.map_blocks(lambda a: a + 1.0, blocks, load, store)
+    ex.map_blocks(kernel, blocks, load, store)
     wall = time.perf_counter() - t0
     serial = len(blocks) * read_delay
     assert wall < 0.6 * serial, f"no overlap: wall={wall:.2f}s serial={serial:.2f}s"
