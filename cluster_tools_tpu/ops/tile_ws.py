@@ -447,6 +447,132 @@ def fill_unseeded_basins(
     return edge_vals, edge_finals, overflow > 0
 
 
+def fill_unseeded_basins_dense(
+    values: jnp.ndarray,
+    height: jnp.ndarray,
+    max_rounds: int = 16,
+):
+    """Sort-free unseeded-basin fill: dense scatter-min Boruvka rounds.
+
+    Same MSF semantics as :func:`fill_unseeded_basins` but computed over
+    the FULL face grids instead of capacity-compacted candidate lists: no
+    sorts, no caps, no truncation, and the saddle per basin pair is the
+    exact minimum over every shared face voxel (the capacity fill samples
+    run-start saddles — see the ``keep`` flags there).  Designed for the
+    512³ capacity-audit regime (docs/PERFORMANCE.md): basin-face loads
+    are ~9% of voxels per axis, so the capacity path's dedup sorts run at
+    tens of millions of rows while these rounds are a handful of dense
+    full-volume passes each (HBM-bandwidth-bound, the shape TPUs like).
+    Memory: the round body's live set (``P``, ``best_h``, ``best_e``,
+    indices, resolved labels, scatter temporaries) is several int32
+    volumes — ~1.8GB transient at 512³.
+
+    ``values``: >0 seeded label, <= -2 unseeded terminal code
+    (``-flat_index - 2``), 0 invalid.  Returns ``(resolved_values,
+    overflow_int32)`` — per-voxel labels with every reachable unseeded
+    basin resolved to its adopted seed label (unreachable basins keep
+    their codes; callers zero them), overflow set when ``max_rounds``
+    rounds did not converge.
+
+    Selected by ``CT_FILL_MODE=dense`` (trace-time, like
+    :func:`~cluster_tools_tpu.ops.tile_ccl.tier_mode`); the default
+    ``capacity`` keeps the compacted path.
+    """
+    shape = values.shape
+    n = int(np.prod(shape))
+    v = values.ravel()
+    h = _sortable_float_key(height.astype(jnp.float32))
+    i32max = jnp.iinfo(jnp.int32).max
+
+    # P[g] = current label of the basin whose terminal voxel is g; codes
+    # resolve through it, seeds are terminal by value
+    P0 = _match_vma(-jnp.arange(n, dtype=jnp.int32) - 2, values)
+
+    def resolve(P, x):
+        return jnp.where(x <= -2, P[jnp.clip(-x - 2, 0, n - 1)], x)
+
+    def round_cond(s):
+        _, changed, it = s
+        return changed & (it < max_rounds)
+
+    def round_body(s):
+        P, _, it = s
+        rv = resolve(P, v).reshape(shape)
+        best_h = _match_vma(jnp.full((n,), i32max, jnp.int32), values)
+        best_e = _match_vma(jnp.full((n,), i32max, jnp.int32), values)
+        # per-axis face passes; eid = axis * n + flat index is a globally
+        # distinct tie-break seen identically from both sides, so the
+        # min-edge graph is a forest plus 2-cycles (the classic distinct-
+        # weight Boruvka argument, as in _fill_core)
+        flat_idx = _match_vma(
+            jnp.arange(n, dtype=jnp.int32).reshape(shape), values
+        )
+        sides = []
+        for axis in range(3):
+            nb = _shift(rv, -1, axis, jnp.int32(0))
+            saddle = jnp.maximum(
+                h, _shift(h, -1, axis, jnp.int32(i32max))
+            )
+            ok = (rv != nb) & (rv != 0) & (nb != 0)
+            eid = jnp.int32(axis) * jnp.int32(n) + flat_idx
+            sides.append((rv, nb, saddle, ok, eid))
+            sides.append((nb, rv, saddle, ok, eid))
+        for src, dst, saddle, ok, eid in sides:
+            m = ok & (src <= -2)
+            g = jnp.where(m, -src - 2, n).ravel()
+            best_h = best_h.at[g].min(
+                jnp.where(m, saddle, i32max).ravel(), mode="drop"
+            )
+        for src, dst, saddle, ok, eid in sides:
+            m = ok & (src <= -2)
+            g = jnp.where(m, -src - 2, n).ravel()
+            tie = m & (best_h[jnp.clip(-src - 2, 0, n - 1)] == saddle)
+            gt = jnp.where(tie, -src - 2, n).ravel()
+            best_e = best_e.at[gt].min(
+                jnp.where(tie, eid, i32max).ravel(), mode="drop"
+            )
+        P2 = P
+        for src, dst, saddle, ok, eid in sides:
+            m = ok & (src <= -2)
+            gsafe = jnp.clip(-src - 2, 0, n - 1)
+            win = (
+                m
+                & (best_h[gsafe] == saddle)
+                & (best_e[gsafe] == eid)
+            )
+            gw = jnp.where(win, -src - 2, n).ravel()
+            P2 = P2.at[gw].set(jnp.where(win, dst, 0).ravel(), mode="drop")
+        # break 2-cycles (two roots that picked the same edge from both
+        # sides): the smaller terminal index stays a root
+        me = _match_vma(jnp.arange(n, dtype=jnp.int32), values)
+        tgt = jnp.clip(-P2 - 2, 0, n - 1)
+        mutual = (P2 <= -2) & (P2[tgt] == (-me - 2)) & (me < tgt)
+        P2 = jnp.where(mutual, -me - 2, P2)
+        # pointer-jump to CLOSURE, not a fixed count: a partially
+        # compressed table would let the next round's rv expose
+        # intermediate codes, and a non-root's re-hook would then
+        # overwrite (sever) an already-contracted MSF union — the exact-
+        # semantics claim depends on every round starting from true roots
+        def comp_cond(t):
+            _, ch = t
+            return ch
+
+        def comp_body(t):
+            p, _ = t
+            p2 = resolve(p, p)
+            return p2, jnp.any(p2 != p)
+
+        P2, _ = lax.while_loop(comp_cond, comp_body, (P2, _true_like(P2)))
+        changed = jnp.any(P2 != P)
+        return P2, changed, it + 1
+
+    P, unconverged, _ = lax.while_loop(
+        round_cond, round_body, (P0, _true_like(v), jnp.int32(0))
+    )
+    resolved = resolve(P, v).reshape(shape)
+    return resolved, unconverged.astype(jnp.int32)
+
+
 def _fill_core(a, b, hk, adj_cap, max_rounds, vma_like):
     """Dedup + dense ids + Boruvka rounds over one capacity tier.
 
@@ -528,10 +654,25 @@ def _fill_core(a, b, hk, adj_cap, max_rounds, vma_like):
         pp = parent2[parent2]
         me = jnp.arange(np_, dtype=jnp.int32)
         parent2 = jnp.where((pp == me) & (me < parent2), me, parent2)
-        # jump to closure
-        parent2 = parent2[parent2]
-        parent2 = parent2[parent2]
-        parent2 = parent2[parent2]
+        # jump to CLOSURE, not a fixed count: a round's hook forest can
+        # chain arbitrarily many roots (monotone saddle runs), and a
+        # partially-composed P would let the next round hook from
+        # intermediate nodes — splitting one component's members across
+        # different final seeds.  P stays closed inductively: P0 is the
+        # identity, and composing a closed P through a closed parent2
+        # yields true roots only.
+        def comp_cond(t):
+            _, ch = t
+            return ch
+
+        def comp_body(t):
+            p, _ = t
+            p2 = p[p]
+            return p2, jnp.any(p2 != p)
+
+        parent2, _ = lax.while_loop(
+            comp_cond, comp_body, (parent2, _true_like(parent2))
+        )
         newP = parent2[P]
         return newP, jnp.any(newP != P), it + 1
 
@@ -667,7 +808,26 @@ def seeded_watershed_tiled(
     else:
         values = _resolve_codes_gather(values, codes, finals)
 
-    # unseeded-basin fill across lowest saddles
+    # unseeded-basin fill across lowest saddles.  CT_FILL_MODE (trace-
+    # time, like tier_mode) selects the machinery: "capacity" (default)
+    # compacts candidates into capped lists and sort-dedups them;
+    # "dense" runs sort-free scatter-min Boruvka rounds over the full
+    # face grids — no caps, exact min saddles, built for the high-load
+    # 512^3 regime (see fill_unseeded_basins_dense)
+    fill_mode = os.environ.get("CT_FILL_MODE", "capacity")
+    if fill_mode == "dense":
+        values, fill_unconv = fill_unseeded_basins_dense(
+            values, h, max_rounds=fill_rounds
+        )
+        overflow = overflow | (fill_unconv > 0)
+        out = jnp.where(values > 0, values, 0).astype(jnp.int32)
+        if padded:
+            out = out[:z, :y, :x]
+        return out, overflow
+    if fill_mode != "capacity":
+        raise ValueError(
+            f"CT_FILL_MODE must be capacity/dense, got {fill_mode!r}"
+        )
     fill_vals, fill_finals, fill_overflow = fill_unseeded_basins(
         values, h, fill_cap=fill_cap, max_rounds=fill_rounds, adj_cap=adj_cap
     )
