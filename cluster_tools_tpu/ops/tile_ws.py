@@ -23,12 +23,15 @@ restructures the resolution:
 4. **Apply**: per-tile value-remap tables (the ops/tile_ccl machinery) or a
    gather fallback.
 5. **Unseeded-basin fill**: instead of ring-growing, basins without seeds
-   merge into their neighbor across the *lowest saddle* (Boruvka rounds on a
-   compacted basin-boundary edge list) — minimum-spanning-forest watershed
-   semantics, strictly closer to priority-flood than the old relaxation, and
-   O(log) rounds of small-array work instead of O(basin diameter) full-volume
-   sweeps.  Basins with no seeded reachable neighbor keep label 0 (legacy
-   behavior).
+   merge into their neighbor across the *lowest saddle* (Boruvka rounds) —
+   minimum-spanning-forest watershed semantics, strictly closer to
+   priority-flood than the old relaxation.  Two machines compute it
+   (``CT_FILL_MODE``): ``capacity`` (default) runs the rounds on a
+   compacted basin-boundary edge list with run-start saddle sampling;
+   ``dense`` (the bench default) runs sort-free scatter-min rounds over
+   the full face grids with exact per-pair min saddles
+   (:func:`fill_unseeded_basins_dense`).  Basins with no seeded reachable
+   neighbor keep label 0 (legacy behavior).
 
 When every basin is seeded (e.g. the oracle test's fully-seeded minima) the
 result is bit-identical to the legacy kernel; only unseeded-basin fill order
