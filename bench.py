@@ -206,10 +206,10 @@ def main():
     # compile_table.py sets the same default so its persistent-cache
     # entries match this program.
     os.environ.setdefault("CT_SEED_CCL", "sparse")
-    # and the sort-free dense fill: exact min-saddle MSF, no fill/adj
-    # caps, 3.5x faster end-to-end on the host substrate at 128^3
-    # (docs/PERFORMANCE.md capacity audit); same consistency rule — the
-    # compile probes set the same default so cache entries match
+    # explicit pin (also the library default since the flip): bench and
+    # the compile probes must agree on the fill machinery or their cache
+    # entries diverge — pinning here keeps that invariant even if the
+    # library default changes again
     os.environ.setdefault("CT_FILL_MODE", "dense")
     if accel is None:
         from __graft_entry__ import _force_cpu_platform

@@ -53,6 +53,7 @@ def main() -> None:
         # must match bench.py's accel-run default or the cache entry this
         # probe leaves behind is not the one the bench rung looks up
         os.environ.setdefault("CT_SEED_CCL", "sparse")
+        # explicit pin (also the library default) — must match bench.py
         os.environ.setdefault("CT_FILL_MODE", "dense")
     impl = os.environ.get("CT_PROBE_IMPL", "auto")
     threshold = 0.45

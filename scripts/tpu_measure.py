@@ -17,6 +17,7 @@ sys.path.insert(0, ".")
 # program (the tiled seed labeler can still be measured by exporting
 # CT_SEED_CCL=tiled)
 os.environ.setdefault("CT_SEED_CCL", "sparse")
+# explicit pin (also the library default) — must match bench.py
 os.environ.setdefault("CT_FILL_MODE", "dense")
 
 import jax

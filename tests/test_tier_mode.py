@@ -95,7 +95,9 @@ def test_small_mode_exact_when_fits(rng, monkeypatch):
 def test_small_mode_flags_truncation(rng, monkeypatch):
     # two seeds in pure noise: the unseeded-basin fill sees ~1.3e5 face
     # voxels, beyond the small tier — small mode must FLAG, not silently
-    # truncate (cond mode handles this via its big branch, no overflow)
+    # truncate (cond mode handles this via its big branch, no overflow).
+    # Pin the CAPACITY fill: the dense default has no capacities to tier
+    monkeypatch.setenv("CT_FILL_MODE", "capacity")
     shape = (24, 24, 130)
     height = rng.random(shape).astype(np.float32)
     seeds = np.zeros(shape, np.int32)

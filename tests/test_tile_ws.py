@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 import scipy.ndimage as ndi
 
+import jax
 import jax.numpy as jnp
 
 from cluster_tools_tpu.ops.watershed import local_maxima, seeded_watershed
@@ -125,7 +126,12 @@ def test_pallas_interpret_matches_xla(rng):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_overflow_flag(rng):
+def test_overflow_flag(rng, monkeypatch):
+    # pin the capacity fill: fill_cap only exists there (the dense
+    # default has no candidate caps — exit_cap alone would still trip,
+    # but this test exists to cover the FILL capacity class)
+    monkeypatch.setenv("CT_FILL_MODE", "capacity")
+    jax.clear_caches()
     height = rng.random((32, 32, 128)).astype(np.float32)
     seeds = np.zeros((32, 32, 128), np.int32)
     seeds[0, 0, 0] = 1
@@ -134,6 +140,7 @@ def test_overflow_flag(rng):
         exit_cap=8, fill_cap=8,
     )
     assert bool(ovf)
+    jax.clear_caches()
 
 
 def test_chase_exits_small_tier_matches_oracle(rng):
@@ -209,11 +216,14 @@ def test_value_join_small_tier_matches_core(rng):
         assert got[i] == lut.get(int(queries[i]), int(queries[i])), i
 
 
-def test_sparse_seed_noise_fill_knobs(rng):
+def test_sparse_seed_noise_fill_knobs(rng, monkeypatch):
     """Sparse seeds in a noise-heavy volume exceed the default fill
     capacities (many small unseeded basins) — the overflow flag must say
     so, and the public knobs (adj_cap, fill_rounds) must be enough to
-    complete the fill with every voxel labeled by a seed."""
+    complete the fill with every voxel labeled by a seed.  Pinned to the
+    CAPACITY fill: the dense default has no fill/adj caps to exercise."""
+    monkeypatch.setenv("CT_FILL_MODE", "capacity")
+    jax.clear_caches()
     height = rng.random((64, 64, 64)).astype(np.float32)
     seeds = np.zeros((64, 64, 64), np.int32)
     seeds[8, 8, 8] = 1
@@ -228,6 +238,7 @@ def test_sparse_seed_noise_fill_knobs(rng):
     assert not bool(ovf)
     assert (seg > 0).all()
     assert set(np.unique(seg)) == {1, 2}
+    jax.clear_caches()
 
 
 def test_dt_watershed_seeded_tiled_external_encoding(rng):
