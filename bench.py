@@ -206,11 +206,10 @@ def main():
     # compile_table.py sets the same default so its persistent-cache
     # entries match this program.
     os.environ.setdefault("CT_SEED_CCL", "sparse")
-    # explicit pin (also the library default since the flip): bench and
-    # the compile probes must agree on the fill machinery or their cache
-    # entries diverge — pinning here keeps that invariant even if the
-    # library default changes again
-    os.environ.setdefault("CT_FILL_MODE", "dense")
+    # fill machinery follows the library's substrate-aware auto default
+    # (dense on cpu, capacity on tpu — see tile_ws); bench and the
+    # compile probes resolve it identically by backend, so cache entries
+    # stay consistent without a pin here
     if accel is None:
         from __graft_entry__ import _force_cpu_platform
 

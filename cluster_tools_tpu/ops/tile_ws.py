@@ -26,12 +26,14 @@ restructures the resolution:
    merge into their neighbor across the *lowest saddle* (Boruvka rounds) —
    minimum-spanning-forest watershed semantics, strictly closer to
    priority-flood than the old relaxation.  Two machines compute it
-   (``CT_FILL_MODE``): ``dense`` (default) runs sort-free scatter-min
-   rounds over the full face grids with exact per-pair min saddles
-   (:func:`fill_unseeded_basins_dense`); ``capacity`` runs the rounds on
-   a compacted basin-boundary edge list with run-start saddle sampling
-   (~1/18 the transient memory).  Basins with no seeded reachable
-   neighbor keep label 0 (legacy behavior).
+   (``CT_FILL_MODE``, default ``auto`` = substrate-aware): ``dense``
+   (auto on non-TPU) runs sort-free scatter-min rounds over the full
+   face grids with exact per-pair min saddles
+   (:func:`fill_unseeded_basins_dense`); ``capacity`` (auto on TPU,
+   where volume-scale random access is the bottleneck) runs the rounds
+   on a compacted basin-boundary edge list with run-start saddle
+   sampling (~1/18 the transient memory).  Basins with no seeded
+   reachable neighbor keep label 0 (legacy behavior).
 
 When every basin is seeded (e.g. the oracle test's fully-seeded minima) the
 result is bit-identical to the legacy kernel; only unseeded-basin fill order
@@ -462,10 +464,14 @@ def fill_unseeded_basins_dense(
     sorts, no caps, no truncation, and the saddle per basin pair is the
     exact minimum over every shared face voxel (the capacity fill samples
     run-start saddles — see the ``keep`` flags there).  Designed for the
-    512³ capacity-audit regime (docs/PERFORMANCE.md): basin-face loads
-    are ~9% of voxels per axis, so the capacity path's dedup sorts run at
-    tens of millions of rows while these rounds are a handful of dense
-    full-volume passes each (HBM-bandwidth-bound, the shape TPUs like).
+    512³ capacity-audit regime on gather-friendly substrates
+    (docs/PERFORMANCE.md): basin-face loads are ~9% of voxels per axis,
+    so the capacity path's dedup sorts run at tens of millions of rows
+    while these rounds are a handful of full-volume passes each.  NOTE
+    the passes are random-access gathers/scatters, which the chip runs
+    at ~165M elem/s regardless of locality — on TPU the capacity sorts
+    are the predicted-fast path and the auto default picks them; the
+    on-chip A/B lives in scripts/tpu_measure.py.
     Memory: the round body's live set (``P``, ``best_h``, ``best_e``,
     indices, resolved labels, scatter temporaries) is several int32
     volumes — ~1.8GB transient at 512³.
@@ -477,9 +483,10 @@ def fill_unseeded_basins_dense(
     their codes; callers zero them), overflow set when ``max_rounds``
     rounds did not converge.
 
-    The default (``CT_FILL_MODE`` unset or ``dense``; trace-time, like
+    Selected by ``CT_FILL_MODE=dense``, or by the substrate-aware
+    ``auto`` default on non-TPU backends (trace-time, like
     :func:`~cluster_tools_tpu.ops.tile_ccl.tier_mode`);
-    ``CT_FILL_MODE=capacity`` selects the compacted path instead.
+    ``CT_FILL_MODE=capacity`` selects the compacted path.
     """
     shape = values.shape
     n = int(np.prod(shape))
@@ -812,14 +819,29 @@ def seeded_watershed_tiled(
         values = _resolve_codes_gather(values, codes, finals)
 
     # unseeded-basin fill across lowest saddles.  CT_FILL_MODE (trace-
-    # time, like tier_mode) selects the machinery: "dense" (default)
-    # runs sort-free scatter-min Boruvka rounds over the full face
-    # grids — no caps, exact min saddles, 3.8x faster end-to-end at
-    # 128^3 even on the host substrate (fill_unseeded_basins_dense,
-    # oracle-pinned); "capacity" keeps the compacted-list machinery
-    # (~1/18 the transient memory — prefer it on very tight-memory
-    # shards, at the cost of run-start saddle sampling)
-    fill_mode = os.environ.get("CT_FILL_MODE", "dense")
+    # time, like tier_mode) selects the machinery; the "auto" default is
+    # SUBSTRATE-AWARE because the two paths' cost models invert:
+    # - "dense" (auto on non-TPU backends): sort-free scatter-min
+    #   Boruvka over the full face grids — exact min saddles, no caps,
+    #   3.8x faster end-to-end at 128^3 on the host, where gathers are
+    #   cache-friendly (fill_unseeded_basins_dense, oracle-pinned);
+    # - "capacity" (auto on TPU): compacted lists + dedup sorts.  On
+    #   the chip, random gather/scatter runs ~165M elem/s regardless of
+    #   locality (docs/PERFORMANCE.md "Where the time goes"), so the
+    #   dense rounds' ~15 volume-scale passes per round project to
+    #   ~13s/round at 512^3 — likely far worse than the (predictable,
+    #   post-capacity-audit) sorts.  The on-chip A/B in tpu_measure
+    #   decides for real; until then auto keeps each substrate on its
+    #   predicted-fast path.
+    fill_mode = os.environ.get("CT_FILL_MODE", "auto")
+    if fill_mode == "auto":
+        # ("tpu", "axon"): the tunneled chip's plugin may register under
+        # either name (same convention as bench.py's ACCEL_PLATFORMS)
+        fill_mode = (
+            "capacity"
+            if jax.default_backend() in ("tpu", "axon")
+            else "dense"
+        )
     if fill_mode == "dense":
         values, fill_unconv = fill_unseeded_basins_dense(
             values, h, max_rounds=fill_rounds
@@ -831,7 +853,7 @@ def seeded_watershed_tiled(
         return out, overflow
     if fill_mode != "capacity":
         raise ValueError(
-            f"CT_FILL_MODE must be capacity/dense, got {fill_mode!r}"
+            f"CT_FILL_MODE must be auto/capacity/dense, got {fill_mode!r}"
         )
     fill_vals, fill_finals, fill_overflow = fill_unseeded_basins(
         values, h, fill_cap=fill_cap, max_rounds=fill_rounds, adj_cap=adj_cap

@@ -53,8 +53,8 @@ def main() -> None:
         # must match bench.py's accel-run default or the cache entry this
         # probe leaves behind is not the one the bench rung looks up
         os.environ.setdefault("CT_SEED_CCL", "sparse")
-        # explicit pin (also the library default) — must match bench.py
-        os.environ.setdefault("CT_FILL_MODE", "dense")
+        # CT_FILL_MODE follows the substrate-aware auto default, which
+        # resolves identically here and in bench.py (same backend)
     impl = os.environ.get("CT_PROBE_IMPL", "auto")
     threshold = 0.45
     shape = (extent, extent, extent)

@@ -17,8 +17,6 @@ sys.path.insert(0, ".")
 # program (the tiled seed labeler can still be measured by exporting
 # CT_SEED_CCL=tiled)
 os.environ.setdefault("CT_SEED_CCL", "sparse")
-# explicit pin (also the library default) — must match bench.py
-os.environ.setdefault("CT_FILL_MODE", "dense")
 
 import jax
 import jax.numpy as jnp
@@ -114,6 +112,31 @@ def main():
         ),
         vol,
     )
+
+    # fill-machinery A/B at bench scale: the two paths' cost models
+    # invert across substrates (host: dense 3.8x faster; chip model:
+    # dense rounds are volume-scale random access, capacity is
+    # sort-bound) — this row pair is the evidence that decides the
+    # substrate-aware auto default in tile_ws
+    fill_mode_on_entry = os.environ.get("CT_FILL_MODE")
+    for fill_mode in ("capacity", "dense"):
+        os.environ["CT_FILL_MODE"] = fill_mode
+        jax.clear_caches()
+        timeit(
+            f"dt_ws fill={fill_mode}",
+            lambda b: dt_watershed_tiled(
+                b, threshold=threshold, dt_max_distance=float(halo),
+                min_seed_distance=msd, impl="pallas",
+            ),
+            vol,
+            runs=2,
+        )
+    # restore the caller's pin (or the unset default), not a literal
+    if fill_mode_on_entry is None:
+        os.environ.pop("CT_FILL_MODE", None)
+    else:
+        os.environ["CT_FILL_MODE"] = fill_mode_on_entry
+    jax.clear_caches()
 
     # seed-labeler comparison at bench scale: the sparse labeler vs the
     # full tiled machinery on the actual maxima mask
