@@ -196,20 +196,33 @@ def collect_negative_values(
     for axis in range(3):
         for side in (0, 1):
             sl, tid = _strip_entries(values, tile, axis, side)
+            # a family can never hold more entries than its strip has
+            # voxels, so capping at the strip size is FREE headroom-wise
+            # and stops thin families (x strips are volume/128) from
+            # being padded to the full exit capacity — at 512^3 this
+            # nearly halves the concat the dedup sort below runs over
+            fam_cap = max(1024, min(cap, int(np.prod(sl.shape))))
             neg = sl <= -2
             dedup_axis = 2 if axis != 2 else 1
             prev = _shift1(sl, dedup_axis, -1)
             prev_t = _shift1(tid, dedup_axis, -1)
             keep = neg & ((sl != prev) | (tid != prev_t))
-            (v, t_), kept = _compact(keep, (sl, tid), cap, BIG)
-            overflow = jnp.maximum(overflow, (kept > cap).astype(jnp.int32))
-            n_total = n_total + jnp.minimum(kept, cap)
+            (v, t_), kept = _compact(keep, (sl, tid), fam_cap, BIG)
+            overflow = jnp.maximum(
+                overflow, (kept > fam_cap).astype(jnp.int32)
+            )
+            n_total = n_total + jnp.minimum(kept, fam_cap)
             vs.append(v)
             ts.append(t_)
     v = jnp.concatenate(vs)
     t_ = jnp.concatenate(ts)
-    # the value-dedup sort runs at the static 6*cap concat size — tier it
-    # like the merge cores (shared rationale in run_capacity_tiered)
+    # the value-dedup sort runs at the static sum-of-family-caps concat
+    # size (≤ 6*cap; ~half of it at 512³ thanks to the strip-size bounds
+    # above) — tier it like the merge cores (shared rationale in
+    # run_capacity_tiered).  Note the 1/16 small tier's exact envelope
+    # scales with this concat, so CT_TIER_MODE=small covers ~half the
+    # live-entry range it did with untrimmed buffers — cond mode (the
+    # default) is unaffected
     cv, ct, n_kept = run_capacity_tiered(
         (v, t_), n_total, cap, _collect_core, 2, 0, values,
         # last output is a COUNT checked against ``cap`` by the caller:
