@@ -513,13 +513,6 @@ def label_components_sparse(mask: jnp.ndarray, cap: Optional[int] = None):
     return out[:n].reshape(mask.shape), overflow
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "connectivity", "impl", "tile", "pair_cap", "edge_cap", "table_cap",
-        "interpret",
-    ),
-)
 def label_components_tiled(
     mask: jnp.ndarray,
     connectivity: int = 1,
@@ -547,7 +540,41 @@ def label_components_tiled(
     legacy kernel.  Capacities default to volume-scaled values (static,
     shape-derived); pass explicit caps for workloads with unusually many
     fragments per tile face.
+
+    ``CT_TIER_MODE`` is resolved here, OUTSIDE the jit boundary, and passed
+    down as a static argument — flipping the env var mid-process correctly
+    retraces (no stale-cache surprise).  Callers that wrap this function in
+    their own ``jax.jit`` capture the mode at their own trace time, the
+    usual closure semantics.
     """
+    return _label_components_tiled_jit(
+        mask, connectivity=connectivity, impl=impl, tile=tile,
+        pair_cap=pair_cap, edge_cap=edge_cap, table_cap=table_cap,
+        interpret=interpret, _tier=tier_mode(),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "connectivity", "impl", "tile", "pair_cap", "edge_cap", "table_cap",
+        "interpret", "_tier",
+    ),
+)
+def _label_components_tiled_jit(
+    mask: jnp.ndarray,
+    connectivity: int = 1,
+    impl: str = "auto",
+    tile: Optional[Tuple[int, int, int]] = None,
+    pair_cap: Optional[int] = None,
+    edge_cap: Optional[int] = None,
+    table_cap: int = DEFAULT_TABLE_CAP,
+    interpret: bool = False,
+    _tier: str = "cond",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    # _tier is keying-only: the tiered sites below read tier_mode() at trace
+    # time, and including the resolved value in the static key guarantees
+    # that read always matches the cache entry being built.
     if mask.ndim != 3:
         raise ValueError("label_components_tiled expects a 3-D mask")
     if connectivity != 1:

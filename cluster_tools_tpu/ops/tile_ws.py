@@ -27,13 +27,18 @@ restructures the resolution:
    minimum-spanning-forest watershed semantics, strictly closer to
    priority-flood than the old relaxation.  Two machines compute it
    (``CT_FILL_MODE``, default ``auto`` = substrate-aware): ``dense``
-   (auto on non-TPU) runs sort-free scatter-min rounds over the full
+   (auto on cpu only) runs sort-free scatter-min rounds over the full
    face grids with exact per-pair min saddles
-   (:func:`fill_unseeded_basins_dense`); ``capacity`` (auto on TPU,
-   where volume-scale random access is the bottleneck) runs the rounds
-   on a compacted basin-boundary edge list with run-start saddle
-   sampling (~1/18 the transient memory).  Basins with no seeded
-   reachable neighbor keep label 0 (legacy behavior).
+   (:func:`fill_unseeded_basins_dense`); ``capacity`` (auto on tpu AND
+   gpu — volume-scale random access is the chip bottleneck, and the
+   host-cache rationale doesn't transfer to gpu) runs the rounds on a
+   compacted basin-boundary edge list with run-start saddle sampling
+   (~1/18 the transient memory).  Basins with no seeded reachable
+   neighbor keep label 0 (legacy behavior).  All mode env vars
+   (``CT_FILL_MODE``/``CT_SEED_CCL``/``CT_TIER_MODE``) are resolved at
+   the public entry points, OUTSIDE jit, and folded into the compile
+   key — flipping one mid-process retraces, no ``jax.clear_caches()``
+   needed.
 
 When every basin is seeded (e.g. the oracle test's fully-seeded minima) the
 result is bit-identical to the legacy kernel; only unseeded-basin fill order
@@ -52,7 +57,7 @@ import numpy as np
 from jax import lax
 
 from .ccl import _match_vma, _shift, _true_like
-from .pallas_kernels import WS_MARKER, WS_OFFS, ws_propagate_step
+from .pallas_kernels import WS_OFFS
 from .tile_ccl import (
     BIG,
     DEFAULT_TABLE_CAP,
@@ -74,6 +79,52 @@ DEFAULT_FILL_CAP = 1 << 21
 # unique unseeded-basin adjacencies (deduped (a, b) pairs), not face
 # voxels — object-scale, so orders of magnitude below FILL_CAP
 DEFAULT_ADJ_CAP = 1 << 18
+
+
+def _resolve_fill_mode(fill_mode: Optional[str]) -> str:
+    """Resolve the unseeded-basin fill machinery to ``dense``/``capacity``.
+
+    ``None`` reads ``CT_FILL_MODE`` (default ``auto``).  ``auto`` is
+    substrate-aware because the two machines' cost models invert across
+    backends:
+
+    - ``dense`` on the **cpu** backend only: sort-free scatter-min Boruvka
+      over the full face grids — exact min saddles, no caps, 3.8x faster
+      end-to-end at 128^3 on the host, where gathers are cache-friendly.
+    - ``capacity`` everywhere else (tpu/axon AND gpu): compacted lists +
+      dedup sorts.  On the chip, random gather/scatter runs ~165M elem/s
+      regardless of locality (docs/PERFORMANCE.md "Where the time goes"),
+      so the dense rounds' ~15 volume-scale passes per round project to
+      ~13s/round at 512^3; on gpu the host-cache rationale simply doesn't
+      transfer and the dense path's ~1.8GB transient at 512^3 is a real
+      risk (advisor r4) — capacity until a measured A/B says otherwise.
+
+    Resolved OUTSIDE the jit boundary so the value is part of the compile
+    key: flipping the env var mid-process retraces instead of silently
+    reusing the previously compiled mode.
+    """
+    if fill_mode is None:
+        fill_mode = os.environ.get("CT_FILL_MODE", "auto")
+    if fill_mode == "auto":
+        fill_mode = "dense" if jax.default_backend() == "cpu" else "capacity"
+    if fill_mode not in ("dense", "capacity"):
+        raise ValueError(
+            f"CT_FILL_MODE must be auto/capacity/dense, got {fill_mode!r}"
+        )
+    return fill_mode
+
+
+def _resolve_seed_mode(seed_mode: Optional[str]) -> str:
+    """Resolve the seed-plateau CCL program (``None`` -> ``CT_SEED_CCL``).
+
+    Like :func:`_resolve_fill_mode`, resolved pre-jit so the env var is
+    folded into the compile key.
+    """
+    if seed_mode is None:
+        seed_mode = os.environ.get("CT_SEED_CCL", "tiled")
+    if seed_mode not in ("tiled", "sparse"):
+        raise ValueError(f"CT_SEED_CCL must be tiled/sparse, got {seed_mode!r}")
+    return seed_mode
 
 
 def _sortable_float_key(f: jnp.ndarray) -> jnp.ndarray:
@@ -119,7 +170,20 @@ def descent_directions(
 def tile_ws_propagate_xla(
     dirs: jnp.ndarray, sv: jnp.ndarray, tile: Tuple[int, int, int]
 ) -> jnp.ndarray:
-    """Portable in-tile pointer flow (same math as the Mosaic kernel)."""
+    """Portable in-tile pointer flow — pointer-jumping formulation.
+
+    Output contract is identical to the Mosaic kernel's per-hop dense flow
+    (each voxel ends with its in-tile path terminal's value: seed label,
+    unseeded-terminal code ``-gidx-2``, or the exit code of the FIRST
+    out-of-tile hop), but instead of one dense shift round per path hop
+    (O(path length) full-volume passes — the old formulation, and the r4
+    smoke's dominant cost) the in-tile successor table is composed to
+    closure: O(log path length) rounds of per-tile gathers over
+    L1/L2-resident ``tz*ty*tx`` tables.  Voxels whose descent target
+    leaves the tile become pseudo-terminals carrying their exit code, so
+    closure over ``nxt`` reaches exactly the same fixpoint the stepping
+    recurrence does.
+    """
     z, y, x = dirs.shape
     tz, ty, tx = tile
     gz, gy, gx = z // tz, y // ty, x // tx
@@ -142,21 +206,53 @@ def tile_ws_propagate_xla(
     gidx = to_tiles(idx)
     dirs_t = to_tiles(dirs)
     sv_t = to_tiles(sv)
+
+    # per-code offsets as lookup tables indexed by the direction code
+    offs = np.concatenate([[[0, 0, 0]], np.asarray(WS_OFFS)]).astype(np.int32)
+    oz = jnp.asarray(offs[:, 0])[dirs_t]
+    oy = jnp.asarray(offs[:, 1])[dirs_t]
+    ox = jnp.asarray(offs[:, 2])[dirs_t]
+    cz = lax.broadcasted_iota(jnp.int32, dirs_t.shape, 1)
+    cy = lax.broadcasted_iota(jnp.int32, dirs_t.shape, 2)
+    cx = lax.broadcasted_iota(jnp.int32, dirs_t.shape, 3)
+    tzc, tyc, txc = cz + oz, cy + oy, cx + ox
+    inb = (
+        (tzc >= 0) & (tzc < tz) & (tyc >= 0) & (tyc < ty)
+        & (txc >= 0) & (txc < tx)
+    )
+    self_flat = (cz * ty + cy) * tx + cx
+    tgt_flat = (tzc * ty + tyc) * tx + txc
     terminal = dirs_t == 0
-    value = jnp.where(
-        sv_t > 0, sv_t, jnp.where(terminal & (sv_t == 0), -gidx - 2, 0)
+    # exit code: -(global flat index of the out-of-tile target) - 2
+    foff = (oz * y + oy) * x + ox
+    exit_code = -(gidx + foff) - 2
+    pseudo_term = terminal | ~inb
+    nxt = jnp.where(pseudo_term, self_flat, tgt_flat)
+    val = jnp.where(
+        sv_t > 0,
+        sv_t,
+        jnp.where(
+            terminal & (sv_t == 0),
+            -gidx - 2,
+            jnp.where(~inb & ~terminal, exit_code, 0),
+        ),
     ).astype(jnp.int32)
+
+    nt = gz * gy * gx
+    nxt = nxt.reshape(nt, tz * ty * tx)
+    val = val.reshape(nt, tz * ty * tx)
 
     def cond(s):
         return s[1]
 
     def body(s):
-        v, _ = s
-        v2 = ws_propagate_step(v, dirs_t, gidx, (1, 2, 3), y, x)
-        return v2, jnp.any(v2 != v)
+        p, _ = s
+        p2 = jnp.take_along_axis(p, p, axis=1)
+        return p2, jnp.any(p2 != p)
 
-    value, _ = lax.while_loop(cond, body, (value, _true_like(value)))
-    return from_tiles(value)
+    nxt, _ = lax.while_loop(cond, body, (nxt, _true_like(nxt)))
+    out = jnp.where(val != 0, val, jnp.take_along_axis(val, nxt, axis=1))
+    return from_tiles(out.reshape(nt, tz, ty, tx))
 
 
 def _strip_entries(values: jnp.ndarray, tile, axis: int, side: int):
@@ -490,16 +586,21 @@ def fill_unseeded_basins_dense(
     volumes — ~1.8GB transient at 512³.
 
     ``values``: >0 seeded label, <= -2 unseeded terminal code
-    (``-flat_index - 2``), 0 invalid.  Returns ``(resolved_values,
-    overflow_int32)`` — per-voxel labels with every reachable unseeded
-    basin resolved to its adopted seed label (unreachable basins keep
-    their codes; callers zero them), overflow set when ``max_rounds``
-    rounds did not converge.
+    (``-flat_index - 2``), 0 invalid, and **-1 for masked/padded voxels**
+    (what :func:`seeded_watershed_tiled` actually passes by fill time).
+    -1 voxels are hookable neighbors: the edge predicate (``rv != 0 &
+    nb != 0``) admits them and an unseeded basin whose lowest saddle
+    touches one adopts -1, which the caller's final ``values > 0`` squash
+    maps to background 0 — the same adopt-to-0 semantics as the capacity
+    path.  Callers must NOT assume invalid voxels sit out of saddle
+    competition.  Returns ``(resolved_values, overflow_int32)`` —
+    per-voxel labels with every reachable unseeded basin resolved to its
+    adopted seed label (unreachable basins keep their codes; callers zero
+    them), overflow set when ``max_rounds`` rounds did not converge.
 
-    Selected by ``CT_FILL_MODE=dense``, or by the substrate-aware
-    ``auto`` default on non-TPU backends (trace-time, like
-    :func:`~cluster_tools_tpu.ops.tile_ccl.tier_mode`);
-    ``CT_FILL_MODE=capacity`` selects the compacted path.
+    Selected by ``fill_mode="dense"`` (``CT_FILL_MODE``), or by the
+    substrate-aware ``auto`` default on the cpu backend — resolution
+    happens pre-jit in :func:`_resolve_fill_mode`.
     """
     shape = values.shape
     n = int(np.prod(shape))
@@ -713,13 +814,6 @@ def _fill_core(a, b, hk, adj_cap, max_rounds, vma_like):
     return edge_vals, edge_finals, overflow
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "impl", "tile", "exit_cap", "fill_cap", "table_cap", "interpret",
-        "adj_cap", "fill_rounds",
-    ),
-)
 def seeded_watershed_tiled(
     height: jnp.ndarray,
     seeds: jnp.ndarray,
@@ -732,6 +826,7 @@ def seeded_watershed_tiled(
     interpret: bool = False,
     adj_cap: Optional[int] = None,
     fill_rounds: int = 16,
+    fill_mode: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Seeded watershed with the two-level tile machinery.
 
@@ -746,20 +841,76 @@ def seeded_watershed_tiled(
     (a round at least halves the unseeded component count, so the default
     16 covers ~64k basins); the overflow flag reports it and ``adj_cap`` /
     ``fill_rounds`` are the knobs to raise.
+
+    ``fill_mode``: ``dense``/``capacity``/``None`` (= ``CT_FILL_MODE``,
+    default substrate-aware ``auto`` — see :func:`_resolve_fill_mode`).
+    Mode env vars are resolved HERE, outside jit, so flipping one
+    mid-process retraces instead of reusing a stale cache entry.
     """
-    if height.ndim != 3:
-        raise ValueError("seeded_watershed_tiled expects a 3-D volume")
-    if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
-    z, y, x = height.shape
-    tile = _tile_for(height.shape) if tile is None else tile
+    return _seeded_watershed_tiled_jit(
+        height, seeds, mask, impl=impl, tile=tile, exit_cap=exit_cap,
+        fill_cap=fill_cap, table_cap=table_cap, interpret=interpret,
+        adj_cap=adj_cap, fill_rounds=fill_rounds,
+        fill_mode=_resolve_fill_mode(fill_mode), _tier=tier_mode(),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "impl", "tile", "exit_cap", "fill_cap", "table_cap", "interpret",
+        "adj_cap", "fill_rounds", "fill_mode", "_tier",
+    ),
+)
+def _seeded_watershed_tiled_jit(
+    height: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    impl: str = "auto",
+    tile: Optional[Tuple[int, int, int]] = None,
+    exit_cap: Optional[int] = None,
+    fill_cap: Optional[int] = None,
+    table_cap: int = DEFAULT_TABLE_CAP,
+    interpret: bool = False,
+    adj_cap: Optional[int] = None,
+    fill_rounds: int = 16,
+    fill_mode: str = "capacity",
+    _tier: str = "cond",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    # _tier is keying-only (the tiered sites read tier_mode() at trace time;
+    # the static arg pins the cache entry to the resolved value).
+    # The body is flow-phase + fill-phase cores so the split execution mode
+    # (parallel/split_pipeline.py) can jit each phase as its OWN program —
+    # composing them here compiles the identical fused program.
+    values, h, flow_overflow = _ws_flow_core(
+        height, seeds, mask, impl=impl, tile=tile, exit_cap=exit_cap,
+        table_cap=table_cap, interpret=interpret,
+    )
+    out, fill_overflow = _ws_fill_core(
+        values, h, height.shape, impl=impl, tile=tile, exit_cap=exit_cap,
+        fill_cap=fill_cap, table_cap=table_cap, interpret=interpret,
+        adj_cap=adj_cap, fill_rounds=fill_rounds, fill_mode=fill_mode,
+    )
+    return out, flow_overflow | fill_overflow
+
+
+def _resolve_impl(impl: str) -> str:
+    return ("pallas" if jax.default_backend() == "tpu" else "xla") \
+        if impl == "auto" else impl
+
+
+def _ws_static_plan(shape, tile, exit_cap, fill_cap):
+    """Tile/padded geometry + capacity defaults, shared by the fused program
+    and the split-phase programs so both compile identical caps."""
+    z, y, x = shape
+    tile = _tile_for(shape) if tile is None else tile
     tz, ty, tx = tile
     zp, yp, xp = _round_up(z, tz), _round_up(y, ty), _round_up(x, tx)
     if zp * yp * xp >= BIG:
         raise ValueError(
             f"padded volume {(zp, yp, xp)} has >= 2**30 voxels; shard it"
         )
-    padded = (zp != z) or (yp != y) or (xp != x)
+    n_pad = zp * yp * xp
     if exit_cap is None:
         # n/3 >= the total strip voxel count for the default tile, so exits
         # can never overflow below ~6M voxels.  ABOVE that the loads keep
@@ -773,7 +924,6 @@ def seeded_watershed_tiled(
         # per-family headroom up to the 2^24 ceiling (int32 buffers,
         # ~600MB transient at 512³).  The ~8% total only picks the
         # capacity TIER, never the flag.
-        n_pad = zp * yp * xp
         exit_cap = min(
             1 << 24, max(_auto_cap(n_pad, DEFAULT_EXIT_CAP, 3), n_pad // 12)
         )
@@ -781,10 +931,36 @@ def seeded_watershed_tiled(
         # fill edges can reach ~n/2 per axis in pure-noise/sparse-seed
         # regimes (overflow-flagged); the proportional floor covers the
         # measured ~9%-per-axis bench-like load with ~2.5x margin
-        n_pad = zp * yp * xp
         fill_cap = min(
             1 << 24, max(_auto_cap(n_pad, DEFAULT_FILL_CAP, 1), n_pad // 8)
         )
+    return tile, (zp, yp, xp), exit_cap, fill_cap
+
+
+def _ws_flow_core(
+    height: jnp.ndarray,
+    seeds: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    *,
+    impl: str,
+    tile: Optional[Tuple[int, int, int]],
+    exit_cap: Optional[int],
+    table_cap: int,
+    interpret: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Flow phase: tile-pad, descent directions, in-tile flow, exit chase +
+    remap.  Returns ``(values, h, overflow)`` at TILE-PADDED shape: >0
+    seeded label, <= -2 unseeded terminal code, -1 masked/padded, plus the
+    padded float32 heights the fill phase needs."""
+    if height.ndim != 3:
+        raise ValueError("seeded_watershed_tiled expects a 3-D volume")
+    impl = _resolve_impl(impl)
+    z, y, x = height.shape
+    tile, (zp, yp, xp), exit_cap, _ = _ws_static_plan(
+        height.shape, tile, exit_cap, 0
+    )
+    tz, ty, tx = tile
+    padded = (zp != z) or (yp != y) or (xp != x)
     valid = jnp.ones(height.shape, bool) if mask is None else mask.astype(bool)
     h = height.astype(jnp.float32)
     s = seeds.astype(jnp.int32)
@@ -830,50 +1006,56 @@ def seeded_watershed_tiled(
         values = lax.cond(tbl_overflow, slow, fast, (values, old_tbl, new_tbl))
     else:
         values = _resolve_codes_gather(values, codes, finals)
+    return values, h, overflow
 
-    # unseeded-basin fill across lowest saddles.  CT_FILL_MODE (trace-
-    # time, like tier_mode) selects the machinery; the "auto" default is
-    # SUBSTRATE-AWARE because the two paths' cost models invert:
-    # - "dense" (auto on non-TPU backends): sort-free scatter-min
-    #   Boruvka over the full face grids — exact min saddles, no caps,
-    #   3.8x faster end-to-end at 128^3 on the host, where gathers are
-    #   cache-friendly (fill_unseeded_basins_dense, oracle-pinned);
-    # - "capacity" (auto on TPU): compacted lists + dedup sorts.  On
-    #   the chip, random gather/scatter runs ~165M elem/s regardless of
-    #   locality (docs/PERFORMANCE.md "Where the time goes"), so the
-    #   dense rounds' ~15 volume-scale passes per round project to
-    #   ~13s/round at 512^3 — likely far worse than the (predictable,
-    #   post-capacity-audit) sorts.  The on-chip A/B in tpu_measure
-    #   decides for real; until then auto keeps each substrate on its
-    #   predicted-fast path.
-    fill_mode = os.environ.get("CT_FILL_MODE", "auto")
-    if fill_mode == "auto":
-        # ("tpu", "axon"): the tunneled chip's plugin may register under
-        # either name (same convention as bench.py's ACCEL_PLATFORMS)
-        fill_mode = (
-            "capacity"
-            if jax.default_backend() in ("tpu", "axon")
-            else "dense"
+
+def _ws_fill_core(
+    values: jnp.ndarray,
+    h: jnp.ndarray,
+    orig_shape: Tuple[int, int, int],
+    *,
+    impl: str,
+    tile: Optional[Tuple[int, int, int]],
+    exit_cap: Optional[int],
+    fill_cap: Optional[int],
+    table_cap: int,
+    interpret: bool,
+    adj_cap: Optional[int],
+    fill_rounds: int,
+    fill_mode: str,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fill phase: unseeded-basin fill across lowest saddles (fill_mode
+    selects the machinery — see :func:`_resolve_fill_mode`), remap, squash
+    leftovers to 0, crop the tile padding back to ``orig_shape``."""
+    impl = _resolve_impl(impl)
+    z, y, x = orig_shape
+    tile, (zp, yp, xp), exit_cap, fill_cap = _ws_static_plan(
+        orig_shape, tile, exit_cap, fill_cap
+    )
+    tz, ty, tx = tile
+    padded = (zp != z) or (yp != y) or (xp != x)
+    if values.shape != (zp, yp, xp):
+        raise ValueError(
+            f"fill phase expects tile-padded values {(zp, yp, xp)}, "
+            f"got {values.shape}"
         )
     if fill_mode == "dense":
         values, fill_unconv = fill_unseeded_basins_dense(
             values, h, max_rounds=fill_rounds
         )
-        overflow = overflow | (fill_unconv > 0)
+        overflow = fill_unconv > 0
         out = jnp.where(values > 0, values, 0).astype(jnp.int32)
         if padded:
             out = out[:z, :y, :x]
         return out, overflow
-    if fill_mode != "capacity":
-        raise ValueError(
-            f"CT_FILL_MODE must be auto/capacity/dense, got {fill_mode!r}"
-        )
-    fill_vals, fill_finals, fill_overflow = fill_unseeded_basins(
+    fill_vals, fill_finals, overflow = fill_unseeded_basins(
         values, h, fill_cap=fill_cap, max_rounds=fill_rounds, adj_cap=adj_cap
     )
-    overflow = overflow | fill_overflow
+    n_tiles = (zp // tz) * (yp // ty) * (xp // tx)
 
     if impl == "pallas":
+        from .pallas_kernels import apply_remap_pallas
+
         # tiles needing a basin's entry: strip incidences + the terminal's tile
         bvals, btiles, b_overflow = collect_negative_values(values, tile, exit_cap)
         overflow = overflow | b_overflow
@@ -914,80 +1096,30 @@ def seeded_watershed_tiled(
     return out, overflow
 
 
-def _seed_ccl(maxima, seed_cap, *, impl, tile, pair_cap, edge_cap,
-              table_cap, interpret):
-    """Label seed plateaus: ``CT_SEED_CCL`` picks the program.
-
-    - ``tiled`` (default): the full two-level CCL machinery — exact for
-      any maxima density.
-    - ``sparse``: :func:`~.tile_ccl.label_components_sparse` — ~1/10 the
-      compiled program (the single biggest compile-size lever in the
-      fused step, see docs/PERFORMANCE.md "program-size analysis");
-      exact while maxima fit ``seed_cap`` (default volume/16 — bench-like
-      volumes measure ~1.4% at ``min_seed_distance=2``), overflow-flagged
-      beyond.
-
-    Like :func:`~.tile_ccl.tier_mode`, the env var is read at TRACE time.
-    """
-    mode = os.environ.get("CT_SEED_CCL", "tiled")
-    if mode == "sparse":
-        from .tile_ccl import label_components_sparse
-
-        return label_components_sparse(maxima, cap=seed_cap)
-    if mode != "tiled":
-        raise ValueError(f"CT_SEED_CCL must be tiled/sparse, got {mode!r}")
-    from .tile_ccl import label_components_tiled
-
-    return label_components_tiled(
-        maxima, impl=impl, tile=tile, pair_cap=pair_cap, edge_cap=edge_cap,
-        table_cap=table_cap, interpret=interpret,
-    )
-
-
-@partial(
-    jax.jit,
-    static_argnames=(
-        "threshold", "sigma_seeds", "min_seed_distance", "sampling",
-        "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
-        "exit_cap", "fill_cap", "table_cap", "interpret", "seed_cap",
-        "adj_cap", "fill_rounds",
-    ),
-)
-def dt_watershed_tiled(
+def _dt_seeds_core(
     boundaries: jnp.ndarray,
-    threshold: float = 0.25,
-    sigma_seeds: float = 0.0,
-    min_seed_distance: float = 0.0,
-    sampling: Optional[Tuple[float, ...]] = None,
-    mask: Optional[jnp.ndarray] = None,
-    dist: Optional[jnp.ndarray] = None,
-    dt_max_distance: Optional[float] = None,
-    impl: str = "auto",
-    tile: Optional[Tuple[int, int, int]] = None,
-    pair_cap: Optional[int] = None,
-    edge_cap: Optional[int] = None,
-    exit_cap: Optional[int] = None,
-    fill_cap: Optional[int] = None,
-    table_cap: int = DEFAULT_TABLE_CAP,
-    interpret: bool = False,
-    seed_cap: Optional[int] = None,
-    adj_cap: Optional[int] = None,
-    fill_rounds: int = 16,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Fused distance-transform watershed on the two-level machinery.
-
-    The same pipeline as
-    :func:`~cluster_tools_tpu.ops.watershed.distance_transform_watershed`
-    (threshold -> capped EDT -> seeds = CCL of DT maxima plateaus -> seeded
-    watershed; reference ``_ws_block``, SURVEY.md §2a "watershed") with the
-    seed CCL and the flood running on the tiled kernels.  3-D only,
-    connectivity 1.  Returns ``(labels, overflow)``; labels are
-    ``seed_rep + 1`` flat-index based, 0 outside mask/unreached.
-
-    ``dist``: optional precomputed *squared* distances (e.g. the mesh-exact
-    transform from :mod:`cluster_tools_tpu.parallel.distributed_edt`); when
-    given, the internal EDT (and ``dt_max_distance``) is skipped.
-    """
+    mask: Optional[jnp.ndarray],
+    dist: Optional[jnp.ndarray],
+    *,
+    threshold: float,
+    sigma_seeds: float,
+    min_seed_distance: float,
+    sampling,
+    dt_max_distance: Optional[float],
+    impl: str,
+    tile,
+    pair_cap: Optional[int],
+    edge_cap: Optional[int],
+    table_cap: int,
+    interpret: bool,
+    seed_cap: Optional[int],
+    seed_mode: str,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Seed phase of the DT watershed: threshold -> (capped) EDT -> optional
+    smoothing -> maxima plateaus -> seed CCL.  Returns ``(seeds, valid,
+    overflow)`` at the input shape — the split execution mode
+    (parallel/split_pipeline.py) jits this as its own program; the fused
+    ``dt_watershed_tiled`` inlines it."""
     from .edt import distance_transform_squared
     from .filters import gaussian_smooth
     from .watershed import local_maxima
@@ -1015,17 +1147,95 @@ def dt_watershed_tiled(
         & (dist >= min_seed_distance * min_seed_distance)
     )
     raw, seed_overflow = _seed_ccl(
-        maxima, seed_cap, impl=impl, tile=tile, pair_cap=pair_cap,
-        edge_cap=edge_cap, table_cap=table_cap, interpret=interpret,
+        maxima, seed_cap, mode=seed_mode, impl=impl, tile=tile,
+        pair_cap=pair_cap, edge_cap=edge_cap, table_cap=table_cap,
+        interpret=interpret,
     )
     n = int(np.prod(boundaries.shape))
     seeds = jnp.where(raw == n, 0, raw + 1).astype(jnp.int32)
-    labels, ws_overflow = seeded_watershed_tiled(
-        boundaries, seeds, mask=valid, impl=impl, tile=tile,
-        exit_cap=exit_cap, fill_cap=fill_cap, table_cap=table_cap,
-        interpret=interpret, adj_cap=adj_cap, fill_rounds=fill_rounds,
+    return seeds, valid, seed_overflow
+
+
+def _seed_ccl(maxima, seed_cap, *, mode, impl, tile, pair_cap, edge_cap,
+              table_cap, interpret):
+    """Label seed plateaus: ``mode`` picks the program.
+
+    - ``tiled`` (the API default): the full two-level CCL machinery —
+      exact for any maxima density.
+    - ``sparse``: :func:`~.tile_ccl.label_components_sparse` — ~1/10 the
+      compiled program (the single biggest compile-size lever in the
+      fused step, see docs/PERFORMANCE.md "program-size analysis");
+      exact while maxima fit ``seed_cap`` (default volume/16 — bench-like
+      volumes measure ~1.4% at ``min_seed_distance=2``), overflow-flagged
+      beyond.
+
+    ``mode`` is a static argument resolved from ``CT_SEED_CCL`` by the
+    public entry points (:func:`_resolve_seed_mode`), never read from the
+    environment here.
+    """
+    if mode == "sparse":
+        from .tile_ccl import label_components_sparse
+
+        return label_components_sparse(maxima, cap=seed_cap)
+    from .tile_ccl import label_components_tiled
+
+    return label_components_tiled(
+        maxima, impl=impl, tile=tile, pair_cap=pair_cap, edge_cap=edge_cap,
+        table_cap=table_cap, interpret=interpret,
     )
-    return labels, seed_overflow | ws_overflow
+
+
+def dt_watershed_tiled(
+    boundaries: jnp.ndarray,
+    threshold: float = 0.25,
+    sigma_seeds: float = 0.0,
+    min_seed_distance: float = 0.0,
+    sampling: Optional[Tuple[float, ...]] = None,
+    mask: Optional[jnp.ndarray] = None,
+    dist: Optional[jnp.ndarray] = None,
+    dt_max_distance: Optional[float] = None,
+    impl: str = "auto",
+    tile: Optional[Tuple[int, int, int]] = None,
+    pair_cap: Optional[int] = None,
+    edge_cap: Optional[int] = None,
+    exit_cap: Optional[int] = None,
+    fill_cap: Optional[int] = None,
+    table_cap: int = DEFAULT_TABLE_CAP,
+    interpret: bool = False,
+    seed_cap: Optional[int] = None,
+    adj_cap: Optional[int] = None,
+    fill_rounds: int = 16,
+    fill_mode: Optional[str] = None,
+    seed_mode: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused distance-transform watershed on the two-level machinery.
+
+    The same pipeline as
+    :func:`~cluster_tools_tpu.ops.watershed.distance_transform_watershed`
+    (threshold -> capped EDT -> seeds = CCL of DT maxima plateaus -> seeded
+    watershed; reference ``_ws_block``, SURVEY.md §2a "watershed") with the
+    seed CCL and the flood running on the tiled kernels.  3-D only,
+    connectivity 1.  Returns ``(labels, overflow)``; labels are
+    ``seed_rep + 1`` flat-index based, 0 outside mask/unreached.
+
+    ``dist``: optional precomputed *squared* distances (e.g. the mesh-exact
+    transform from :mod:`cluster_tools_tpu.parallel.distributed_edt`); when
+    given, the internal EDT (and ``dt_max_distance``) is skipped.
+
+    ``fill_mode`` / ``seed_mode``: explicit machinery selection; ``None``
+    resolves ``CT_FILL_MODE`` / ``CT_SEED_CCL`` here, OUTSIDE jit, so the
+    env values are part of the compile key (see :func:`_resolve_fill_mode`).
+    """
+    return _dt_watershed_tiled_jit(
+        boundaries, threshold=threshold, sigma_seeds=sigma_seeds,
+        min_seed_distance=min_seed_distance, sampling=sampling, mask=mask,
+        dist=dist, dt_max_distance=dt_max_distance, impl=impl, tile=tile,
+        pair_cap=pair_cap, edge_cap=edge_cap, exit_cap=exit_cap,
+        fill_cap=fill_cap, table_cap=table_cap, interpret=interpret,
+        seed_cap=seed_cap, adj_cap=adj_cap, fill_rounds=fill_rounds,
+        fill_mode=_resolve_fill_mode(fill_mode),
+        seed_mode=_resolve_seed_mode(seed_mode), _tier=tier_mode(),
+    )
 
 
 @partial(
@@ -1034,9 +1244,49 @@ def dt_watershed_tiled(
         "threshold", "sigma_seeds", "min_seed_distance", "sampling",
         "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
         "exit_cap", "fill_cap", "table_cap", "interpret", "seed_cap",
-        "adj_cap", "fill_rounds",
+        "adj_cap", "fill_rounds", "fill_mode", "seed_mode", "_tier",
     ),
 )
+def _dt_watershed_tiled_jit(
+    boundaries: jnp.ndarray,
+    threshold: float = 0.25,
+    sigma_seeds: float = 0.0,
+    min_seed_distance: float = 0.0,
+    sampling: Optional[Tuple[float, ...]] = None,
+    mask: Optional[jnp.ndarray] = None,
+    dist: Optional[jnp.ndarray] = None,
+    dt_max_distance: Optional[float] = None,
+    impl: str = "auto",
+    tile: Optional[Tuple[int, int, int]] = None,
+    pair_cap: Optional[int] = None,
+    edge_cap: Optional[int] = None,
+    exit_cap: Optional[int] = None,
+    fill_cap: Optional[int] = None,
+    table_cap: int = DEFAULT_TABLE_CAP,
+    interpret: bool = False,
+    seed_cap: Optional[int] = None,
+    adj_cap: Optional[int] = None,
+    fill_rounds: int = 16,
+    fill_mode: str = "capacity",
+    seed_mode: str = "tiled",
+    _tier: str = "cond",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    seeds, valid, seed_overflow = _dt_seeds_core(
+        boundaries, mask, dist, threshold=threshold, sigma_seeds=sigma_seeds,
+        min_seed_distance=min_seed_distance, sampling=sampling,
+        dt_max_distance=dt_max_distance, impl=impl, tile=tile,
+        pair_cap=pair_cap, edge_cap=edge_cap, table_cap=table_cap,
+        interpret=interpret, seed_cap=seed_cap, seed_mode=seed_mode,
+    )
+    labels, ws_overflow = _seeded_watershed_tiled_jit(
+        boundaries, seeds, mask=valid, impl=impl, tile=tile,
+        exit_cap=exit_cap, fill_cap=fill_cap, table_cap=table_cap,
+        interpret=interpret, adj_cap=adj_cap, fill_rounds=fill_rounds,
+        fill_mode=fill_mode, _tier=_tier,
+    )
+    return labels, seed_overflow | ws_overflow
+
+
 def dt_watershed_seeded_tiled(
     boundaries: jnp.ndarray,
     ext_seeds: jnp.ndarray,
@@ -1057,6 +1307,8 @@ def dt_watershed_seeded_tiled(
     seed_cap: Optional[int] = None,
     adj_cap: Optional[int] = None,
     fill_rounds: int = 16,
+    fill_mode: Optional[str] = None,
+    seed_mode: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Two-pass-mode DT watershed on the tiled machinery.
 
@@ -1067,38 +1319,70 @@ def dt_watershed_seeded_tiled(
     planted where no external seed sits.  Output values > N are external
     (+N offset, N = voxel count); 1..N are new internal fragments.  Returns
     ``(labels, overflow)``.
-    """
-    from .edt import distance_transform_squared
-    from .filters import gaussian_smooth
-    from .watershed import local_maxima
 
+    ``fill_mode`` / ``seed_mode`` as in :func:`dt_watershed_tiled` —
+    resolved pre-jit so the env values join the compile key.
+    """
+    return _dt_watershed_seeded_tiled_jit(
+        boundaries, ext_seeds, threshold=threshold, sigma_seeds=sigma_seeds,
+        min_seed_distance=min_seed_distance, sampling=sampling, mask=mask,
+        dt_max_distance=dt_max_distance, impl=impl, tile=tile,
+        pair_cap=pair_cap, edge_cap=edge_cap, exit_cap=exit_cap,
+        fill_cap=fill_cap, table_cap=table_cap, interpret=interpret,
+        seed_cap=seed_cap, adj_cap=adj_cap, fill_rounds=fill_rounds,
+        fill_mode=_resolve_fill_mode(fill_mode),
+        seed_mode=_resolve_seed_mode(seed_mode), _tier=tier_mode(),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "threshold", "sigma_seeds", "min_seed_distance", "sampling",
+        "dt_max_distance", "impl", "tile", "pair_cap", "edge_cap",
+        "exit_cap", "fill_cap", "table_cap", "interpret", "seed_cap",
+        "adj_cap", "fill_rounds", "fill_mode", "seed_mode", "_tier",
+    ),
+)
+def _dt_watershed_seeded_tiled_jit(
+    boundaries: jnp.ndarray,
+    ext_seeds: jnp.ndarray,
+    threshold: float = 0.25,
+    sigma_seeds: float = 0.0,
+    min_seed_distance: float = 0.0,
+    sampling: Optional[Tuple[float, ...]] = None,
+    mask: Optional[jnp.ndarray] = None,
+    dt_max_distance: Optional[float] = None,
+    impl: str = "auto",
+    tile: Optional[Tuple[int, int, int]] = None,
+    pair_cap: Optional[int] = None,
+    edge_cap: Optional[int] = None,
+    exit_cap: Optional[int] = None,
+    fill_cap: Optional[int] = None,
+    table_cap: int = DEFAULT_TABLE_CAP,
+    interpret: bool = False,
+    seed_cap: Optional[int] = None,
+    adj_cap: Optional[int] = None,
+    fill_rounds: int = 16,
+    fill_mode: str = "capacity",
+    seed_mode: str = "tiled",
+    _tier: str = "cond",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     n = int(np.prod(boundaries.shape))
-    valid = jnp.ones(boundaries.shape, bool) if mask is None else mask.astype(bool)
-    fg = (boundaries < threshold) & valid
-    # "xla" must stay Mosaic-free end-to-end; other modes let the EDT pick
-    # its own fast path ("pallas" lacks an interpret plumb, so not forwarded)
-    dist = distance_transform_squared(
-        fg, sampling=sampling, max_distance=dt_max_distance,
-        impl="xla" if impl == "xla" else "auto",
+    internal, valid, seed_overflow = _dt_seeds_core(
+        boundaries, mask, None, threshold=threshold, sigma_seeds=sigma_seeds,
+        min_seed_distance=min_seed_distance, sampling=sampling,
+        dt_max_distance=dt_max_distance, impl=impl, tile=tile,
+        pair_cap=pair_cap, edge_cap=edge_cap, table_cap=table_cap,
+        interpret=interpret, seed_cap=seed_cap, seed_mode=seed_mode,
     )
-    if sigma_seeds > 0:
-        dist = gaussian_smooth(dist, sigma_seeds, sampling=sampling)
-    maxima = (
-        local_maxima(dist, 1)
-        & fg
-        & (dist >= min_seed_distance * min_seed_distance)
-    )
-    raw, seed_overflow = _seed_ccl(
-        maxima, seed_cap, impl=impl, tile=tile, pair_cap=pair_cap,
-        edge_cap=edge_cap, table_cap=table_cap, interpret=interpret,
-    )
-    internal = jnp.where(raw == n, 0, raw + 1).astype(jnp.int32)
     ext = ext_seeds.astype(jnp.int32)
     # external seeds dominate; internal ids live in 1..N, external in N+1..
     seeds = jnp.where(ext > 0, ext + jnp.int32(n), internal)
-    labels, ws_overflow = seeded_watershed_tiled(
+    labels, ws_overflow = _seeded_watershed_tiled_jit(
         boundaries, seeds, mask=valid, impl=impl, tile=tile,
         exit_cap=exit_cap, fill_cap=fill_cap, table_cap=table_cap,
         interpret=interpret, adj_cap=adj_cap, fill_rounds=fill_rounds,
+        fill_mode=fill_mode, _tier=_tier,
     )
     return labels, seed_overflow | ws_overflow

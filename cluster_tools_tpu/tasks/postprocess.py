@@ -1,7 +1,11 @@
 """Postprocessing: size filtering, background filtering, connected components
 on an existing segmentation (reference: ``cluster_tools/postprocess/``,
-SURVEY.md §2a).  This module currently covers the size-filter family; the
-graph-watershed reassignment variant lands with the graph tasks."""
+SURVEY.md §2a).  Covers the size-filter family (threshold + background
+filtering), hole filling, connected components on a segmentation, and the
+graph-watershed reassignment variant (``GraphWatershedAssignmentsBase`` /
+``GraphWatershedSizeFilterWorkflow`` below), which reassigns filtered
+fragments to their surviving graph neighbours via seeded watershed on the
+region graph instead of discarding them."""
 
 from __future__ import annotations
 
