@@ -138,19 +138,24 @@ def _timeit(name, fn, *args, runs=3):
 
 
 def _host_baseline_vps(vol: np.ndarray, threshold: float) -> float:
-    """voxels/sec of the equivalent scipy pipeline (single core, in-process)."""
+    """voxels/sec of the equivalent scipy pipeline (single core, in-process).
+
+    Timed through ``_timeit`` (untimed warm-up + best-of-2) so the
+    baseline gets the identical protocol to the headline measurements."""
     from scipy import ndimage
 
-    t0 = time.perf_counter()
-    fg = vol < threshold
-    dist = ndimage.distance_transform_edt(fg)
-    maxima = (ndimage.maximum_filter(dist, size=3) == dist) & fg
-    seeds, _ = ndimage.label(maxima)
-    hmap = np.clip(vol * 255, 0, 255).astype(np.uint8)
-    ndimage.watershed_ift(hmap, seeds.astype(np.int32))
-    ndimage.label(fg)  # the CC pass
-    dt = time.perf_counter() - t0
-    return vol.size / dt
+    def pipeline():
+        fg = vol < threshold
+        dist = ndimage.distance_transform_edt(fg)
+        maxima = (ndimage.maximum_filter(dist, size=3) == dist) & fg
+        seeds, _ = ndimage.label(maxima)
+        hmap = np.clip(vol * 255, 0, 255).astype(np.uint8)
+        ndimage.watershed_ift(hmap, seeds.astype(np.int32))
+        ndimage.label(fg)  # the CC pass
+        return 0
+
+    best, _ = _timeit("host baseline pipeline", pipeline, runs=2)
+    return vol.size / best
 
 
 def _host_rag_gaec(seg: np.ndarray, boundaries: np.ndarray) -> float:
@@ -253,12 +258,12 @@ def main():
         halo = 32
         batch, z, y, x = dp, sp * max(halo, ext // sp), ext, ext
     else:
-        # smoke fallback only: this box has ONE physical core, so the
-        # virtual mesh is fully serial — keep the volume small enough that
-        # the whole bench (3 timed runs + configs + scipy baseline) fits
-        # the driver's window even here
+        # smoke fallback only: the box has ~2 cores, so the virtual mesh is
+        # ~serial — the extent balances non-toy shapes (r4 verdict weak #2)
+        # against the driver's window; CT_BENCH_EXTENT_CPU de-risks reruns
         halo = 8
-        batch, z, y, x = dp, sp * max(halo, 32), 32, 64
+        ext = int(os.environ.get("CT_BENCH_EXTENT_CPU", "48"))
+        batch, z, y, x = dp, sp * max(halo, ext), ext, 2 * ext
     log(f"mesh dp={dp} sp={sp}; volume ({batch},{z},{y},{x}), halo={halo}")
 
     # deterministic CREMI-like boundary map, synthesized ON DEVICE (see
@@ -305,6 +310,10 @@ def main():
 
     rung_mode = bool(os.environ.get("CT_BENCH_SOFT_DEADLINE_AT"))
     base_vps = None
+    # provenance of base_vps, carried into every emitted JSON record so a
+    # nominal fallback can never masquerade as a measurement (advisor r4):
+    # "measured" | "rung_cache" | "nominal_fallback"
+    base_src = {"v": None}
 
     def _compute_baseline():
         # size-matched single-core scipy baseline.  A smaller crop reads
@@ -323,6 +332,7 @@ def main():
             with open(cache_key) as f:
                 bv = float(f.read())
             log(f"host baseline from rung cache: {bv:,.0f} voxels/s")
+            base_src["v"] = "rung_cache"
             return bv
         except (OSError, ValueError):
             pass
@@ -339,11 +349,13 @@ def main():
                     f.write(str(bv))
             except OSError:
                 pass
+        base_src["v"] = "measured"
         if bv is None:
             # the contract guarantees vs_baseline in the JSON: fall back to
             # the last recorded figure for this host class rather than
-            # dividing by nothing (labeled so the provenance is visible)
+            # dividing by nothing; baseline_source in the record marks it
             bv = 3.39e6 if on_accel else 1.0e6
+            base_src["v"] = "nominal_fallback"
             log(f"baseline fell back to nominal {bv:,.0f} voxels/s")
         log(f"baseline throughput: {bv:,.0f} voxels/s (single core)")
         return bv
@@ -369,6 +381,7 @@ def main():
             "backend": backend,
             "impl": impl_env or "auto",
             "headline_path": path,
+            "baseline_source": base_src["v"],
             "provisional": True,
         }
         rec.update(extra or {})
@@ -389,7 +402,9 @@ def main():
         # the legacy rung is the guaranteed-completion last resort: it must
         # reach its (small, always-compiling) fused program without risking
         # a tiled-kernel wedge first, so it skips the pre-pass
-        pre_impl = configs_impl = impl_env or "auto"
+        pre_impl = configs_impl = (
+            "auto" if impl_env in (None, "split") else impl_env
+        )
 
         def _config1_pre():
             # pre_impl is never "legacy" here (the legacy rung skips the
@@ -428,7 +443,13 @@ def main():
             pre_state["ws_overflow"] = bool(ws_ovf)
             return t_ws
 
-        t_ws = _shielded("config 2 (pre)", _config2_pre)
+        # the split rung exists to avoid the dt_ws monolith (the program
+        # that has wedged remote compiles): it goes straight to the staged
+        # chain, whose stages are each strictly smaller than config 2
+        t_ws = (
+            None if impl_env == "split"
+            else _shielded("config 2 (pre)", _config2_pre)
+        )
         # host-side baseline before the fused compile (no chip involvement;
         # cached in /tmp so the auto/xla rung subprocesses pay it once):
         # every later provisional and the final JSON carry a real
@@ -461,26 +482,74 @@ def main():
     # wall-clock cap, because a wedged remote compile HANGS rather than
     # raising — an in-process ladder cannot recover from that.
     step = None
+    split_stage_ms = None
     headline_impl = "none"
-    for impl in ((impl_env,) if impl_env else ("auto", "xla", "legacy")):
-        try:
-            candidate = make_ws_ccl_step(
-                mesh, halo=halo, threshold=threshold,
-                dt_max_distance=float(halo),
-                min_seed_distance=min_seed_distance, impl=impl,
-                # config 3 is "to merged labels": fragments stitch across sp
-                # cuts by face consensus (free at sp=1 — no cuts exist)
-                stitch_ws_threshold=threshold,
-            )
-            log(f"config 3 (headline): compiling fused ws+ccl step (impl={impl})")
-            out0 = candidate(vol)
-            _sync(out0)
-            step = candidate
-            headline_impl = impl
-            break
-        except Exception as e:
-            log(f"impl={impl} FAILED: {type(e).__name__}: {str(e)[:300]}")
-    headline_path = "device_fused_step"
+    if impl_env == "split":
+        # staged chain: four per-stage programs with device-resident
+        # intermediates (parallel/split_pipeline.py) — each strictly
+        # smaller than the fused monolith whose remote compile has
+        # exceeded every cap (r4).  Compiles run smallest-program-first
+        # by construction of the chain order.
+        from cluster_tools_tpu.parallel.split_pipeline import (
+            make_ws_ccl_split,
+        )
+
+        split_step = make_ws_ccl_split(
+            mesh, halo=halo, threshold=threshold,
+            dt_max_distance=float(halo),
+            min_seed_distance=min_seed_distance, impl="auto",
+            stitch_ws_threshold=threshold,
+        )
+
+        def _timed_chain(v):
+            # per-stage sync-by-fetch timing; the LAST run's stage splits
+            # are recorded (stage sums track the chain total closely)
+            marks = []
+
+            def sync(name, *arrs):
+                _sync(arrs)
+                marks.append((name, time.perf_counter()))
+
+            t0 = time.perf_counter()
+            marks.append(("start", t0))
+            out = split_step.run_staged(v, sync)
+            _sync(out)
+            nonlocal split_stage_ms
+            split_stage_ms = {
+                f"{name}_ms": round((t - prev) * 1000, 1)
+                for (_, prev), (name, t) in zip(marks, marks[1:])
+            }
+            return out
+
+        log("config 3 (headline): compiling staged split chain (4 programs)")
+        step = _timed_chain
+        headline_impl = "auto"
+    else:
+        for impl in ((impl_env,) if impl_env else ("auto", "xla", "legacy")):
+            try:
+                candidate = make_ws_ccl_step(
+                    mesh, halo=halo, threshold=threshold,
+                    dt_max_distance=float(halo),
+                    min_seed_distance=min_seed_distance, impl=impl,
+                    # config 3 is "to merged labels": fragments stitch across
+                    # sp cuts by face consensus (free at sp=1 — no cuts exist)
+                    stitch_ws_threshold=threshold,
+                )
+                log(
+                    f"config 3 (headline): compiling fused ws+ccl step "
+                    f"(impl={impl})"
+                )
+                out0 = candidate(vol)
+                _sync(out0)
+                step = candidate
+                headline_impl = impl
+                break
+            except Exception as e:
+                log(f"impl={impl} FAILED: {type(e).__name__}: {str(e)[:300]}")
+    headline_path = (
+        "split_programs_single_chip (staged device chain)"
+        if impl_env == "split" else "device_fused_step"
+    )
     if step is None and t_cc is not None and t_ws is not None:
         # every fused impl raised, but the pre-pass measured both component
         # programs: finish the run with the split headline (ws + cc
@@ -566,7 +635,12 @@ def main():
 
         t_cc = _shielded("config 1", _config1)
 
-    if t_ws is None:
+    # the split rung must NEVER compile the dt_ws monolith — avoiding its
+    # cap-exceeding remote compile is the rung's entire purpose, and a
+    # hang here (shielding catches exceptions, not wedges) would cost the
+    # complete staged-chain JSON after the headline already landed.  Its
+    # ws evidence is the per-stage split timings instead.
+    if t_ws is None and impl_env != "split":
 
         def _config2():
             if headline_impl == "legacy":
@@ -650,7 +724,61 @@ def main():
     if t_cc is not None:
         stages["cc_total"] = t_cc
     stages_ms = {k: round(v * 1000, 1) for k, v in stages.items()}
+    if split_stage_ms:
+        # per-program splits of the staged-chain headline (sync-by-fetch
+        # between programs; from the LAST timed run)
+        stages_ms.update({f"split_{k}": v for k, v in split_stage_ms.items()})
     log(f"stages: {stages_ms}")
+
+    # ---- split-vs-fused A/B (r4 verdict #2): the staged chain timed on
+    # the same substrate as the fused headline, so the on-chip decision
+    # between the two execution modes is a recorded measurement ----
+    def _split_ab():
+        if impl_env == "split" or headline_impl == "legacy" or step is None:
+            return None
+        from cluster_tools_tpu.parallel.split_pipeline import (
+            make_ws_ccl_split,
+        )
+
+        sstep = make_ws_ccl_split(
+            mesh, halo=halo, threshold=threshold,
+            dt_max_distance=float(halo),
+            min_seed_distance=min_seed_distance, impl=sub_impl,
+            stitch_ws_threshold=threshold,
+        )
+        marks = {}
+
+        def sync(name, *arrs):
+            _sync(arrs)
+            marks[name] = time.perf_counter()
+
+        def chain():
+            marks.clear()
+            marks["start"] = time.perf_counter()
+            return sstep.run_staged(vol, sync)
+
+        # _timeit protocol (warm-up pays the 4 stage compiles + best-of-2);
+        # marks keep the LAST run's stage splits
+        t_split, _ = _timeit("split chain", chain, runs=2)
+        names = ["start", "seeds", "flow", "fill", "cc"]
+        stage_ms = {
+            f"{b}_ms": round((marks[b] - marks[a]) * 1000, 1)
+            for a, b in zip(names, names[1:])
+        }
+        log(
+            f"split chain: {t_split:.3f}s vs fused {t_fused:.3f}s "
+            f"({t_split / t_fused:.2f}x); stages {stage_ms}"
+        )
+        return {
+            "seconds": round(t_split, 3),
+            "voxels_per_sec": round(vol.size / t_split, 1),
+            "overhead_vs_fused": round(t_split / t_fused, 3),
+            "stage_ms": stage_ms,
+            "note": "4 per-stage programs, device-resident intermediates "
+            "(parallel/split_pipeline.py); warm-run best-of-2",
+        }
+
+    split_ab = _shielded("split chain A/B", _split_ab)
 
     # ---- host baseline (computed in the on-accel pre-pass, here on cpu) --
     if base_vps is None:
@@ -670,13 +798,20 @@ def main():
         full = np.asarray(vol[0])
 
         def _host_headline():
-            t0 = time.perf_counter()
-            host_ws_ccl(
-                full, threshold,
-                dt_max_distance=float(halo),
-                min_seed_distance=min_seed_distance,
+            # identical protocol to every device measurement: _timeit's
+            # untimed warm-up + best-of-3 (the native kernels put single
+            # runs well under a second, so the extra runs cost little and
+            # de-noise the recorded number on the shared 2-core box)
+            best, _ = _timeit(
+                "cpu headline (host pipeline)",
+                lambda: host_ws_ccl(
+                    full, threshold,
+                    dt_max_distance=float(halo),
+                    min_seed_distance=min_seed_distance,
+                )[2],
+                runs=3,
             )
-            return full.size / (time.perf_counter() - t0)
+            return full.size / best
 
         host_vps = _shielded(
             "cpu headline (shipped host pipeline, full volume)",
@@ -749,6 +884,7 @@ def main():
         "timing": "sync-by-scalar-fetch (block_until_ready does not block on axon)",
         "baseline": "single-core scipy pipeline (reference per-job compute path)",
         "baseline_voxels_per_sec": round(base_vps, 1),
+        "baseline_source": base_src["v"],
         "best_run_seconds": round(t_fused, 3),
         "stages_ms": stages_ms,
         "configs": {
@@ -768,11 +904,16 @@ def main():
                 "seconds": round(t_fused, 3),
                 "voxels_per_sec": round(vps, 1),
                 **(
-                    {"note": "split ws+cc sequential sum — the fused "
-                     "program itself never compiled (see headline_path)"}
+                    {"note": "staged 4-program chain, device-resident "
+                     "intermediates (the fused monolith was not attempted "
+                     "in this rung)"}
+                    if "staged device chain" in headline_path
+                    else {"note": "split ws+cc sequential sum — the fused "
+                          "program itself never compiled (see headline_path)"}
                     if headline_path.startswith("split_programs") else {}
                 ),
             },
+            "split_chain": split_ab,
             "rag_multicut_crop": rag_result,
             "exact_edt_global": None if t_exact_edt is None else {
                 "seconds": round(t_exact_edt, 3),
@@ -809,6 +950,11 @@ def orchestrate() -> None:
     # without changing the driver-facing defaults
     rungs = (
         ("auto", float(os.environ.get("CT_BENCH_CAP_AUTO", "600"))),
+        # staged chain: four programs, each strictly smaller than the fused
+        # monolith — the structural answer to the r4 finding that the
+        # monolith's remote compile exceeds every cap for BOTH kernel
+        # families while its components compile fine
+        ("split", float(os.environ.get("CT_BENCH_CAP_SPLIT", "600"))),
         ("xla", float(os.environ.get("CT_BENCH_CAP_XLA", "480"))),
         ("legacy", float("inf")),
     )
@@ -935,6 +1081,8 @@ def orchestrate() -> None:
             # comparable since ccl-only omits t_ws), value-tiebreak within
             # a kind; remaining rungs still try for a complete fused line
             _rank = {
+                # a measured staged chain beats the ws+cc arithmetic sum
+                "split_programs_single_chip (staged device chain)": 4,
                 "split_programs_single_chip (fused compile failed)": 3,
                 "provisional_ws_plus_cc_sequential": 2,
                 "provisional_ccl_only": 1,

@@ -30,4 +30,5 @@ from .distributed_ccl import (
     distributed_connected_components,
 )
 from .pipeline import make_ws_ccl_step
+from .split_pipeline import make_ws_ccl_split
 from .multihost import initialize as initialize_distributed, pod_mesh

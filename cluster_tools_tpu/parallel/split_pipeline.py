@@ -1,0 +1,317 @@
+"""Split execution mode: the fused ws+cc step as a chain of per-stage
+jitted SPMD programs with device-resident (HBM-pinned) intermediates.
+
+Why this exists: on the tunneled TPU backend the fused monolith's remote
+compile has exceeded every operational cap (Mosaic >=600s, portable XLA
+>=440s for a ~4.5-6.3k-line HLO that XLA:CPU compiles in 19s —
+docs/PERFORMANCE.md round-4 log), while the per-stage programs are
+individually in the class of the tiled CCL (~1.4k lines), the one program
+PROVEN to compile on-chip in round 3.  Splitting the step into four
+programs whose intermediates never leave the device makes the headline
+number robust to the monolith never compiling:
+
+1. ``seeds``   — halo exchange, (optionally mesh-exact) EDT, maxima,
+                 seed CCL (collectives: ppermute halo, EDT reshard).
+2. ``flow``    — descent directions, in-tile VMEM flow, exit chase +
+                 remap (no collectives).
+3. ``fill``    — unseeded-basin fill, remap, halo crop, fragment-id
+                 globalization, cross-shard stitch (collectives:
+                 all_gather merge).
+4. ``cc``      — distributed CCL of the foreground + global stats
+                 (collectives: all_gather merge, psum).
+
+Each stage is its own ``jax.jit(shard_map(...))`` over the same mesh and
+specs as the fused step (``make_ws_ccl_step``); outputs equal the fused
+step's bit-for-bit on every oracle in tests/test_split_pipeline.py.  The
+cost is a few host dispatches per batch instead of one — measured on the
+8-device CPU mesh the overhead is small compared to any stage's compute
+(recorded by ``bench.py``'s split path and the A/B test).
+
+Intermediates are donated where consumed (``padded`` to flow, ``values``/
+``h`` to fill) so peak HBM stays in the fused step's class.
+
+Reference mapping (SURVEY.md §3.5): this IS the reference's five-task
+blockwise decomposition (write block -> ws block -> merge faces ->
+merge assignments -> write relabeled) re-cut on program-compile
+boundaries instead of luigi-task/filesystem boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.ccl import _match_vma, relabel_consecutive
+from ..ops.tile_ccl import DEFAULT_TABLE_CAP
+from ..ops.tile_ws import (
+    _dt_seeds_core,
+    _resolve_fill_mode,
+    _resolve_seed_mode,
+    _ws_flow_core,
+    _ws_fill_core,
+)
+from .distributed_ccl import (
+    linearized_shard_rank,
+    sharded_label_components,
+    sp_axes_for_mesh,
+)
+from .halo import crop_halo, exchange_halo
+from .pipeline import _stitch_ws_fragments
+
+
+class SplitWsCclStep:
+    """Callable chain of per-stage programs; see the module docstring.
+
+    ``step(boundaries)`` returns ``(ws_labels, cc_labels, n_foreground,
+    overflow)`` — the same contract as the fused step from
+    ``make_ws_ccl_step``.  ``stages`` maps stage name to its jitted
+    function for individual compile-probing / cache warming; ``run_staged``
+    exposes per-stage sync points for stage-resolved timing.
+    """
+
+    def __init__(self, stages, runner):
+        self.stages = stages
+        self._runner = runner
+
+    def __call__(self, boundaries):
+        return self._runner(boundaries, sync=None)
+
+    def run_staged(self, boundaries, sync):
+        """Run with ``sync(name, *arrays)`` called after dispatching each
+        stage — pass a blocking sync to time stages individually."""
+        return self._runner(boundaries, sync=sync)
+
+
+def make_ws_ccl_split(
+    mesh: Mesh,
+    halo: int = 4,
+    threshold: float = 0.3,
+    connectivity: int = 1,
+    dp_axis: str = "dp",
+    sp_axis: Union[str, Sequence[str]] = "sp",
+    dt_max_distance: Optional[float] = None,
+    min_seed_distance: float = 0.0,
+    max_labels_per_shard: Optional[int] = None,
+    impl: str = "auto",
+    exact_edt: bool = False,
+    stitch_ws_threshold: Optional[float] = None,
+    fill_mode: Optional[str] = None,
+    seed_mode: Optional[str] = None,
+) -> SplitWsCclStep:
+    """Build the split-mode twin of ``make_ws_ccl_step`` for ``mesh``.
+
+    Same arguments and output contract as the fused builder; ``impl`` is
+    restricted to the tiled kernel family ("auto"/"pallas"/"xla"/"tiled")
+    because the split exists to deploy the tiled path on compile-capped
+    backends — "legacy" has no phase seams to cut (its fused program is
+    small enough to compile everywhere).  3-D volumes, connectivity 1.
+
+    ``fill_mode``/``seed_mode``: as in ``dt_watershed_tiled`` — ``None``
+    resolves ``CT_FILL_MODE``/``CT_SEED_CCL`` here, at build time, so the
+    env values are fixed into the stage programs.
+    """
+    if impl == "legacy":
+        raise ValueError("split mode covers the tiled kernels only")
+    if connectivity != 1:
+        raise ValueError("split mode supports connectivity=1 only")
+    names = (sp_axis,) if isinstance(sp_axis, str) else tuple(sp_axis)
+    sp_axes = sp_axes_for_mesh(mesh, sp_axis)
+    n_shards = int(np.prod([s for _, _, s in sp_axes]))
+    fill_mode = _resolve_fill_mode(fill_mode)
+    seed_mode = _resolve_seed_mode(seed_mode)
+    # tier_mode() is read at trace time inside the tiered sites; each call
+    # to this builder returns FRESH jitted closures (fresh caches), so the
+    # env value at first use is the one compiled — same contract as the
+    # fused builder.
+    tiled_impl = "xla" if impl == "tiled" else impl
+    spec = P(dp_axis, *names)
+    rep = P()
+
+    def _smap(body, in_specs, out_specs, donate=()):
+        fn = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            ),
+            donate_argnums=donate,
+        )
+        return fn
+
+    def exchange_all(x, fill):
+        # one ppermute per sharded axis; later exchanges forward the halos
+        # received by earlier ones, so corner regions arrive correctly
+        for a, name, size in sp_axes:
+            x = exchange_halo(x, halo, a, name, size, fill=fill)
+        return x
+
+    def _reduce_all(v):
+        for _, name, _ in sp_axes:
+            v = lax.pmax(v, name)
+        return lax.pmax(v, dp_axis)
+
+    # ---- stage 1: halo exchange + EDT + maxima + seed CCL ----
+    def seeds_body(boundaries):
+        if boundaries.ndim - 1 != 3:
+            raise ValueError("split mode expects 3-D volumes")
+        local_b = boundaries.shape[0]
+        pad_out, seed_out = [], []
+        ovf = _match_vma(jnp.zeros((), jnp.int32), boundaries)
+        for b in range(local_b):
+            vol = boundaries[b]
+            padded = exchange_all(vol, fill=1.0)
+            dist_pad = None
+            if exact_edt:
+                from .distributed_edt import (
+                    sharded_distance_transform_squared,
+                )
+
+                dist_sq = sharded_distance_transform_squared(
+                    vol < threshold,
+                    shard_axes=sp_axes,
+                    max_distance=dt_max_distance,
+                    impl="xla" if impl in ("xla", "tiled") else "auto",
+                )
+                dist_pad = exchange_all(dist_sq, fill=0.0)
+            seeds, _, s_ovf = _dt_seeds_core(
+                padded, None, dist_pad, threshold=threshold,
+                sigma_seeds=0.0, min_seed_distance=min_seed_distance,
+                sampling=None, dt_max_distance=dt_max_distance,
+                impl=tiled_impl, tile=None, pair_cap=None, edge_cap=None,
+                table_cap=DEFAULT_TABLE_CAP, interpret=False,
+                seed_cap=None, seed_mode=seed_mode,
+            )
+            ovf = jnp.maximum(ovf, s_ovf.astype(jnp.int32))
+            pad_out.append(padded)
+            seed_out.append(seeds)
+        return jnp.stack(pad_out), jnp.stack(seed_out), _reduce_all(ovf)
+
+    # ---- stage 2: descent + in-tile flow + exit chase/remap ----
+    def flow_body(padded, seeds, ovf_in):
+        local_b = padded.shape[0]
+        val_out, h_out = [], []
+        ovf = ovf_in
+        for b in range(local_b):
+            values, h, o = _ws_flow_core(
+                padded[b], seeds[b], None, impl=tiled_impl, tile=None,
+                exit_cap=None, table_cap=DEFAULT_TABLE_CAP, interpret=False,
+            )
+            ovf = jnp.maximum(ovf, o.astype(jnp.int32))
+            val_out.append(values)
+            h_out.append(h)
+        # pmax so the replicated out_spec is honest (check_vma is off —
+        # an unreduced per-shard flag would silently take one shard's copy)
+        return jnp.stack(val_out), jnp.stack(h_out), _reduce_all(ovf)
+
+    # ---- stage 3: fill + halo crop + globalize + stitch ----
+    def fill_body(values, h, boundaries, ovf_in):
+        local_b = values.shape[0]
+        rank = linearized_shard_rank(sp_axes)
+        pad_shape = tuple(
+            boundaries.shape[1 + i]
+            + (2 * halo if i in [a for a, _, _ in sp_axes] else 0)
+            for i in range(3)
+        )
+        n_pad = int(np.prod(pad_shape))
+        ws_out = []
+        ovf = ovf_in
+        for b in range(local_b):
+            ws, o = _ws_fill_core(
+                values[b], h[b], pad_shape, impl=tiled_impl, tile=None,
+                exit_cap=None, fill_cap=None, table_cap=DEFAULT_TABLE_CAP,
+                interpret=False, adj_cap=None, fill_rounds=16,
+                fill_mode=fill_mode,
+            )
+            ovf = jnp.maximum(ovf, o.astype(jnp.int32))
+            for a, _, _ in sp_axes:
+                ws = crop_halo(ws, halo, a)
+            # globalize fragment ids by shard rank (identical arithmetic to
+            # the fused body — parallel/pipeline.py _ws_ccl_shard)
+            if max_labels_per_shard is not None:
+                cap = int(max_labels_per_shard)
+                if n_shards * (cap + 1) >= 2**31:
+                    raise ValueError(
+                        f"{n_shards} shards x {cap} ws fragments overflow int32"
+                    )
+                ws, n_frag = relabel_consecutive(
+                    ws, max_labels=cap, value_bound=n_pad + 1
+                )
+                ovf = jnp.maximum(ovf, (n_frag > cap).astype(jnp.int32))
+                ws = jnp.where(ws > 0, ws + rank * jnp.int32(cap + 1), 0)
+                ws_span = cap + 1
+            else:
+                if n_shards * n_pad >= 2**31:
+                    raise ValueError(
+                        f"{n_shards} shards of {n_pad} padded voxels overflow "
+                        "int32 labels; pass max_labels_per_shard"
+                    )
+                ws = jnp.where(ws > 0, ws + rank * jnp.int32(n_pad), 0)
+                ws_span = n_pad
+            if stitch_ws_threshold is not None and n_shards > 1:
+                ws = _stitch_ws_fragments(
+                    ws, boundaries[b], sp_axes, rank, ws_span,
+                    float(stitch_ws_threshold),
+                )
+            ws_out.append(ws)
+        return jnp.stack(ws_out), _reduce_all(ovf)
+
+    # ---- stage 4: distributed CC of the foreground + global stats ----
+    def cc_body(boundaries, ovf_in):
+        local_b = boundaries.shape[0]
+        cc_out = []
+        ovf = ovf_in
+        for b in range(local_b):
+            vol = boundaries[b]
+            cc, cc_over = sharded_label_components(
+                vol < threshold,
+                shard_axes=sp_axes,
+                connectivity=connectivity,
+                max_labels_per_shard=max_labels_per_shard,
+                return_overflow=True,
+                impl=impl,
+            )
+            ovf = jnp.maximum(ovf, cc_over.astype(jnp.int32))
+            cc_out.append(cc)
+        cc_lab = jnp.stack(cc_out)
+        # float32 psum: an int32 count would wrap past 2**31 global
+        # foreground voxels (same rationale as the fused step)
+        n_fg = jnp.sum(cc_lab > 0).astype(jnp.float32)
+        for _, name, _ in sp_axes:
+            n_fg = lax.psum(n_fg, name)
+        n_fg = lax.psum(n_fg, dp_axis)
+        overflow = _reduce_all(ovf) > 0
+        return cc_lab, n_fg, overflow
+
+    stages = {
+        "seeds": _smap(seeds_body, (spec,), (spec, spec, rep)),
+        # donate the padded volume (consumed by flow) and values/h
+        # (consumed by fill) so peak HBM stays in the fused step's class
+        "flow": _smap(
+            flow_body, (spec, spec, rep), (spec, spec, rep), donate=(0, 1)
+        ),
+        "fill": _smap(
+            fill_body, (spec, spec, spec, rep), (spec, rep), donate=(0, 1)
+        ),
+        "cc": _smap(cc_body, (spec, rep), (spec, rep, rep)),
+    }
+
+    def runner(boundaries, sync=None):
+        padded, seeds, ovf = stages["seeds"](boundaries)
+        if sync is not None:
+            sync("seeds", seeds)
+        values, h, ovf = stages["flow"](padded, seeds, ovf)
+        if sync is not None:
+            sync("flow", values)
+        ws_lab, ovf = stages["fill"](values, h, boundaries, ovf)
+        if sync is not None:
+            sync("fill", ws_lab)
+        cc_lab, n_fg, overflow = stages["cc"](boundaries, ovf)
+        if sync is not None:
+            sync("cc", cc_lab)
+        return ws_lab, cc_lab, n_fg, overflow
+
+    return SplitWsCclStep(stages, runner)

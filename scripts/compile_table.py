@@ -8,7 +8,9 @@ size-stable the 512^3 tunnel wedge is a backend/transport problem, not a
 program-structure problem.
 
 Usage: python scripts/compile_table.py <target> <extent> [halo]
-    targets: ccl, dt_ws, fused (CT_PROBE_IMPL selects pallas/xla/auto)
+    targets: ccl, dt_ws, fused, split (CT_PROBE_IMPL selects pallas/xla/auto);
+    "split" lowers + compiles each of the four staged-chain programs
+    (parallel/split_pipeline.py) in chain order, one TABLE line per stage
 Run each invocation in its own capped subprocess (a wedged remote compile
 hangs rather than raising); sweep with scripts/run_compile_table.sh.
 
@@ -96,6 +98,43 @@ def main() -> None:
             stitch_ws_threshold=threshold,
         )
         spec = jax.ShapeDtypeStruct((1,) + shape, jnp.float32)
+    elif target == "split":
+        import numpy as np
+
+        from jax.sharding import Mesh
+
+        from cluster_tools_tpu.parallel.split_pipeline import make_ws_ccl_split
+
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+        # bench's exact split-rung build (same params as the fused target)
+        # so every cache entry these probes leave is one the rung looks up
+        split = make_ws_ccl_split(
+            mesh, halo=halo, threshold=threshold,
+            dt_max_distance=float(halo), min_seed_distance=2.0, impl=impl,
+            stitch_ws_threshold=threshold,
+        )
+        vspec = jax.ShapeDtypeStruct((1,) + shape, jnp.float32)
+        out_seeds = jax.eval_shape(split.stages["seeds"], vspec)
+        stage_args = {"seeds": (vspec,), "flow": tuple(out_seeds)}
+        out_flow = jax.eval_shape(split.stages["flow"], *stage_args["flow"])
+        stage_args["fill"] = (out_flow[0], out_flow[1], vspec, out_flow[2])
+        out_fill = jax.eval_shape(split.stages["fill"], *stage_args["fill"])
+        stage_args["cc"] = (vspec, out_fill[1])
+        for name in ("seeds", "flow", "fill", "cc"):
+            t0 = time.monotonic()
+            lowered = split.stages[name].lower(*stage_args[name])
+            t_lower = time.monotonic() - t0
+            n_lines = len(lowered.as_text().splitlines())
+            t0 = time.monotonic()
+            lowered.compile()
+            t_compile = time.monotonic() - t0
+            print(
+                f"TABLE target=split_{name} extent={extent} impl={impl} "
+                f"backend={backend} trace_lower={t_lower:.1f} "
+                f"compile={t_compile:.1f} hlo_lines={n_lines}",
+                flush=True,
+            )
+        return
     else:
         raise SystemExit(f"unknown target {target!r}")
 

@@ -59,6 +59,10 @@ say "stage 2b: compile_table fused 64 (auto)"
 CT_PROBE_IMPL=auto timeout 1800 python scripts/compile_table.py fused 64 32 >> "$LOG" 2>&1
 say "stage 2b exit: $?"
 wait_healthy || exit 1
+say "stage 2c: compile_table split 64 (auto) — 4 staged-chain programs"
+CT_PROBE_IMPL=auto timeout 1800 python scripts/compile_table.py split 64 32 >> "$LOG" 2>&1
+say "stage 2c exit: $?"
+wait_healthy || exit 1
 
 # stage 3: bench-scale compiles in the exact order bench's pre-pass runs
 # them — every completed compile is CACHED for the bench rung below and
@@ -66,6 +70,13 @@ wait_healthy || exit 1
 say "stage 3a: compile_table ccl 512 (auto), cap 20min"
 CT_PROBE_IMPL=auto timeout 1200 python scripts/compile_table.py ccl 512 32 >> "$LOG" 2>&1
 say "stage 3a exit: $?"
+wait_healthy || exit 1
+# split stages are each strictly smaller than the dt_ws monolith, so they
+# compile next (smallest-first invariant); a completed set guarantees the
+# bench's split rung an on-chip headline even if dt_ws/fused never land
+say "stage 3a2: compile_table split 512 (auto), cap 30min"
+CT_PROBE_IMPL=auto timeout 1800 python scripts/compile_table.py split 512 32 >> "$LOG" 2>&1
+say "stage 3a2 exit: $?"
 wait_healthy || exit 1
 say "stage 3b: compile_table dt_ws 512 (auto), cap 30min"
 CT_PROBE_IMPL=auto timeout 1800 python scripts/compile_table.py dt_ws 512 32 >> "$LOG" 2>&1
@@ -104,7 +115,8 @@ fi
 # in seconds; without it the pre-pass still banks configs 1/2 + salvage.
 say "stage 4: bench.py (budget 3600, auto cap 1500, tier='${BENCH_TIER:-cond}')"
 CT_TIER_MODE="${BENCH_TIER:-cond}" \
-CT_BENCH_BUDGET=3600 CT_BENCH_CAP_AUTO=1500 CT_BENCH_CAP_XLA=900 \
+CT_BENCH_BUDGET=3600 CT_BENCH_CAP_AUTO=1200 CT_BENCH_CAP_SPLIT=900 \
+CT_BENCH_CAP_XLA=600 \
   timeout 4200 python bench.py >> "$LOG" 2>&1
 say "stage 4 exit: $?"
 wait_healthy || exit 1
