@@ -80,6 +80,8 @@ def _load() -> Optional[ctypes.CDLL]:
                 "ct_merge_edge_features",
                 "ct_mutex_watershed",
                 "ct_kernighan_lin",
+                "ct_edt_sq",
+                "ct_ws_flood",
             ):
                 getattr(lib, sym)
             return lib
@@ -143,6 +145,29 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_double,
         ]
         lib.ct_kernighan_lin.restype = ctypes.c_int
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.ct_edt_sq.argtypes = [
+            u8p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_double,
+            f32p,
+        ]
+        lib.ct_edt_sq.restype = ctypes.c_int
+        lib.ct_ws_flood.argtypes = [
+            u8p,
+            u8p,
+            i32p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.ct_ws_flood.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -257,3 +282,46 @@ def merge_edge_features(parts, table: np.ndarray):
             uv, feats, len(uv), table, k, means, m2s, mins, maxs, counts
         )
     return means, m2s, mins, maxs, counts
+
+
+def edt_sq(
+    fg: np.ndarray,
+    sampling=None,
+    cap: Optional[float] = None,
+) -> Optional[np.ndarray]:
+    """Exact squared EDT of a 3-D bool mask (float32), or None when the
+    library is unavailable.  ``sampling`` is per-axis voxel size (scipy
+    convention); ``cap`` clips the (unsquared) distance like the device
+    kernels' ``dt_max_distance``."""
+    lib = _load()
+    if lib is None:
+        return None
+    fg = np.ascontiguousarray(np.asarray(fg), np.uint8)
+    if fg.ndim != 3:
+        raise ValueError("edt_sq expects a 3-D mask")
+    nz, ny, nx = fg.shape
+    sz, sy, sx = (1.0, 1.0, 1.0) if sampling is None else map(float, sampling)
+    out = np.empty(fg.shape, np.float32)
+    cap_sq = float(cap) * float(cap) if cap is not None else 0.0
+    lib.ct_edt_sq(fg, nz, ny, nx, sz, sy, sx, cap_sq, out)
+    return out
+
+
+def ws_flood(
+    hmap: np.ndarray, fg: np.ndarray, seeds: np.ndarray
+) -> Optional[np.ndarray]:
+    """Seeded watershed by 256-level bucket-queue priority flood
+    (6-connectivity) over a uint8 priority map, or None when the library
+    is unavailable.  ``seeds``: int32, > 0; returns flooded labels with 0
+    outside ``fg``/unreached."""
+    lib = _load()
+    if lib is None:
+        return None
+    hmap = np.ascontiguousarray(np.asarray(hmap), np.uint8)
+    fg = np.ascontiguousarray(np.asarray(fg), np.uint8)
+    if hmap.ndim != 3 or hmap.shape != fg.shape:
+        raise ValueError("ws_flood expects matching 3-D hmap/fg")
+    labels = np.ascontiguousarray(np.asarray(seeds), np.int32).copy()
+    nz, ny, nx = hmap.shape
+    lib.ct_ws_flood(hmap, fg, labels, nz, ny, nx)
+    return labels

@@ -1,12 +1,20 @@
-"""Host (scipy) per-block kernels — the reference's per-job compute path.
+"""Host per-block kernels — the reference's per-job compute path, faster.
 
 The reference framework runs its per-block compute as single-core scipy /
 vigra calls inside cluster jobs (SURVEY.md §2a watershed +
 connected_components per-job kernels).  On a machine without an
 accelerator the device-shaped tiled/XLA kernels of this framework pay
 virtual-mesh serialization for no benefit, so the same capability is
-shipped as plain scipy, selectable with ``impl="host"`` in the watershed
+shipped as host kernels, selectable with ``impl="host"`` in the watershed
 task and used by ``bench.py``'s cpu-smoke headline.
+
+The hot stages call the framework's own C++ layer when available
+(``native/ct_native.cpp`` via ctypes — the same pattern the reference
+used for vigra/nifty): an exact Felzenszwalb-Huttenlocher squared EDT
+and a 256-level bucket-queue priority-flood watershed, each roughly an
+order of magnitude over the scipy generic equivalents they replace
+(``distance_transform_edt`` / ``watershed_ift``).  scipy remains the
+always-available fallback.
 
 These functions are the semantic (not bit-exact) host twins of
 :func:`..ops.tile_ws.dt_watershed_tiled` /
@@ -56,20 +64,38 @@ def host_dt_watershed(
     """
     from scipy import ndimage
 
+    from .. import native
+
     if fg is None:
         fg = vol < threshold
     if mask is not None:
         fg = fg & mask
-    dist = ndimage.distance_transform_edt(fg, sampling=sampling)
-    if dt_max_distance is not None:
-        dist = np.minimum(dist, float(dt_max_distance))
+    dist_sq = (
+        native.edt_sq(fg, sampling=sampling, cap=dt_max_distance)
+        if vol.ndim == 3 else None
+    )
+    if dist_sq is not None:
+        # maxima of the squared distance == maxima of the distance
+        # (monotone); the cap is applied inside the native kernel
+        dist = dist_sq
+        min_seed = min_seed_distance * min_seed_distance
+    else:
+        dist = ndimage.distance_transform_edt(fg, sampling=sampling)
+        if dt_max_distance is not None:
+            dist = np.minimum(dist, float(dt_max_distance))
+        min_seed = min_seed_distance
     maxima = (ndimage.maximum_filter(dist, size=3) == dist) & fg
     if min_seed_distance > 0:
-        maxima &= dist >= min_seed_distance
+        maxima &= dist >= min_seed
     seeds, _ = ndimage.label(maxima)
     hmap = np.clip(vol * 255, 0, 255).astype(np.uint8)
-    ws = ndimage.watershed_ift(hmap, seeds.astype(np.int32))
-    ws[~fg] = 0
+    ws = (
+        native.ws_flood(hmap, fg, seeds.astype(np.int32))
+        if vol.ndim == 3 else None
+    )
+    if ws is None:
+        ws = ndimage.watershed_ift(hmap, seeds.astype(np.int32))
+        ws[~fg] = 0
     return ws
 
 

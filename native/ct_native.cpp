@@ -393,4 +393,150 @@ int ct_kernighan_lin(int64_t n_nodes, const int64_t* edges,
   return static_cast<int>(max_outer);
 }
 
+// Exact squared Euclidean distance transform of a 3-D binary mask
+// (distance from each foreground voxel to the nearest background voxel,
+// anisotropic sampling, optional cap), Felzenszwalb-Huttenlocher
+// separable lower-envelope — O(n) per axis.  The host twin of the device
+// EDT (ops/edt.py); scipy's generic kd-tree-free EDT runs ~2M vox/s
+// where this runs tens of M vox/s, which is what lets the shipped host
+// pipeline beat the reference-equivalent scipy baseline (bench.py).
+//
+// fg: [nz*ny*nx] uint8 (1 = foreground), out: float32 squared distances
+// (0 on background).  sz/sy/sx: per-axis voxel size.  cap_sq > 0 clips
+// the result (matching the device kernels' capped transform).
+int ct_edt_sq(const uint8_t* fg, int64_t nz, int64_t ny, int64_t nx,
+              double sz, double sy, double sx, double cap_sq, float* out) {
+  const int64_t n = nz * ny * nx;
+  const double kInf = 1e30;
+  std::vector<double> f(n);
+  // pass 1 (x, contiguous): two-sweep 1-D distance in voxel units
+  for (int64_t zy = 0; zy < nz * ny; ++zy) {
+    const uint8_t* row = fg + zy * nx;
+    double* o = f.data() + zy * nx;
+    double d = kInf;
+    for (int64_t i = 0; i < nx; ++i) {
+      d = row[i] ? d + 1.0 : 0.0;
+      o[i] = d;
+    }
+    d = kInf;
+    for (int64_t i = nx - 1; i >= 0; --i) {
+      d = row[i] ? std::min(o[i], d + 1.0) : 0.0;
+      o[i] = d;
+      if (d >= kInf) d = kInf;  // keep all-foreground rows saturated
+    }
+    for (int64_t i = 0; i < nx; ++i)
+      o[i] = o[i] >= kInf ? kInf : o[i] * sx * o[i] * sx;
+  }
+  // passes 2/3 (y then z): lower envelope of parabolas over the current
+  // squared distances, strided access gathered into a scratch line
+  auto envelope_pass = [&](int64_t len, int64_t stride, double s,
+                           double* line, double* dist, int64_t* vx,
+                           double* zx, double* base) {
+    for (int64_t i = 0; i < len; ++i) line[i] = base[i * stride];
+    // parabolas with saturated (kInf) bases never win — build the
+    // envelope over finite entries only; if none exist the line is
+    // unreachable in-plane and a later pass (or the cap) resolves it
+    int64_t q0 = 0;
+    while (q0 < len && line[q0] >= kInf) ++q0;
+    if (q0 == len) return;
+    int64_t k = 0;
+    vx[0] = q0;
+    zx[0] = -kInf;
+    zx[1] = kInf;
+    const double s2 = s * s;
+    for (int64_t q = q0 + 1; q < len; ++q) {
+      if (line[q] >= kInf) continue;
+      const double qq = static_cast<double>(q);
+      while (true) {
+        const double vq = static_cast<double>(vx[k]);
+        const double inter =
+            (line[q] - line[vx[k]] + s2 * (qq * qq - vq * vq)) /
+            (2.0 * s2 * (qq - vq));
+        if (inter <= zx[k]) {  // zx[0] = -inf: never pops the last vertex
+          --k;
+          continue;
+        }
+        ++k;
+        vx[k] = q;
+        zx[k] = inter;
+        zx[k + 1] = kInf;
+        break;
+      }
+    }
+    int64_t j = 0;
+    for (int64_t q = 0; q < len; ++q) {
+      const double qq = static_cast<double>(q);
+      while (zx[j + 1] < qq) ++j;
+      const double dv = qq - static_cast<double>(vx[j]);
+      dist[q] = s2 * dv * dv + line[vx[j]];
+    }
+    for (int64_t i = 0; i < len; ++i) base[i * stride] = dist[i];
+  };
+  {
+    const int64_t len = ny > nz ? ny : nz;
+    std::vector<double> line(len), dist(len), zx(len + 1);
+    std::vector<int64_t> vx(len);
+    for (int64_t z = 0; z < nz; ++z)
+      for (int64_t x = 0; x < nx; ++x)
+        envelope_pass(ny, nx, sy, line.data(), dist.data(), vx.data(),
+                      zx.data(), f.data() + z * ny * nx + x);
+    for (int64_t y = 0; y < ny; ++y)
+      for (int64_t x = 0; x < nx; ++x)
+        envelope_pass(nz, ny * nx, sz, line.data(), dist.data(), vx.data(),
+                      zx.data(), f.data() + y * nx + x);
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    double d = fg[i] ? f[i] : 0.0;
+    if (cap_sq > 0.0 && d > cap_sq) d = cap_sq;
+    out[i] = static_cast<float>(d >= kInf ? (cap_sq > 0 ? cap_sq : kInf) : d);
+  }
+  return 0;
+}
+
+// Seeded watershed by 256-level bucket-queue priority flood,
+// 6-connectivity — the host twin of the device MSF watershed
+// (ops/tile_ws.py) and the replacement for scipy's watershed_ift in the
+// shipped host pipeline (same uint8 priority map, ~10x the throughput).
+// A voxel is claimed by the first neighbor popped at the lowest
+// priority; ties resolve FIFO within a level, matching the device
+// kernel's deterministic lex-min flavor closely enough for the
+// segmentation oracles (semantic, not bit-exact, twin — ops/host.py).
+//
+// hmap: [n] uint8 priorities; fg: [n] uint8 mask; labels: int32 in-out
+// (in: seeds > 0, 0 = unassigned; out: flooded labels, 0 outside fg).
+int ct_ws_flood(const uint8_t* hmap, const uint8_t* fg, int32_t* labels,
+                int64_t nz, int64_t ny, int64_t nx) {
+  const int64_t n = nz * ny * nx;
+  std::vector<std::vector<int64_t>> bucket(256);
+  // head index per bucket: pops are FIFO and nothing is ever re-pushed
+  // at a lower level (priority = max(level, hmap[nb]) is monotone)
+  std::vector<size_t> head(256, 0);
+  for (int64_t i = 0; i < n; ++i)
+    if (labels[i] > 0 && fg[i]) bucket[hmap[i]].push_back(i);
+  const int64_t sy_ = nx, sz_ = ny * nx;
+  for (int lev = 0; lev < 256; ++lev) {
+    auto& b = bucket[lev];
+    while (head[lev] < b.size()) {
+      const int64_t v = b[head[lev]++];
+      const int32_t lab = labels[v];
+      const int64_t z = v / sz_, y = (v / sy_) % ny, x = v % nx;
+      const int64_t nb6[6] = {z > 0 ? v - sz_ : -1, z < nz - 1 ? v + sz_ : -1,
+                              y > 0 ? v - sy_ : -1, y < ny - 1 ? v + sy_ : -1,
+                              x > 0 ? v - 1 : -1,   x < nx - 1 ? v + 1 : -1};
+      for (int k = 0; k < 6; ++k) {
+        const int64_t u = nb6[k];
+        if (u < 0 || labels[u] != 0 || !fg[u]) continue;
+        labels[u] = lab;
+        const int p = hmap[u] > lev ? hmap[u] : lev;
+        bucket[p].push_back(u);
+      }
+    }
+    b.clear();
+    b.shrink_to_fit();
+  }
+  for (int64_t i = 0; i < n; ++i)
+    if (!fg[i]) labels[i] = 0;
+  return 0;
+}
+
 }  // extern "C"

@@ -111,3 +111,94 @@ def test_merge_edge_features_matches_python():
     finally:
         nat.merge_edge_features = orig
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("seed,sampling,cap", [
+    (0, None, None),
+    (1, (2.0, 1.0, 0.5), None),
+    (2, None, 4.0),
+    (3, (1.0, 3.0, 1.0), 5.0),
+])
+def test_edt_sq_matches_scipy(seed, sampling, cap):
+    from scipy import ndimage
+
+    rng = np.random.default_rng(seed)
+    fg = rng.random((19, 23, 31)) < 0.7
+    got = native.edt_sq(fg, sampling=sampling, cap=cap)
+    want = ndimage.distance_transform_edt(fg, sampling=sampling)
+    if cap is not None:
+        want = np.minimum(want, cap)
+    np.testing.assert_allclose(got, (want * want).astype(np.float32), rtol=1e-5)
+
+
+def test_edt_sq_degenerate_masks():
+    from scipy import ndimage
+
+    # all-background: zeros
+    fg = np.zeros((8, 9, 10), bool)
+    np.testing.assert_array_equal(native.edt_sq(fg), 0.0)
+    # all-foreground WITH a cap: the saturated volume clips to cap^2
+    fg = np.ones((8, 9, 10), bool)
+    np.testing.assert_array_equal(native.edt_sq(fg, cap=3.0), 9.0)
+    # single background voxel: exact distances everywhere
+    fg[4, 4, 4] = False
+    got = native.edt_sq(fg)
+    want = ndimage.distance_transform_edt(fg)
+    np.testing.assert_allclose(got, (want * want).astype(np.float32), rtol=1e-5)
+
+
+def test_ws_flood_properties(rng):
+    """Priority flood: seeds keep their labels, every fg voxel reachable
+    from a seed is labeled, background stays 0, and regions are connected
+    monotone-reachable sets (semantic watershed contract — the scipy
+    watershed_ift twin differs only in plateau tie order)."""
+    from scipy import ndimage
+
+    v = rng.random((24, 24, 24)).astype(np.float32)
+    for _ in range(6):
+        for ax in range(3):
+            v = (np.roll(v, 1, ax) + v + np.roll(v, -1, ax)) / 3
+    v = (v - v.min()) / (v.max() - v.min())
+    fg = v < 0.55
+    dist = ndimage.distance_transform_edt(fg)
+    maxima = (ndimage.maximum_filter(dist, size=3) == dist) & fg
+    seeds, n_seeds = ndimage.label(maxima)
+    hmap = np.clip(v * 255, 0, 255).astype(np.uint8)
+    ws = native.ws_flood(hmap, fg, seeds.astype(np.int32))
+    assert ws.shape == v.shape and ws.dtype == np.int32
+    # seeds keep their labels
+    np.testing.assert_array_equal(ws[seeds > 0], seeds[seeds > 0])
+    # background stays 0
+    assert (ws[~fg] == 0).all()
+    # every fg voxel in a seeded CC is labeled; unseeded CCs stay 0
+    cc, _ = ndimage.label(fg)
+    seeded_ccs = np.unique(cc[seeds > 0])
+    seeded_mask = np.isin(cc, seeded_ccs) & fg
+    assert (ws[seeded_mask] > 0).all()
+    assert (ws[fg & ~seeded_mask] == 0).all()
+    # each region is connected
+    for lab in np.unique(ws[ws > 0])[:20]:
+        region_cc, k = ndimage.label(ws == lab)
+        assert k == 1
+
+
+def test_host_pipeline_uses_native_and_matches_contract(rng):
+    """host_ws_ccl with the native kernels keeps its documented contract
+    (ws fragments in fg, cc == scipy label, n_fg exact)."""
+    from scipy import ndimage
+
+    from cluster_tools_tpu.ops.host import host_ws_ccl
+
+    v = rng.random((20, 24, 28)).astype(np.float32)
+    for _ in range(6):
+        for ax in range(3):
+            v = (np.roll(v, 1, ax) + v + np.roll(v, -1, ax)) / 3
+    v = (v - v.min()) / (v.max() - v.min())
+    ws, cc, n_fg = host_ws_ccl(v, 0.55, dt_max_distance=4.0,
+                               min_seed_distance=1.0)
+    fg = v < 0.55
+    assert n_fg == int(fg.sum())
+    assert (ws[~fg] == 0).all()
+    assert (ws[fg] > 0).mean() > 0.9
+    want, n_want = ndimage.label(fg)
+    assert len(np.unique(cc[fg])) == n_want
