@@ -32,7 +32,11 @@ class FusedSegmentationBase(BaseTask):
     ``min_seed_distance``, ``stitch_ws_threshold``, ``exact_edt``,
     ``max_labels_per_shard``, ``impl``, ``decomposition`` — the
     fused-pipeline knobs; ``decomposition="grid"`` shards the ROI over z
-    AND y instead of z-slabs.
+    AND y instead of z-slabs.  ``execution="split"`` runs the step as the
+    four-program staged chain (``parallel.split_pipeline``) instead of the
+    fused monolith — bit-identical outputs, per-program compile cost in
+    the tiled-CCL class; the mode for backends where the monolith's
+    compile time, not runtime, is the binding constraint.
 
     The ROI must fit in device memory (sharded over the mesh); this task
     refuses inputs whose sharded extents (z; plus y for "grid") do not
@@ -56,6 +60,9 @@ class FusedSegmentationBase(BaseTask):
             # "slab" shards z only; "grid" factors the devices over z AND y
             # (the 2-axis spatial decomposition) — both extents must divide
             "decomposition": "slab",
+            # "fused" = one compiled program; "split" = the staged
+            # four-program chain (same outputs, compile-cap friendly)
+            "execution": "fused",
         }
 
     def run_impl(self):
@@ -63,6 +70,7 @@ class FusedSegmentationBase(BaseTask):
 
         from ..parallel.mesh import make_mesh
         from ..parallel.pipeline import make_ws_ccl_step
+        from ..parallel.split_pipeline import make_ws_ccl_split
 
         cfg = self.get_config()
         inp = file_reader(cfg["input_path"])[cfg["input_key"]]
@@ -111,7 +119,13 @@ class FusedSegmentationBase(BaseTask):
             # semantics); with exact_edt, None means truly global radii —
             # the saturation exact_edt exists to remove must stay removable
             dt_max = float(halo)
-        step = make_ws_ccl_step(
+        execution = str(cfg.get("execution", "fused"))
+        if execution not in ("fused", "split"):
+            raise ValueError(
+                f"execution must be 'fused' or 'split', got {execution!r}"
+            )
+        build_step = make_ws_ccl_step if execution == "fused" else make_ws_ccl_split
+        step = build_step(
             mesh,
             halo=halo,
             threshold=float(cfg["threshold"]),
@@ -124,7 +138,7 @@ class FusedSegmentationBase(BaseTask):
             stitch_ws_threshold=cfg.get("stitch_ws_threshold"),
         )
         self.logger.info(
-            f"fused step on mesh {sp_desc}, roi {roi_shape}, halo={halo}"
+            f"{execution} step on mesh {sp_desc}, roi {roi_shape}, halo={halo}"
         )
         vol = np.asarray(inp[roi]).astype(np.float32)
         ws, cc, n_fg, overflow = jax.block_until_ready(step(vol[None]))

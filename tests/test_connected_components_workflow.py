@@ -277,3 +277,43 @@ def test_fused_and_blockwise_cc_agree(workspace, rng):
     assert build([wf])
     r = file_reader(path, "r")
     assert_labels_equivalent(r["cc_fused"][...], r["cc_block"][...])
+
+
+def test_fused_segmentation_split_execution(workspace, rng):
+    """execution='split': the staged four-program chain through the task
+    API writes the same labels the fused monolith does."""
+    from cluster_tools_tpu.tasks.fused import FusedSegmentationLocal
+
+    tmp_folder, config_dir, root = workspace
+    path = os.path.join(root, "fuseds.zarr")
+    vol = ndi.gaussian_filter(rng.random((64, 32, 32)).astype(np.float32), 2)
+    vol = (vol - vol.min()) / (vol.max() - vol.min())
+    f = file_reader(path)
+    f.create_dataset(
+        "boundaries", shape=vol.shape, chunks=(32, 32, 32), dtype="float32"
+    )[...] = vol
+    common = dict(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=2,
+        input_path=path,
+        input_key="boundaries",
+        threshold=0.6,
+        halo=4,
+        stitch_ws_threshold=0.6,
+        block_shape=[32, 32, 32],
+    )
+    t = FusedSegmentationLocal(
+        output_path=path, ws_key="ws_s", cc_key="cc_s",
+        execution="split", **common,
+    )
+    assert build([t]), "split-execution task failed (see logs)"
+    t2 = FusedSegmentationLocal(
+        output_path=path, ws_key="ws_f", cc_key="cc_f", **common,
+    )
+    assert build([t2]), "fused-execution task failed (see logs)"
+    r = file_reader(path, "r")
+    np.testing.assert_array_equal(r["ws_s"][...], r["ws_f"][...])
+    np.testing.assert_array_equal(r["cc_s"][...], r["cc_f"][...])
+    want, _ = ndi.label(vol < 0.6, ndi.generate_binary_structure(3, 1))
+    assert_labels_equivalent(r["cc_s"][...], want)
