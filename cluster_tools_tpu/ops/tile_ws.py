@@ -170,20 +170,39 @@ def descent_directions(
 def tile_ws_propagate_xla(
     dirs: jnp.ndarray, sv: jnp.ndarray, tile: Tuple[int, int, int]
 ) -> jnp.ndarray:
-    """Portable in-tile pointer flow — pointer-jumping formulation.
+    """Portable in-tile pointer flow; the formulation is substrate-aware.
 
-    Output contract is identical to the Mosaic kernel's per-hop dense flow
-    (each voxel ends with its in-tile path terminal's value: seed label,
-    unseeded-terminal code ``-gidx-2``, or the exit code of the FIRST
-    out-of-tile hop), but instead of one dense shift round per path hop
-    (O(path length) full-volume passes — the old formulation, and the r4
-    smoke's dominant cost) the in-tile successor table is composed to
-    closure: O(log path length) rounds of per-tile gathers over
-    L1/L2-resident ``tz*ty*tx`` tables.  Voxels whose descent target
-    leaves the tile become pseudo-terminals carrying their exit code, so
-    closure over ``nxt`` reaches exactly the same fixpoint the stepping
-    recurrence does.
+    Output contract (both formulations, bit-identical — oracle-locked in
+    tests/test_tile_ws.py): each voxel ends with its in-tile path
+    terminal's value — seed label, unseeded-terminal code ``-gidx-2``, or
+    the exit code of the FIRST out-of-tile hop.
+
+    - off-TPU (cpu and anything else): **pointer jumping** — the in-tile
+      successor table composed to closure in O(log path) rounds of
+      gathers over L1/L2-resident ``tz*ty*tx`` tables; 5.4× the stepping
+      recurrence on the host (docs/PERFORMANCE.md r5).
+    - on TPU (``tpu``/``axon``): the **per-hop dense stepping** recurrence
+      (same math as the Mosaic kernel) — dense shifts ride full VPU/HBM
+      bandwidth while random gathers run ~165M elem/s regardless of
+      locality, so O(path) vectorized rounds beat O(log path) gather
+      rounds there.  This path only matters when the portable kernels run
+      on-chip (the impl="xla" fallback rung); impl="auto" uses the Mosaic
+      kernel.
+
+    The choice is made at trace time from ``jax.default_backend()`` —
+    part of program identity per backend, like every other
+    substrate-aware selection in this module.
     """
+    if jax.default_backend() in ("tpu", "axon"):
+        return _tile_ws_propagate_stepping(dirs, sv, tile)
+    return _tile_ws_propagate_jump(dirs, sv, tile)
+
+
+def _flow_tile_setup(dirs: jnp.ndarray, sv: jnp.ndarray, tile):
+    """Shared tile scatter/gather plumbing for both flow formulations:
+    returns ``(gidx, dirs_t, sv_t, from_tiles)`` — the tiled global flat
+    indices, tiled inputs, and the inverse layout transform.  One home so
+    a layout change cannot drift the oracle-locked formulations apart."""
     z, y, x = dirs.shape
     tz, ty, tx = tile
     gz, gy, gx = z // tz, y // ty, x // tx
@@ -203,9 +222,47 @@ def tile_ws_propagate_xla(
         )
 
     idx = jnp.arange(z * y * x, dtype=jnp.int32).reshape(z, y, x)
-    gidx = to_tiles(idx)
-    dirs_t = to_tiles(dirs)
-    sv_t = to_tiles(sv)
+    return to_tiles(idx), to_tiles(dirs), to_tiles(sv), from_tiles
+
+
+def _tile_ws_propagate_stepping(
+    dirs: jnp.ndarray, sv: jnp.ndarray, tile: Tuple[int, int, int]
+) -> jnp.ndarray:
+    """Per-hop dense stepping recurrence (the Mosaic kernel's math)."""
+    from .pallas_kernels import ws_propagate_step
+
+    _, y, x = dirs.shape
+    gidx, dirs_t, sv_t, from_tiles = _flow_tile_setup(dirs, sv, tile)
+    terminal = dirs_t == 0
+    value = jnp.where(
+        sv_t > 0, sv_t, jnp.where(terminal & (sv_t == 0), -gidx - 2, 0)
+    ).astype(jnp.int32)
+
+    def cond(s):
+        return s[1]
+
+    def body(s):
+        v, _ = s
+        v2 = ws_propagate_step(v, dirs_t, gidx, (1, 2, 3), y, x)
+        return v2, jnp.any(v2 != v)
+
+    value, _ = lax.while_loop(cond, body, (value, _true_like(value)))
+    return from_tiles(value)
+
+
+def _tile_ws_propagate_jump(
+    dirs: jnp.ndarray, sv: jnp.ndarray, tile: Tuple[int, int, int]
+) -> jnp.ndarray:
+    """Pointer-jumping formulation: successor table composed to closure.
+
+    Voxels whose descent target leaves the tile become pseudo-terminals
+    carrying their exit code, so closure over ``nxt`` reaches exactly the
+    same fixpoint the stepping recurrence does.
+    """
+    z, y, x = dirs.shape
+    tz, ty, tx = tile
+    gz, gy, gx = z // tz, y // ty, x // tx
+    gidx, dirs_t, sv_t, from_tiles = _flow_tile_setup(dirs, sv, tile)
 
     # per-code offsets as lookup tables indexed by the direction code
     offs = np.concatenate([[[0, 0, 0]], np.asarray(WS_OFFS)]).astype(np.int32)

@@ -180,6 +180,36 @@ def main():
             runs=2,
         )
 
+    # flow-formulation A/B (r5): off-TPU the pointer-jumping closure wins
+    # 5.4x; ON the chip the projection says dense stepping wins (gathers
+    # ~165M elem/s vs full-bandwidth shifts).  tile_ws_propagate_xla picks
+    # by backend at trace time — this row records the actual on-chip
+    # numbers so the selection rests on measurement, not projection.
+    from cluster_tools_tpu.ops.tile_ws import (
+        _tile_ws_propagate_jump,
+        _tile_ws_propagate_stepping,
+        _ws_static_plan,
+        descent_directions,
+    )
+
+    tile_fl, (zp, yp, xp), _, _ = _ws_static_plan(vol.shape, None, None, 0)
+    pads = ((0, zp - vol.shape[0]), (0, yp - vol.shape[1]),
+            (0, xp - vol.shape[2]))
+    hp = jnp.pad(vol, pads, constant_values=np.float32(3e38))
+    seeds_fl = jnp.pad((maxima).astype(jnp.int32), pads)
+    dirs_fl = jax.jit(descent_directions)(hp, seeds_fl > 0, hp < 3e37)
+    sv_fl = jnp.where(hp < 3e37, seeds_fl, -1)
+    timeit(
+        "flow stepping (dense per-hop)",
+        jax.jit(lambda d, s: _tile_ws_propagate_stepping(d, s, tile_fl)),
+        dirs_fl, sv_fl, runs=2,
+    )
+    timeit(
+        "flow pointer-jumping (gather closure)",
+        jax.jit(lambda d, s: _tile_ws_propagate_jump(d, s, tile_fl)),
+        dirs_fl, sv_fl, runs=2,
+    )
+
     # the full fused mesh step at bench config
     mesh = make_mesh(1, axis_names=("dp", "sp"), devices=jax.devices())
     volb = vol[None, halo:-halo]  # (1, side, side, side)
@@ -191,6 +221,36 @@ def main():
         t, out = timeit(f"fused step impl={impl}", step, volb, runs=3)
         if t:
             log(f"  -> {volb.size / t:,.0f} voxels/s")
+
+    # split-chain stages at the same config (r5): per-stage on-chip
+    # timings for the execution mode the bench's split rung ships
+    from cluster_tools_tpu.parallel.split_pipeline import make_ws_ccl_split
+
+    split = make_ws_ccl_split(
+        mesh, halo=halo, threshold=threshold, dt_max_distance=float(halo),
+        min_seed_distance=msd, impl="auto", stitch_ws_threshold=threshold,
+    )
+
+    run_no = [0]
+
+    def staged(v):
+        # run 0 is timeit's warm-up: its stage times INCLUDE compiles —
+        # the tag keeps it distinguishable from the steady-state runs
+        tag = "warmup+compile" if run_no[0] == 0 else f"run {run_no[0]}"
+        run_no[0] += 1
+        marks = [("start", time.perf_counter())]
+
+        def s(name, *arrs):
+            sync(arrs)
+            marks.append((name, time.perf_counter()))
+
+        out = split.run_staged(v, s)
+        sync(out)
+        for (pn, pt), (nn, nt) in zip(marks, marks[1:]):
+            log(f"  split stage {nn} [{tag}]: {(nt - pt) * 1000:.0f}ms")
+        return out
+
+    timeit("split chain (4 programs)", staged, volb, runs=2)
 
     log("battery done")
 

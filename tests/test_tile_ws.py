@@ -287,3 +287,34 @@ def test_dt_watershed_tiled_precomputed_dist_identity(rng):
     )
     np.testing.assert_array_equal(np.asarray(internal), np.asarray(supplied))
     assert bool(ovf1) == bool(ovf2) is False
+
+
+@pytest.mark.parametrize("smooth", [0, 6])
+def test_propagate_formulations_bit_identical(rng, smooth):
+    """The substrate-aware flow formulations (pointer jumping off-TPU,
+    dense stepping on-TPU) must be bit-identical — the on-chip xla rung
+    compiles whichever its backend selects, so divergence would make the
+    portable path's results substrate-dependent."""
+    from cluster_tools_tpu.ops.tile_ws import (
+        _tile_ws_propagate_jump,
+        _tile_ws_propagate_stepping,
+        descent_directions,
+    )
+
+    h = rng.random((32, 32, 128)).astype(np.float32)
+    for _ in range(smooth):
+        for ax in range(3):
+            h = (np.roll(h, 1, ax) + h + np.roll(h, -1, ax)) / 3
+    seeds = (
+        (rng.random(h.shape) < 0.001).astype(np.int32)
+        * np.arange(1, h.size + 1).reshape(h.shape).astype(np.int32)
+    )
+    valid = rng.random(h.shape) < 0.95
+    dirs = descent_directions(
+        jnp.asarray(h), jnp.asarray(seeds > 0), jnp.asarray(valid)
+    )
+    sv = jnp.where(jnp.asarray(valid), jnp.asarray(seeds), -1)
+    tile = (16, 16, 128)
+    a = np.asarray(_tile_ws_propagate_jump(dirs, sv, tile))
+    b = np.asarray(_tile_ws_propagate_stepping(dirs, sv, tile))
+    np.testing.assert_array_equal(a, b)
