@@ -622,38 +622,46 @@ def fill_unseeded_basins_dense(
     values: jnp.ndarray,
     height: jnp.ndarray,
     max_rounds: int = 16,
+    face_cap: Optional[int] = None,
 ):
-    """Sort-free unseeded-basin fill: dense scatter-min Boruvka rounds.
+    """Sort-free unseeded-basin fill: face-list scatter-min Boruvka rounds.
 
-    Same MSF semantics as :func:`fill_unseeded_basins` but computed over
-    the FULL face grids instead of capacity-compacted candidate lists: no
-    sorts, no caps, no truncation, and the saddle per basin pair is the
-    exact minimum over every shared face voxel (the capacity fill samples
-    run-start saddles — see the ``keep`` flags there).  Designed for the
-    512³ capacity-audit regime on gather-friendly substrates
-    (docs/PERFORMANCE.md): basin-face loads are ~9% of voxels per axis,
-    so the capacity path's dedup sorts run at tens of millions of rows
-    while these rounds are a handful of full-volume passes each.  NOTE
-    the passes are random-access gathers/scatters, which the chip runs
+    Same MSF semantics as :func:`fill_unseeded_basins` with the saddle per
+    basin pair the exact minimum over every shared face voxel (the
+    capacity fill samples run-start saddles — see the ``keep`` flags
+    there), and still NO SORTS anywhere.  r5 restructure: the per-axis
+    basin-face candidate set is harvested ONCE into compacted lists (an
+    O(n) cumsum compact, not a sort) — sound because a face can only
+    LEAVE the edge set as basins merge, never join it — and every Boruvka
+    round then runs face-sized gathers/scatters (~9% of voxels per axis
+    on bench-like data, docs/PERFORMANCE.md "512³ capacity audit")
+    instead of ~18 full-volume passes.  ``face_cap`` (default
+    ``max(2^16, n/6)`` with a 2^24 ceiling) bounds each list: ≥1.8× the
+    measured ~9%/axis load while n/6 governs (n ≲ 100M), narrowing to
+    ~1.4× at 512³ where the int32-memory ceiling binds; regimes that
+    exceed it are truncated and REPORTED through the overflow flag,
+    never silent.  NOTE the
+    round passes are random-access gathers/scatters, which the chip runs
     at ~165M elem/s regardless of locality — on TPU the capacity sorts
     are the predicted-fast path and the auto default picks them; the
     on-chip A/B lives in scripts/tpu_measure.py.
-    Memory: the round body's live set (``P``, ``best_h``, ``best_e``,
-    indices, resolved labels, scatter temporaries) is several int32
-    volumes — ~1.8GB transient at 512³.
+    Memory: three per-axis lists of five ``face_cap`` arrays plus the
+    ``P``/``best`` tables — ~1.1GB transient at 512³ (below the old
+    full-grid formulation's ~1.8GB).
 
     ``values``: >0 seeded label, <= -2 unseeded terminal code
     (``-flat_index - 2``), 0 invalid, and **-1 for masked/padded voxels**
     (what :func:`seeded_watershed_tiled` actually passes by fill time).
-    -1 voxels are hookable neighbors: the edge predicate (``rv != 0 &
-    nb != 0``) admits them and an unseeded basin whose lowest saddle
+    -1 voxels are hookable neighbors: the edge predicate admits
+    (unseeded, -1) faces and an unseeded basin whose lowest saddle
     touches one adopts -1, which the caller's final ``values > 0`` squash
     maps to background 0 — the same adopt-to-0 semantics as the capacity
     path.  Callers must NOT assume invalid voxels sit out of saddle
     competition.  Returns ``(resolved_values, overflow_int32)`` —
     per-voxel labels with every reachable unseeded basin resolved to its
     adopted seed label (unreachable basins keep their codes; callers zero
-    them), overflow set when ``max_rounds`` rounds did not converge.
+    them), overflow set when ``max_rounds`` rounds did not converge OR a
+    face list truncated.
 
     Selected by ``fill_mode="dense"`` (``CT_FILL_MODE``), or by the
     substrate-aware ``auto`` default on the cpu backend — resolution
@@ -662,15 +670,49 @@ def fill_unseeded_basins_dense(
     shape = values.shape
     n = int(np.prod(shape))
     v = values.ravel()
-    h = _sortable_float_key(height.astype(jnp.float32))
+    h = _sortable_float_key(height.astype(jnp.float32)).ravel()
     i32max = jnp.iinfo(jnp.int32).max
+    if face_cap is None:
+        face_cap = min(1 << 24, max(1 << 16, n // 6))
 
     # P[g] = current label of the basin whose terminal voxel is g; codes
     # resolve through it, seeds are terminal by value
     P0 = _match_vma(-jnp.arange(n, dtype=jnp.int32) - 2, values)
 
-    def resolve(P, x):
+    def resolve_flat(P, x):
         return jnp.where(x <= -2, P[jnp.clip(-x - 2, 0, n - 1)], x)
+
+    # ---- one-time face harvest (round-invariant superset) ----
+    # a face is a candidate edge iff the ORIGINAL codes differ, both are
+    # nonzero, and at least one side is an unseeded basin; merging only
+    # shrinks this set (equal-resolved faces drop out via the per-round
+    # predicate), so harvesting once is exact.  eid = axis * n + voxel
+    # index is globally distinct and seen identically from both sides, so
+    # the min-edge graph is a forest plus 2-cycles (the classic
+    # distinct-weight Boruvka argument, as in _fill_core).
+    flat_idx = _match_vma(jnp.arange(n, dtype=jnp.int32), values)
+    trunc = _match_vma(jnp.zeros((), jnp.int32), values)
+    faces = []
+    for axis in range(3):
+        nb = _shift(values, -1, axis, jnp.int32(0)).ravel()
+        ok0 = (
+            (v != nb) & (v != 0) & (nb != 0)
+            & ((v <= -2) | (nb <= -2))
+        )
+        (idx_c,), n_faces = _compact(ok0, (flat_idx,), face_cap, n)
+        trunc = jnp.maximum(trunc, (n_faces > face_cap).astype(jnp.int32))
+        stride = int(np.prod(shape[axis + 1:], dtype=np.int64))
+        pad = idx_c >= n
+        ia = jnp.clip(idx_c, 0, n - 1)
+        ib = jnp.clip(idx_c + stride, 0, n - 1)
+        va = jnp.where(pad, 0, v[ia])
+        vb = jnp.where(pad, 0, v[ib])
+        sad = jnp.maximum(h[ia], h[ib])
+        eid = jnp.where(
+            pad, i32max, jnp.int32(axis) * jnp.int32(n) + idx_c
+        )
+        faces.append((va, vb, sad, eid, pad))
+    me_idx = _match_vma(jnp.arange(n, dtype=jnp.int32), values)
 
     def round_cond(s):
         _, changed, it = s
@@ -678,59 +720,43 @@ def fill_unseeded_basins_dense(
 
     def round_body(s):
         P, _, it = s
-        rv = resolve(P, v).reshape(shape)
         best_h = _match_vma(jnp.full((n,), i32max, jnp.int32), values)
         best_e = _match_vma(jnp.full((n,), i32max, jnp.int32), values)
-        # per-axis face passes; eid = axis * n + flat index is a globally
-        # distinct tie-break seen identically from both sides, so the
-        # min-edge graph is a forest plus 2-cycles (the classic distinct-
-        # weight Boruvka argument, as in _fill_core)
-        flat_idx = _match_vma(
-            jnp.arange(n, dtype=jnp.int32).reshape(shape), values
-        )
         sides = []
-        for axis in range(3):
-            nb = _shift(rv, -1, axis, jnp.int32(0))
-            saddle = jnp.maximum(
-                h, _shift(h, -1, axis, jnp.int32(i32max))
-            )
-            ok = (rv != nb) & (rv != 0) & (nb != 0)
-            eid = jnp.int32(axis) * jnp.int32(n) + flat_idx
-            sides.append((rv, nb, saddle, ok, eid))
-            sides.append((nb, rv, saddle, ok, eid))
-        for src, dst, saddle, ok, eid in sides:
-            m = ok & (src <= -2)
-            g = jnp.where(m, -src - 2, n).ravel()
+        for va, vb, sad, eid, pad in faces:
+            ra = resolve_flat(P, va)
+            rb = resolve_flat(P, vb)
+            live = ~pad & (ra != rb)
+            sides.append((ra, rb, sad, live, eid))
+            sides.append((rb, ra, sad, live, eid))
+        for src, dst, sad, live, eid in sides:
+            m = live & (src <= -2)
+            g = jnp.where(m, -src - 2, n)
             best_h = best_h.at[g].min(
-                jnp.where(m, saddle, i32max).ravel(), mode="drop"
+                jnp.where(m, sad, i32max), mode="drop"
             )
-        for src, dst, saddle, ok, eid in sides:
-            m = ok & (src <= -2)
-            g = jnp.where(m, -src - 2, n).ravel()
-            tie = m & (best_h[jnp.clip(-src - 2, 0, n - 1)] == saddle)
-            gt = jnp.where(tie, -src - 2, n).ravel()
+        for src, dst, sad, live, eid in sides:
+            m = live & (src <= -2)
+            tie = m & (best_h[jnp.clip(-src - 2, 0, n - 1)] == sad)
+            gt = jnp.where(tie, -src - 2, n)
             best_e = best_e.at[gt].min(
-                jnp.where(tie, eid, i32max).ravel(), mode="drop"
+                jnp.where(tie, eid, i32max), mode="drop"
             )
         P2 = P
-        for src, dst, saddle, ok, eid in sides:
-            m = ok & (src <= -2)
+        for src, dst, sad, live, eid in sides:
+            m = live & (src <= -2)
             gsafe = jnp.clip(-src - 2, 0, n - 1)
-            win = (
-                m
-                & (best_h[gsafe] == saddle)
-                & (best_e[gsafe] == eid)
-            )
-            gw = jnp.where(win, -src - 2, n).ravel()
-            P2 = P2.at[gw].set(jnp.where(win, dst, 0).ravel(), mode="drop")
+            win = m & (best_h[gsafe] == sad) & (best_e[gsafe] == eid)
+            gw = jnp.where(win, -src - 2, n)
+            P2 = P2.at[gw].set(jnp.where(win, dst, 0), mode="drop")
         # break 2-cycles (two roots that picked the same edge from both
         # sides): the smaller terminal index stays a root
-        me = _match_vma(jnp.arange(n, dtype=jnp.int32), values)
+        me = me_idx
         tgt = jnp.clip(-P2 - 2, 0, n - 1)
         mutual = (P2 <= -2) & (P2[tgt] == (-me - 2)) & (me < tgt)
         P2 = jnp.where(mutual, -me - 2, P2)
         # pointer-jump to CLOSURE, not a fixed count: a partially
-        # compressed table would let the next round's rv expose
+        # compressed table would let the next round's resolution expose
         # intermediate codes, and a non-root's re-hook would then
         # overwrite (sever) an already-contracted MSF union — the exact-
         # semantics claim depends on every round starting from true roots
@@ -740,7 +766,7 @@ def fill_unseeded_basins_dense(
 
         def comp_body(t):
             p, _ = t
-            p2 = resolve(p, p)
+            p2 = resolve_flat(p, p)
             return p2, jnp.any(p2 != p)
 
         P2, _ = lax.while_loop(comp_cond, comp_body, (P2, _true_like(P2)))
@@ -750,8 +776,8 @@ def fill_unseeded_basins_dense(
     P, unconverged, _ = lax.while_loop(
         round_cond, round_body, (P0, _true_like(v), jnp.int32(0))
     )
-    resolved = resolve(P, v).reshape(shape)
-    return resolved, unconverged.astype(jnp.int32)
+    resolved = resolve_flat(P, v).reshape(shape)
+    return resolved, jnp.maximum(unconverged.astype(jnp.int32), trunc)
 
 
 def _fill_core(a, b, hk, adj_cap, max_rounds, vma_like):

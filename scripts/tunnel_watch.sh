@@ -66,15 +66,31 @@ EOF
 }
 
 say "=== tunnel watch start (period ${PERIOD}s) ==="
+# keep watching across tunnel windows: a battery cut short by the tunnel
+# dying mid-way gets another chance when it resurfaces (compiles that
+# completed are cached, so a re-fired battery fast-forwards); cap the
+# battery count so a flapping tunnel can't fire endless batteries
+BATTERIES=0
 while true; do
   if probe | grep -q PROBE_OK; then
     say "TUNNEL UP"
-    cache_exp
-    say "launching battery v2"
-    bash scripts/when_tpu_up2.sh "${LOG%.log}_battery.log" >> "$LOG" 2>&1
-    say "watcher exiting after recovery battery (relaunch to keep watching)"
-    exit 0
+    if [ "$BATTERIES" -eq 0 ]; then cache_exp; fi
+    BATTERIES=$((BATTERIES + 1))
+    say "launching battery v2 (#$BATTERIES)"
+    bash scripts/when_tpu_up2.sh "${LOG%.log}_battery$BATTERIES.log" >> "$LOG" 2>&1
+    RC=$?
+    say "battery #$BATTERIES finished (rc=$RC)"
+    if [ "$RC" -eq 0 ]; then
+      say "battery completed all stages; watcher done"
+      exit 0
+    fi
+    if [ "$BATTERIES" -ge 3 ]; then
+      say "watcher exiting after $BATTERIES cut-short batteries"
+      exit 0
+    fi
+    say "resuming watch (battery was cut short: rc=$RC)"
+  else
+    say "tunnel still down"
   fi
-  say "tunnel still down"
   sleep "$PERIOD"
 done
