@@ -250,3 +250,45 @@ def test_capacity_fill_deep_chain(L):
         _resolve_codes_gather(jnp.asarray(vals), fv, ff)
     )
     assert (got[1, 1, 1:-1] == 1).all(), got[1, 1]
+
+
+def test_mode_env_flip_retraces_without_clear_caches(rng, monkeypatch):
+    """r5 contract: CT_FILL_MODE is resolved OUTSIDE jit and folded into
+    the compile key, so flipping it mid-process retraces — the old
+    trace-time read silently kept the previously compiled machinery
+    unless the caller knew to jax.clear_caches() (r4 advisor finding).
+    Both machines are MSF-exact in the singleton-seed regime here, so the
+    outputs must agree AND the explicit-kwarg selection must match the
+    env selection."""
+    from cluster_tools_tpu.ops.tile_ws import seeded_watershed_tiled
+
+    shape = (16, 16, 130)
+    height = rng.random(shape).astype(np.float32)
+    seeds = np.zeros(shape, np.int32)
+    seeds[2, 2, 5] = 1
+    seeds[13, 13, 120] = 2
+    h, s = jnp.asarray(height), jnp.asarray(seeds)
+
+    from cluster_tools_tpu.ops.tile_ws import _seeded_watershed_tiled_jit
+
+    monkeypatch.setenv("CT_FILL_MODE", "capacity")
+    cap_out, cap_ovf = seeded_watershed_tiled(h, s, impl="xla")
+    assert not bool(cap_ovf)  # the equality premise: both paths exact here
+    # NO clear_caches: the env flip alone must select the dense machinery
+    # — proven by a fresh jit-cache entry, not just by equal outputs
+    before = _seeded_watershed_tiled_jit._cache_size()
+    monkeypatch.setenv("CT_FILL_MODE", "dense")
+    dense_out, dense_ovf = seeded_watershed_tiled(h, s, impl="xla")
+    assert not bool(dense_ovf)
+    assert _seeded_watershed_tiled_jit._cache_size() == before + 1, (
+        "env flip did not retrace: stale mode silently reused"
+    )
+    np.testing.assert_array_equal(np.asarray(dense_out), np.asarray(cap_out))
+    # the kwarg spelling is the SAME compile key as the env spelling:
+    # cache size must not move (a third entry would mean key drift)
+    kw_out, kw_ovf = seeded_watershed_tiled(h, s, impl="xla", fill_mode="dense")
+    assert not bool(kw_ovf)
+    assert _seeded_watershed_tiled_jit._cache_size() == before + 1, (
+        "kwarg spelling compiled a separate cache entry: key drift"
+    )
+    np.testing.assert_array_equal(np.asarray(kw_out), np.asarray(dense_out))
