@@ -81,6 +81,22 @@ DEFAULT_FILL_CAP = 1 << 21
 DEFAULT_ADJ_CAP = 1 << 18
 
 
+def _auto_fill_rounds(n_pad: int) -> int:
+    """Default Boruvka round bound for the unseeded-basin fill.
+
+    A round at least halves the unseeded component count, so
+    ``ceil(log2(n)) + 1`` rounds suffice for ANY input (components can
+    never exceed voxels).  The bound is a while-loop max trip count —
+    generous values cost nothing at runtime (the loop exits on
+    convergence) and nothing in program size.  The old fixed 16 silently
+    under-covered volumes with more than 2^16 unseeded basins: the 512³
+    host-substrate rehearsal measured 80,902 distinct basins and the fill
+    correctly raised its overflow flag at exactly this bound —
+    caught before any chip window paid for it (r5).
+    """
+    return max(16, int(np.ceil(np.log2(max(2, n_pad)))) + 1)
+
+
 def _resolve_fill_mode(fill_mode: Optional[str]) -> str:
     """Resolve the unseeded-basin fill machinery to ``dense``/``capacity``.
 
@@ -548,7 +564,7 @@ def fill_unseeded_basins(
     labels: jnp.ndarray,
     height: jnp.ndarray,
     fill_cap: int = DEFAULT_FILL_CAP,
-    max_rounds: int = 16,
+    max_rounds: Optional[int] = None,
     adj_cap: Optional[int] = None,
 ):
     """Merge unseeded basins across their lowest saddles (Boruvka rounds).
@@ -566,8 +582,11 @@ def fill_unseeded_basins(
     dedup+rounds machine is capacity-tiered (``run_capacity_tiered``) so
     the common few-unseeded-basins case executes at 1/16 size.
     Overflowing ``adj_cap`` raises the overflow flag like every other
-    capacity.
+    capacity.  ``max_rounds=None`` resolves to the always-sufficient
+    volume-scaled bound (:func:`_auto_fill_rounds`).
     """
+    if max_rounds is None:
+        max_rounds = _auto_fill_rounds(labels.size)
     h = height.astype(jnp.float32)
     evs_a, evs_b, evs_h = [], [], []
     overflow = _match_vma(jnp.zeros((), jnp.int32), labels)
@@ -594,16 +613,20 @@ def fill_unseeded_basins(
     b = jnp.concatenate(evs_b)
     hk = jnp.concatenate(evs_h)
 
-    # Default adjacency capacity must stay OBJECT-scale at every volume
-    # size or the dedup buys nothing — ``labels.size // 128`` keeps it far
-    # below the raw 3*fill_cap candidate buffer (~48x at 512³ with the
-    # capacity-audit fill_cap of n/8: 1.2M unique adjacencies vs 50M raw
-    # face voxels) while the DEFAULT_ADJ_CAP floor covers pure-noise small
-    # volumes (~size/27 basins, a few adjacencies each).  Overflow is
-    # flagged; a pure-noise large shard should raise adj_cap explicitly.
+    # Default adjacency capacity must stay well below the raw 3*fill_cap
+    # candidate buffer or the dedup buys nothing, but "object-scale"
+    # undershoots: the r5 512³ host rehearsal MEASURED 1.77M unique
+    # adjacencies on the bench synthetic (n/85 — 80,902 unseeded basins
+    # averaging ~22 distinct neighbors each, dense seeding makes small
+    # basins touch many seeded labels), so the old n/128 truncated and
+    # flagged the whole headline run.  n/32 gives ~2.7x headroom over
+    # that measurement while staying ~11x under the raw buffer at 512³
+    # (3 * fill_cap = 3 * 2^24 ≈ 50.3M vs n/32 ≈ 4.7M); the
+    # DEFAULT_ADJ_CAP floor covers pure-noise small volumes.  Overflow is
+    # flagged; adversarial regimes should raise adj_cap explicitly.
     if adj_cap is None:
         adj_cap = min(
-            3 * fill_cap, max(DEFAULT_ADJ_CAP, labels.size // 128)
+            3 * fill_cap, max(DEFAULT_ADJ_CAP, labels.size // 32)
         )
 
     # Capacity tiering: a realistic seeded volume (few unseeded basins)
@@ -621,7 +644,7 @@ def fill_unseeded_basins(
 def fill_unseeded_basins_dense(
     values: jnp.ndarray,
     height: jnp.ndarray,
-    max_rounds: int = 16,
+    max_rounds: Optional[int] = None,
     face_cap: Optional[int] = None,
 ):
     """Sort-free unseeded-basin fill: face-list scatter-min Boruvka rounds.
@@ -674,6 +697,8 @@ def fill_unseeded_basins_dense(
     i32max = jnp.iinfo(jnp.int32).max
     if face_cap is None:
         face_cap = min(1 << 24, max(1 << 16, n // 6))
+    if max_rounds is None:
+        max_rounds = _auto_fill_rounds(n)
 
     # P[g] = current label of the basin whose terminal voxel is g; codes
     # resolve through it, seeds are terminal by value
@@ -908,7 +933,7 @@ def seeded_watershed_tiled(
     table_cap: int = DEFAULT_TABLE_CAP,
     interpret: bool = False,
     adj_cap: Optional[int] = None,
-    fill_rounds: int = 16,
+    fill_rounds: Optional[int] = None,
     fill_mode: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Seeded watershed with the two-level tile machinery.
@@ -921,9 +946,10 @@ def seeded_watershed_tiled(
 
     Sparse-seed / noise-heavy regimes (many unseeded basins) may overflow
     the fill capacities or need more than ``fill_rounds`` Boruvka rounds
-    (a round at least halves the unseeded component count, so the default
-    16 covers ~64k basins); the overflow flag reports it and ``adj_cap`` /
-    ``fill_rounds`` are the knobs to raise.
+    (a round at least halves the unseeded component count; the ``None``
+    default resolves to ``max(16, ceil(log2(n)) + 1)`` — sufficient for
+    ANY basin count, see :func:`_auto_fill_rounds`); the overflow flag
+    reports capacity truncation and ``adj_cap`` is the knob to raise.
 
     ``fill_mode``: ``dense``/``capacity``/``None`` (= ``CT_FILL_MODE``,
     default substrate-aware ``auto`` — see :func:`_resolve_fill_mode`).
@@ -956,7 +982,7 @@ def _seeded_watershed_tiled_jit(
     table_cap: int = DEFAULT_TABLE_CAP,
     interpret: bool = False,
     adj_cap: Optional[int] = None,
-    fill_rounds: int = 16,
+    fill_rounds: Optional[int] = None,
     fill_mode: str = "capacity",
     _tier: str = "cond",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -1104,7 +1130,7 @@ def _ws_fill_core(
     table_cap: int,
     interpret: bool,
     adj_cap: Optional[int],
-    fill_rounds: int,
+    fill_rounds: Optional[int],
     fill_mode: str,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fill phase: unseeded-basin fill across lowest saddles (fill_mode
@@ -1122,6 +1148,8 @@ def _ws_fill_core(
             f"fill phase expects tile-padded values {(zp, yp, xp)}, "
             f"got {values.shape}"
         )
+    if fill_rounds is None:
+        fill_rounds = _auto_fill_rounds(zp * yp * xp)
     if fill_mode == "dense":
         values, fill_unconv = fill_unseeded_basins_dense(
             values, h, max_rounds=fill_rounds
@@ -1287,7 +1315,7 @@ def dt_watershed_tiled(
     interpret: bool = False,
     seed_cap: Optional[int] = None,
     adj_cap: Optional[int] = None,
-    fill_rounds: int = 16,
+    fill_rounds: Optional[int] = None,
     fill_mode: Optional[str] = None,
     seed_mode: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -1349,7 +1377,7 @@ def _dt_watershed_tiled_jit(
     interpret: bool = False,
     seed_cap: Optional[int] = None,
     adj_cap: Optional[int] = None,
-    fill_rounds: int = 16,
+    fill_rounds: Optional[int] = None,
     fill_mode: str = "capacity",
     seed_mode: str = "tiled",
     _tier: str = "cond",
@@ -1389,7 +1417,7 @@ def dt_watershed_seeded_tiled(
     interpret: bool = False,
     seed_cap: Optional[int] = None,
     adj_cap: Optional[int] = None,
-    fill_rounds: int = 16,
+    fill_rounds: Optional[int] = None,
     fill_mode: Optional[str] = None,
     seed_mode: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -1446,7 +1474,7 @@ def _dt_watershed_seeded_tiled_jit(
     interpret: bool = False,
     seed_cap: Optional[int] = None,
     adj_cap: Optional[int] = None,
-    fill_rounds: int = 16,
+    fill_rounds: Optional[int] = None,
     fill_mode: str = "capacity",
     seed_mode: str = "tiled",
     _tier: str = "cond",

@@ -223,7 +223,7 @@ def make_ws_ccl_split(
             ws, o = _ws_fill_core(
                 values[b], h[b], pad_shape, impl=tiled_impl, tile=None,
                 exit_cap=None, fill_cap=None, table_cap=DEFAULT_TABLE_CAP,
-                interpret=False, adj_cap=None, fill_rounds=16,
+                interpret=False, adj_cap=None, fill_rounds=None,
                 fill_mode=fill_mode,
             )
             ovf = jnp.maximum(ovf, o.astype(jnp.int32))
