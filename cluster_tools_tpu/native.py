@@ -11,11 +11,14 @@ Public API:
 - :func:`available` — True when the library is importable/buildable,
 - :func:`union_find` — min-label roots over equivalence pairs,
 - :func:`greedy_additive` — GAEC node labels,
+- :func:`parallel_contract` — round-based parallel edge contraction
+  (ops/contraction.py's host fast path),
 - :func:`merge_edge_features` — the count-weighted per-edge feature merge.
 """
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import subprocess
@@ -30,6 +33,21 @@ _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libct_native.so"))
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_forced_off = False
+
+
+@contextlib.contextmanager
+def force_python():
+    """Temporarily disable every native kernel (each returns None, taking
+    its caller down the pure-Python/numpy fallback) — the oracle/baseline
+    switch used by the contraction tests and bench's solver-scale record,
+    kept here so both disable the ladder the same way."""
+    global _forced_off
+    _forced_off = True
+    try:
+        yield
+    finally:
+        _forced_off = False
 
 
 def _build() -> bool:
@@ -59,6 +77,8 @@ def _build() -> bool:
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
+    if _forced_off:
+        return None
     with _lock:
         if _lib is not None or _tried:
             return _lib
@@ -77,6 +97,7 @@ def _load() -> Optional[ctypes.CDLL]:
             for sym in (
                 "ct_union_find",
                 "ct_greedy_additive",
+                "ct_parallel_contract",
                 "ct_merge_edge_features",
                 "ct_mutex_watershed",
                 "ct_kernighan_lin",
@@ -111,6 +132,17 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p,
         ]
         lib.ct_greedy_additive.restype = ctypes.c_int
+        lib.ct_parallel_contract.argtypes = [
+            ctypes.c_int64,
+            i64p,
+            f64p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_double,
+            i64p,
+        ]
+        lib.ct_parallel_contract.restype = ctypes.c_int
         lib.ct_merge_edge_features.argtypes = [
             u64p,
             f64p,
@@ -199,6 +231,32 @@ def greedy_additive(
     out = np.empty(int(n_nodes), np.int64)
     lib.ct_greedy_additive(
         int(n_nodes), edges, costs, len(edges), float(stop_cost), out
+    )
+    return out
+
+
+def parallel_contract(
+    n_nodes: int,
+    edges: np.ndarray,
+    payload: np.ndarray,
+    mode_max: bool,
+    threshold: float,
+) -> Optional[np.ndarray]:
+    """Round-based parallel edge contraction (ops/contraction.py semantics):
+    labels 0..k-1, or None when the library is unavailable.  ``payload`` is
+    [m, k] float64 columns summed on merge; priority is column 0 (k == 1)
+    or column 0 / column 1 (k == 2)."""
+    lib = _load()
+    if lib is None:
+        return None
+    edges = np.ascontiguousarray(np.asarray(edges).reshape(-1, 2), np.int64)
+    payload = np.ascontiguousarray(
+        np.asarray(payload, np.float64).reshape(len(edges), -1)
+    )
+    out = np.empty(int(n_nodes), np.int64)
+    lib.ct_parallel_contract(
+        int(n_nodes), edges, payload, len(edges), payload.shape[1],
+        int(bool(mode_max)), float(threshold), out,
     )
     return out
 

@@ -30,6 +30,13 @@ def average_agglomeration(
 
     ``probs``: per-edge mean boundary probability (low = merge);
     ``sizes``: per-edge contact areas (the averaging weights).
+
+    Tie-breaking is deterministic and documented: heap entries are
+    ``(mean, u, v, size_sum)`` tuples, so among equal-mean edges the
+    smallest ``(u, v)`` endpoint pair (cluster representatives at push
+    time) merges first — the same ordering contract as
+    :func:`..ops.multicut.greedy_additive`, keeping impl-ladder parity
+    tests stable across platforms.
     """
     n_nodes = int(n_nodes)
     edges = np.asarray(edges, dtype=np.int64)

@@ -126,6 +126,13 @@ def greedy_additive(
     ``stop_cost`` (default 0: only attractive edges merge); parallel edges
     arising from a contraction have their costs *added*.  Returns int64
     node labels 0..k-1.
+
+    Tie-breaking is deterministic and documented: heap entries are
+    ``(-cost, u, v)`` tuples, so among equal-cost edges the smallest
+    ``(u, v)`` endpoint pair (current cluster representatives at push time)
+    contracts first.  The native kernel (``ct_greedy_additive``) orders its
+    heap identically, so the two paths agree across platforms and the
+    impl-ladder parity tests are stable.
     """
     n_nodes = int(n_nodes)
     edges = np.asarray(edges, dtype=np.int64)

@@ -41,6 +41,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import typeof
 from .ccl import _shift
 
 # Sentinel must exceed any global flat index (volumes are int32-bounded
@@ -64,7 +65,7 @@ def _out_struct(shape, dtype, *like) -> jax.ShapeDtypeStruct:
     """
     vma = frozenset()
     for a in like:
-        v = getattr(jax.typeof(a), "vma", None)
+        v = getattr(typeof(a), "vma", None)
         if v:
             vma = vma | v
     if vma:

@@ -159,11 +159,151 @@ def device_edge_aggregate(
     return out_lo, out_hi, count, vsum, vsumsq, vmin, vmax, shift, n_edges
 
 
+def _densify_labels(seg: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-densify a label block to int32 ids: returns ``(dense, table)``
+    with ``table[dense] == seg`` and ``table[0] == 0`` (background keeps
+    slot 0).  Shared by every device RAG path so the int32 guard and the
+    dtype-preserving zero-prepend stay in one place."""
+    uniq = np.unique(seg)
+    if uniq[0] != 0:
+        # dtype-preserving prepend: a bare [0] would promote uint64
+        # labels to float64 and corrupt ids above 2**53
+        uniq = np.concatenate([np.zeros(1, uniq.dtype), uniq])
+    if len(uniq) >= 2**31:
+        raise ValueError("block has too many labels for int32 densification")
+    return np.searchsorted(uniq, seg).astype(np.int32), uniq
+
+
+@partial(jax.jit, static_argnames=("edge_cap", "inner_shape"))
+def device_rag_costs(
+    seg: jnp.ndarray,
+    values: jnp.ndarray,
+    edge_cap: int,
+    beta,
+    inner_shape: Optional[Tuple[int, ...]] = None,
+):
+    """Fused RAG -> costs -> dense remap, one jitted program.
+
+    Extends :func:`device_edge_aggregate` with the two host stages every
+    graph workflow used to run between extraction and solve:
+
+    - the ``probs_to_costs`` transform (tasks/costs.py) on the per-edge mean
+      boundary value, computed in-program from the segment sums,
+    - dense node remapping: the unique edge-endpoint labels are compacted on
+      device (one more sort over the 2*edge_cap endpoint slots — edge-scale,
+      not voxel-scale) and the edge list is rewritten in dense node indices,
+      eliminating the host ``np.unique(uv)`` + remap round-trip.
+
+    Returns ``(node_table, n_nodes, lo_dense, hi_dense, costs, count,
+    mean, n_edges)``; ``node_table`` has static length ``2 * edge_cap``
+    (slots past ``n_nodes`` hold int32 max) and carries the dense->seg-label
+    mapping.  ``beta`` is a traced scalar (no recompile per value).
+    """
+    from jax import lax
+
+    INT_MAX = jnp.int32(np.iinfo(np.int32).max)
+    (lo, hi, count, vsum, _vsumsq, _vmin, _vmax, _shift,
+     n_edges) = device_edge_aggregate(
+        seg, values, edge_cap, with_values=True, inner_shape=inner_shape
+    )
+    valid = jnp.arange(edge_cap) < n_edges
+    mean = jnp.where(valid, vsum / jnp.maximum(count, 1), 0.0)
+    eps = jnp.float32(1e-5)
+    p = jnp.clip(mean, eps, 1.0 - eps)
+    beta = jnp.clip(jnp.asarray(beta, jnp.float32), eps, 1.0 - eps)
+    costs = jnp.where(
+        valid, jnp.log((1.0 - p) / p) + jnp.log((1.0 - beta) / beta), 0.0
+    )
+    # dense node compaction over the endpoint slots (sort-compact idiom)
+    lab = jnp.concatenate(
+        [jnp.where(valid, lo, INT_MAX), jnp.where(valid, hi, INT_MAX)]
+    )
+    lab = lax.sort(lab)
+    lvalid = lab != INT_MAX
+    is_first = lvalid & (lab != jnp.concatenate([INT_MAX[None], lab[:-1]]))
+    nid = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+    n_nodes = jnp.where(lvalid.any(), nid[-1] + 1, 0)
+    node_table = jnp.full((2 * edge_cap,), INT_MAX, jnp.int32).at[
+        jnp.where(is_first, nid, 2 * edge_cap - 1)
+    ].min(jnp.where(is_first, lab, INT_MAX))
+    lo_dense = jnp.where(
+        valid, jnp.searchsorted(node_table, lo).astype(jnp.int32), 0
+    )
+    hi_dense = jnp.where(
+        valid, jnp.searchsorted(node_table, hi).astype(jnp.int32), 0
+    )
+    return node_table, n_nodes, lo_dense, hi_dense, costs, count, mean, n_edges
+
+
+def block_rag_fused(
+    seg: np.ndarray,
+    values: np.ndarray,
+    beta: float = 0.5,
+    inner_shape: Optional[Sequence[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Solver-ready block problem straight from the label volume.
+
+    One device program (:func:`device_rag_costs`) extracts the RAG,
+    deduplicates edges, turns mean boundary values into signed multicut
+    costs, and compacts node ids — the host sees only edge-scale arrays.
+    ``seg`` may be any integer dtype; labels that do not fit int32 take the
+    densify-first path of :func:`block_rag` internally.
+
+    Returns ``(nodes, edges, costs, sizes, mean)``: ``nodes`` the original
+    labels (dense index -> label, sorted ascending), ``edges`` int64 [m, 2]
+    in dense indices, ``costs`` float32 (``probs_to_costs`` with ``beta``),
+    ``sizes`` int64 contact counts, ``mean`` float32 mean boundary value.
+    """
+    if seg.ndim != 3:
+        raise ValueError("block_rag_fused expects a 3-D block")
+    inner = tuple(inner_shape) if inner_shape is not None else seg.shape
+    orig_table = None
+    # dtype bound first: skips the O(voxels) host max() scan entirely for
+    # label dtypes that cannot trip the int32 guard
+    if seg.dtype.kind not in "iu" or (
+        np.iinfo(seg.dtype).max >= np.iinfo(np.int32).max
+        and seg.size
+        and int(seg.max()) >= np.iinfo(np.int32).max
+    ):
+        # uint64 global ids: densify on host first (the _block_rag_device
+        # path), then map the node table back at the end
+        seg, orig_table = _densify_labels(seg)
+    seg_j = jnp.asarray(np.ascontiguousarray(seg).astype(np.int32, copy=False))
+    vals_j = jnp.asarray(values, jnp.float32)
+
+    cap = 1 << 14
+    while True:
+        (node_table, n_nodes, lo, hi, costs, count, mean,
+         n_edges) = device_rag_costs(
+            seg_j, vals_j, cap, float(beta), inner_shape=inner
+        )
+        n = int(n_edges)
+        if n <= cap:
+            break
+        while cap < n:
+            cap *= 2
+    k = int(n_nodes)
+    nodes = np.asarray(node_table[:k]).astype(np.int64)
+    if orig_table is not None:
+        nodes = orig_table[nodes]
+    edges = np.stack(
+        [np.asarray(lo[:n]), np.asarray(hi[:n])], axis=1
+    ).astype(np.int64)
+    return (
+        nodes,
+        edges,
+        np.asarray(costs[:n], np.float32),
+        np.asarray(count[:n]).astype(np.int64),
+        np.asarray(mean[:n], np.float32),
+    )
+
+
 def block_rag(
     seg: np.ndarray,
     values: Optional[np.ndarray] = None,
     inner_shape: Optional[Sequence[int]] = None,
-) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    return_nodes: bool = False,
+):
     """Extract the RAG of one block: unique undirected edges + edge sizes
     (+ per-edge boundary statistics if ``values`` given).
 
@@ -179,6 +319,11 @@ def block_rag(
     - ``feats``  float32 [m, 5] per-edge (mean, min, max, count, variance) of the
       boundary values, or None.
 
+    With ``return_nodes`` a fourth element is appended: the sorted unique
+    non-zero labels of the *inner* (halo-free) region — the block's node
+    set, computed from the extraction's own label pass instead of a second
+    host ``np.unique`` over the voxels (the graph task used to re-scan).
+
     3-D blocks dedup on device (:func:`device_edge_aggregate` — one sort +
     segmented reductions instead of shipping every adjacent pair to the host
     for ``np.unique``); other ranks use the host path
@@ -186,8 +331,14 @@ def block_rag(
     """
     inner = tuple(inner_shape) if inner_shape is not None else seg.shape
     if seg.ndim == 3:
-        return _block_rag_device(seg, values, inner)
-    return _block_rag_host(seg, values, inner)
+        out = _block_rag_device(seg, values, inner, return_nodes=return_nodes)
+    else:
+        out = _block_rag_host(seg, values, inner)
+        if return_nodes:
+            inner_bb = tuple(slice(0, s) for s in inner)
+            nodes = np.unique(np.asarray(seg[inner_bb]))
+            out = out + (nodes[nodes != 0],)
+    return out
 
 
 def _block_rag_host(
@@ -245,8 +396,11 @@ def _block_rag_host(
 
 
 def _block_rag_device(
-    seg: np.ndarray, values: Optional[np.ndarray], inner: Tuple[int, ...]
-) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    seg: np.ndarray,
+    values: Optional[np.ndarray],
+    inner: Tuple[int, ...],
+    return_nodes: bool = False,
+):
     """Device-dedup path of :func:`block_rag` (3-D blocks).
 
     Labels are densified on host (one unique over the block's voxels — tiny
@@ -256,14 +410,7 @@ def _block_rag_device(
     bucket compiles once per process.
     """
     with_values = values is not None
-    uniq = np.unique(seg)
-    if uniq[0] != 0:
-        # dtype-preserving prepend: a bare [0] would promote uint64
-        # labels to float64 and corrupt ids above 2**53
-        uniq = np.concatenate([np.zeros(1, uniq.dtype), uniq])
-    if len(uniq) >= 2**31:
-        raise ValueError("block has too many labels for int32 densification")
-    dense = np.searchsorted(uniq, seg).astype(np.int32)
+    dense, uniq = _densify_labels(seg)
     vals_j = None if values is None else jnp.asarray(values, jnp.float32)
 
     cap = 1 << 14
@@ -282,8 +429,16 @@ def _block_rag_device(
     hi = np.asarray(hi[:n]).astype(np.int64)
     sizes = np.asarray(count[:n]).astype(np.int64)
     uv = np.stack([uniq[lo], uniq[hi]], axis=1).astype(np.uint64)
+    nodes: Tuple = ()
+    if return_nodes:
+        # inner node set from the dense table (int32 pass over the inner
+        # region, cheaper than re-uniquing the original-dtype labels)
+        inner_bb = tuple(slice(0, s) for s in inner)
+        inner_ids = np.unique(dense[inner_bb])
+        inner_lab = uniq[inner_ids]
+        nodes = (inner_lab[inner_lab != 0],)
     if not with_values:
-        return uv, sizes, None
+        return (uv, sizes, None) + nodes
     s = np.asarray(vsum[:n], np.float64)
     sq = np.asarray(vsumsq[:n], np.float64)
     mean = s / np.maximum(sizes, 1)
@@ -301,7 +456,7 @@ def _block_rag_device(
         ],
         axis=1,
     ).astype(np.float32)
-    return uv, sizes, feats
+    return (uv, sizes, feats) + nodes
 
 
 def merge_edge_lists(edge_lists) -> Tuple[np.ndarray, np.ndarray]:

@@ -40,11 +40,13 @@ from functools import partial
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
 from ..ops.ccl import _match_vma, label_components, relabel_consecutive
 from ..ops.tile_ccl import _compact, _shift1
 from ..ops.unionfind import union_find
@@ -391,7 +393,7 @@ def distributed_connected_components(
     """
     names = [sp_axis] if isinstance(sp_axis, str) else list(sp_axis)
     shard_axes = sp_axes_for_mesh(mesh, sp_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(
             sharded_label_components,
             shard_axes=shard_axes,

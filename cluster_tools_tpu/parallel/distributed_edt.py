@@ -20,10 +20,12 @@ from functools import partial
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
 from ..ops.edt import _BIG, _norm_sampling, edt_axis_pass
 from .reshard import reshard_axis
 
@@ -158,7 +160,7 @@ def _distributed_distance_transform(
     for a, name in zip(array_axes, names):
         spec[a] = name
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(
             sharded_distance_transform_squared,
             shard_axes=shard_axes,

@@ -26,11 +26,13 @@ from functools import partial
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
 from ..ops.ccl import _match_vma, relabel_consecutive
 from ..ops.watershed import distance_transform_watershed
 from .distributed_ccl import (
@@ -338,7 +340,7 @@ def make_ws_ccl_step(
     # collectives (ppermute halo, all_gather merge, psum stats) are
     # unaffected; only the static replication *check* is off.
     spec = P(dp_axis, *names)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=spec,

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
+
 
 def reshard_axis(
     x: jnp.ndarray, axis_name: str, from_axis: int, to_axis: int
@@ -55,7 +57,7 @@ def transpose_sharding(
     spec_out = [None] * vol.ndim
     spec_out[to_axis] = axis_name
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(
             reshard_axis,
             axis_name=axis_name,

@@ -41,11 +41,13 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
 from ..ops.ccl import _match_vma, relabel_consecutive
 from ..ops.tile_ccl import DEFAULT_TABLE_CAP
 from ..ops.tile_ws import (
@@ -134,7 +136,7 @@ def make_ws_ccl_split(
 
     def _smap(body, in_specs, out_specs, donate=()):
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
             ),

@@ -16,7 +16,7 @@ import os
 
 import numpy as np
 
-from ..ops.agglomeration import average_agglomeration
+from ..ops.contraction import average_parallel
 from ..runtime.task import BaseTask, WorkflowBase
 from .features import features_path
 from .graph import load_global_graph
@@ -28,24 +28,33 @@ def agglomerative_assignments_path(tmp_folder: str) -> str:
 
 class AgglomerativeClusteringBase(BaseTask):
     """Params: ``threshold`` (merge edges while mean boundary prob is below
-    it, default 0.5)."""
+    it, default 0.5); ``impl`` selects the contraction engine
+    (:mod:`..ops.contraction` ladder: ``auto`` resolves device-JAX on an
+    accelerator, else native C++, else numpy; ``heap`` is the sequential
+    oracle of :mod:`..ops.agglomeration`)."""
 
     task_name = "agglomerative_clustering"
 
     @staticmethod
     def default_task_config():
-        return {"threads_per_job": 1, "device_batch": 1, "threshold": 0.5}
+        return {
+            "threads_per_job": 1,
+            "device_batch": 1,
+            "threshold": 0.5,
+            "impl": "auto",
+        }
 
     def run_impl(self):
         cfg = self.get_config()
         nodes, _, edges, sizes = load_global_graph(self.tmp_folder)
         feats = np.load(features_path(self.tmp_folder))
-        labels = average_agglomeration(
+        labels = average_parallel(
             len(nodes),
             edges.astype(np.int64),
             feats[:, 0],
             sizes,
             float(cfg.get("threshold", 0.5)),
+            impl=str(cfg.get("impl", "auto")),
         )
         np.savez(
             agglomerative_assignments_path(self.tmp_folder),

@@ -79,11 +79,13 @@ class InitialSubGraphsBase(BaseTask):
         def process(block_id: int):
             block = blocking.get_block(block_id)
             seg = np.asarray(ds[_upper_halo_bb(block, shape)])
-            uv, sizes, _ = block_rag(seg, inner_shape=block.shape)
-            nodes = np.setdiff1d(
-                np.unique(seg[tuple(slice(0, s) for s in block.shape)]),
-                [0],
-            ).astype(np.uint64)
+            # return_nodes: the inner node set comes out of the extraction's
+            # own dense-label pass instead of a second host np.unique scan
+            # over the block's voxels (ISSUE 1 fused-path satellite)
+            uv, sizes, _, nodes = block_rag(
+                seg, inner_shape=block.shape, return_nodes=True
+            )
+            nodes = nodes.astype(np.uint64)
             np.savez(
                 block_graph_path(self.tmp_folder, block_id),
                 nodes=nodes,

@@ -19,6 +19,14 @@ Criterion semantics: ``merge_mode='less'`` (default) merges a face pair if
 its mean map value is *below* ``stitch_threshold`` (boundary-map
 convention); ``'greater'`` merges above (affinity convention, used by the
 MWS workflow with the attractive channels averaged).
+``merge_mode='multicut'`` replaces the per-pair threshold with a global
+solve: face-pair means become signed costs (``probs_to_costs`` with
+``beta = 1 - stitch_threshold``, so a pair is attractive exactly when its
+mean is below the threshold) and the round-based parallel GAEC
+(:mod:`..ops.contraction`) decides the merges — connectivity-aware
+stitching where a borderline face merges only if the contraction chain
+around it is net-attractive, the cheap in-task form of the reference's
+stitch-via-multicut.
 """
 
 from __future__ import annotations
@@ -168,6 +176,9 @@ class MergeStitchAssignmentsBase(BaseTask):
             "device_batch": 1,
             "stitch_threshold": 0.5,
             "merge_mode": "less",
+            # merge_mode='multicut' only: weight each face pair's cost by
+            # its contact area before the global GAEC solve
+            "weight_by_contact_area": False,
         }
 
     def run_impl(self):
@@ -217,13 +228,31 @@ class MergeStitchAssignmentsBase(BaseTask):
             mean = s / np.maximum(c, 1)
             thr = float(cfg.get("stitch_threshold", 0.5))
             mode = cfg.get("merge_mode", "less")
+            dense = np.searchsorted(nodes, uv).astype(np.int64)
             if mode == "less":
                 merge = mean < thr
             elif mode == "greater":
                 merge = mean > thr
+            elif mode == "multicut":
+                # stitch-via-multicut on the face graph: probs -> costs
+                # (cost > 0 iff mean < thr, see compute_costs: attractive
+                # when p < 1 - beta), then the parallel GAEC decides which
+                # pairs actually merge given the whole graph
+                from ..ops.contraction import gaec_parallel
+                from .costs import compute_costs
+
+                costs = compute_costs(
+                    mean.astype(np.float32),
+                    beta=min(max(1.0 - thr, 1e-4), 1.0 - 1e-4),
+                    edge_sizes=c.astype(np.float64)
+                    if cfg.get("weight_by_contact_area")
+                    else None,
+                ).astype(np.float64)
+                labels = gaec_parallel(len(nodes), dense, costs)
+                merge = labels[dense[:, 0]] == labels[dense[:, 1]]
             else:
                 raise ValueError(f"unknown merge_mode {mode!r}")
-            merge_pairs = np.searchsorted(nodes, uv[merge]).astype(np.int64)
+            merge_pairs = dense[merge]
         else:
             merge_pairs = np.zeros((0, 2), np.int64)
 

@@ -5,13 +5,16 @@ Mirrors the reference's ``cluster_tools/utils/segmentation_utils.py``
 (kernighan-lin, greedy-additive, fusion-moves, ...) to nifty C++ solvers.
 Here every key maps to its faithful counterpart in :mod:`..ops.multicut`:
 GAEC, true Kernighan-Lin (gain sequences + joins), fusion moves, and the
-attractive-component decomposition solver.
+attractive-component decomposition solver — plus the round-based parallel
+engine of :mod:`..ops.contraction` as ``gaec_parallel`` /
+``average_parallel``, the vectorized path for RAG-scale problems.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from ..ops.contraction import average_parallel, gaec_parallel
 from ..ops.multicut import (
     decompose_solve,
     fusion_moves,
@@ -41,6 +44,22 @@ def _solve_node_moves(n_nodes, edges, costs, **kw):
     return greedy_node_moves(n_nodes, edges, costs, **kw)
 
 
+def _solve_gaec_parallel(n_nodes, edges, costs, **kw):
+    return gaec_parallel(n_nodes, edges, costs, **kw)
+
+
+def _solve_average_parallel(n_nodes, edges, costs, **kw):
+    # registry solvers speak signed costs; invert the probs_to_costs
+    # transform (beta = 0.5) so the linkage engine sees probabilities —
+    # cost 0 maps to p = 0.5, the default merge threshold.  The inversion
+    # assumes UNWEIGHTED beta=0.5 costs: under weighting_scheme='size' (or
+    # beta != 0.5) the recovered pseudo-probabilities are distorted toward
+    # 0.5 for small-contact edges — pair this solver with unweighted costs,
+    # or call average_parallel directly with the raw probabilities
+    probs = 1.0 / (1.0 + np.exp(np.asarray(costs, np.float64)))
+    return average_parallel(n_nodes, edges, probs, **kw)
+
+
 # solvers that take a SolverCheckpoint (ops.multicut.SolverCheckpoint) and
 # persist their partition between outer sweeps — the task layer passes one
 # for the global solve so preemption resumes mid-solve (SURVEY.md §5.3)
@@ -53,6 +72,8 @@ key_to_agglomerator = {
     "fusion-moves": _solve_fm,
     "decomposition": _solve_decompose,
     "greedy-node-moves": _solve_node_moves,
+    "gaec_parallel": _solve_gaec_parallel,
+    "average_parallel": _solve_average_parallel,
 }
 
 

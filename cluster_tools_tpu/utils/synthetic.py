@@ -98,3 +98,25 @@ def synthetic_em_volume(
         mask = np.ones(shape, bool)
     gt = np.where(mask, gt, 0).astype(np.uint64)
     return boundaries, gt, mask
+
+
+def grid_rag(
+    g: int = 16, seed: int = 0, mu: float = 0.2, sigma: float = 1.0
+) -> Tuple[int, np.ndarray, np.ndarray]:
+    """Grid-adjacency RAG (the shape of watershed-fragment graphs) with
+    noisy signed costs: mostly-attractive with repulsive salt, nothing
+    planted — the adversarial regime for greedy-order differences between
+    agglomeration solvers.  Returns ``(n_nodes, edges [m, 2], costs [m])``.
+    Shared by the contraction oracle tests and bench's solver-scale record
+    so both measure the same instance family."""
+    rng = np.random.default_rng(seed)
+    n = g**3
+    ids = np.arange(n).reshape(g, g, g)
+    parts = []
+    for ax in range(3):
+        a = np.moveaxis(ids, ax, 0)[:-1].ravel()
+        b = np.moveaxis(ids, ax, 0)[1:].ravel()
+        parts.append(np.stack([a, b], 1))
+    edges = np.concatenate(parts)
+    costs = rng.normal(mu, sigma, len(edges))
+    return n, edges, costs

@@ -73,7 +73,14 @@ int ct_greedy_additive(int64_t n_nodes, const int64_t* edges,
   struct Entry {
     double w;
     int64_t u, v;
-    bool operator<(const Entry& o) const { return w < o.w; }
+    // deterministic tie-break on equal costs: the smallest (u, v) pair
+    // pops first, matching Python heapq's (-w, u, v) tuple order so the
+    // two paths contract in the same documented order across platforms
+    bool operator<(const Entry& o) const {
+      if (w != o.w) return w < o.w;
+      if (u != o.u) return u > o.u;
+      return v > o.v;
+    }
   };
   std::priority_queue<Entry> heap;
   for (int64_t u = 0; u < n_nodes; ++u)
@@ -391,6 +398,139 @@ int ct_kernighan_lin(int64_t n_nodes, const int64_t* edges,
     if (improved <= epsilon) return static_cast<int>(outer + 1);
   }
   return static_cast<int>(max_outer);
+}
+
+// Round-based parallel edge contraction (ops/contraction.py's native twin,
+// kept operation-for-operation parallel with the numpy reference so the two
+// are bit-identical in float64): each round every node picks its best
+// incident contractible edge (max priority, smallest edge id on ties —
+// after canonical re-aggregation edge ids are the (lo, hi)-lexsorted row
+// order, so the tie-break is a documented total order), mutually-selected
+// pairs contract (a matching — depth-1 parents), endpoints remap and
+// parallel edges merge by stable-order accumulation (the same summation
+// order as numpy's bincount over the original edge sequence).
+//
+// edges: [m, 2] int64; payload: [m, k] double columns summed on merge
+// (k == 1: GAEC cost = priority; k == 2: (weight*size, size), priority =
+// ratio).  mode_max != 0 contracts while priority > threshold, else while
+// priority < threshold.  Writes consecutive labels 0..c-1 to out_labels.
+int ct_parallel_contract(int64_t n_nodes, const int64_t* edges,
+                         const double* payload, int64_t m, int64_t k,
+                         int mode_max, double threshold,
+                         int64_t* out_labels) {
+  const double sign = mode_max ? 1.0 : -1.0;
+  const double thr = sign * threshold;
+
+  std::vector<int64_t> u, v;
+  std::vector<double> pay;  // row-major [n_edges, k]
+  u.reserve(m);
+  v.reserve(m);
+  pay.reserve(m * k);
+
+  // canonicalize + merge parallel edges: stable sort of row indices by
+  // (lo, hi), then accumulate payload in ORIGINAL edge order per group
+  // (numpy bincount order, so float sums match the reference exactly)
+  auto dedup = [&](std::vector<int64_t>& eu, std::vector<int64_t>& ev,
+                   std::vector<double>& ep) {
+    const int64_t n = static_cast<int64_t>(eu.size());
+    std::vector<int64_t> idx;
+    idx.reserve(n);
+    for (int64_t i = 0; i < n; ++i)
+      if (eu[i] != ev[i]) idx.push_back(i);
+    std::stable_sort(idx.begin(), idx.end(), [&](int64_t a, int64_t b) {
+      int64_t la = std::min(eu[a], ev[a]), ha = std::max(eu[a], ev[a]);
+      int64_t lb = std::min(eu[b], ev[b]), hb = std::max(eu[b], ev[b]);
+      return la < lb || (la == lb && ha < hb);
+    });
+    // group id per original row, groups in (lo, hi) order
+    std::vector<int64_t> group(n, -1);
+    std::vector<int64_t> glo, ghi;
+    int64_t g = -1;
+    int64_t prev_lo = -1, prev_hi = -1;
+    for (int64_t i : idx) {
+      int64_t lo = std::min(eu[i], ev[i]), hi = std::max(eu[i], ev[i]);
+      if (lo != prev_lo || hi != prev_hi) {
+        ++g;
+        glo.push_back(lo);
+        ghi.push_back(hi);
+        prev_lo = lo;
+        prev_hi = hi;
+      }
+      group[i] = g;
+    }
+    std::vector<double> gpay((g + 1) * k, 0.0);
+    for (int64_t i = 0; i < n; ++i) {
+      if (group[i] < 0) continue;  // self edge
+      for (int64_t c = 0; c < k; ++c) gpay[group[i] * k + c] += ep[i * k + c];
+    }
+    eu.swap(glo);
+    ev.swap(ghi);
+    ep.swap(gpay);
+  };
+
+  {
+    std::vector<int64_t> eu(m), ev(m);
+    std::vector<double> ep(m * k);
+    for (int64_t i = 0; i < m; ++i) {
+      eu[i] = edges[2 * i];
+      ev[i] = edges[2 * i + 1];
+      for (int64_t c = 0; c < k; ++c) ep[i * k + c] = payload[i * k + c];
+    }
+    dedup(eu, ev, ep);
+    u.swap(eu);
+    v.swap(ev);
+    pay.swap(ep);
+  }
+
+  std::vector<int64_t> labels(n_nodes);
+  for (int64_t i = 0; i < n_nodes; ++i) labels[i] = i;
+  std::vector<double> best_p(n_nodes);
+  std::vector<int64_t> best_e(n_nodes), root(n_nodes);
+  std::vector<double> prio;
+
+  while (!u.empty()) {
+    const int64_t ne = static_cast<int64_t>(u.size());
+    prio.assign(ne, 0.0);
+    bool any_elig = false;
+    for (int64_t e = 0; e < ne; ++e) {
+      double p = k == 1 ? pay[e * k]
+                        : pay[e * k] / std::max(pay[e * k + 1], 1e-300);
+      prio[e] = sign * p;
+      any_elig |= prio[e] > thr;
+    }
+    if (!any_elig) break;
+    std::fill(best_p.begin(), best_p.end(), -1e300);
+    for (int64_t e = 0; e < ne; ++e) {
+      if (prio[e] <= thr) continue;
+      best_p[u[e]] = std::max(best_p[u[e]], prio[e]);
+      best_p[v[e]] = std::max(best_p[v[e]], prio[e]);
+    }
+    std::fill(best_e.begin(), best_e.end(), ne);
+    for (int64_t e = 0; e < ne; ++e) {
+      if (prio[e] <= thr) continue;
+      if (prio[e] == best_p[u[e]]) best_e[u[e]] = std::min(best_e[u[e]], e);
+      if (prio[e] == best_p[v[e]]) best_e[v[e]] = std::min(best_e[v[e]], e);
+    }
+    for (int64_t i = 0; i < n_nodes; ++i) root[i] = i;
+    for (int64_t e = 0; e < ne; ++e)
+      if (prio[e] > thr && best_e[u[e]] == e && best_e[v[e]] == e)
+        root[v[e]] = u[e];  // matching: depth-1 parents
+    for (int64_t i = 0; i < n_nodes; ++i) labels[i] = root[labels[i]];
+    for (int64_t e = 0; e < ne; ++e) {
+      u[e] = root[u[e]];
+      v[e] = root[v[e]];
+    }
+    dedup(u, v, pay);
+  }
+
+  // consecutive relabel, root-id ascending (np.unique semantics)
+  std::vector<int64_t> dense(n_nodes, -1);
+  for (int64_t i = 0; i < n_nodes; ++i) dense[labels[i]] = -2;  // mark roots
+  int64_t next = 0;
+  for (int64_t r = 0; r < n_nodes; ++r)
+    if (dense[r] == -2) dense[r] = next++;
+  for (int64_t i = 0; i < n_nodes; ++i) out_labels[i] = dense[labels[i]];
+  return 0;
 }
 
 // Exact squared Euclidean distance transform of a 3-D binary mask

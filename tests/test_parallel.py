@@ -15,6 +15,7 @@ from cluster_tools_tpu.parallel import (
     make_mesh,
     mesh_axis_sizes,
 )
+from cluster_tools_tpu.compat import shard_map
 from cluster_tools_tpu.parallel.mesh import backend_devices
 from cluster_tools_tpu.parallel.pipeline import make_ws_ccl_step
 
@@ -34,7 +35,7 @@ def test_exchange_halo_matches_pad():
     x = np.arange(z * 4 * 4, dtype=np.float32).reshape(z, 4, 4)
     halo = 2
 
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda v: exchange_halo(v, halo, 0, "sp", sp, fill=-1.0),
         mesh=mesh,
         in_specs=P("sp"),
@@ -209,7 +210,6 @@ def test_distributed_ccl_compacted_labels(rng):
 
 def test_sharded_ccl_overflow_flag():
     # a shard with more components than the cap must raise the overflow flag
-    import jax as _jax
     from cluster_tools_tpu.parallel.distributed_ccl import sharded_label_components
 
     mesh = _mesh(("sp",))
@@ -227,7 +227,7 @@ def test_sharded_ccl_overflow_flag():
             return_overflow=True,
         )
 
-    _, overflow = _jax.shard_map(
+    _, overflow = shard_map(
         body, mesh=mesh, in_specs=P("sp"), out_specs=(P("sp"), P())
     )(mask)
     assert bool(overflow)
@@ -577,7 +577,7 @@ def test_replication_fence_detects_varying_escape():
         return jax.lax.axis_index("sp").astype(jnp.float32)
 
     leaked = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=P("sp"), out_specs=P(),
             check_vma=False,
         )
