@@ -1,0 +1,23 @@
+# Developer entry points.  `test` = tier-1 (fast, chaos excluded via the
+# slow marker) followed by the chaos suite; `chaos` = the fault-injection
+# suite alone, fixed seed (docs/ROBUSTNESS.md).
+PY ?= python
+CTT_CHAOS_SEED ?= 7
+
+.PHONY: test tier1 chaos native clean
+
+test: tier1 chaos
+
+tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+chaos:
+	JAX_PLATFORMS=cpu CTT_CHAOS_SEED=$(CTT_CHAOS_SEED) \
+		$(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+native:
+	$(MAKE) -C native
+
+clean:
+	$(MAKE) -C native clean

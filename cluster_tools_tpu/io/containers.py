@@ -40,6 +40,21 @@ except ImportError:  # pragma: no cover
 _ZARR_EXTS = (".zarr", ".zr", ".n5")
 _H5_EXTS = (".h5", ".hdf5", ".hdf")
 
+_faults_mod = None
+
+
+def _inject(site: str) -> None:
+    """Fault-injection hook for the container IO layer (sites ``io_read`` /
+    ``io_write``; see runtime/faults.py).  A no-op unless an injector is
+    configured — chaos tests exercise the executor's load/store retries
+    against storage-level failures through this."""
+    global _faults_mod
+    if _faults_mod is None:
+        from ..runtime import faults as _fm
+
+        _faults_mod = _fm
+    _faults_mod.get_injector().maybe_fail(site)
+
 # numpy dtype -> zarr v2 dtype string
 def _zarr_dtype(dtype) -> str:
     return np.dtype(dtype).newbyteorder("<").str
@@ -73,17 +88,21 @@ class Dataset:
         return len(self.shape)
 
     def __getitem__(self, bb) -> np.ndarray:
+        _inject("io_read")
         return np.asarray(self._store[bb].read().result())
 
     def __setitem__(self, bb, value) -> None:
+        _inject("io_write")
         value = np.asarray(value, dtype=self.dtype)
         self._store[bb].write(value).result()
 
     def read_async(self, bb):
         """Start an async read; returns a future with ``.result()`` -> numpy."""
+        _inject("io_read")
         return self._store[bb].read()
 
     def write_async(self, bb, value):
+        _inject("io_write")
         value = np.asarray(value, dtype=self.dtype)
         return self._store[bb].write(value)
 
@@ -100,8 +119,12 @@ class Dataset:
             raise RuntimeError("dataset has no attribute store")
         attrs = self.attrs
         attrs.update(kwargs)
-        with open(self._attrs_path, "w") as f:
+        # atomic: a kill mid-write must not tear the sidecar (it is shared
+        # with external zarr/N5 readers)
+        tmp = f"{self._attrs_path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
             json.dump(attrs, f, indent=2, default=_json_default)
+        os.replace(tmp, self._attrs_path)
 
 
 def _json_default(o):
@@ -311,15 +334,19 @@ class _H5Dataset:
         return tuple(self._ds.chunks) if self._ds.chunks else tuple(self._ds.shape)
 
     def __getitem__(self, bb):
+        _inject("io_read")
         return self._ds[bb]
 
     def __setitem__(self, bb, value):
+        _inject("io_write")
         self._ds[bb] = value
 
     def read_async(self, bb):
+        _inject("io_read")
         return _ImmediateFuture(self._ds[bb])
 
     def write_async(self, bb, value):
+        _inject("io_write")
         self._ds[bb] = value
         return _ImmediateFuture(None)
 
@@ -440,15 +467,19 @@ class _MemDataset:
     ndim = property(lambda self: self._arr.ndim)
 
     def __getitem__(self, bb):
+        _inject("io_read")
         return self._arr[bb].copy()
 
     def __setitem__(self, bb, value):
+        _inject("io_write")
         self._arr[bb] = value
 
     def read_async(self, bb):
+        _inject("io_read")
         return _ImmediateFuture(self._arr[bb].copy())
 
     def write_async(self, bb, value):
+        _inject("io_write")
         self._arr[bb] = value
         return _ImmediateFuture(None)
 

@@ -1,2 +1,9 @@
 from .task import BaseTask, SuccessTarget, build, DummyTask, WorkflowBase, get_task_cls
-from .executor import BlockwiseExecutor, get_devices, get_mesh
+from .executor import (
+    BlockwiseExecutor,
+    check_finite_outputs,
+    get_devices,
+    get_mesh,
+    validate_labels,
+)
+from .faults import FaultInjector, InjectedFault, configure, get_injector

@@ -40,6 +40,7 @@ import time
 from typing import Any, Dict, Optional
 
 from ..utils import function_utils as fu
+from . import faults as faults_mod
 
 
 class ClusterSubmitter:
@@ -149,6 +150,45 @@ class LSFSubmitter(ClusterSubmitter):
 _SUBMITTERS = {"slurm": SlurmSubmitter, "lsf": LSFSubmitter}
 
 
+def submit_with_retries(
+    submitter: ClusterSubmitter,
+    script_path: str,
+    job_name: str,
+    out_path: str,
+    cfg: Dict[str, Any],
+    logger=None,
+) -> str:
+    """Submit, retrying transient scheduler failures (slurmctld restarts,
+    comm timeouts — the submit-side twin of the probe-failure grace) with
+    capped exponential backoff + jitter.
+
+    Config keys: ``submit_retries`` (default 3), ``submit_backoff_s``
+    (base, default 2), ``submit_backoff_max_s`` (cap, default 30).
+    """
+    retries = int(cfg.get("submit_retries", 3))
+    base = float(cfg.get("submit_backoff_s", 2.0))
+    cap = float(cfg.get("submit_backoff_max_s", 30.0))
+    for attempt in range(retries + 1):
+        try:
+            faults_mod.get_injector().maybe_fail("submit")
+            return submitter.submit(script_path, job_name, out_path, cfg)
+        except FileNotFoundError:
+            # sbatch/bsub not on PATH: a configuration error, not an
+            # outage — retrying only delays the real message
+            raise
+        except Exception as e:
+            if attempt >= retries:
+                raise
+            delay = fu.backoff_delay(attempt, base, cap)
+            if logger is not None:
+                logger.warning(
+                    f"{submitter.flavor} submit failed (attempt "
+                    f"{attempt + 1}/{retries + 1}): {e}; retrying in "
+                    f"{delay:.1f}s"
+                )
+            time.sleep(delay)
+
+
 def _spec_default(obj):
     """Numpy scalars/arrays become their Python equivalents; anything else
     fails AT SUBMIT TIME instead of reaching the remote node stringified."""
@@ -220,7 +260,9 @@ def make_cluster_task(local_cls, flavor: str):
             pass
 
         submitter = submitter_cls()
-        job_id = submitter.submit(script_path, self.uid, out_path, cfg)
+        job_id = submit_with_retries(
+            submitter, script_path, self.uid, out_path, cfg, self.logger
+        )
         self.logger.info(f"{flavor} job {job_id} submitted ({script_path})")
 
         poll = float(cfg.get("poll_interval_s", 5.0))

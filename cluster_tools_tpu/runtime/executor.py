@@ -16,21 +16,38 @@ The pipeline per batch:
     host threads: crop inner blocks, write to chunked storage      [IO bound]
 
 Reads for batch i+1 overlap compute for batch i (prefetch depth 2); writes
-are fire-and-forget futures drained at the end.  Block-level success markers
-give the same resume grain as the reference's ``log_block_success``.
+are fire-and-forget futures drained promptly in a bounded window.
+
+Fault tolerance (docs/ROBUSTNESS.md): per-block loads and stores retry with
+exponential backoff + jitter; blocks that exhaust their retries (or whose
+outputs fail validation — NaN/inf, or a task-supplied ``validate_fn``) are
+*quarantined*: the batch and the run continue, and quarantined blocks are
+re-attempted at the end on a reduced-batch path (the block replicated to the
+batch width through the *same* compiled kernel, so a recovered block is
+bit-identical to an undisturbed run).  Every block that ever failed is
+recorded in a structured ``failures.json`` manifest (block id, per-site
+attempt counts, capped traceback, resolution); blocks that stay failed after
+the quarantine pass raise with their ids attributed.  Block-level success
+markers give the same resume grain as the reference's ``log_block_success``
+— ``done_block_ids`` filters them built-in.
 """
 
 from __future__ import annotations
 
 import math
+import threading
+import time
+import traceback
 from concurrent.futures import ThreadPoolExecutor, Future
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import function_utils as fu
 from ..utils.volume_utils import Block, Blocking
+from . import faults as faults_mod
 
 
 # canonical device-selection policy lives in parallel/mesh.py
@@ -44,6 +61,32 @@ def get_mesh(
 ) -> Mesh:
     devs = get_devices(target, n_devices)
     return Mesh(np.array(devs), (axis_name,))
+
+
+def check_finite_outputs(block: Block, out) -> Optional[str]:
+    """Built-in output validator: any non-finite value in a float leaf is a
+    corrupt kernel output (the classic silent NaN-producing-kernel failure)."""
+    for leaf in jax.tree_util.tree_leaves(out):
+        a = np.asarray(leaf)
+        if a.dtype.kind == "f" and not np.isfinite(a).all():
+            return "non-finite values (NaN/inf) in kernel output"
+    return None
+
+
+def validate_labels(block: Block, out) -> Optional[str]:
+    """Validator for label-producing kernels: negative (signed) or
+    saturated (unsigned) label values are the integer shadows of a corrupt
+    kernel — a NaN cast to int yields exactly these.  Float leaves are
+    covered by ``map_blocks``' built-in ``check_finite`` pass, not here."""
+    for leaf in jax.tree_util.tree_leaves(out):
+        a = np.asarray(leaf)
+        if a.size == 0:
+            continue
+        if a.dtype.kind == "i" and int(a.min()) < 0:
+            return "negative label values (corrupt kernel output)"
+        if a.dtype.kind == "u" and bool((a == np.iinfo(a.dtype).max).any()):
+            return "saturated label values (corrupt kernel output)"
+    return None
 
 
 class BlockwiseExecutor:
@@ -62,6 +105,9 @@ class BlockwiseExecutor:
         n_devices: Optional[int] = None,
         device_batch: int = 1,
         io_threads: int = 8,
+        max_retries: int = 2,
+        backoff_base: float = 0.05,
+        backoff_max: float = 5.0,
     ):
         self.target = target
         self.devices = get_devices(target, n_devices)
@@ -70,6 +116,29 @@ class BlockwiseExecutor:
         self.batch_size = self.n_devices * self.device_batch
         self.mesh = Mesh(np.array(self.devices), ("blocks",))
         self.io_threads = io_threads
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+
+    # -- retry/backoff machinery ------------------------------------------
+    def _backoff(self, attempt: int) -> float:
+        return fu.backoff_delay(attempt, self.backoff_base, self.backoff_max)
+
+    def _io_with_retries(self, site: str, block: Block, fn: Callable):
+        """Run ``fn`` with injection + retries.  Returns
+        ``(value, attempts, traceback_or_None)``; the caller quarantines on
+        a non-None traceback."""
+        injector = faults_mod.get_injector()
+        last_tb = None
+        for k in range(self.max_retries + 1):
+            try:
+                injector.maybe_fail(site, block.block_id)
+                return fn(), k + 1, None
+            except Exception:
+                last_tb = fu.cap_traceback(traceback.format_exc())
+                if k < self.max_retries:
+                    time.sleep(self._backoff(k))
+        return None, self.max_retries + 1, last_tb
 
     def map_blocks(
         self,
@@ -79,10 +148,28 @@ class BlockwiseExecutor:
         store_fn: Optional[Callable[[Block, Any], None]] = None,
         on_block_done: Optional[Callable[[Block], None]] = None,
         prefetch: int = 2,
-    ) -> None:
-        """Execute ``kernel`` over ``blocks``; see class docstring."""
+        done_block_ids: Optional[Iterable[int]] = None,
+        validate_fn: Optional[Callable[[Block, Any], Optional[str]]] = None,
+        check_finite: bool = True,
+        failures_path: Optional[str] = None,
+        task_name: str = "map_blocks",
+    ) -> Dict[str, int]:
+        """Execute ``kernel`` over ``blocks``; see class docstring.
+
+        ``done_block_ids`` — block ids to skip (success-marker resume grain).
+        ``validate_fn(block, outputs) -> Optional[str]`` — extra output
+        validation; a non-None message quarantines the block for re-compute.
+        ``check_finite`` — built-in NaN/inf validation of float outputs.
+        ``failures_path`` — where to record the ``failures.json`` manifest.
+        Raises RuntimeError naming every block that stays failed after the
+        end-of-run quarantine pass.
+        """
+        if done_block_ids:
+            done = {int(b) for b in done_block_ids}
+            blocks = [b for b in blocks if int(b.block_id) not in done]
         if not blocks:
-            return
+            return {"n_blocks": 0, "n_quarantined": 0, "n_failed": 0}
+        injector = faults_mod.get_injector()
         bs = self.batch_size
         n_batches = math.ceil(len(blocks) / bs)
         sharding = NamedSharding(self.mesh, P("blocks"))
@@ -90,28 +177,151 @@ class BlockwiseExecutor:
             jax.vmap(kernel), in_shardings=sharding, out_shardings=sharding
         )
 
+        # per-block failure bookkeeping (threads: IO pool + dispatch loop)
+        failures: Dict[int, Dict[str, Any]] = {}
+        fail_lock = threading.Lock()
+        quarantined_ids: set = set()
+
+        def note_failure(block, site, attempts, error, quarantine):
+            with fail_lock:
+                rec = failures.setdefault(
+                    int(block.block_id),
+                    {
+                        "block_id": int(block.block_id),
+                        "sites": {},
+                        "error": None,
+                        "quarantined": False,
+                        "resolved": True,
+                    },
+                )
+                rec["sites"][site] = rec["sites"].get(site, 0) + int(attempts)
+                if error is not None:
+                    rec["error"] = error
+                if quarantine:
+                    rec["quarantined"] = True
+                    rec["resolved"] = False
+                    quarantined_ids.add(int(block.block_id))
+
+        def mark_resolved(block):
+            with fail_lock:
+                rec = failures.get(int(block.block_id))
+                if rec is not None:
+                    rec["resolved"] = True
+
+        def validate(block, out) -> Optional[str]:
+            if check_finite:
+                err = check_finite_outputs(block, out)
+                if err:
+                    return err
+            if validate_fn is not None:
+                return validate_fn(block, out)
+            return None
+
+        class _PreIssueFailed(Exception):
+            pass
+
+        def load_block(block, pre=None, pre_tb=None):
+            """Load one block with retries; returns arrays or None
+            (quarantined).  ``pre`` is an already-issued load_fn result
+            consumed by the first attempt (batch reads are issued together
+            so the storage layer runs the chunk IO concurrently)."""
+            last_tb, attempts = None, 0
+            for k in range(self.max_retries + 1):
+                attempts = k + 1
+                try:
+                    injector.maybe_fail("load", block.block_id)
+                    if k == 0 and pre_tb is not None:
+                        last_tb = pre_tb
+                        raise _PreIssueFailed()
+                    per = pre if (k == 0 and pre is not None) else load_fn(block)
+                    val = tuple(
+                        x.result() if hasattr(x, "result") else x for x in per
+                    )
+                except _PreIssueFailed:
+                    if k < self.max_retries:
+                        time.sleep(self._backoff(k))
+                except Exception:
+                    last_tb = fu.cap_traceback(traceback.format_exc())
+                    if k < self.max_retries:
+                        time.sleep(self._backoff(k))
+                else:
+                    if attempts > 1:
+                        note_failure(block, "load", attempts - 1, None, False)
+                    return val
+            note_failure(block, "load", attempts, last_tb, quarantine=True)
+            return None
+
         def load_batch(batch_idx: int):
             batch = blocks[batch_idx * bs : (batch_idx + 1) * bs]
             # load_fn may return futures (e.g. io.prefetch.async_loader's
             # tensorstore read futures): issue EVERY read of the batch first,
             # then resolve — the storage layer runs the chunk IO concurrently
-            per_block = [load_fn(b) for b in batch]
-            per_block = [
-                tuple(
-                    x.result() if hasattr(x, "result") else x for x in pb
-                )
-                for pb in per_block
-            ]
+            issued = []
+            for b in batch:
+                try:
+                    issued.append((load_fn(b), None))
+                except Exception:
+                    issued.append(
+                        (None, fu.cap_traceback(traceback.format_exc()))
+                    )
+            ok_blocks, per_block = [], []
+            for b, (pre, pre_tb) in zip(batch, issued):
+                val = load_block(b, pre=pre, pre_tb=pre_tb)
+                if val is not None:
+                    ok_blocks.append(b)
+                    per_block.append(val)
+            if not ok_blocks:
+                return [], None
             n_args = len(per_block[0])
-            # pad the final partial batch by repeating the last block so the
-            # compiled shape stays static; padded outputs are dropped
-            n_pad = bs - len(batch)
+            # pad the partial batch (tail, or quarantine-induced holes) by
+            # repeating the last block so the compiled shape stays static;
+            # padded outputs are dropped
+            n_pad = bs - len(per_block)
             if n_pad:
                 per_block = per_block + [per_block[-1]] * n_pad
             arrays = tuple(
                 np.stack([pb[i] for pb in per_block]) for i in range(n_args)
             )
-            return batch, arrays
+            return ok_blocks, arrays
+
+        def handle_block_output(blk, block_out):
+            """Corrupt-injection, validation, store (with retries), marker.
+            Never raises — failures (including programming errors in the
+            validate/marker hooks) quarantine the block, keeping every
+            error attributed to its block id."""
+            try:
+                block_out = injector.corrupt("kernel", blk.block_id, block_out)
+                err = validate(blk, block_out)
+                if err is not None:
+                    note_failure(blk, "validate", 1, err, quarantine=True)
+                    return
+                if store_fn is not None:
+                    _, attempts, tb = self._io_with_retries(
+                        "store", blk, lambda: store_fn(blk, block_out)
+                    )
+                    if tb is not None:
+                        note_failure(blk, "store", attempts, tb, quarantine=True)
+                        return
+                    if attempts > 1:
+                        note_failure(
+                            blk, "store", attempts - 1, None, quarantine=False
+                        )
+                mark_resolved(blk)
+                if on_block_done is not None:
+                    on_block_done(blk)
+            except Exception:
+                # site "hook", not "store": the store path itself retries
+                # and records above — only validate_fn/on_block_done/corrupt
+                # programming errors land here
+                note_failure(
+                    blk,
+                    "hook",
+                    1,
+                    fu.cap_traceback(traceback.format_exc()),
+                    quarantine=True,
+                )
+                return
+            injector.kill_point("block_done")
 
         with ThreadPoolExecutor(max_workers=self.io_threads) as pool:
             pending_loads: List[Future] = [
@@ -122,8 +332,23 @@ class BlockwiseExecutor:
                 batch, arrays = pending_loads.pop(0).result()
                 if i + prefetch < n_batches:
                     pending_loads.append(pool.submit(load_batch, i + prefetch))
+                # prompt drain: surface finished stores (and any programming
+                # error in the store path, with its batch's block ids) now,
+                # not at the end of the run
+                while write_futures and write_futures[0].done():
+                    write_futures.pop(0).result()
+                if not batch:
+                    continue  # every block of this batch was quarantined
                 arrays = tuple(jax.device_put(a, sharding) for a in arrays)
-                out = batched_kernel(*arrays)
+                try:
+                    out = batched_kernel(*arrays)
+                except Exception:
+                    # a compute failure poisons the whole batch; quarantine
+                    # all of it — the reduced-batch pass isolates the culprit
+                    tb = fu.cap_traceback(traceback.format_exc())
+                    for blk in batch:
+                        note_failure(blk, "compute", 1, tb, quarantine=True)
+                    continue
 
                 def store_batch(batch=batch, out=out):
                     # the device->host copy happens HERE, on the IO pool, so
@@ -134,10 +359,7 @@ class BlockwiseExecutor:
                         block_out = jax.tree_util.tree_map(
                             lambda a: a[j], out_np
                         )
-                        if store_fn is not None:
-                            store_fn(blk, block_out)
-                        if on_block_done is not None:
-                            on_block_done(blk)
+                        handle_block_output(blk, block_out)
 
                 write_futures.append(pool.submit(store_batch))
                 # backpressure: each pending store closure pins its batch's
@@ -148,3 +370,52 @@ class BlockwiseExecutor:
                     write_futures.pop(0).result()
             for f in write_futures:
                 f.result()
+
+            # -- quarantine pass: reduced-batch re-attempts -----------------
+            # re-run each quarantined block alone, replicated to the batch
+            # width through the SAME compiled kernel — bit-identical results,
+            # and a batch-poisoning block is isolated to itself
+            for blk in [b for b in blocks if int(b.block_id) in quarantined_ids]:
+                val = load_block(blk)
+                if val is None:
+                    continue  # still failing; stays unresolved
+                stacked = tuple(np.stack([x] * bs) for x in val)
+                stacked = tuple(jax.device_put(a, sharding) for a in stacked)
+                try:
+                    out = batched_kernel(*stacked)
+                except Exception:
+                    tb = fu.cap_traceback(traceback.format_exc())
+                    note_failure(blk, "compute", 1, tb, quarantine=True)
+                    continue
+                out0 = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[0], out
+                )
+                handle_block_output(blk, out0)
+
+        unresolved = sorted(
+            b for b, rec in failures.items() if not rec["resolved"]
+        )
+        if failures_path and failures:
+            fu.record_failures(
+                failures_path,
+                task_name,
+                [failures[b] for b in sorted(failures)],
+            )
+        if unresolved:
+            details = "\n".join(
+                f"-- block {b} (sites {failures[b]['sites']}) --\n"
+                f"{failures[b]['error']}"
+                for b in unresolved[:5]
+            )
+            raise RuntimeError(
+                f"{task_name}: {len(unresolved)}/{len(blocks)} blocks failed "
+                f"permanently after retries + quarantine re-attempts "
+                f"(ids: {unresolved})"
+                + (f"; see {failures_path}" if failures_path else "")
+                + f"; first errors:\n{details}"
+            )
+        return {
+            "n_blocks": len(blocks),
+            "n_quarantined": len(quarantined_ids),
+            "n_failed": 0,
+        }

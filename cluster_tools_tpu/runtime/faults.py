@@ -1,0 +1,254 @@
+"""Deterministic fault injection for chaos testing the execution layer.
+
+Long-running multi-chip jobs make preemption, transient IO failure, and
+corrupt kernel outputs the *common* case; this module is how we prove the
+runtime survives them.  A :class:`FaultInjector` is configured from a JSON
+document (programmatically via :func:`configure`, or across process
+boundaries via the ``CTT_FAULTS`` environment variable — either inline JSON
+or a path to a JSON file) and exposes three hook points that the executor,
+task runtime, and container IO layer call at their failure-relevant sites:
+
+- :meth:`FaultInjector.maybe_fail` — raise :class:`InjectedFault` at a load
+  / store / io_read / io_write site (transient or persistent, depending on
+  ``fail_attempts``),
+- :meth:`FaultInjector.corrupt` — poison kernel outputs (NaN for float
+  leaves; the NaN-cast garbage values for integer leaves), modelling a
+  NaN/inf-producing kernel,
+- :meth:`FaultInjector.kill_point` — ``os._exit`` at the N-th crossing of a
+  named progress point (``block_done`` / ``task_done``), modelling
+  preemption.  A latch file in ``state_dir`` makes the kill one-shot, so a
+  resumed run with the *same* ``CTT_FAULTS`` does not die again.
+
+Config schema::
+
+    {
+      "seed": 7,                      # drives rate-based faults
+      "state_dir": "/scratch/chaos",  # kill latches (required for kills)
+      "faults": [
+        # transient load failure: block 3 fails its first attempt
+        {"site": "load", "kind": "error", "blocks": [3]},
+        # persistent store failure: block 5 fails its first 4 attempts
+        {"site": "store", "kind": "error", "blocks": [5], "fail_attempts": 4},
+        # NaN-producing kernel on block 2 (first attempt only)
+        {"site": "kernel", "kind": "nan", "blocks": [2]},
+        # random 10% of io reads fail (seeded, deterministic per attempt)
+        {"site": "io_read", "kind": "error", "rate": 0.1,
+         "fail_attempts": 1000000},
+        # preemption: exit hard at the 3rd completed block
+        {"site": "block_done", "kind": "kill", "after": 3}
+      ]
+    }
+
+Attempt counting is per ``(site, block, fault)`` and in-memory: the N-th
+call of a hook for a given block is the N-th attempt, so ``fail_attempts``
+models transient (1–2) versus persistent (> the executor's retry budget)
+failures, and retries/quarantine re-attempts eventually pass.  Rate-based
+faults hash ``(seed, site, block, attempt)`` so they are reproducible
+without shared state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+#: Exit code used by kill faults — chaos tests assert on it to distinguish
+#: an injected kill from a genuine crash.
+KILL_EXIT_CODE = 113
+
+ENV_VAR = "CTT_FAULTS"
+
+_ERROR_SITES = ("load", "store", "io_read", "io_write", "submit", "task")
+_KILL_SITES = ("block_done", "task_done")
+
+
+class InjectedFault(RuntimeError):
+    """The exception raised by ``kind='error'`` faults."""
+
+    def __init__(self, site: str, block_id: Optional[int], attempt: int):
+        self.site = site
+        self.block_id = block_id
+        self.attempt = attempt
+        super().__init__(
+            f"injected {site} fault"
+            + (f" on block {block_id}" if block_id is not None else "")
+            + f" (attempt {attempt})"
+        )
+
+
+def _poison_leaf(a):
+    """Model a NaN-producing kernel: float leaves become NaN; integer
+    leaves get the value a NaN cast yields (INT_MIN for signed, max for
+    unsigned) — the garbage that reaches storage when nobody validates."""
+    a = np.asarray(a)
+    if a.dtype.kind == "f":
+        return np.full_like(a, np.nan)
+    if a.dtype.kind == "i":
+        return np.full_like(a, np.iinfo(a.dtype).min)
+    if a.dtype.kind == "u":
+        return np.full_like(a, np.iinfo(a.dtype).max)
+    return a
+
+
+class FaultInjector:
+    """Seeded, deterministic fault injector.  With no faults configured
+    every hook is a cheap no-op, so the hooks stay permanently wired into
+    the production paths."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = dict(config or {})
+        self.seed = int(config.get("seed", 0))
+        self.state_dir = config.get("state_dir")
+        self.specs = [dict(s) for s in config.get("faults", [])]
+        self.enabled = bool(self.specs)
+        self._counts: Dict[tuple, int] = {}
+        self._lock = threading.Lock()
+        for spec in self.specs:
+            kind = spec.get("kind")
+            site = spec.get("site")
+            if kind == "kill":
+                if site not in _KILL_SITES:
+                    raise ValueError(
+                        f"kill fault site must be one of {_KILL_SITES}, "
+                        f"got {site!r}"
+                    )
+                if not self.state_dir:
+                    raise ValueError(
+                        "kill faults require 'state_dir' (the one-shot "
+                        "latch must survive the process they kill)"
+                    )
+            elif kind == "nan":
+                if site != "kernel":
+                    raise ValueError("nan faults only apply to site='kernel'")
+            elif kind == "error":
+                if site not in _ERROR_SITES:
+                    raise ValueError(
+                        f"error fault site must be one of {_ERROR_SITES}, "
+                        f"got {site!r}"
+                    )
+            else:
+                raise ValueError(f"unknown fault kind {kind!r}")
+        if self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+
+    # -- internals ---------------------------------------------------------
+    def _unit(self, *parts) -> float:
+        key = ":".join(str(p) for p in (self.seed,) + parts)
+        return zlib.crc32(key.encode()) / 0xFFFFFFFF
+
+    def _next_attempt(self, site, block_id, idx) -> int:
+        with self._lock:
+            key = (site, block_id, idx)
+            attempt = self._counts.get(key, 0) + 1
+            self._counts[key] = attempt
+            return attempt
+
+    def _active(self, idx, spec, site, block_id, kind) -> Optional[int]:
+        """Attempt number if this spec fires for (site, block), else None.
+        Calling this *counts* an attempt for matching specs."""
+        if spec.get("kind") != kind or spec.get("site") != site:
+            return None
+        blocks = spec.get("blocks")
+        if blocks is not None:
+            if block_id is None or int(block_id) not in {int(b) for b in blocks}:
+                return None
+        attempt = self._next_attempt(site, block_id, idx)
+        if attempt > int(spec.get("fail_attempts", 1)):
+            return None
+        rate = spec.get("rate")
+        if rate is not None and self._unit(site, block_id, attempt) >= float(rate):
+            return None
+        return attempt
+
+    # -- hook points ---------------------------------------------------------
+    def maybe_fail(self, site: str, block_id: Optional[int] = None) -> None:
+        """Raise :class:`InjectedFault` if an error fault fires here."""
+        if not self.enabled:
+            return
+        for idx, spec in enumerate(self.specs):
+            attempt = self._active(idx, spec, site, block_id, "error")
+            if attempt is not None:
+                raise InjectedFault(site, block_id, attempt)
+
+    def corrupt(self, site: str, block_id: Optional[int], tree):
+        """Return ``tree`` with every array leaf poisoned if a nan fault
+        fires here, else ``tree`` unchanged."""
+        if not self.enabled:
+            return tree
+        for idx, spec in enumerate(self.specs):
+            if self._active(idx, spec, site, block_id, "nan") is not None:
+                import jax
+
+                return jax.tree_util.tree_map(_poison_leaf, tree)
+        return tree
+
+    def kill_point(self, site: str) -> None:
+        """Hard-exit (``os._exit``) at the configured crossing of ``site``.
+        One-shot per fault via a latch file in ``state_dir``."""
+        if not self.enabled:
+            return
+        for idx, spec in enumerate(self.specs):
+            if spec.get("kind") != "kill" or spec.get("site") != site:
+                continue
+            count = self._next_attempt(site, None, idx)
+            if count != int(spec.get("after", 1)):
+                continue
+            latch = os.path.join(self.state_dir, f"kill_{idx}.done")
+            if os.path.exists(latch):
+                continue
+            # latch first (atomically), then die: the resumed run must not
+            # re-fire even if the exit races other threads
+            tmp = latch + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(site)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, latch)
+            os._exit(KILL_EXIT_CODE)
+
+
+# -- module-level singleton ---------------------------------------------------
+
+_injector: Optional[FaultInjector] = None
+_singleton_lock = threading.Lock()
+
+
+def _load_env_config() -> Dict[str, Any]:
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if not raw:
+        return {}
+    if raw.startswith("{"):
+        return json.loads(raw)
+    with open(raw) as f:
+        return json.load(f)
+
+
+def get_injector() -> FaultInjector:
+    """The process-wide injector; configured lazily from ``CTT_FAULTS``."""
+    global _injector
+    if _injector is None:
+        with _singleton_lock:
+            if _injector is None:
+                _injector = FaultInjector(_load_env_config())
+    return _injector
+
+
+def configure(config: Optional[Dict[str, Any]]) -> FaultInjector:
+    """Install an injector programmatically (tests); pass None to disable."""
+    global _injector
+    with _singleton_lock:
+        _injector = FaultInjector(config)
+    return _injector
+
+
+def reset() -> None:
+    """Drop the installed injector; the next ``get_injector`` re-reads the
+    environment."""
+    global _injector
+    with _singleton_lock:
+        _injector = None

@@ -38,25 +38,34 @@ from ..utils import task_utils as tu
 
 
 class SuccessTarget:
-    """A success manifest file: the task's luigi-style output target."""
+    """A success manifest file: the task's luigi-style output target.
+
+    Written atomically (temp file + ``os.replace``) and validated on read:
+    a kill mid-write must leave either no manifest or the previous one —
+    a torn manifest counts as NOT done, so resume re-runs the task instead
+    of crashing on (or worse, trusting) half a JSON document.
+    """
 
     def __init__(self, tmp_folder: str, task_name: str):
         self.path = os.path.join(tmp_folder, f"{task_name}.success.json")
 
     def exists(self) -> bool:
-        return os.path.exists(self.path)
+        return fu.read_json_if_valid(self.path) is not None
 
     def write(self, payload: Optional[Dict[str, Any]] = None):
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         doc = {"time": time.time()}
         if payload:
             doc.update(payload)
-        with open(self.path, "w") as f:
-            json.dump(doc, f, indent=2, default=tu._default)
+        fu.atomic_write_json(self.path, doc, default=tu._default)
 
     def read(self) -> Dict[str, Any]:
-        with open(self.path) as f:
-            return json.load(f)
+        doc = fu.read_json_if_valid(self.path)
+        if doc is None:
+            raise FileNotFoundError(
+                f"no valid success manifest at {self.path} (missing or torn)"
+            )
+        return doc
 
 
 class BaseTask:
@@ -105,6 +114,20 @@ class BaseTask:
         return {"threads_per_job": 1, "device_batch": 1}
 
     @staticmethod
+    def default_retry_config() -> Dict[str, Any]:
+        """Fault-tolerance knobs honored for every task (docs/ROBUSTNESS.md):
+        ``max_retries`` task-level re-runs in :func:`build` (0 = fail fast),
+        ``retry_backoff_s`` base of the capped exponential task backoff,
+        ``io_retries`` / ``io_backoff_s`` per-block load/store retries inside
+        :class:`~cluster_tools_tpu.runtime.executor.BlockwiseExecutor`."""
+        return {
+            "max_retries": 0,
+            "retry_backoff_s": 1.0,
+            "io_retries": 2,
+            "io_backoff_s": 0.05,
+        }
+
+    @staticmethod
     def default_global_config() -> Dict[str, Any]:
         return {
             "block_shape": [64, 64, 64],
@@ -115,6 +138,7 @@ class BaseTask:
 
     def get_config(self) -> Dict[str, Any]:
         defaults = dict(self.default_global_config())
+        defaults.update(self.default_retry_config())
         defaults.update(self.default_task_config())
         config = tu.load_task_config(self.config_dir, self.task_name, defaults)
         config.update(self.params)
@@ -148,6 +172,19 @@ class BaseTask:
     def log_block_success(self, block_id: int):
         fu.log_block_success(self.tmp_folder, self.uid, block_id)
 
+    @property
+    def failures_path(self) -> str:
+        """The run's shared ``failures.json`` manifest (docs/ROBUSTNESS.md)."""
+        return fu.failures_path(self.tmp_folder)
+
+    def clean_up_for_retry(self):
+        """Clear stale partial state before a re-run (the reference's
+        ``clean_up_for_retry``): job-level markers go; valid block-level
+        markers are kept — the re-run resumes at block grain.  Torn block
+        markers are pruned as a side effect of :meth:`blocks_done`."""
+        fu.clean_up_for_retry(self.tmp_folder, self.uid)
+        self.blocks_done()
+
     def host_block_map(self, block_ids: Sequence[int], process) -> int:
         """Run ``process(block_id)`` for every block without a success
         marker, on the host IO thread pool, marking each success.
@@ -155,8 +192,10 @@ class BaseTask:
         The common scaffold of host-side blockwise tasks (thin-slab scans,
         relabel writes, artifact dumps): resume-filtering, pooling, and
         error propagation live here so every task behaves identically.
-        All failures are surfaced (not just the first): raises RuntimeError
-        listing every failed block.  Returns the number of blocks run.
+        All failures are surfaced (not just the first): every failed block
+        is recorded in ``failures.json`` (same schema as the executor's,
+        tracebacks capped) and a RuntimeError lists every failed block id.
+        Returns the number of blocks run.
         """
         done = set(self.blocks_done())
         todo = [b for b in block_ids if b not in done]
@@ -167,7 +206,9 @@ class BaseTask:
                 process(block_id)
                 self.log_block_success(block_id)
             except Exception:
-                errors.append((block_id, traceback.format_exc()))
+                errors.append(
+                    (block_id, fu.cap_traceback(traceback.format_exc()))
+                )
 
         from concurrent.futures import ThreadPoolExecutor
 
@@ -175,12 +216,27 @@ class BaseTask:
             list(pool.map(wrapped, todo))
         if errors:
             failed_ids = sorted(b for b, _ in errors)
+            fu.record_failures(
+                self.failures_path,
+                self.uid,
+                [
+                    {
+                        "block_id": int(b),
+                        "sites": {"host": 1},
+                        "error": tb,
+                        "quarantined": False,
+                        "resolved": False,
+                    }
+                    for b, tb in sorted(errors)
+                ],
+            )
             detail = "\n".join(
                 f"-- block {b} --\n{tb}" for b, tb in errors[:5]
             )
             raise RuntimeError(
                 f"{self.task_name}: {len(errors)}/{len(todo)} blocks failed "
-                f"(ids: {failed_ids}); first tracebacks:\n{detail}"
+                f"(ids: {failed_ids}); see {self.failures_path}; "
+                f"first tracebacks:\n{detail}"
             )
         return len(todo)
 
@@ -244,23 +300,78 @@ class WorkflowBase(BaseTask):
         return {}
 
 
+def _task_retry_knobs(task: BaseTask) -> tuple:
+    """(max_retries, backoff_base_s) from the task's config; tolerant of
+    tasks whose config cannot be loaded (defaults: fail fast)."""
+    try:
+        cfg = task.get_config()
+        return (
+            int(cfg.get("max_retries", 0) or 0),
+            float(cfg.get("retry_backoff_s", 1.0) or 0.0),
+        )
+    except Exception:
+        return 0, 1.0
+
+
+def _run_with_retries(task: BaseTask) -> bool:
+    """One task to completion: ``max_retries`` re-runs with capped
+    exponential backoff, clearing stale partial state between attempts
+    (``clean_up_for_retry`` — valid block markers survive, so each retry
+    resumes at block grain rather than recomputing the task from scratch)."""
+    max_retries, backoff = _task_retry_knobs(task)
+    for attempt in range(max_retries + 1):
+        if attempt:
+            delay = fu.backoff_delay(attempt - 1, backoff, 60.0)
+            task.logger.warning(
+                f"retry {attempt}/{max_retries} for {task.task_name} "
+                f"after {delay:.1f}s backoff"
+            )
+            try:
+                task.clean_up_for_retry()
+            except Exception:
+                task.logger.warning(
+                    f"clean_up_for_retry failed:\n{traceback.format_exc()}"
+                )
+            time.sleep(delay)
+        try:
+            task.run()
+        except Exception:
+            task.logger.error(
+                f"task {task.task_name} failed (attempt {attempt + 1}/"
+                f"{max_retries + 1}):\n{traceback.format_exc()}"
+            )
+            continue
+        if task.output().exists():
+            return True
+        task.logger.error(f"task {task.task_name} produced no target")
+    return False
+
+
 def build(tasks: Sequence[BaseTask], rerun: bool = False) -> bool:
     """Run a task DAG to completion (reference: ``luigi.build``).
 
     Topologically executes ``requires()`` dependencies first, skipping tasks
-    whose success target already exists (idempotent resume).  Returns True on
-    success; on failure logs the traceback and returns False (matching
-    luigi's boolean contract).
+    whose success target already exists (idempotent resume).  A failed task
+    (after its ``max_retries`` re-runs) does NOT abort the DAG: only its
+    downstream dependents are skipped, independent branches keep running —
+    one bad branch no longer throws away hours of progress elsewhere, and
+    the manifests it did produce still shrink the eventual re-run.  Returns
+    True only if every task succeeded (matching luigi's boolean contract).
     """
     order: List[BaseTask] = []
     seen = set()
+    deps_of: Dict[tuple, List[tuple]] = {}
+
+    def _key(task: BaseTask) -> tuple:
+        return (type(task).__name__, task.uid, task.tmp_folder)
 
     def visit(task: BaseTask, stack: tuple):
-        key = (type(task).__name__, task.uid, task.tmp_folder)
+        key = _key(task)
         if key in stack:
             raise RuntimeError(f"dependency cycle at {key}")
         if key in seen:
             return
+        deps_of[key] = [_key(dep) for dep in task.requires()]
         for dep in task.requires():
             visit(dep, stack + (key,))
         seen.add(key)
@@ -269,18 +380,27 @@ def build(tasks: Sequence[BaseTask], rerun: bool = False) -> bool:
     for t in tasks:
         visit(t, ())
 
+    failed: set = set()
     for task in order:
+        key = _key(task)
+        # completeness first: a task whose target already exists is done,
+        # even when an upstream failed (luigi semantics) — its own
+        # dependents still get their real input
         if task.output().exists() and not rerun:
             task.logger.info(f"skip {task.task_name}: target exists")
             continue
-        try:
-            task.run()
-        except Exception:
+        blocked = [d for d in deps_of[key] if d in failed]
+        if blocked:
             task.logger.error(
-                f"task {task.task_name} failed:\n{traceback.format_exc()}"
+                f"skip {task.task_name}: upstream failed "
+                f"({[d[0] for d in blocked]})"
             )
-            return False
-        if not task.output().exists():
-            task.logger.error(f"task {task.task_name} produced no target")
-            return False
-    return True
+            failed.add(key)
+            continue
+        if _run_with_retries(task):
+            from . import faults as faults_mod
+
+            faults_mod.get_injector().kill_point("task_done")
+        else:
+            failed.add(key)
+    return not failed

@@ -34,7 +34,7 @@ import numpy as np
 
 from ..ops.ccl import label_components, label_components_keyed
 from ..ops.unionfind import union_find, union_find_host
-from ..runtime.executor import BlockwiseExecutor
+from ..runtime.executor import BlockwiseExecutor, validate_labels
 from ..runtime.task import BaseTask, WorkflowBase, build
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader, pad_block_to
 
@@ -86,7 +86,7 @@ class BlockComponentsBase(BaseTask):
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
         done = set(self.blocks_done())
-        todo = [blocking.get_block(b) for b in block_ids if b not in done]
+        blocks_all = [blocking.get_block(b) for b in block_ids]
 
         out_f = file_reader(cfg["output_path"])
         out = out_f.require_dataset(
@@ -155,13 +155,19 @@ class BlockComponentsBase(BaseTask):
             target=self.target,
             device_batch=int(cfg.get("device_batch", 1)),
             io_threads=max(1, self.max_jobs),
+            max_retries=int(cfg.get("io_retries", 2)),
+            backoff_base=float(cfg.get("io_backoff_s", 0.05)),
         )
         executor.map_blocks(
             kernel,
-            todo,
+            blocks_all,
             load,
             store,
             on_block_done=lambda b: self.log_block_success(b.block_id),
+            done_block_ids=done,
+            validate_fn=validate_labels,
+            failures_path=self.failures_path,
+            task_name=self.uid,
         )
         return {"n_blocks": len(block_ids), "shape": list(shape)}
 

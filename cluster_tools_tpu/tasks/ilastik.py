@@ -640,7 +640,8 @@ class IlastikPredictionBase(BaseTask):
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
         done = set(self.blocks_done())
-        todo = [blocking.get_block(b, halo) for b in block_ids if b not in done]
+        blocks_all = [blocking.get_block(b, halo) for b in block_ids]
+        todo = [b for b in blocks_all if b.block_id not in done]
         outer = tuple(b + 2 * h for b, h in zip(block_shape, halo))
 
         def load(block):
@@ -671,13 +672,20 @@ class IlastikPredictionBase(BaseTask):
             target=self.target,
             device_batch=int(cfg.get("device_batch", 1)),
             io_threads=max(1, self.max_jobs),
+            max_retries=int(cfg.get("io_retries", 2)),
+            backoff_base=float(cfg.get("io_backoff_s", 0.05)),
         )
+        # float probability outputs: the built-in NaN/inf check quarantines
+        # blocks corrupted by a bad forest / feature overflow
         executor.map_blocks(
             kernel,
-            todo,
+            blocks_all,
             load,
             store,
             on_block_done=lambda b: self.log_block_success(b.block_id),
+            done_block_ids=done,
+            failures_path=self.failures_path,
+            task_name=self.uid,
         )
         return {"n_blocks": len(todo), "n_classes": int(n_classes)}
 

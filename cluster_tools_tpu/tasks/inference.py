@@ -142,7 +142,8 @@ class InferenceBase(BaseTask):
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
         done = set(self.blocks_done())
-        todo = [blocking.get_block(b, halo) for b in block_ids if b not in done]
+        blocks_all = [blocking.get_block(b, halo) for b in block_ids]
+        todo = [b for b in blocks_all if b.block_id not in done]
 
         pct = cfg.get("normalize_percentile")
         rng_norm = cfg.get("normalize_range")
@@ -177,13 +178,20 @@ class InferenceBase(BaseTask):
             target=self.target,
             device_batch=int(cfg.get("device_batch", 1)),
             io_threads=max(1, self.max_jobs),
+            max_retries=int(cfg.get("io_retries", 2)),
+            backoff_base=float(cfg.get("io_backoff_s", 0.05)),
         )
+        # float probability outputs: the executor's built-in NaN/inf check
+        # quarantines any block a bad kernel or checkpoint corrupts
         executor.map_blocks(
             kernel,
-            todo,
+            blocks_all,
             load,
             store,
             on_block_done=lambda b: self.log_block_success(b.block_id),
+            done_block_ids=done,
+            failures_path=self.failures_path,
+            task_name=self.uid,
         )
         return {
             "n_blocks": len(todo),

@@ -29,7 +29,7 @@ from ..ops.watershed import (
     dt_watershed_seeded,
     filter_small_segments,
 )
-from ..runtime.executor import BlockwiseExecutor
+from ..runtime.executor import BlockwiseExecutor, validate_labels
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import (
     Blocking,
@@ -228,7 +228,8 @@ class WatershedBase(_WsTaskBase):
                 if sum(blocking.block_grid_position(b)) % 2 == int(parity)
             ]
         done = set(self.blocks_done())
-        todo = [blocking.get_block(b, halo) for b in block_ids if b not in done]
+        blocks_all = [blocking.get_block(b, halo) for b in block_ids]
+        todo = [b for b in blocks_all if b.block_id not in done]
         outer = _outer_shape(block_shape, halo)
         n_outer = int(np.prod(outer))
         kp = self._kernel_params(cfg)
@@ -285,13 +286,13 @@ class WatershedBase(_WsTaskBase):
                 )
             return lab, ovf
 
-        overflow_blocks = []
+        overflow_blocks = set()
 
         def store(block, raw):
             lab, ovf = raw
             if bool(np.asarray(ovf)):
                 # capacity-truncated labels are under-merged — record loudly
-                overflow_blocks.append(block.block_id)
+                overflow_blocks.add(block.block_id)
                 self.logger.warning(
                     f"block {block.block_id} overflowed a tiled-watershed "
                     "capacity; labels may be under-merged (raise the caps "
@@ -299,10 +300,12 @@ class WatershedBase(_WsTaskBase):
                 )
             lab = np.asarray(lab)
             if agg_thr is not None:
+                # peek, don't pop: a store retry must find the stash intact
                 lab = self._agglomerate_block(
-                    lab, bnd_stash.pop(block.block_id), float(agg_thr)
+                    lab, bnd_stash[block.block_id], float(agg_thr)
                 )
             self._store_labels(out, block, lab, n_outer)
+            bnd_stash.pop(block.block_id, None)
 
         if impl == "host":
             # reference-style per-job scipy compute (ops/host.py): no
@@ -351,18 +354,24 @@ class WatershedBase(_WsTaskBase):
                 target=self.target,
                 device_batch=int(cfg.get("device_batch", 1)),
                 io_threads=max(1, self.max_jobs),
+                max_retries=int(cfg.get("io_retries", 2)),
+                backoff_base=float(cfg.get("io_backoff_s", 0.05)),
             )
             executor.map_blocks(
                 kernel,
-                todo,
+                blocks_all,
                 load,
                 store,
                 on_block_done=lambda b: self.log_block_success(b.block_id),
+                done_block_ids=done,
+                validate_fn=validate_labels,
+                failures_path=self.failures_path,
+                task_name=self.uid,
             )
         return {
             "n_blocks": len(block_ids),
             "n_outer": n_outer,
-            "overflow_blocks": overflow_blocks,
+            "overflow_blocks": sorted(overflow_blocks),
         }
 
 
@@ -420,7 +429,7 @@ class TwoPassWatershedBase(_WsTaskBase):
             if sum(blocking.block_grid_position(b)) % 2 == 1
         ]
         done = set(self.blocks_done())
-        todo = [blocking.get_block(b, halo) for b in block_ids if b not in done]
+        blocks_all = [blocking.get_block(b, halo) for b in block_ids]
         outer = _outer_shape(block_shape, halo)
         n_outer = int(np.prod(outer))
         kp = self._kernel_params(cfg)
@@ -492,7 +501,7 @@ class TwoPassWatershedBase(_WsTaskBase):
                 )
             return lab, ovf
 
-        overflow_blocks = []
+        overflow_blocks = set()
 
         def store(block, raw):
             raw, ovf = raw
@@ -500,14 +509,15 @@ class TwoPassWatershedBase(_WsTaskBase):
                 # same contract as the single-pass store: capacity
                 # truncation means under-merged labels — never silent,
                 # and recorded so the blocks can be rerun programmatically
-                overflow_blocks.append(block.block_id)
+                overflow_blocks.add(block.block_id)
                 self.logger.warning(
                     f"block {block.block_id} overflowed a tiled-watershed "
                     "capacity; labels may be under-merged (raise the caps "
                     "or use impl=legacy)"
                 )
             raw = np.asarray(raw)[block.inner_in_outer_bb]
-            ext_labels = tables.pop(block.block_id)
+            # peek, don't pop: a store retry must find the table intact
+            ext_labels = tables[block.block_id]
             is_ext = raw > n_outer
             glob = np.zeros(raw.shape, np.uint64)
             if is_ext.any():
@@ -519,23 +529,30 @@ class TwoPassWatershedBase(_WsTaskBase):
                 new
             ].astype(np.uint64)
             out[block.bb] = glob
+            tables.pop(block.block_id, None)
 
         executor = BlockwiseExecutor(
             target=self.target,
             device_batch=int(cfg.get("device_batch", 1)),
             io_threads=max(1, self.max_jobs),
+            max_retries=int(cfg.get("io_retries", 2)),
+            backoff_base=float(cfg.get("io_backoff_s", 0.05)),
         )
         executor.map_blocks(
             kernel,
-            todo,
+            blocks_all,
             load,
             store,
             on_block_done=lambda b: self.log_block_success(b.block_id),
+            done_block_ids=done,
+            validate_fn=validate_labels,
+            failures_path=self.failures_path,
+            task_name=self.uid,
         )
         return {
             "n_blocks": len(block_ids),
             "n_outer": n_outer,
-            "overflow_blocks": overflow_blocks,
+            "overflow_blocks": sorted(overflow_blocks),
         }
 
 

@@ -45,6 +45,74 @@ def log(msg: str, log_file: Optional[str] = None):
     get_logger(log_file=log_file).info(msg)
 
 
+def atomic_write_json(path: str, doc, default=None) -> None:
+    """Write JSON via a temp file + ``os.replace`` so readers never observe
+    a torn document — a kill mid-write leaves the old file (or nothing),
+    never half a manifest."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, default=default)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_json_if_valid(path: str):
+    """Parse a JSON file; return None for missing or torn (unparseable)
+    files — torn manifests are treated as not-done, never as fatal."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def backoff_delay(attempt: int, base: float, cap: float) -> float:
+    """Capped exponential backoff with full jitter (0.5x-1x): the one
+    retry-delay policy shared by the executor's per-block IO retries, the
+    scheduler submit retries, and the task-level re-runs — jitter keeps N
+    workers recovering from a shared outage from thundering-herd retrying
+    at the same instant."""
+    import random
+
+    return min(cap, base * (2 ** attempt)) * (0.5 + 0.5 * random.random())
+
+
+def cap_traceback(tb: str, max_chars: int = 2000) -> str:
+    """Tail-capped traceback (the last lines carry the error) so failure
+    manifests aggregating hundreds of blocks stay bounded."""
+    if len(tb) <= max_chars:
+        return tb
+    return "... [truncated] ...\n" + tb[-max_chars:]
+
+
+def failures_path(tmp_folder: str) -> str:
+    """The per-run structured failure manifest (shared by all tasks)."""
+    return os.path.join(tmp_folder, "failures.json")
+
+
+def record_failures(path: str, task_name: str, records) -> None:
+    """Merge block-failure records into ``failures.json`` (atomic).
+
+    Schema: ``{"version": 1, "records": [{"task", "block_id",
+    "sites": {site: attempts}, "error", "quarantined", "resolved"}]}``.
+    Records are keyed by (task, block_id): a resumed run's record replaces
+    the stale one from before the restart.
+    """
+    doc = read_json_if_valid(path) or {}
+    existing = {
+        (r.get("task"), r.get("block_id")): r for r in doc.get("records", [])
+    }
+    for rec in records:
+        rec = dict(rec)
+        rec["task"] = task_name
+        existing[(task_name, rec.get("block_id"))] = rec
+    merged = sorted(
+        existing.values(), key=lambda r: (str(r.get("task")), r.get("block_id") or 0)
+    )
+    atomic_write_json(path, {"version": 1, "records": merged})
+
+
 def _marker_dir(tmp_folder: str, task_name: str) -> str:
     d = os.path.join(tmp_folder, "markers", task_name)
     os.makedirs(d, exist_ok=True)
@@ -52,24 +120,34 @@ def _marker_dir(tmp_folder: str, task_name: str) -> str:
 
 
 def log_block_success(tmp_folder: str, task_name: str, block_id: int):
-    """Record that one block of a task finished (block-level resume grain)."""
+    """Record that one block of a task finished (block-level resume grain).
+    Atomic: a kill mid-write must not leave a torn marker that a resumed
+    run would count as done."""
     path = os.path.join(_marker_dir(tmp_folder, task_name), f"block_{block_id}.json")
-    with open(path, "w") as f:
-        json.dump({"block_id": block_id, "time": _now()}, f)
+    atomic_write_json(path, {"block_id": block_id, "time": _now()})
 
 
 def log_job_success(tmp_folder: str, task_name: str, job_id: int):
     path = os.path.join(_marker_dir(tmp_folder, task_name), f"job_{job_id}.json")
-    with open(path, "w") as f:
-        json.dump({"job_id": job_id, "time": _now()}, f)
+    atomic_write_json(path, {"job_id": job_id, "time": _now()})
 
 
 def blocks_done(tmp_folder: str, task_name: str) -> List[int]:
+    """Block ids with a *valid* success marker.  Torn markers (partial
+    writes from a kill predating atomic markers, or filesystem damage) are
+    pruned and reported as not-done so the block re-runs."""
     d = _marker_dir(tmp_folder, task_name)
     out = []
     for fname in os.listdir(d):
         if fname.startswith("block_") and fname.endswith(".json"):
-            out.append(int(fname[len("block_"):-len(".json")]))
+            block_id = int(fname[len("block_"):-len(".json")])
+            if read_json_if_valid(os.path.join(d, fname)) is None:
+                try:
+                    os.remove(os.path.join(d, fname))
+                except OSError:
+                    pass
+                continue
+            out.append(block_id)
     return sorted(out)
 
 

@@ -28,6 +28,27 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection chaos tests (run via `make chaos`; also "
+        "marked slow so tier-1 skips them)",
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture
+def inject():
+    """Install a fault-injection config for the duration of one test and
+    restore the (disabled) env-driven injector afterwards."""
+    from cluster_tools_tpu.runtime import faults
+
+    yield faults.configure
+    faults.reset()

@@ -1,0 +1,151 @@
+"""End-to-end chaos test (ISSUE 2 acceptance): the watershed -> graph ->
+multicut workflow under seeded fault injection — transient load errors,
+persistent store errors, a NaN-producing kernel, plus mid-run kills at both
+the block grain and the task grain — must complete on resume and produce a
+final segmentation BIT-IDENTICAL to a fault-free run, with every
+quarantined block recorded in ``failures.json``.
+
+Excluded from tier-1 via the markers; run with ``make chaos`` (fixed seed,
+overridable via ``CTT_CHAOS_SEED``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime.faults import KILL_EXIT_CODE
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+from .test_multicut_workflow import make_case, _write_ds
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+SEED = int(os.environ.get("CTT_CHAOS_SEED", 7))
+DRIVER = os.path.join(os.path.dirname(__file__), "chaos_driver.py")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_driver(spec_path, faults_cfg=None, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if faults_cfg is not None:
+        env["CTT_FAULTS"] = json.dumps(faults_cfg)
+    else:
+        env.pop("CTT_FAULTS", None)
+    proc = subprocess.run(
+        [sys.executable, DRIVER, spec_path],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    return proc
+
+
+def _workspace(root, name, bmap):
+    """Per-run directories + data + workflow spec (identical inputs)."""
+    base = os.path.join(root, name)
+    tmp_folder = os.path.join(base, "tmp")
+    config_dir = os.path.join(base, "config")
+    os.makedirs(config_dir, exist_ok=True)
+    with open(os.path.join(config_dir, "global.config"), "w") as f:
+        json.dump({"block_shape": [8, 8, 8]}, f)
+    path = os.path.join(base, "data.zarr")
+    _write_ds(path, "bmap", bmap)
+    spec = dict(
+        tmp_folder=tmp_folder,
+        config_dir=config_dir,
+        max_jobs=4,
+        target="local",
+        input_path=path,
+        input_key="bmap",
+        ws_path=path,
+        ws_key="ws",
+        output_path=path,
+        output_key="seg",
+        threshold=0.5,
+        halo=[2, 2, 2],
+        beta=0.5,
+    )
+    spec_path = os.path.join(base, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f, indent=2)
+    return spec_path, path, tmp_folder
+
+
+def test_chaos_workflow_survives_faults_and_kills(tmp_path):
+    root = str(tmp_path)
+    _, _, bmap = make_case(noise=0.02, seed=SEED)
+
+    # -- reference: fault-free run ----------------------------------------
+    ref_spec, ref_path, _ = _workspace(root, "ref", bmap)
+    proc = _run_driver(ref_spec)
+    assert proc.returncode == 0, f"fault-free run failed:\n{proc.stderr[-4000:]}"
+    ref = file_reader(ref_path, "r")
+    ref_ws, ref_seg = ref["ws"][...], ref["seg"][...]
+
+    # -- chaos run: >=3 fault classes + kills at block and task grain ------
+    chaos_spec, chaos_path, tmp_folder = _workspace(root, "chaos", bmap)
+    state_dir = os.path.join(root, "chaos", "fault_state")
+    faults_cfg = {
+        "seed": SEED,
+        "state_dir": state_dir,
+        "faults": [
+            # transient load error: watershed block 1 fails its first read
+            {"site": "load", "kind": "error", "blocks": [1],
+             "fail_attempts": 1},
+            # persistent store error: block 2 exhausts the in-batch retry
+            # budget (3 tries) and only succeeds via quarantine re-attempts
+            {"site": "store", "kind": "error", "blocks": [2],
+             "fail_attempts": 4},
+            # NaN-producing kernel on block 3: caught by validation,
+            # recomputed clean in the quarantine pass
+            {"site": "kernel", "kind": "nan", "blocks": [3],
+             "fail_attempts": 1},
+            # preemption mid-watershed (block grain) ...
+            {"site": "block_done", "kind": "kill", "after": 3},
+            # ... and preemption between tasks (task grain) on the resume
+            {"site": "task_done", "kind": "kill", "after": 3},
+        ],
+    }
+    kills = 0
+    for _ in range(6):
+        proc = _run_driver(chaos_spec, faults_cfg)
+        if proc.returncode == 0:
+            break
+        assert proc.returncode == KILL_EXIT_CODE, (
+            f"chaos run died with rc={proc.returncode}, expected injected "
+            f"kill ({KILL_EXIT_CODE}):\n{proc.stderr[-4000:]}"
+        )
+        kills += 1
+    assert proc.returncode == 0, "chaos run never completed after resumes"
+    assert kills == 2, f"expected exactly 2 injected kills, got {kills}"
+
+    # -- the acceptance bar: bit-identical final (and intermediate) labels -
+    chaos = file_reader(chaos_path, "r")
+    np.testing.assert_array_equal(chaos["ws"][...], ref_ws)
+    np.testing.assert_array_equal(chaos["seg"][...], ref_seg)
+
+    # -- failures.json: every quarantined block, with attempt counts -------
+    with open(os.path.join(tmp_folder, "failures.json")) as f:
+        doc = json.load(f)
+    ws_recs = {
+        r["block_id"]: r
+        for r in doc["records"]
+        if r["task"].startswith("watershed")
+    }
+    assert {2, 3} <= set(ws_recs), f"missing quarantine records: {ws_recs}"
+    store_rec = ws_recs[2]
+    assert store_rec["quarantined"] and store_rec["resolved"]
+    assert store_rec["sites"].get("store", 0) >= 4
+    nan_rec = ws_recs[3]
+    assert nan_rec["quarantined"] and nan_rec["resolved"]
+    assert nan_rec["sites"].get("validate", 0) >= 1
+    assert "label" in (nan_rec["error"] or "") or "finite" in (
+        nan_rec["error"] or ""
+    )

@@ -1,0 +1,440 @@
+"""Fault-tolerance unit tests: injector determinism, retry/backoff,
+quarantine, atomic manifests/markers (torn-JSON resume), task-level retries,
+DAG branch continuation, scheduler submit retries, and the ``failures.json``
+schema (docs/ROBUSTNESS.md)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime import faults
+from cluster_tools_tpu.runtime.executor import BlockwiseExecutor
+from cluster_tools_tpu.runtime.faults import FaultInjector, InjectedFault
+from cluster_tools_tpu.runtime.task import BaseTask, SuccessTarget, build
+from cluster_tools_tpu.utils import function_utils as fu
+from cluster_tools_tpu.utils.volume_utils import Blocking
+
+
+# -- injector ----------------------------------------------------------------
+
+
+def test_injector_disabled_is_noop():
+    inj = FaultInjector({})
+    assert not inj.enabled
+    inj.maybe_fail("load", 0)
+    assert inj.corrupt("kernel", 0, (np.ones(2),))[0].sum() == 2
+    inj.kill_point("block_done")
+
+
+def test_injector_attempt_gating():
+    inj = FaultInjector(
+        {"faults": [{"site": "load", "kind": "error", "blocks": [3],
+                     "fail_attempts": 2}]}
+    )
+    # other blocks and sites never fail
+    inj.maybe_fail("load", 1)
+    inj.maybe_fail("store", 3)
+    # block 3 fails exactly its first two load attempts
+    with pytest.raises(InjectedFault):
+        inj.maybe_fail("load", 3)
+    with pytest.raises(InjectedFault):
+        inj.maybe_fail("load", 3)
+    inj.maybe_fail("load", 3)  # third attempt passes
+
+
+def test_injector_rate_deterministic():
+    cfg = {"seed": 11, "faults": [{"site": "io_read", "kind": "error",
+                                   "rate": 0.5, "fail_attempts": 10**6}]}
+
+    def pattern():
+        inj = FaultInjector(cfg)
+        out = []
+        for b in range(32):
+            try:
+                inj.maybe_fail("io_read", b)
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    first, second = pattern(), pattern()
+    assert first == second
+    assert 0 < sum(first) < 32  # actually mixed at rate 0.5
+
+
+def test_injector_corrupt_poisons_leaves():
+    inj = FaultInjector(
+        {"faults": [{"site": "kernel", "kind": "nan", "blocks": [2]}]}
+    )
+    f = np.ones((3,), np.float32)
+    i = np.ones((3,), np.int32)
+    u = np.ones((3,), np.uint64)
+    pf, pi, pu = inj.corrupt("kernel", 2, (f, i, u))
+    assert np.isnan(pf).all()
+    assert (pi == np.iinfo(np.int32).min).all()
+    assert (pu == np.iinfo(np.uint64).max).all()
+    # only the first attempt is corrupted; and other blocks never are
+    cf, _, _ = inj.corrupt("kernel", 2, (f, i, u))
+    assert np.isfinite(cf).all()
+    cf, _, _ = inj.corrupt("kernel", 0, (f, i, u))
+    assert np.isfinite(cf).all()
+
+
+def test_kill_fault_requires_state_dir():
+    with pytest.raises(ValueError, match="state_dir"):
+        FaultInjector(
+            {"faults": [{"site": "block_done", "kind": "kill", "after": 1}]}
+        )
+
+
+# -- atomic manifests and markers --------------------------------------------
+
+
+def test_torn_success_manifest_is_not_done(tmp_path):
+    t = SuccessTarget(str(tmp_path), "torn_task")
+    t.write({"n": 1})
+    assert t.exists() and t.read()["n"] == 1
+    # simulate a kill mid-write before manifests were atomic
+    with open(t.path, "w") as f:
+        f.write('{"time": 12345.0, "n":')
+    assert not t.exists()
+    with pytest.raises(FileNotFoundError, match="torn"):
+        t.read()
+
+
+def test_torn_block_marker_is_not_done(tmp_path):
+    folder = str(tmp_path)
+    fu.log_block_success(folder, "t", 1)
+    fu.log_block_success(folder, "t", 2)
+    assert fu.blocks_done(folder, "t") == [1, 2]
+    marker = os.path.join(folder, "markers", "t", "block_2.json")
+    with open(marker, "w") as f:
+        f.write('{"block_id": 2, "ti')
+    # torn marker -> not done, and pruned so the re-run rewrites it
+    assert fu.blocks_done(folder, "t") == [1]
+    assert not os.path.exists(marker)
+
+
+def test_record_failures_merges_by_task_and_block(tmp_path):
+    path = str(tmp_path / "failures.json")
+    fu.record_failures(path, "a", [{"block_id": 1, "resolved": False}])
+    fu.record_failures(path, "b", [{"block_id": 1, "resolved": False}])
+    fu.record_failures(path, "a", [{"block_id": 1, "resolved": True}])
+    doc = json.load(open(path))
+    recs = {(r["task"], r["block_id"]): r for r in doc["records"]}
+    assert len(recs) == 2
+    assert recs[("a", 1)]["resolved"] is True  # resumed record replaced stale
+
+
+def test_cap_traceback():
+    tb = "x" * 10000
+    capped = fu.cap_traceback(tb, max_chars=100)
+    assert len(capped) < 150 and capped.startswith("... [truncated]")
+
+
+# -- executor retries / quarantine -------------------------------------------
+
+
+def _run_executor(inject_cfg, store_faults=None, n_blocks_axis=16,
+                  failures_path=None, **map_kw):
+    """Shared harness: x+1 over an 8-block float volume, dict-backed IO."""
+    if inject_cfg is not None:
+        faults.configure(inject_cfg)
+    shape, bshape = (n_blocks_axis, 8, 8), (8, 8, 8)
+    data = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    out = np.zeros(shape, np.float32)
+    blocking = Blocking(shape, bshape)
+    blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
+    ex = BlockwiseExecutor(target="local", backoff_base=1e-4)
+
+    def load(b):
+        return (data[b.bb],)
+
+    def store(b, raw):
+        out[b.bb] = np.asarray(raw)
+
+    summary = ex.map_blocks(
+        lambda x: x + 1, blocks, load, store,
+        failures_path=failures_path, task_name="unit", **map_kw
+    )
+    return out, data, summary
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    yield
+    faults.reset()
+
+
+def test_executor_transient_load_retry(tmp_path):
+    fp = str(tmp_path / "failures.json")
+    cfg = {"faults": [{"site": "load", "kind": "error", "blocks": [1],
+                       "fail_attempts": 1}]}
+    out, data, summary = _run_executor(cfg, failures_path=fp)
+    np.testing.assert_array_equal(out, data + 1)
+    assert summary == {"n_blocks": 2, "n_quarantined": 0, "n_failed": 0}
+    rec = json.load(open(fp))["records"][0]
+    assert rec["block_id"] == 1 and rec["resolved"] and not rec["quarantined"]
+    assert rec["sites"]["load"] >= 1
+
+
+def test_executor_persistent_store_quarantine_recovers(tmp_path):
+    fp = str(tmp_path / "failures.json")
+    # fails 4 attempts: exhausts the main pass (3 tries), recovers on the
+    # end-of-run quarantine re-attempt
+    cfg = {"faults": [{"site": "store", "kind": "error", "blocks": [0],
+                       "fail_attempts": 4}]}
+    out, data, summary = _run_executor(cfg, failures_path=fp)
+    np.testing.assert_array_equal(out, data + 1)
+    assert summary["n_quarantined"] == 1 and summary["n_failed"] == 0
+    rec = json.load(open(fp))["records"][0]
+    assert rec["block_id"] == 0 and rec["quarantined"] and rec["resolved"]
+    assert rec["sites"]["store"] >= 4
+
+
+def test_executor_kernel_nan_quarantine_recovers(tmp_path):
+    fp = str(tmp_path / "failures.json")
+    cfg = {"faults": [{"site": "kernel", "kind": "nan", "blocks": [1]}]}
+    out, data, summary = _run_executor(cfg, failures_path=fp)
+    # the corrupted first compute was caught by validation, never stored
+    np.testing.assert_array_equal(out, data + 1)
+    assert summary["n_quarantined"] == 1
+    rec = json.load(open(fp))["records"][0]
+    assert rec["quarantined"] and rec["resolved"]
+    assert "validate" in rec["sites"]
+    assert "non-finite" in rec["error"]
+
+
+def test_executor_permanent_failure_raises_with_block_ids(tmp_path):
+    fp = str(tmp_path / "failures.json")
+    cfg = {"faults": [{"site": "store", "kind": "error", "blocks": [1],
+                       "fail_attempts": 10**6}]}
+    with pytest.raises(RuntimeError, match=r"ids: \[1\]"):
+        _run_executor(cfg, failures_path=fp)
+    rec = json.load(open(fp))["records"][0]
+    assert rec["block_id"] == 1 and rec["quarantined"] and not rec["resolved"]
+
+
+def test_executor_done_block_ids_resume_filter():
+    marker = np.zeros(2, np.int64)
+    shape, bshape = (16, 8, 8), (8, 8, 8)
+    data = np.zeros(shape, np.float32)
+    blocking = Blocking(shape, bshape)
+    blocks = [blocking.get_block(i) for i in range(2)]
+    ex = BlockwiseExecutor(target="local")
+    summary = ex.map_blocks(
+        lambda x: x,
+        blocks,
+        lambda b: (data[b.bb],),
+        lambda b, raw: None,
+        on_block_done=lambda b: marker.__setitem__(b.block_id, 1),
+        done_block_ids=[0],
+    )
+    assert summary["n_blocks"] == 1
+    assert marker.tolist() == [0, 1]  # block 0 skipped, block 1 ran
+
+
+def test_executor_validate_fn_hook(tmp_path):
+    calls = []
+
+    def veto_block0(block, out):
+        calls.append(block.block_id)
+        return "vetoed" if block.block_id == 0 and len(calls) <= 1 else None
+
+    out, data, summary = _run_executor(None, validate_fn=veto_block0)
+    np.testing.assert_array_equal(out, data + 1)
+    assert summary["n_quarantined"] == 1
+
+
+def test_container_io_injection_recovered_by_load_retries(tmp_path):
+    from cluster_tools_tpu.utils.volume_utils import file_reader
+
+    path = os.path.join(str(tmp_path), "io.zarr")
+    f = file_reader(path)
+    data = np.random.default_rng(0).random((16, 8, 8)).astype(np.float32)
+    ds = f.create_dataset("x", shape=data.shape, chunks=(8, 8, 8),
+                          dtype="float32")
+    ds[...] = data
+    out_ds = f.create_dataset("y", shape=data.shape, chunks=(8, 8, 8),
+                              dtype="float32")
+    # first two storage reads fail (scheduler/NFS hiccup model); the
+    # executor's load retries absorb them
+    faults.configure(
+        {"faults": [{"site": "io_read", "kind": "error", "fail_attempts": 2}]}
+    )
+    blocking = Blocking(data.shape, (8, 8, 8))
+    blocks = [blocking.get_block(i) for i in range(2)]
+    ex = BlockwiseExecutor(target="local", backoff_base=1e-4)
+    ex.map_blocks(
+        lambda x: x * 2, blocks,
+        lambda b: (ds[b.bb],),
+        lambda b, raw: out_ds.__setitem__(b.bb, np.asarray(raw)),
+    )
+    np.testing.assert_allclose(out_ds[...], data * 2)
+
+
+# -- task runtime ------------------------------------------------------------
+
+
+class _FlakyTask(BaseTask):
+    """Fails until a countdown file hits zero (crash-count persisted on
+    disk, like a real flaky dependency)."""
+
+    task_name = "flaky"
+
+    def run_impl(self):
+        count_file = os.path.join(self.tmp_folder, "flaky_count")
+        n = int(open(count_file).read()) if os.path.exists(count_file) else \
+            int(self.params["fail_times"])
+        if n > 0:
+            with open(count_file, "w") as f:
+                f.write(str(n - 1))
+            raise RuntimeError("flaky failure")
+        return {"ok": True}
+
+
+class _OkTask(BaseTask):
+    task_name = "ok"
+
+    def run_impl(self):
+        return {}
+
+
+class _AlwaysFails(BaseTask):
+    task_name = "always_fails"
+
+    def run_impl(self):
+        raise RuntimeError("doomed")
+
+
+class _Dependent(BaseTask):
+    task_name = "dependent"
+
+    def run_impl(self):
+        return {}
+
+
+def test_build_task_level_retries(tmp_path):
+    t = _FlakyTask(str(tmp_path / "tmp"), "", fail_times=2,
+                   max_retries=2, retry_backoff_s=0.01)
+    assert build([t])
+    assert t.output().exists()
+    # job-level markers were cleared between attempts
+    assert fu.jobs_done(t.tmp_folder, t.uid) == []
+
+
+def test_build_retries_exhausted_fails(tmp_path):
+    t = _FlakyTask(str(tmp_path / "tmp"), "", fail_times=5,
+                   max_retries=1, retry_backoff_s=0.01)
+    assert not build([t])
+    assert not t.output().exists()
+
+
+def test_build_independent_branches_continue(tmp_path):
+    folder = str(tmp_path / "tmp")
+    bad = _AlwaysFails(folder, "")
+    dependent = _Dependent(folder, "", dependencies=[bad])
+    ok = _OkTask(folder, "")
+    assert not build([dependent, ok])
+    # the independent branch completed despite the failed one
+    assert ok.output().exists()
+    # the dependent task was skipped, not run
+    assert not dependent.output().exists()
+
+
+def test_build_completed_task_survives_failed_upstream(tmp_path):
+    """luigi semantics: a task whose target already exists is DONE even if
+    an upstream re-check fails now — its own dependents must still run."""
+    folder = str(tmp_path / "tmp")
+    mid = _OkTask(folder, "")
+    assert build([mid])  # mid's manifest now exists
+    bad = _AlwaysFails(folder, "")
+    mid_again = _OkTask(folder, "", dependencies=[bad])
+    leaf = _Dependent(folder, "", dependencies=[mid_again])
+    assert not build([leaf])  # bad still fails the DAG overall ...
+    assert leaf.output().exists()  # ... but leaf ran off mid's manifest
+
+
+def test_host_block_map_records_failures_capped(tmp_path):
+    class T(BaseTask):
+        task_name = "hostmap"
+
+        def run_impl(self):
+            def process(block_id):
+                if block_id in (2, 4):
+                    raise ValueError("boom " + "y" * 10000)
+
+            self.host_block_map(range(6), process)
+
+    t = T(str(tmp_path / "tmp"), "", max_jobs=2)
+    with pytest.raises(RuntimeError, match=r"\[2, 4\]"):
+        t.run()
+    doc = json.load(open(t.failures_path))
+    recs = {r["block_id"]: r for r in doc["records"]}
+    assert set(recs) == {2, 4}
+    for r in recs.values():
+        assert r["sites"] == {"host": 1} and not r["resolved"]
+        assert len(r["error"]) < 2200  # capped traceback
+    # successful blocks got markers; failed ones did not
+    assert t.blocks_done() == [0, 1, 3, 5]
+
+
+# -- scheduler submit retries ------------------------------------------------
+
+
+def test_submit_with_retries_transient(tmp_path):
+    from cluster_tools_tpu.runtime.cluster import (
+        ClusterSubmitter,
+        submit_with_retries,
+    )
+
+    class Flaky(ClusterSubmitter):
+        flavor = "test"
+
+        def __init__(self):
+            self.calls = 0
+
+        def submit(self, script_path, job_name, out_path, cfg):
+            self.calls += 1
+            if self.calls <= 2:
+                raise RuntimeError("sbatch: Socket timed out")
+            return "42"
+
+    s = Flaky()
+    jid = submit_with_retries(
+        s, "/x.sh", "j", "/x.out",
+        {"submit_retries": 3, "submit_backoff_s": 0.001},
+    )
+    assert jid == "42" and s.calls == 3
+
+    s = Flaky()
+    with pytest.raises(RuntimeError, match="Socket timed out"):
+        submit_with_retries(
+            s, "/x.sh", "j", "/x.out",
+            {"submit_retries": 1, "submit_backoff_s": 0.001},
+        )
+    assert s.calls == 2
+
+
+def test_submit_retry_absorbs_injected_outage(inject):
+    from cluster_tools_tpu.runtime.cluster import (
+        ClusterSubmitter,
+        submit_with_retries,
+    )
+
+    inject({"faults": [{"site": "submit", "kind": "error",
+                        "fail_attempts": 2}]})
+
+    class Ok(ClusterSubmitter):
+        flavor = "test"
+
+        def submit(self, script_path, job_name, out_path, cfg):
+            return "7"
+
+    jid = submit_with_retries(
+        Ok(), "/x.sh", "j", "/x.out",
+        {"submit_retries": 3, "submit_backoff_s": 0.001},
+    )
+    assert jid == "7"
