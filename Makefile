@@ -1,10 +1,14 @@
 # Developer entry points.  `test` = tier-1 (fast, chaos excluded via the
 # slow marker) followed by the chaos suite; `chaos` = the fault-injection
-# suite alone, fixed seed (docs/ROBUSTNESS.md).
+# suite alone, fixed seed — kills/resume plus the silent-failure scenarios
+# (hang, chunk corruption, job loss) from ISSUE 3; `supervise-demo` = a
+# smoke-check recipe that runs a watershed workflow on the stub-slurm
+# cluster target under an injected job loss and prints the supervisor's
+# resubmission log (docs/ROBUSTNESS.md).
 PY ?= python
 CTT_CHAOS_SEED ?= 7
 
-.PHONY: test tier1 chaos native clean
+.PHONY: test tier1 chaos supervise-demo native clean
 
 test: tier1 chaos
 
@@ -15,6 +19,9 @@ tier1:
 chaos:
 	JAX_PLATFORMS=cpu CTT_CHAOS_SEED=$(CTT_CHAOS_SEED) \
 		$(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+supervise-demo:
+	JAX_PLATFORMS=cpu $(PY) scripts/supervise_demo.py
 
 native:
 	$(MAKE) -C native

@@ -1,1 +1,8 @@
-from .containers import open_container, ZarrContainer, H5Container, MemoryContainer
+from .containers import (
+    ChunkCorruptionError,
+    H5Container,
+    MemoryContainer,
+    ZarrContainer,
+    checksums_enabled,
+    open_container,
+)

@@ -15,6 +15,20 @@ Datasets are addressed by key (group paths like ``volumes/raw`` work).
 ``read_async``/``write_async`` return storage-level futures, consumed by the
 bounded-window pipelines in :mod:`cluster_tools_tpu.io.prefetch` and by
 ``BlockwiseExecutor``'s batch assembly.
+
+Data integrity (docs/ROBUSTNESS.md "Silent failures"): every stored block
+region gets a CRC32 digest *sidecar* (``<dataset>/.ctt_checksums/`` for
+zarr/N5, in-memory for ``memory://``), written after the data lands.  Reads
+whose bounding box exactly matches a recorded region are verified against
+the digest; a mismatch raises the typed :class:`ChunkCorruptionError`, which
+the executor treats as a retriable-then-repairable fault (re-store, or
+recompute the owning block through the same compiled kernel).  Writes that
+overlap a recorded region invalidate its stale digest.  The async
+``read_async``/``write_async`` paths verify/record on ``.result()`` — the
+same sites and accounting as the synchronous paths, so prefetched IO is not
+a hole in the fault model.  ``CTT_CHECKSUMS=0`` disables the whole layer
+(HDF5 never has it: a single shared file has no place for per-region
+sidecars).
 """
 
 from __future__ import annotations
@@ -22,6 +36,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import zlib
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -43,17 +58,271 @@ _H5_EXTS = (".h5", ".hdf5", ".hdf")
 _faults_mod = None
 
 
-def _inject(site: str) -> None:
-    """Fault-injection hook for the container IO layer (sites ``io_read`` /
-    ``io_write``; see runtime/faults.py).  A no-op unless an injector is
-    configured — chaos tests exercise the executor's load/store retries
-    against storage-level failures through this."""
+def _faults():
     global _faults_mod
     if _faults_mod is None:
         from ..runtime import faults as _fm
 
         _faults_mod = _fm
-    _faults_mod.get_injector().maybe_fail(site)
+    return _faults_mod
+
+
+def _inject(site: str) -> Optional[int]:
+    """Fault-injection hook for the container IO layer (sites ``io_read`` /
+    ``io_write``; see runtime/faults.py).  A no-op unless an injector is
+    configured — chaos tests exercise the executor's load/store retries
+    against storage-level failures through this.  The block id is inherited
+    from the executor's thread-local :func:`~...runtime.faults.block_context`
+    and returned so async completions can reuse it."""
+    fm = _faults()
+    block_id = fm.current_block_id()
+    fm.get_injector().maybe_fail(site, block_id)
+    return block_id
+
+
+def _hang(site: str, block_id: Optional[int]) -> None:
+    _faults().get_injector().maybe_hang(site, block_id)
+
+
+def checksums_enabled() -> bool:
+    """Digest sidecars on stored regions (default on); ``CTT_CHECKSUMS=0``
+    is the kill switch for workloads where the extra sidecar IO hurts."""
+    return os.environ.get("CTT_CHECKSUMS", "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+class ChunkCorruptionError(RuntimeError):
+    """A stored region's bytes no longer match its digest sidecar: the data
+    was corrupted *on storage* after a successful write (bit rot, torn
+    chunk, misbehaving storage layer).  The executor treats this as
+    retriable (re-read), then repairable (re-store / recompute the owning
+    block through the same compiled kernel)."""
+
+    def __init__(self, label: str, region, expected, actual):
+        self.label = label
+        self.region = tuple(region)
+        self.expected = expected
+        self.actual = actual
+        super().__init__(
+            f"chunk corruption in {label} region "
+            + "x".join(f"[{a}:{b}]" for a, b in self.region)
+            + f": stored digest {expected}, read digest {actual}"
+        )
+
+
+def _norm_region(bb, shape) -> Optional[Tuple[Tuple[int, int], ...]]:
+    """Resolve a numpy-style index to ``((start, stop), ...)`` per axis, or
+    None when it is not a plain step-1 slice box (fancy/int indexing has no
+    region identity to checksum)."""
+    if bb is Ellipsis:
+        return tuple((0, int(s)) for s in shape)
+    if isinstance(bb, slice):
+        bb = (bb,)
+    if not isinstance(bb, tuple):
+        return None
+    if any(b is Ellipsis for b in bb):
+        i = next(j for j, b in enumerate(bb) if b is Ellipsis)
+        bb = bb[:i] + (slice(None),) * (len(shape) - len(bb) + 1) + bb[i + 1:]
+    if len(bb) < len(shape):
+        bb = bb + (slice(None),) * (len(shape) - len(bb))
+    if len(bb) != len(shape):
+        return None
+    out = []
+    for sl, s in zip(bb, shape):
+        if not isinstance(sl, slice) or sl.step not in (None, 1):
+            return None
+        start, stop, _ = sl.indices(int(s))
+        out.append((int(start), max(int(start), int(stop))))
+    return tuple(out)
+
+
+def _region_shape(region) -> Tuple[int, ...]:
+    return tuple(b - a for a, b in region)
+
+
+def _regions_overlap(r1, r2) -> bool:
+    return len(r1) == len(r2) and all(
+        a1 < b2 and a2 < b1 for (a1, b1), (a2, b2) in zip(r1, r2)
+    )
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+class _ChecksumIndex:
+    """Digest sidecars for stored regions: one tiny JSON per region under
+    ``<dataset>/.ctt_checksums/`` (filesystem containers) or an in-memory
+    dict (``memory://``).  Per-region files keep parallel block writers
+    conflict-free — the same reason block writes must tile whole chunks.
+
+    The set of on-disk region keys is cached per index (seeded by ONE
+    ``listdir`` on first write, then maintained incrementally), so the
+    overlap-invalidation scan is an in-memory set walk instead of a
+    directory listing per block write — a run storing N blocks would
+    otherwise pay O(N^2) filesystem work.  Regions recorded by *other*
+    handles after seeding are invisible to the scan; that only matters for
+    concurrently-overlapping writers, which the chunk-alignment contract
+    already forbids."""
+
+    def __init__(self, dirpath: Optional[str] = None):
+        self._dir = dirpath
+        self._mem: Optional[Dict] = {} if dirpath is None else None
+        self._fs_keys: Optional[set] = None  # lazy on-disk region cache
+        self._lock = threading.Lock()
+
+    def _known_regions(self) -> set:
+        """Cached set of regions with an on-disk sidecar (call under
+        ``_lock``); seeded once from the directory."""
+        if self._fs_keys is None:
+            keys = set()
+            if self._dir is not None and os.path.isdir(self._dir):
+                for fname in os.listdir(self._dir):
+                    r = self._parse(fname)
+                    if r is not None:
+                        keys.add(r)
+            self._fs_keys = keys
+        return self._fs_keys
+
+    @staticmethod
+    def _key(region) -> str:
+        return "r_" + "_".join(f"{a}-{b}" for a, b in region)
+
+    @staticmethod
+    def _parse(name: str):
+        if not (name.startswith("r_") and name.endswith(".json")):
+            return None
+        try:
+            return tuple(
+                (int(p.split("-")[0]), int(p.split("-")[1]))
+                for p in name[2:-len(".json")].split("_")
+            )
+        except (ValueError, IndexError):
+            return None
+
+    def record(self, region, value: np.ndarray) -> None:
+        entry = {
+            "algo": "crc32",
+            "crc": _crc(value),
+            "dtype": value.dtype.str,
+            "shape": list(value.shape),
+        }
+        self.invalidate_overlaps(region)
+        if self._mem is not None:
+            with self._lock:
+                self._mem[region] = entry
+            return
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(self._dir, self._key(region) + ".json")
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(entry, f)
+        os.replace(tmp, path)
+        with self._lock:
+            self._known_regions().add(region)
+
+    def lookup(self, region) -> Optional[Dict]:
+        if self._mem is not None:
+            with self._lock:
+                return self._mem.get(region)
+        path = os.path.join(self._dir, self._key(region) + ".json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def invalidate_overlaps(self, region) -> None:
+        """Drop digests of regions intersecting ``region`` — a partial
+        overwrite makes them stale, and a stale digest would turn a later
+        valid read into a false corruption alarm.  Walks the cached key
+        set, not the directory (see class docstring)."""
+        if self._mem is not None:
+            with self._lock:
+                for r in [r for r in self._mem if _regions_overlap(r, region)]:
+                    del self._mem[r]
+            return
+        if self._dir is None:
+            return
+        with self._lock:
+            known = self._known_regions()
+            hits = [r for r in known if _regions_overlap(r, region)]
+            for r in hits:
+                known.discard(r)
+        for r in hits:
+            try:
+                os.unlink(os.path.join(self._dir, self._key(r) + ".json"))
+            except OSError:
+                pass
+
+
+# async completion hooks (verify / record digest) ride on prefetch's
+# future-mapping adapter — the async IO paths stay inside the same fault
+# model as the sync ones, at the moment the data is actually consumed
+from .prefetch import _MappedFuture as _WrappedFuture  # noqa: E402
+
+
+class _ChecksumOps:
+    """Shared digest behavior for datasets that support it.  Subclasses
+    provide ``_read_back(bb)`` (raw region read, no injection) and
+    ``_write_raw(bb, value)`` (raw write, no sidecar) plus ``_checksums``
+    and ``_label`` attributes."""
+
+    def _read_back(self, bb) -> np.ndarray:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _write_raw(self, bb, value) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _after_write(self, bb, value: np.ndarray, block_id) -> None:
+        """Record the region digest, then apply any injected silent
+        corruption (bit-flip the stored bytes, sidecar untouched — only
+        checksum verification can tell)."""
+        region = _norm_region(bb, self.shape)
+        if region is not None and checksums_enabled():
+            if value.shape == _region_shape(region):
+                self._checksums.record(region, value)
+            else:
+                # broadcast / scalar fill: no digestable identity, but any
+                # previous digest for this box is now stale
+                self._checksums.invalidate_overlaps(region)
+        if _faults().get_injector().chunk_corrupt("io_write", block_id):
+            bad = np.ascontiguousarray(value).copy()
+            if bad.size and bad.dtype.itemsize:
+                bad.reshape(-1).view(np.uint8)[0] ^= 0x01
+            self._write_raw(bb, bad)
+
+    def _verify_read(self, bb, arr: np.ndarray) -> None:
+        if not checksums_enabled():
+            return
+        region = _norm_region(bb, self.shape)
+        if region is None:
+            return
+        entry = self._checksums.lookup(region)
+        if entry is None:
+            return
+        if (
+            list(entry.get("shape", [])) != list(arr.shape)
+            or entry.get("dtype") != arr.dtype.str
+        ):
+            return  # stale sidecar (shape/dtype drifted): not verifiable
+        actual = _crc(arr)
+        if actual != entry.get("crc"):
+            raise ChunkCorruptionError(self._label, region, entry.get("crc"), actual)
+
+    def verify_region(self, bb) -> None:
+        """Read back a stored region and check it against its digest
+        sidecar; raises :class:`ChunkCorruptionError` on mismatch, no-op
+        when no digest exists.  The executor's store path calls this so
+        corruption is caught while the writer still holds the clean data
+        (retry) or can recompute it (quarantine repair)."""
+        if not checksums_enabled():
+            return
+        region = _norm_region(bb, self.shape)
+        if region is None or self._checksums.lookup(region) is None:
+            return
+        self._verify_read(bb, np.asarray(self._read_back(bb)))
 
 # numpy dtype -> zarr v2 dtype string
 def _zarr_dtype(dtype) -> str:
@@ -64,12 +333,20 @@ def _n5_dtype(dtype) -> str:
     return np.dtype(dtype).name
 
 
-class Dataset:
+class Dataset(_ChecksumOps):
     """A chunked dataset backed by tensorstore."""
 
-    def __init__(self, store, attrs_path: Optional[str] = None):
+    def __init__(self, store, attrs_path: Optional[str] = None,
+                 checksum_dir: Optional[str] = None, label: str = ""):
         self._store = store
         self._attrs_path = attrs_path
+        self._checksums = _ChecksumIndex(
+            checksum_dir
+            if checksum_dir is not None
+            else (os.path.join(os.path.dirname(attrs_path), ".ctt_checksums")
+                  if attrs_path else None)
+        )
+        self._label = label or (attrs_path or "<dataset>")
 
     @property
     def shape(self) -> Tuple[int, ...]:
@@ -87,24 +364,52 @@ class Dataset:
     def ndim(self) -> int:
         return len(self.shape)
 
-    def __getitem__(self, bb) -> np.ndarray:
-        _inject("io_read")
+    def _read_back(self, bb) -> np.ndarray:
         return np.asarray(self._store[bb].read().result())
 
-    def __setitem__(self, bb, value) -> None:
-        _inject("io_write")
-        value = np.asarray(value, dtype=self.dtype)
+    def _write_raw(self, bb, value) -> None:
         self._store[bb].write(value).result()
 
+    def __getitem__(self, bb) -> np.ndarray:
+        bid = _inject("io_read")
+        _hang("io_read", bid)
+        arr = np.asarray(self._store[bb].read().result())
+        self._verify_read(bb, arr)
+        return arr
+
+    def __setitem__(self, bb, value) -> None:
+        bid = _inject("io_write")
+        _hang("io_write", bid)
+        value = np.asarray(value, dtype=self.dtype)
+        self._store[bb].write(value).result()
+        self._after_write(bb, value, bid)
+
     def read_async(self, bb):
-        """Start an async read; returns a future with ``.result()`` -> numpy."""
-        _inject("io_read")
-        return self._store[bb].read()
+        """Start an async read; returns a future with ``.result()`` -> numpy.
+        Injection fires at issue (same accounting as ``__getitem__``);
+        digest verification runs on ``.result()``, where the data lands."""
+        bid = _inject("io_read")
+        fut = self._store[bb].read()
+
+        def finish(raw):
+            _hang("io_read", bid)
+            arr = np.asarray(raw)
+            self._verify_read(bb, arr)
+            return arr
+
+        return _WrappedFuture(fut, finish)
 
     def write_async(self, bb, value):
-        _inject("io_write")
+        bid = _inject("io_write")
         value = np.asarray(value, dtype=self.dtype)
-        return self._store[bb].write(value)
+        fut = self._store[bb].write(value)
+
+        def finish(_):
+            _hang("io_write", bid)
+            self._after_write(bb, value, bid)
+            return None
+
+        return _WrappedFuture(fut, finish)
 
     # -- attributes (json sidecar, mirroring z5py/zarr .zattrs) -------------
     @property
@@ -276,7 +581,7 @@ class ZarrContainer:
                 have_chunks=store.chunk_layout.read_chunk.shape,
                 want_chunks=chunks,
             )
-        ds = Dataset(store, self._attrs_path(key))
+        ds = Dataset(store, self._attrs_path(key), label=f"{self.path}:{key}")
         with self._lock:
             self._cache[key] = ds
         return ds
@@ -298,7 +603,7 @@ class ZarrContainer:
             if key in self._cache:
                 return self._cache[key]
         store = self._open_store(key)
-        ds = Dataset(store, self._attrs_path(key))
+        ds = Dataset(store, self._attrs_path(key), label=f"{self.path}:{key}")
         with self._lock:
             self._cache[key] = ds
         return ds
@@ -320,7 +625,10 @@ class ZarrContainer:
 
 
 class _H5Dataset:
-    """Adapter giving h5py datasets the same surface as :class:`Dataset`."""
+    """Adapter giving h5py datasets the same surface as :class:`Dataset`.
+    No digest sidecars (one shared .h5 file has no safe place for per-region
+    metadata under parallel writers), so no ``verify_region`` — callers
+    probe for the attribute."""
 
     def __init__(self, ds):
         self._ds = ds
@@ -334,19 +642,23 @@ class _H5Dataset:
         return tuple(self._ds.chunks) if self._ds.chunks else tuple(self._ds.shape)
 
     def __getitem__(self, bb):
-        _inject("io_read")
+        bid = _inject("io_read")
+        _hang("io_read", bid)
         return self._ds[bb]
 
     def __setitem__(self, bb, value):
-        _inject("io_write")
+        bid = _inject("io_write")
+        _hang("io_write", bid)
         self._ds[bb] = value
 
     def read_async(self, bb):
-        _inject("io_read")
+        bid = _inject("io_read")
+        _hang("io_read", bid)
         return _ImmediateFuture(self._ds[bb])
 
     def write_async(self, bb, value):
-        _inject("io_write")
+        bid = _inject("io_write")
+        _hang("io_write", bid)
         self._ds[bb] = value
         return _ImmediateFuture(None)
 
@@ -456,31 +768,51 @@ class MemoryContainer:
         pass
 
 
-class _MemDataset:
+class _MemDataset(_ChecksumOps):
     def __init__(self, arr: np.ndarray, chunks: Tuple[int, ...]):
         self._arr = arr
         self.chunks = chunks
         self._attrs: Dict = {}
+        self._checksums = _ChecksumIndex(None)
+        self._label = "memory://"
 
     shape = property(lambda self: self._arr.shape)
     dtype = property(lambda self: self._arr.dtype)
     ndim = property(lambda self: self._arr.ndim)
 
-    def __getitem__(self, bb):
-        _inject("io_read")
+    def _read_back(self, bb):
         return self._arr[bb].copy()
 
-    def __setitem__(self, bb, value):
-        _inject("io_write")
+    def _write_raw(self, bb, value):
         self._arr[bb] = value
+
+    def __getitem__(self, bb):
+        bid = _inject("io_read")
+        _hang("io_read", bid)
+        arr = self._arr[bb].copy()
+        self._verify_read(bb, arr)
+        return arr
+
+    def __setitem__(self, bb, value):
+        bid = _inject("io_write")
+        _hang("io_write", bid)
+        value = np.asarray(value, dtype=self._arr.dtype)
+        self._arr[bb] = value
+        self._after_write(bb, value, bid)
 
     def read_async(self, bb):
-        _inject("io_read")
-        return _ImmediateFuture(self._arr[bb].copy())
+        bid = _inject("io_read")
+        _hang("io_read", bid)
+        arr = self._arr[bb].copy()
+        self._verify_read(bb, arr)
+        return _ImmediateFuture(arr)
 
     def write_async(self, bb, value):
-        _inject("io_write")
+        bid = _inject("io_write")
+        _hang("io_write", bid)
+        value = np.asarray(value, dtype=self._arr.dtype)
         self._arr[bb] = value
+        self._after_write(bb, value, bid)
         return _ImmediateFuture(None)
 
     @property

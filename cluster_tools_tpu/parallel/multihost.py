@@ -25,6 +25,7 @@ Three pieces live here:
 from __future__ import annotations
 
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -105,9 +106,11 @@ def launch_workers(
     ``jax.distributed`` coordinator on a free localhost port.  The target
     function runs in every process after initialization (classic SPMD).
 
-    Returns ``[(returncode, stdout, stderr), ...]`` per process; raises on
-    timeout.  This is the DCN analogue of the reference's LocalTask
-    fake-cluster: real multi-process collectives, one machine.
+    Returns ``[(returncode, stdout, stderr), ...]`` per process; on timeout
+    every worker's process group is killed and a ``TimeoutError`` carrying
+    the partial per-worker output is raised (:func:`collect_workers`).
+    This is the DCN analogue of the reference's LocalTask fake-cluster:
+    real multi-process collectives, one machine.
     """
     coord = f"127.0.0.1:{free_port()}"
     # workers must be able to import this package regardless of their cwd
@@ -146,15 +149,61 @@ def launch_workers(
                 start_new_session=True,
             )
         )
+    return collect_workers(procs, timeout)
+
+
+def _kill_process_group(p: subprocess.Popen) -> None:
+    """SIGKILL the worker's whole process group (workers are session
+    leaders via ``start_new_session=True``, so pgid == pid) — ``p.kill()``
+    alone would orphan grandchildren as zombies."""
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            p.kill()
+        except OSError:
+            pass
+
+
+def collect_workers(
+    procs: List[subprocess.Popen], timeout: float
+) -> List[Tuple[int, str, str]]:
+    """Wait for every worker, returning ``(returncode, stdout, stderr)``
+    per process.  On timeout, every worker's *process group* is killed (no
+    zombie grandchildren keeping pipes open) and whatever partial
+    stdout/stderr the workers produced is collected and surfaced in the
+    raised ``TimeoutError`` — a hung pod must leave its logs behind, not
+    vanish into a bare ``TimeoutExpired``."""
     results = []
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=timeout)
+        for i, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    if q.poll() is None:
+                        _kill_process_group(q)
+                tails = []
+                for j, q in enumerate(procs):
+                    try:
+                        qo, qe = q.communicate(timeout=10.0)
+                    except Exception:
+                        qo, qe = "", ""
+                    tails.append(
+                        f"-- worker {j} (rc={q.returncode}) --\n"
+                        f"stdout tail:\n{(qo or '')[-800:]}\n"
+                        f"stderr tail:\n{(qe or '')[-800:]}"
+                    )
+                raise TimeoutError(
+                    f"multihost worker {i} exceeded timeout={timeout:g}s; "
+                    f"killed all {len(procs)} worker process group(s).  "
+                    "Partial output:\n" + "\n".join(tails)
+                )
             results.append((p.returncode, out, err))
     finally:
         for p in procs:
             if p.poll() is None:
-                p.kill()
+                _kill_process_group(p)
     return results
 
 

@@ -29,18 +29,41 @@ Config keys (per-task JSON, matching the reference's slurm knobs):
 the result file after the job leaves the queue — NFS cache lag),
 ``probe_failure_grace_s`` (continuous scheduler-unreachable stretch
 tolerated before declaring the job gone).
+
+Supervision (docs/ROBUSTNESS.md "Silent failures"): the poll loop is a
+*supervisor*.  Jobs heartbeat into ``tmp_folder/heartbeats/<uid>.json``
+(the batch script writes the first beat before Python even starts, the
+remote runner every ``heartbeat_interval_s`` after); the supervisor
+declares a job **lost** — and resubmits it, up to ``max_resubmits`` times,
+without waiting out ``submit_timeout_s`` — when any of these hold:
+
+- the scheduler stops listing it and no result file appears within
+  ``result_grace_s`` (crashed / preempted without trace),
+- its heartbeat file has not *changed* for ``heartbeat_timeout_s`` while
+  the scheduler still claims it runs (the classic *lost array task*: the
+  scheduler lies, the node is gone).  Staleness is judged by content
+  change observed on the supervisor's own clock, so worker clock skew
+  cannot fake a loss.  Must exceed worst-case queue wait + worker
+  startup; ``0`` disables heartbeat supervision,
+- the heartbeat's pid is dead on this host (same-host stub/test setups:
+  instant detection).
+
+Every loss is appended to ``cluster/supervisor.log`` and recorded in the
+run's ``failures.json`` (fault class ``job_loss``, job id, resolution).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import socket
 import subprocess
 import time
 from typing import Any, Dict, Optional
 
 from ..utils import function_utils as fu
 from . import faults as faults_mod
+from .supervision import heartbeat_path, pid_alive, read_heartbeat
 
 
 class ClusterSubmitter:
@@ -189,6 +212,212 @@ def submit_with_retries(
             time.sleep(delay)
 
 
+def supervisor_log_path(tmp_folder: str) -> str:
+    return os.path.join(cluster_dir(tmp_folder), "supervisor.log")
+
+
+def _sup_log(tmp_folder: str, msg: str) -> None:
+    """Append one line to the run's supervisor log (the resubmission audit
+    trail `make supervise-demo` prints)."""
+    import datetime
+
+    try:
+        with open(supervisor_log_path(tmp_folder), "a") as f:
+            f.write(f"{datetime.datetime.now().isoformat()} {msg}\n")
+    except OSError:
+        pass
+
+
+def supervise_job(
+    submitter: ClusterSubmitter,
+    *,
+    script_path: str,
+    job_name: str,
+    out_path: str,
+    result_path: str,
+    tmp_folder: str,
+    uid: str,
+    cfg: Dict[str, Any],
+    logger=None,
+    flavor: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Submit a job and supervise it to a result file, resubmitting lost
+    jobs (module docstring).  Returns ``{"job_id", "resubmits", "job_ids"}``
+    once ``result_path`` exists; raises when the job is lost more than
+    ``max_resubmits`` times or exceeds ``submit_timeout_s``.
+
+    The ``job_loss`` fault class hooks in here: a swallowed submission gets
+    a fabricated job id the "scheduler" reports as running forever, so only
+    the heartbeat checks can find it — exactly the failure the supervisor
+    exists for.
+    """
+    flavor = flavor or submitter.flavor
+    injector = faults_mod.get_injector()
+    poll = float(cfg.get("poll_interval_s", 5.0))
+    timeout = cfg.get("submit_timeout_s")
+    grace = float(cfg.get("result_grace_s", 60.0))
+    probe_grace = float(cfg.get("probe_failure_grace_s", 600.0))
+    hb_timeout = float(cfg.get("heartbeat_timeout_s") or 0.0)
+    max_resubmits = int(cfg.get("max_resubmits", 2))
+    host = socket.gethostname()
+    job_ids: list = []
+    resubmits = 0
+    # heartbeat liveness is judged by CHANGE observed on the supervisor's
+    # own clock, never by the timestamps inside the beat: worker nodes'
+    # clocks skew, and a worker behind the supervisor would otherwise have
+    # every beat discarded as stale and the healthy job declared lost
+    hb_seen: Dict[str, Any] = {"raw": None, "at": 0.0}
+
+    def _submit():
+        # snapshot the heartbeat BEFORE submitting: anything the new job
+        # writes afterwards registers as a change of this attempt's
+        submit_t = time.time()
+        hb_seen["raw"] = read_heartbeat(tmp_folder, uid)
+        hb_seen["at"] = submit_t
+        if injector.lose_job():
+            job_id = f"lost:{uid}:{len(job_ids)}"
+        else:
+            job_id = submit_with_retries(
+                submitter, script_path, job_name, out_path, cfg, logger
+            )
+        job_ids.append(job_id)
+        return job_id, submit_t
+
+    def _probe(job_id):
+        if job_id.startswith("lost:"):
+            return True  # the scheduler claims it runs; only heartbeats know
+        return submitter.is_running(job_id)
+
+    def _cancel(job_id):
+        if not job_id.startswith("lost:"):
+            submitter.cancel(job_id)
+
+    def _record_loss(job_id, reason, resolved):
+        fu.record_failures(
+            fu.failures_path(tmp_folder),
+            uid,
+            [{
+                "block_id": None,
+                "sites": {"job_loss": resubmits},
+                "error": reason,
+                "quarantined": False,
+                "resolved": resolved,
+                "job_id": job_id,
+                # full submission history: records merge by (task, block),
+                # so the final resolved record must still name the lost ids
+                "job_ids": list(job_ids),
+            }],
+        )
+
+    job_id, submit_t = _submit()
+    if logger is not None:
+        logger.info(f"{flavor} job {job_id} submitted ({script_path})")
+    t0 = time.time()
+    unknown_since = None
+    while not os.path.exists(result_path):
+        now = time.time()
+        if timeout and now - t0 > float(timeout):
+            _cancel(job_id)
+            raise RuntimeError(
+                f"{flavor} job {job_id} exceeded submit_timeout_s="
+                f"{timeout} (job cancelled); see {out_path}"
+            )
+        running = _probe(job_id)
+        unknown_since = (unknown_since or now) if running is None else None
+        probe_exhausted = (
+            unknown_since is not None and now - unknown_since > probe_grace
+        )
+
+        lost = None
+        hb = read_heartbeat(tmp_folder, uid)
+        if hb != hb_seen["raw"]:
+            # the beat's CONTENT changed since we last looked: something is
+            # alive out there, clocked on OUR side (skew-immune).  A beat
+            # left by a previous, cancelled incarnation never changes, so
+            # it cannot keep a lost resubmission looking alive.
+            hb_seen["raw"] = hb
+            hb_seen["at"] = now
+        last_alive = hb_seen["at"]
+        beat_this_attempt = hb is not None and last_alive > submit_t
+        if (
+            beat_this_attempt
+            and hb.get("host") == host
+            and hb.get("pid") is not None
+            and not pid_alive(hb["pid"])
+        ):
+            lost = f"heartbeat pid {hb['pid']} on {host} is dead"
+        if (
+            lost is None
+            and hb_timeout
+            and running is not False
+            and now - last_alive > hb_timeout
+        ):
+            lost = (
+                f"no live heartbeat for {now - last_alive:.1f}s "
+                f"(heartbeat_timeout_s={hb_timeout:g}) while the scheduler "
+                f"reports the job as {'running' if running else 'unknown'}"
+            )
+        if running is False or probe_exhausted:
+            # job left the queue (or scheduler unreachable too long): give
+            # the result file an NFS-lag grace window before declaring loss
+            t_gone = time.time()
+            while (time.time() - t_gone < grace
+                   and not os.path.exists(result_path)):
+                time.sleep(min(poll, 2.0))
+            if os.path.exists(result_path):
+                break
+            lost = (
+                "job left the queue without a result file"
+                if running is False
+                else f"scheduler unreachable for {probe_grace:.0f}s "
+                     "and no result file"
+            )
+
+        if lost:
+            _cancel(job_id)  # a zombie must not race the resubmission
+            if resubmits >= max_resubmits:
+                tail = ""
+                try:
+                    with open(out_path) as f:
+                        tail = f.read()[-2000:]
+                except OSError:
+                    pass
+                _sup_log(
+                    tmp_folder,
+                    f"{uid}: job {job_id} lost ({lost}); "
+                    f"max_resubmits={max_resubmits} exhausted, giving up",
+                )
+                raise RuntimeError(
+                    f"{flavor} job for {uid} lost ({lost}) after "
+                    f"{resubmits} resubmission(s) — giving up.  "
+                    f"Job output tail:\n{tail}"
+                )
+            resubmits += 1
+            msg = (
+                f"{uid}: job {job_id} declared lost ({lost}); "
+                f"resubmitting ({resubmits}/{max_resubmits})"
+            )
+            if logger is not None:
+                logger.warning(msg)
+            _sup_log(tmp_folder, msg)
+            _record_loss(job_id, lost, resolved=False)
+            unknown_since = None
+            job_id, submit_t = _submit()
+            if logger is not None:
+                logger.info(f"{flavor} job {job_id} resubmitted")
+            continue
+        time.sleep(poll)
+
+    if resubmits:
+        _record_loss(job_id, None, resolved=True)
+        _sup_log(
+            tmp_folder,
+            f"{uid}: job {job_id} delivered a result after {resubmits} "
+            f"resubmission(s)",
+        )
+    return {"job_id": job_id, "resubmits": resubmits, "job_ids": job_ids}
+
+
 def _spec_default(obj):
     """Numpy scalars/arrays become their Python equivalents; anything else
     fails AT SUBMIT TIME instead of reaching the remote node stringified."""
@@ -233,6 +462,10 @@ def make_cluster_task(local_cls, flavor: str):
             "max_jobs": self.max_jobs,
             "params": self.params,
             "result_path": os.path.join(cdir, f"{self.uid}.result.json"),
+            # liveness: the remote runner heartbeats under this uid so the
+            # supervisor below can tell a lost job from a slow one
+            "uid": self.uid,
+            "heartbeat_interval_s": float(cfg.get("heartbeat_interval_s", 5.0)),
         }
         spec_path = os.path.join(cdir, f"{self.uid}.spec.json")
         with open(spec_path, "w") as f:
@@ -245,86 +478,58 @@ def make_cluster_task(local_cls, flavor: str):
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
+        hb_path = heartbeat_path(self.tmp_folder, self.uid)
         with open(script_path, "w") as f:
             f.write(
                 "#!/bin/bash\n"
                 f"export PYTHONPATH={pkg_root}:$PYTHONPATH\n"
+                # boot heartbeat from the shell, BEFORE the interpreter
+                # starts: the supervisor's staleness clock must not count
+                # queue exit -> first Python beat (slow jax imports) as
+                # dead air.  exec keeps the pid, so the pid stays valid.
+                f"mkdir -p {os.path.dirname(hb_path)}\n"
+                'printf \'{"time": %s, "pid": %s, "host": "%s"}\' '
+                '"$(date +%s)" "$$" "$(hostname)" '
+                f"> {hb_path}.boot && mv {hb_path}.boot {hb_path}\n"
                 f"exec {fu.python_executable()} -m "
                 f"cluster_tools_tpu.runtime.cluster_runner {spec_path}\n"
             )
         os.chmod(script_path, 0o755)
-        # a retry must not consume the previous attempt's result
-        try:
-            os.unlink(spec["result_path"])
-        except OSError:
-            pass
-
-        submitter = submitter_cls()
-        job_id = submit_with_retries(
-            submitter, script_path, self.uid, out_path, cfg, self.logger
-        )
-        self.logger.info(f"{flavor} job {job_id} submitted ({script_path})")
-
-        poll = float(cfg.get("poll_interval_s", 5.0))
-        timeout = cfg.get("submit_timeout_s")
-        # NFS attribute/dentry caches commonly delay file visibility by
-        # 30-60 s, so after the job leaves the queue keep re-checking for
-        # the result file for a full grace window before declaring failure
-        grace = float(cfg.get("result_grace_s", 60.0))
-        # scheduler outages (slurmctld restart, comm timeouts) last
-        # minutes, not polls — tolerate a continuous stretch of unknown
-        # status before concluding the job is gone
-        probe_grace = float(cfg.get("probe_failure_grace_s", 600.0))
-        t0 = time.time()
-        unknown_since = None
-        while True:
-            if os.path.exists(spec["result_path"]):
-                break
-            running = submitter.is_running(job_id)
-            if running is None:
-                unknown_since = unknown_since or time.time()
-            else:
-                unknown_since = None
-            probe_exhausted = (
-                unknown_since is not None
-                and time.time() - unknown_since > probe_grace
-            )
-            if running is False or probe_exhausted:
-                t_gone = time.time()
-                while (time.time() - t_gone < grace
-                       and not os.path.exists(spec["result_path"])):
-                    time.sleep(min(poll, 2.0))
-                break
-            if timeout and time.time() - t0 > float(timeout):
-                submitter.cancel(job_id)
-                raise RuntimeError(
-                    f"{flavor} job {job_id} exceeded submit_timeout_s="
-                    f"{timeout} (job cancelled); see {out_path}"
-                )
-            time.sleep(poll)
-
-        if not os.path.exists(spec["result_path"]):
-            # the job may still exist (probe-grace exhaustion): kill it so
-            # it cannot race a resubmission on the same uid-keyed paths
-            submitter.cancel(job_id)
-            tail = ""
+        # a retry must not consume the previous attempt's result (nor its
+        # heartbeat: a stale beat would mask a lost resubmission)
+        for stale in (spec["result_path"], hb_path):
             try:
-                with open(out_path) as f:
-                    tail = f.read()[-2000:]
+                os.unlink(stale)
             except OSError:
                 pass
-            raise RuntimeError(
-                f"{flavor} job {job_id} finished without a result file — "
-                f"remote failure (job cancelled).  Job output tail:\n{tail}"
-            )
+
+        submitter = submitter_cls()
+        sup = supervise_job(
+            submitter,
+            script_path=script_path,
+            job_name=self.uid,
+            out_path=out_path,
+            result_path=spec["result_path"],
+            tmp_folder=self.tmp_folder,
+            uid=self.uid,
+            cfg=cfg,
+            logger=self.logger,
+            flavor=flavor,
+        )
         with open(spec["result_path"]) as f:
             remote = json.load(f)
         if not remote.get("ok"):
             raise RuntimeError(
-                f"{flavor} job {job_id} failed remotely: "
+                f"{flavor} job {sup['job_id']} failed remotely: "
                 f"{remote.get('error', 'unknown error')}"
             )
-        return remote.get("result", {})
+        result = remote.get("result") or {}
+        if sup["resubmits"]:
+            result["supervisor"] = {
+                "resubmits": sup["resubmits"],
+                "job_ids": sup["job_ids"],
+            }
+        return result
 
     return type(
         local_cls.__name__.replace("Local", flavor.upper() if flavor == "lsf"

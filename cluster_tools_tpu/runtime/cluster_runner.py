@@ -8,6 +8,11 @@ node, writing ``{ok, result|error}`` to the spec's ``result_path``
 filesystem).  Block markers and per-task logs land in the shared
 ``tmp_folder`` exactly as for a local run, so a preempted job resumes at
 the block grain when resubmitted.
+
+Liveness: for specs carrying a ``uid``, a heartbeat thread writes
+``tmp_folder/heartbeats/<uid>.json`` every ``heartbeat_interval_s`` for
+the submitting supervisor's staleness/pid checks (the batch script wrote
+the first beat before Python started — see ``runtime/cluster.py``).
 """
 
 from __future__ import annotations
@@ -43,7 +48,20 @@ def main(spec_path: str) -> int:
             json.dump(payload, f, default=_default)
         os.replace(tmp, result_path)
 
+    heartbeat = None
+    if spec.get("uid"):
+        from .supervision import HeartbeatWriter
+
+        heartbeat = HeartbeatWriter(
+            spec["tmp_folder"], spec["uid"],
+            float(spec.get("heartbeat_interval_s", 5.0)),
+        ).start()
+
     try:
+        from . import faults as faults_mod
+
+        # fault specs with a "tasks" filter target this job's task uid
+        faults_mod.set_current_task(spec.get("uid"))
         module = importlib.import_module(spec["module"])
         cls = getattr(module, spec["cls"])
         task = cls(
@@ -62,6 +80,9 @@ def main(spec_path: str) -> int:
             "traceback": traceback.format_exc(),
         })
         return 1
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
 
 
 if __name__ == "__main__":

@@ -30,10 +30,21 @@ attempt counts, capped traceback, resolution); blocks that stay failed after
 the quarantine pass raise with their ids attributed.  Block-level success
 markers give the same resume grain as the reference's ``log_block_success``
 — ``done_block_ids`` filters them built-in.
+
+Silent failures (docs/ROBUSTNESS.md "Silent failures"): ``block_deadline_s``
+arms a watchdog that detects *hung* blocks (stuck IO, wedged kernel) within
+one watchdog period of the deadline, quarantines them, and speculatively
+re-executes them through the same compiled kernel — first result wins, with
+a bit-identity check when both copies complete.  ``store_verify_fn`` (built
+by :func:`region_verifier` from a checksummed dataset) re-reads each stored
+region so a chunk corrupted on storage is repaired by a re-store (retry) or
+a recompute (quarantine) while the writer still owns the block.
 """
 
 from __future__ import annotations
 
+import contextlib
+import itertools
 import math
 import threading
 import time
@@ -45,9 +56,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..io.containers import ChunkCorruptionError
 from ..utils import function_utils as fu
 from ..utils.volume_utils import Block, Blocking
 from . import faults as faults_mod
+from .supervision import FirstWins, Watchdog, array_digest
 
 
 # canonical device-selection policy lives in parallel/mesh.py
@@ -71,6 +84,27 @@ def check_finite_outputs(block: Block, out) -> Optional[str]:
         if a.dtype.kind == "f" and not np.isfinite(a).all():
             return "non-finite values (NaN/inf) in kernel output"
     return None
+
+
+def region_verifier(
+    dataset, bb_of: Optional[Callable[[Block], Any]] = None
+) -> Optional[Callable[[Block], None]]:
+    """Build a ``store_verify_fn`` for :meth:`BlockwiseExecutor.map_blocks`
+    from a dataset with digest sidecars: read the block's stored region back
+    and raise :class:`~cluster_tools_tpu.io.containers.ChunkCorruptionError`
+    if its bytes no longer match the recorded checksum.  Returns None for
+    datasets without checksum support (HDF5), so call sites wire it
+    unconditionally."""
+    verify = getattr(dataset, "verify_region", None)
+    if verify is None:
+        return None
+    if bb_of is None:
+        bb_of = lambda block: block.bb  # noqa: E731 - trivial default
+
+    def store_verify(block: Block) -> None:
+        verify(bb_of(block))
+
+    return store_verify
 
 
 def validate_labels(block: Block, out) -> Optional[str]:
@@ -124,17 +158,27 @@ class BlockwiseExecutor:
     def _backoff(self, attempt: int) -> float:
         return fu.backoff_delay(attempt, self.backoff_base, self.backoff_max)
 
-    def _io_with_retries(self, site: str, block: Block, fn: Callable):
+    def _io_with_retries(
+        self, site: str, block: Block, fn: Callable,
+        on_error: Optional[Callable[[Exception], None]] = None,
+    ):
         """Run ``fn`` with injection + retries.  Returns
         ``(value, attempts, traceback_or_None)``; the caller quarantines on
-        a non-None traceback."""
+        a non-None traceback.  ``on_error`` observes each caught exception
+        (failure-class attribution, e.g. counting ChunkCorruptionErrors)."""
         injector = faults_mod.get_injector()
         last_tb = None
         for k in range(self.max_retries + 1):
             try:
                 injector.maybe_fail(site, block.block_id)
+                injector.maybe_hang(site, block.block_id)
                 return fn(), k + 1, None
-            except Exception:
+            except Exception as e:
+                if on_error is not None:
+                    try:
+                        on_error(e)
+                    except Exception:
+                        pass
                 last_tb = fu.cap_traceback(traceback.format_exc())
                 if k < self.max_retries:
                     time.sleep(self._backoff(k))
@@ -153,6 +197,10 @@ class BlockwiseExecutor:
         check_finite: bool = True,
         failures_path: Optional[str] = None,
         task_name: str = "map_blocks",
+        block_deadline_s: Optional[float] = None,
+        watchdog_period_s: Optional[float] = None,
+        speculate: bool = True,
+        store_verify_fn: Optional[Callable[[Block], None]] = None,
     ) -> Dict[str, int]:
         """Execute ``kernel`` over ``blocks``; see class docstring.
 
@@ -161,6 +209,17 @@ class BlockwiseExecutor:
         validation; a non-None message quarantines the block for re-compute.
         ``check_finite`` — built-in NaN/inf validation of float outputs.
         ``failures_path`` — where to record the ``failures.json`` manifest.
+        ``block_deadline_s`` — per-block wall-clock budget: a watchdog
+        thread declares blocks whose load/compute/store exceeds it *hung*
+        (recorded + quarantined within one ``watchdog_period_s``, default
+        ``deadline/4``) and, when ``speculate``, launches a duplicate
+        re-execution through the same compiled kernel — first result wins,
+        and if both copies complete they must agree bit-for-bit (a
+        disagreement is recorded as a ``determinism`` failure and the block
+        is recomputed).  ``store_verify_fn(block)`` — post-store integrity
+        check (see :func:`region_verifier`); a ChunkCorruptionError it
+        raises makes the store retry (re-write repairs the corrupt chunk),
+        then quarantine (recompute repairs it).
         Raises RuntimeError naming every block that stays failed after the
         end-of-run quarantine pass.
         """
@@ -170,6 +229,8 @@ class BlockwiseExecutor:
         if not blocks:
             return {"n_blocks": 0, "n_quarantined": 0, "n_failed": 0}
         injector = faults_mod.get_injector()
+        deadline = float(block_deadline_s or 0.0)
+        block_by_id = {int(b.block_id): b for b in blocks}
         bs = self.batch_size
         n_batches = math.ceil(len(blocks) / bs)
         sharding = NamedSharding(self.mesh, P("blocks"))
@@ -217,37 +278,74 @@ class BlockwiseExecutor:
                 return validate_fn(block, out)
             return None
 
+        # -- hang defense: watchdog + speculative duplicates ----------------
+        # in-flight (block, stage) work registers with a watchdog; overdue
+        # work is recorded as hung + quarantined, and a duplicate of the
+        # block runs through the same compiled kernel — FirstWins arbitrates.
+        # ALL dispatches of the compiled kernel share one lock: the program
+        # is sharded across every device, and two concurrent executions of a
+        # multi-device program deadlock XLA's collective rendezvous (each
+        # waits for all participants) — the devices are a serial resource,
+        # so serializing dispatch costs nothing and removes the hazard.
+        dispatch_lock = threading.Lock()
+        speculated: set = set()
+        commits = FirstWins()
+        spec_pool: Optional[ThreadPoolExecutor] = None
+        spec_futures: List[Future] = []
+        watchdog: Optional[Watchdog] = None
+        _tokens = itertools.count()
+
+        @contextlib.contextmanager
+        def _watched(block, stage, origin="primary"):
+            if watchdog is None:
+                yield
+                return
+            token = next(_tokens)
+            watchdog.register(
+                token, block_id=int(block.block_id), stage=stage, origin=origin
+            )
+            try:
+                yield
+            finally:
+                watchdog.clear(token)
+
         class _PreIssueFailed(Exception):
             pass
 
-        def load_block(block, pre=None, pre_tb=None):
+        def load_block(block, pre=None, pre_tb=None, origin="primary"):
             """Load one block with retries; returns arrays or None
             (quarantined).  ``pre`` is an already-issued load_fn result
             consumed by the first attempt (batch reads are issued together
             so the storage layer runs the chunk IO concurrently)."""
             last_tb, attempts = None, 0
-            for k in range(self.max_retries + 1):
-                attempts = k + 1
-                try:
-                    injector.maybe_fail("load", block.block_id)
-                    if k == 0 and pre_tb is not None:
-                        last_tb = pre_tb
-                        raise _PreIssueFailed()
-                    per = pre if (k == 0 and pre is not None) else load_fn(block)
-                    val = tuple(
-                        x.result() if hasattr(x, "result") else x for x in per
-                    )
-                except _PreIssueFailed:
-                    if k < self.max_retries:
-                        time.sleep(self._backoff(k))
-                except Exception:
-                    last_tb = fu.cap_traceback(traceback.format_exc())
-                    if k < self.max_retries:
-                        time.sleep(self._backoff(k))
-                else:
-                    if attempts > 1:
-                        note_failure(block, "load", attempts - 1, None, False)
-                    return val
+            with contextlib.ExitStack() as stack:
+                stack.enter_context(_watched(block, "load", origin))
+                stack.enter_context(
+                    faults_mod.block_context(int(block.block_id))
+                )
+                for k in range(self.max_retries + 1):
+                    attempts = k + 1
+                    try:
+                        injector.maybe_fail("load", block.block_id)
+                        injector.maybe_hang("load", block.block_id)
+                        if k == 0 and pre_tb is not None:
+                            last_tb = pre_tb
+                            raise _PreIssueFailed()
+                        per = pre if (k == 0 and pre is not None) else load_fn(block)
+                        val = tuple(
+                            x.result() if hasattr(x, "result") else x for x in per
+                        )
+                    except _PreIssueFailed:
+                        if k < self.max_retries:
+                            time.sleep(self._backoff(k))
+                    except Exception:
+                        last_tb = fu.cap_traceback(traceback.format_exc())
+                        if k < self.max_retries:
+                            time.sleep(self._backoff(k))
+                    else:
+                        if attempts > 1:
+                            note_failure(block, "load", attempts - 1, None, False)
+                        return val
             note_failure(block, "load", attempts, last_tb, quarantine=True)
             return None
 
@@ -259,7 +357,8 @@ class BlockwiseExecutor:
             issued = []
             for b in batch:
                 try:
-                    issued.append((load_fn(b), None))
+                    with faults_mod.block_context(int(b.block_id)):
+                        issued.append((load_fn(b), None))
                 except Exception:
                     issued.append(
                         (None, fu.cap_traceback(traceback.format_exc()))
@@ -284,11 +383,28 @@ class BlockwiseExecutor:
             )
             return ok_blocks, arrays
 
-        def handle_block_output(blk, block_out):
-            """Corrupt-injection, validation, store (with retries), marker.
-            Never raises — failures (including programming errors in the
-            validate/marker hooks) quarantine the block, keeping every
-            error attributed to its block id."""
+        finished_ids: set = set()
+
+        def finish_block(blk):
+            """Completion side effects (success marker + block_done kill
+            point) at most ONCE per block — with speculation, two copies of
+            a block can both reach a happy end (uncontended-looking winner
+            plus a later-agreeing duplicate) and must not double-fire."""
+            with fail_lock:
+                if int(blk.block_id) in finished_ids:
+                    return
+                finished_ids.add(int(blk.block_id))
+            if on_block_done is not None:
+                on_block_done(blk)
+            injector.kill_point("block_done")
+
+        def handle_block_output(blk, block_out, origin="primary"):
+            """Corrupt-injection, validation, duplicate arbitration, store
+            (with retries + integrity verify), marker.  Never raises —
+            failures (including programming errors in the validate/marker
+            hooks) quarantine the block, keeping every error attributed to
+            its block id."""
+            bid = int(blk.block_id)
             try:
                 block_out = injector.corrupt("kernel", blk.block_id, block_out)
                 err = validate(blk, block_out)
@@ -296,19 +412,102 @@ class BlockwiseExecutor:
                     note_failure(blk, "validate", 1, err, quarantine=True)
                     return
                 if store_fn is not None:
-                    _, attempts, tb = self._io_with_retries(
-                        "store", blk, lambda: store_fn(blk, block_out)
-                    )
+                    corrupt_seen = [0]
+                    dup_state = {"verdict": None, "digest": None,
+                                 "contended": False}
+
+                    def _classify(exc):
+                        if isinstance(exc, ChunkCorruptionError):
+                            corrupt_seen[0] += 1
+
+                    def _store_and_verify():
+                        # first-wins gate, decided at the LAST moment before
+                        # the write: this copy may have been declared hung
+                        # and overtaken by a speculative duplicate while it
+                        # was stuck on the way here.  With the watchdog
+                        # armed EVERY copy registers its digest — a
+                        # duplicate spawned after an uncontended-looking
+                        # primary passed this point must still find the
+                        # claim.  Decided once; store retries reuse it.
+                        if dup_state["verdict"] is None:
+                            if watchdog is not None:
+                                with fail_lock:
+                                    dup_state["contended"] = bid in speculated
+                                dup_state["digest"] = array_digest(
+                                    jax.tree_util.tree_leaves(block_out)
+                                )
+                                dup_state["verdict"] = commits.commit(
+                                    bid, dup_state["digest"]
+                                )
+                            else:
+                                dup_state["verdict"] = FirstWins.WIN
+                        if dup_state["verdict"] != FirstWins.WIN:
+                            return  # arbitrated below, nothing to store
+                        store_fn(blk, block_out)
+                        if store_verify_fn is not None:
+                            store_verify_fn(blk)
+
+                    with contextlib.ExitStack() as stack:
+                        stack.enter_context(_watched(blk, "store", origin))
+                        stack.enter_context(faults_mod.block_context(bid))
+                        _, attempts, tb = self._io_with_retries(
+                            "store", blk, _store_and_verify, on_error=_classify
+                        )
+                    if dup_state["verdict"] == FirstWins.AGREE:
+                        # this copy confirms the stored winner bit-for-bit:
+                        # resolved without a second store (also the
+                        # arbitration path after a mismatch — a third copy
+                        # agreeing with the winner validates it).  A
+                        # contended winner deferred the completion side
+                        # effects to this settling point; finish_block
+                        # de-duplicates against a winner that already ran
+                        # them (it looked uncontended when it decided).
+                        mark_resolved(blk)
+                        with fail_lock:
+                            rec = failures.get(bid)
+                            if rec is not None:
+                                rec["duplicate"] = "agreed"
+                        finish_block(blk)
+                        return
+                    if dup_state["verdict"] == FirstWins.MISMATCH:
+                        note_failure(
+                            blk, "determinism", 1,
+                            "speculative duplicate disagreed with the first "
+                            "result (nondeterministic kernel or corrupted "
+                            "data); block left unresolved for recompute",
+                            quarantine=True,
+                        )
+                        return
+                    if corrupt_seen[0]:
+                        # attribute the fault class: the store "failures"
+                        # were chunk corruption caught by the digest verify
+                        note_failure(
+                            blk, "corrupt", corrupt_seen[0], None,
+                            quarantine=False,
+                        )
                     if tb is not None:
+                        if dup_state["digest"] is not None:
+                            # the WIN claim's store never landed: release it
+                            # so the quarantine recompute is not misread as
+                            # a duplicate of a result that does not exist
+                            commits.withdraw(bid, dup_state["digest"])
                         note_failure(blk, "store", attempts, tb, quarantine=True)
                         return
                     if attempts > 1:
                         note_failure(
                             blk, "store", attempts - 1, None, quarantine=False
                         )
-                mark_resolved(blk)
-                if on_block_done is not None:
-                    on_block_done(blk)
+                    mark_resolved(blk)
+                    if not dup_state["contended"]:
+                        # a contended winner defers the success marker to the
+                        # duplicate's AGREE above: a mismatch must not leave
+                        # a marker a resumed run would trust (if the other
+                        # copy dies instead, the unmarked block is merely
+                        # recomputed on resume — safe)
+                        finish_block(blk)
+                else:
+                    mark_resolved(blk)
+                    finish_block(blk)
             except Exception:
                 # site "hook", not "store": the store path itself retries
                 # and records above — only validate_fn/on_block_done/corrupt
@@ -321,76 +520,165 @@ class BlockwiseExecutor:
                     quarantine=True,
                 )
                 return
-            injector.kill_point("block_done")
 
-        with ThreadPoolExecutor(max_workers=self.io_threads) as pool:
-            pending_loads: List[Future] = [
-                pool.submit(load_batch, i) for i in range(min(prefetch, n_batches))
-            ]
-            write_futures: List[Future] = []
-            for i in range(n_batches):
-                batch, arrays = pending_loads.pop(0).result()
-                if i + prefetch < n_batches:
-                    pending_loads.append(pool.submit(load_batch, i + prefetch))
-                # prompt drain: surface finished stores (and any programming
-                # error in the store path, with its batch's block ids) now,
-                # not at the end of the run
-                while write_futures and write_futures[0].done():
-                    write_futures.pop(0).result()
-                if not batch:
-                    continue  # every block of this batch was quarantined
-                arrays = tuple(jax.device_put(a, sharding) for a in arrays)
-                try:
-                    out = batched_kernel(*arrays)
-                except Exception:
-                    # a compute failure poisons the whole batch; quarantine
-                    # all of it — the reduced-batch pass isolates the culprit
-                    tb = fu.cap_traceback(traceback.format_exc())
-                    for blk in batch:
-                        note_failure(blk, "compute", 1, tb, quarantine=True)
-                    continue
-
-                def store_batch(batch=batch, out=out):
-                    # the device->host copy happens HERE, on the IO pool, so
-                    # the dispatch loop is free to enqueue the next batch
-                    # while this one's outputs stream back
-                    out_np = jax.tree_util.tree_map(np.asarray, out)
-                    for j, blk in enumerate(batch):
-                        block_out = jax.tree_util.tree_map(
-                            lambda a: a[j], out_np
-                        )
-                        handle_block_output(blk, block_out)
-
-                write_futures.append(pool.submit(store_batch))
-                # backpressure: each pending store closure pins its batch's
-                # DEVICE output buffers until its d2h copy runs, so the bound
-                # must be a small constant (not thread-count) or HBM fills
-                # with undrained outputs
-                while len(write_futures) > 2:
-                    write_futures.pop(0).result()
-            for f in write_futures:
-                f.result()
-
-            # -- quarantine pass: reduced-batch re-attempts -----------------
-            # re-run each quarantined block alone, replicated to the batch
-            # width through the SAME compiled kernel — bit-identical results,
-            # and a batch-poisoning block is isolated to itself
-            for blk in [b for b in blocks if int(b.block_id) in quarantined_ids]:
-                val = load_block(blk)
+        def speculative_rerun(blk):
+            """Duplicate execution of a hung block: fresh load, the SAME
+            compiled kernel on the reduced-batch path, and a first-wins
+            commit against the (possibly still stuck) original."""
+            try:
+                val = load_block(blk, origin="speculative")
                 if val is None:
-                    continue  # still failing; stays unresolved
+                    return
                 stacked = tuple(np.stack([x] * bs) for x in val)
                 stacked = tuple(jax.device_put(a, sharding) for a in stacked)
-                try:
+                with dispatch_lock:
                     out = batched_kernel(*stacked)
-                except Exception:
-                    tb = fu.cap_traceback(traceback.format_exc())
-                    note_failure(blk, "compute", 1, tb, quarantine=True)
-                    continue
-                out0 = jax.tree_util.tree_map(
-                    lambda a: np.asarray(a)[0], out
+                out0 = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], out)
+                handle_block_output(blk, out0, origin="speculative")
+            except Exception:
+                note_failure(
+                    blk, "speculate", 1,
+                    fu.cap_traceback(traceback.format_exc()),
+                    quarantine=False,
                 )
-                handle_block_output(blk, out0)
+
+        if deadline > 0:
+            spec_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="speculate"
+            )
+
+            def _on_hung(token, info, elapsed):
+                bid = int(info["block_id"])
+                blk = block_by_id[bid]
+                note_failure(
+                    blk, "hung", 1,
+                    f"block exceeded block_deadline_s={deadline:g}s in "
+                    f"stage {info['stage']} ({elapsed:.2f}s elapsed)",
+                    quarantine=True,
+                )
+                if not speculate or info.get("origin") != "primary":
+                    return
+                with fail_lock:
+                    if bid in speculated:
+                        return
+                    speculated.add(bid)
+                spec_futures.append(spec_pool.submit(speculative_rerun, blk))
+
+            watchdog = Watchdog(
+                deadline,
+                watchdog_period_s or max(0.02, deadline / 4.0),
+                _on_hung,
+            ).start()
+
+        try:
+            with ThreadPoolExecutor(max_workers=self.io_threads) as pool:
+                pending_loads: List[Future] = [
+                    pool.submit(load_batch, i) for i in range(min(prefetch, n_batches))
+                ]
+                write_futures: List[Future] = []
+                for i in range(n_batches):
+                    batch, arrays = pending_loads.pop(0).result()
+                    if i + prefetch < n_batches:
+                        pending_loads.append(pool.submit(load_batch, i + prefetch))
+                    # prompt drain: surface finished stores (and any programming
+                    # error in the store path, with its batch's block ids) now,
+                    # not at the end of the run
+                    while write_futures and write_futures[0].done():
+                        write_futures.pop(0).result()
+                    if not batch:
+                        continue  # every block of this batch was quarantined
+                    arrays = tuple(jax.device_put(a, sharding) for a in arrays)
+                    try:
+                        # take the dispatch lock BEFORE starting the blocks'
+                        # compute clocks: waiting behind a (possibly cold-
+                        # compiling) speculative dispatch is not this batch's
+                        # wall time, and must not cascade into false hangs
+                        with dispatch_lock, contextlib.ExitStack() as stack:
+                            for blk in batch:
+                                stack.enter_context(_watched(blk, "compute"))
+                            out = batched_kernel(*arrays)
+                    except Exception:
+                        # a compute failure poisons the whole batch; quarantine
+                        # all of it — the reduced-batch pass isolates the culprit
+                        tb = fu.cap_traceback(traceback.format_exc())
+                        for blk in batch:
+                            note_failure(blk, "compute", 1, tb, quarantine=True)
+                        continue
+
+                    def store_batch(batch=batch, out=out):
+                        # the device->host copy happens HERE, on the IO pool, so
+                        # the dispatch loop is free to enqueue the next batch
+                        # while this one's outputs stream back.  This copy is
+                        # also where a kernel wedged at RUNTIME blocks (the
+                        # jitted call above returns at dispatch — async), so
+                        # it is the stage the compute watchdog must cover.
+                        with contextlib.ExitStack() as stack:
+                            for blk in batch:
+                                stack.enter_context(_watched(blk, "compute"))
+                            out_np = jax.tree_util.tree_map(np.asarray, out)
+                        for j, blk in enumerate(batch):
+                            block_out = jax.tree_util.tree_map(
+                                lambda a: a[j], out_np
+                            )
+                            handle_block_output(blk, block_out)
+
+                    write_futures.append(pool.submit(store_batch))
+                    # backpressure: each pending store closure pins its batch's
+                    # DEVICE output buffers until its d2h copy runs, so the bound
+                    # must be a small constant (not thread-count) or HBM fills
+                    # with undrained outputs
+                    while len(write_futures) > 2:
+                        write_futures.pop(0).result()
+                for f in write_futures:
+                    f.result()
+
+                # settle speculative duplicates before judging what is still
+                # unresolved (the list can grow while we drain: a primary still
+                # stuck past its deadline fires the watchdog mid-drain)
+                i_spec = 0
+                while i_spec < len(spec_futures):
+                    spec_futures[i_spec].result()
+                    i_spec += 1
+                if watchdog is not None:
+                    watchdog.stop()
+                if spec_pool is not None:
+                    spec_pool.shutdown(wait=True)
+
+                # -- quarantine pass: reduced-batch re-attempts -----------------
+                # re-run each still-unresolved quarantined block alone,
+                # replicated to the batch width through the SAME compiled kernel
+                # — bit-identical results, and a batch-poisoning block is
+                # isolated to itself.  Blocks a speculative duplicate (or a
+                # late-finishing hung primary) already resolved are skipped.
+                with fail_lock:
+                    unresolved_q = {
+                        b for b in quarantined_ids if not failures[b]["resolved"]
+                    }
+                for blk in [b for b in blocks if int(b.block_id) in unresolved_q]:
+                    val = load_block(blk)
+                    if val is None:
+                        continue  # still failing; stays unresolved
+                    stacked = tuple(np.stack([x] * bs) for x in val)
+                    stacked = tuple(jax.device_put(a, sharding) for a in stacked)
+                    try:
+                        with dispatch_lock:
+                            out = batched_kernel(*stacked)
+                    except Exception:
+                        tb = fu.cap_traceback(traceback.format_exc())
+                        note_failure(blk, "compute", 1, tb, quarantine=True)
+                        continue
+                    out0 = jax.tree_util.tree_map(
+                        lambda a: np.asarray(a)[0], out
+                    )
+                    handle_block_output(blk, out0)
+
+        finally:
+            # the watchdog and speculation pool must not outlive the
+            # sweep, even when a load/store future propagates an error
+            if watchdog is not None:
+                watchdog.stop()
+            if spec_pool is not None:
+                spec_pool.shutdown(wait=True)
 
         unresolved = sorted(
             b for b, rec in failures.items() if not rec["resolved"]
@@ -414,8 +702,14 @@ class BlockwiseExecutor:
                 + (f"; see {failures_path}" if failures_path else "")
                 + f"; first errors:\n{details}"
             )
-        return {
+        summary = {
             "n_blocks": len(blocks),
             "n_quarantined": len(quarantined_ids),
             "n_failed": 0,
         }
+        if deadline > 0:
+            summary["n_hung"] = sum(
+                1 for rec in failures.values() if "hung" in rec["sites"]
+            )
+            summary["n_speculated"] = len(speculated)
+        return summary

@@ -17,7 +17,19 @@ task runtime, and container IO layer call at their failure-relevant sites:
 - :meth:`FaultInjector.kill_point` — ``os._exit`` at the N-th crossing of a
   named progress point (``block_done`` / ``task_done``), modelling
   preemption.  A latch file in ``state_dir`` makes the kill one-shot, so a
-  resumed run with the *same* ``CTT_FAULTS`` does not die again.
+  resumed run with the *same* ``CTT_FAULTS`` does not die again,
+- :meth:`FaultInjector.maybe_hang` — sleep ``seconds`` at a load / store /
+  io_read / io_write site (``kind='hang'``), modelling a stuck kernel or a
+  wedged filesystem call.  The executor's per-block deadline watchdog is
+  what must notice,
+- :meth:`FaultInjector.chunk_corrupt` — report that a just-written chunk
+  should be silently bit-flipped on storage (``kind='corrupt'``, site
+  ``io_write``).  The container layer applies the flip *after* recording
+  the region's checksum sidecar, so only checksum verification can tell,
+- :meth:`FaultInjector.lose_job` — swallow a scheduler submission
+  (``kind='job_loss'``, site ``submit``): the submitter gets a job id, the
+  scheduler keeps reporting it as running, but nothing ever executes —
+  only heartbeat supervision (``runtime/cluster.py``) can find it.
 
 Config schema::
 
@@ -34,6 +46,15 @@ Config schema::
         # random 10% of io reads fail (seeded, deterministic per attempt)
         {"site": "io_read", "kind": "error", "rate": 0.1,
          "fail_attempts": 1000000},
+        # hung block: the first load of block 4 sleeps 2 s (past any
+        # sub-second block_deadline_s), only in watershed tasks
+        {"site": "load", "kind": "hang", "blocks": [4], "seconds": 2.0,
+         "tasks": ["watershed"]},
+        # silent corruption: block 2's first chunk write is bit-flipped on
+        # disk after the checksum sidecar is recorded
+        {"site": "io_write", "kind": "corrupt", "blocks": [2]},
+        # lost scheduler job: the first submission is swallowed
+        {"site": "submit", "kind": "job_loss", "fail_attempts": 1},
         # preemption: exit hard at the 3rd completed block
         {"site": "block_done", "kind": "kill", "after": 3}
       ]
@@ -45,13 +66,22 @@ models transient (1–2) versus persistent (> the executor's retry budget)
 failures, and retries/quarantine re-attempts eventually pass.  Rate-based
 faults hash ``(seed, site, block, attempt)`` so they are reproducible
 without shared state.
+
+Targeting: ``blocks`` gates on the executor's block id — call sites that
+don't know it (the container IO layer) inherit it from the executor through
+:func:`block_context` (thread-local, set around every per-block load/store).
+``tasks`` gates on the running task's uid prefix (:func:`set_current_task`,
+process-global — one task runs at a time per process), so one fault spec can
+target ``watershed`` blocks without also firing in ``graph``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
+import time
 import zlib
 from typing import Any, Dict, Optional
 
@@ -65,6 +95,42 @@ ENV_VAR = "CTT_FAULTS"
 
 _ERROR_SITES = ("load", "store", "io_read", "io_write", "submit", "task")
 _KILL_SITES = ("block_done", "task_done")
+_HANG_SITES = ("load", "store", "io_read", "io_write")
+
+
+# -- fault-targeting context --------------------------------------------------
+# Block ids are thread-local (the executor's IO pool works many blocks at
+# once); the current task is process-global (build() runs one task at a
+# time per process, and the remote cluster runner is single-task anyway).
+
+_tls = threading.local()
+_current_task: Optional[str] = None
+
+
+@contextlib.contextmanager
+def block_context(block_id: Optional[int]):
+    """Tag this thread's container-level IO with a block id, so io_read /
+    io_write faults (and checksum corruption) can target blocks even though
+    the storage layer never sees one."""
+    prev = getattr(_tls, "block_id", None)
+    _tls.block_id = block_id
+    try:
+        yield
+    finally:
+        _tls.block_id = prev
+
+
+def current_block_id() -> Optional[int]:
+    return getattr(_tls, "block_id", None)
+
+
+def set_current_task(name: Optional[str]) -> None:
+    global _current_task
+    _current_task = name
+
+
+def current_task() -> Optional[str]:
+    return _current_task
 
 
 class InjectedFault(RuntimeError):
@@ -131,6 +197,23 @@ class FaultInjector:
                         f"error fault site must be one of {_ERROR_SITES}, "
                         f"got {site!r}"
                     )
+            elif kind == "hang":
+                if site not in _HANG_SITES:
+                    raise ValueError(
+                        f"hang fault site must be one of {_HANG_SITES}, "
+                        f"got {site!r}"
+                    )
+            elif kind == "corrupt":
+                if site != "io_write":
+                    raise ValueError(
+                        "corrupt faults only apply to site='io_write' (a "
+                        "chunk is bit-flipped after it lands on storage)"
+                    )
+            elif kind == "job_loss":
+                if site != "submit":
+                    raise ValueError(
+                        "job_loss faults only apply to site='submit'"
+                    )
             else:
                 raise ValueError(f"unknown fault kind {kind!r}")
         if self.state_dir:
@@ -156,6 +239,11 @@ class FaultInjector:
         blocks = spec.get("blocks")
         if blocks is not None:
             if block_id is None or int(block_id) not in {int(b) for b in blocks}:
+                return None
+        tasks = spec.get("tasks")
+        if tasks is not None:
+            cur = current_task() or ""
+            if not any(cur.startswith(str(t)) for t in tasks):
                 return None
         attempt = self._next_attempt(site, block_id, idx)
         if attempt > int(spec.get("fail_attempts", 1)):
@@ -186,6 +274,39 @@ class FaultInjector:
 
                 return jax.tree_util.tree_map(_poison_leaf, tree)
         return tree
+
+    def maybe_hang(self, site: str, block_id: Optional[int] = None) -> None:
+        """Sleep ``seconds`` (default 1.0) if a hang fault fires here —
+        modelling a stuck kernel / wedged IO call that only a wall-clock
+        deadline can notice.  The sleep is finite so test runs terminate;
+        the watchdog must have declared the block hung long before it ends."""
+        if not self.enabled:
+            return
+        for idx, spec in enumerate(self.specs):
+            attempt = self._active(idx, spec, site, block_id, "hang")
+            if attempt is not None:
+                time.sleep(float(spec.get("seconds", 1.0)))
+
+    def chunk_corrupt(self, site: str, block_id: Optional[int] = None) -> bool:
+        """True if a just-written chunk at this site should be silently
+        bit-flipped on storage (the container layer applies the flip)."""
+        if not self.enabled:
+            return False
+        for idx, spec in enumerate(self.specs):
+            if self._active(idx, spec, site, block_id, "corrupt") is not None:
+                return True
+        return False
+
+    def lose_job(self) -> bool:
+        """True if this scheduler submission should be swallowed: the caller
+        fabricates a job id the scheduler will keep reporting as running,
+        and nothing ever executes — heartbeat supervision must find it."""
+        if not self.enabled:
+            return False
+        for idx, spec in enumerate(self.specs):
+            if self._active(idx, spec, "submit", None, "job_loss") is not None:
+                return True
+        return False
 
     def kill_point(self, site: str) -> None:
         """Hard-exit (``os._exit``) at the configured crossing of ``site``.

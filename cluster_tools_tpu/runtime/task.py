@@ -119,12 +119,21 @@ class BaseTask:
         ``max_retries`` task-level re-runs in :func:`build` (0 = fail fast),
         ``retry_backoff_s`` base of the capped exponential task backoff,
         ``io_retries`` / ``io_backoff_s`` per-block load/store retries inside
-        :class:`~cluster_tools_tpu.runtime.executor.BlockwiseExecutor`."""
+        :class:`~cluster_tools_tpu.runtime.executor.BlockwiseExecutor`,
+        ``block_deadline_s`` / ``watchdog_period_s`` the hung-block deadline
+        + speculative re-execution (None disables), and the cluster-target
+        supervision trio ``heartbeat_interval_s`` / ``heartbeat_timeout_s``
+        / ``max_resubmits`` (``runtime/cluster.py``)."""
         return {
             "max_retries": 0,
             "retry_backoff_s": 1.0,
             "io_retries": 2,
             "io_backoff_s": 0.05,
+            "block_deadline_s": None,
+            "watchdog_period_s": None,
+            "heartbeat_interval_s": 5.0,
+            "heartbeat_timeout_s": 0.0,
+            "max_resubmits": 2,
         }
 
     @staticmethod
@@ -155,9 +164,16 @@ class BaseTask:
         raise NotImplementedError
 
     def run(self):
+        from . import faults as faults_mod
+
         t0 = time.time()
         self.logger.info(f"start {self.task_name} (target={self.target})")
-        result = self.run_impl() or {}
+        # fault specs with a "tasks" filter target the running task's uid
+        faults_mod.set_current_task(self.uid)
+        try:
+            result = self.run_impl() or {}
+        finally:
+            faults_mod.set_current_task(None)
         result["runtime_s"] = time.time() - t0
         result["target"] = self.target
         self.output().write(result)

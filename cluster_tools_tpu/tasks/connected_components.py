@@ -34,7 +34,11 @@ import numpy as np
 
 from ..ops.ccl import label_components, label_components_keyed
 from ..ops.unionfind import union_find, union_find_host
-from ..runtime.executor import BlockwiseExecutor, validate_labels
+from ..runtime.executor import (
+    BlockwiseExecutor,
+    region_verifier,
+    validate_labels,
+)
 from ..runtime.task import BaseTask, WorkflowBase, build
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader, pad_block_to
 
@@ -168,6 +172,9 @@ class BlockComponentsBase(BaseTask):
             validate_fn=validate_labels,
             failures_path=self.failures_path,
             task_name=self.uid,
+            block_deadline_s=cfg.get("block_deadline_s"),
+            watchdog_period_s=cfg.get("watchdog_period_s"),
+            store_verify_fn=region_verifier(out),
         )
         return {"n_blocks": len(block_ids), "shape": list(shape)}
 

@@ -25,7 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..runtime.executor import BlockwiseExecutor
+from ..runtime.executor import BlockwiseExecutor, region_verifier
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader, pad_block_to
 
@@ -192,6 +192,11 @@ class InferenceBase(BaseTask):
             done_block_ids=done,
             failures_path=self.failures_path,
             task_name=self.uid,
+            block_deadline_s=cfg.get("block_deadline_s"),
+            watchdog_period_s=cfg.get("watchdog_period_s"),
+            store_verify_fn=region_verifier(
+                out, bb_of=lambda b: (slice(None),) + b.bb
+            ),
         )
         return {
             "n_blocks": len(todo),

@@ -29,7 +29,11 @@ from ..ops.watershed import (
     dt_watershed_seeded,
     filter_small_segments,
 )
-from ..runtime.executor import BlockwiseExecutor, validate_labels
+from ..runtime.executor import (
+    BlockwiseExecutor,
+    region_verifier,
+    validate_labels,
+)
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import (
     Blocking,
@@ -300,12 +304,17 @@ class WatershedBase(_WsTaskBase):
                 )
             lab = np.asarray(lab)
             if agg_thr is not None:
-                # peek, don't pop: a store retry must find the stash intact
+                # peek, don't pop: a store retry (including a post-store
+                # integrity-verify retry) must find the stash intact — the
+                # stash is released in block_done below
                 lab = self._agglomerate_block(
                     lab, bnd_stash[block.block_id], float(agg_thr)
                 )
             self._store_labels(out, block, lab, n_outer)
+
+        def block_done(block):
             bnd_stash.pop(block.block_id, None)
+            self.log_block_success(block.block_id)
 
         if impl == "host":
             # reference-style per-job scipy compute (ops/host.py): no
@@ -362,11 +371,14 @@ class WatershedBase(_WsTaskBase):
                 blocks_all,
                 load,
                 store,
-                on_block_done=lambda b: self.log_block_success(b.block_id),
+                on_block_done=block_done,
                 done_block_ids=done,
                 validate_fn=validate_labels,
                 failures_path=self.failures_path,
                 task_name=self.uid,
+                block_deadline_s=cfg.get("block_deadline_s"),
+                watchdog_period_s=cfg.get("watchdog_period_s"),
+                store_verify_fn=region_verifier(out),
             )
         return {
             "n_blocks": len(block_ids),
@@ -529,7 +541,12 @@ class TwoPassWatershedBase(_WsTaskBase):
                 new
             ].astype(np.uint64)
             out[block.bb] = glob
+
+        def block_done(block):
+            # release the seed table only once the block is fully stored
+            # (a verify-triggered re-store must still find it)
             tables.pop(block.block_id, None)
+            self.log_block_success(block.block_id)
 
         executor = BlockwiseExecutor(
             target=self.target,
@@ -543,11 +560,14 @@ class TwoPassWatershedBase(_WsTaskBase):
             blocks_all,
             load,
             store,
-            on_block_done=lambda b: self.log_block_success(b.block_id),
+            on_block_done=block_done,
             done_block_ids=done,
             validate_fn=validate_labels,
             failures_path=self.failures_path,
             task_name=self.uid,
+            block_deadline_s=cfg.get("block_deadline_s"),
+            watchdog_period_s=cfg.get("watchdog_period_s"),
+            store_verify_fn=region_verifier(out),
         )
         return {
             "n_blocks": len(block_ids),

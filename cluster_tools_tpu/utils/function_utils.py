@@ -9,12 +9,15 @@ manifests rather than grep-able log lines.
 
 from __future__ import annotations
 
+import contextlib
 import datetime
 import json
 import logging
 import os
+import random
 import sys
 import threading
+import time
 from typing import Iterable, List, Optional
 
 _LOGGERS = {}
@@ -91,26 +94,80 @@ def failures_path(tmp_folder: str) -> str:
     return os.path.join(tmp_folder, "failures.json")
 
 
+@contextlib.contextmanager
+def file_lock(path: str, timeout_s: float = 30.0, stale_s: float = 60.0):
+    """Advisory cross-process lock via an ``O_CREAT|O_EXCL`` lock file
+    (works on the shared filesystems cluster jobs coordinate over, where
+    ``fcntl`` locks are unreliable).  A lock older than ``stale_s`` is
+    broken (its holder died between create and unlink); after ``timeout_s``
+    the lock is stolen rather than raising — the callers guard best-effort
+    bookkeeping on failure paths, where blocking forever or raising would
+    mask the real error."""
+    lock = path + ".lock"
+    # unique ownership token: release must only unlink OUR lock file — a
+    # holder whose lock was stolen (timeout/stale break) must not remove
+    # the thief's lock and cascade the loss of mutual exclusion
+    token = f"{os.getpid()}:{threading.get_ident()}:{random.random()}"
+    deadline = time.time() + float(timeout_s)
+    while True:
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, token.encode())
+            os.close(fd)
+            break
+        except FileExistsError:
+            try:
+                stale = time.time() - os.path.getmtime(lock) > float(stale_s)
+            except OSError:
+                continue  # holder released between exists-check and stat
+            if stale or time.time() > deadline:
+                # atomic steal: rename first — exactly one of N waiters
+                # wins the rename, so two waiters can never both break the
+                # same lock and then break each other's fresh locks
+                grave = f"{lock}.stolen.{os.getpid()}.{threading.get_ident()}"
+                try:
+                    os.rename(lock, grave)
+                    os.unlink(grave)
+                except OSError:
+                    pass  # another waiter stole it first; re-acquire
+                continue
+            time.sleep(0.005 + 0.01 * random.random())
+    try:
+        yield
+    finally:
+        try:
+            with open(lock) as f:
+                if f.read() == token:
+                    os.unlink(lock)
+        except OSError:
+            pass
+
+
 def record_failures(path: str, task_name: str, records) -> None:
     """Merge block-failure records into ``failures.json`` (atomic).
 
     Schema: ``{"version": 1, "records": [{"task", "block_id",
     "sites": {site: attempts}, "error", "quarantined", "resolved"}]}``.
     Records are keyed by (task, block_id): a resumed run's record replaces
-    the stale one from before the restart.
+    the stale one from before the restart.  The read-modify-write runs
+    under a lock file so two cluster jobs recording failures at the same
+    moment cannot drop each other's records.
     """
-    doc = read_json_if_valid(path) or {}
-    existing = {
-        (r.get("task"), r.get("block_id")): r for r in doc.get("records", [])
-    }
-    for rec in records:
-        rec = dict(rec)
-        rec["task"] = task_name
-        existing[(task_name, rec.get("block_id"))] = rec
-    merged = sorted(
-        existing.values(), key=lambda r: (str(r.get("task")), r.get("block_id") or 0)
-    )
-    atomic_write_json(path, {"version": 1, "records": merged})
+    with file_lock(path):
+        doc = read_json_if_valid(path) or {}
+        existing = {
+            (r.get("task"), r.get("block_id")): r
+            for r in doc.get("records", [])
+        }
+        for rec in records:
+            rec = dict(rec)
+            rec["task"] = task_name
+            existing[(task_name, rec.get("block_id"))] = rec
+        merged = sorted(
+            existing.values(),
+            key=lambda r: (str(r.get("task")), r.get("block_id") or 0),
+        )
+        atomic_write_json(path, {"version": 1, "records": merged})
 
 
 def _marker_dir(tmp_folder: str, task_name: str) -> str:

@@ -32,3 +32,49 @@ def random_blobs(rng, shape, p=0.5, smooth=1):
 
     x = gaussian_filter(x, smooth)
     return x > np.quantile(x, 1 - p)
+
+
+def write_stub(path, body):
+    """Write an executable shell stub (`#!/bin/bash` + body)."""
+    import os
+    import stat
+
+    with open(path, "w") as f:
+        f.write("#!/bin/bash\n" + body)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+
+def stub_slurm_bins(bindir):
+    """Stub sbatch/squeue/scancel in ``bindir``: jobs are detached local
+    processes, job id = pid.  sbatch launches the script detached (honoring
+    -o) and prints the pid; squeue prints a row while the pid lives;
+    scancel kills the process group.  Shared by the cluster-target tests,
+    the chaos suite, and scripts/supervise_demo.py — prepend ``bindir`` to
+    PATH to use it."""
+    import os
+
+    os.makedirs(bindir, exist_ok=True)
+    write_stub(
+        os.path.join(bindir, "sbatch"),
+        # last argument is the script; flags before it are accepted+ignored
+        'script="${@: -1}"\n'
+        "out=/dev/null\n"
+        'prev=""\n'
+        'for a in "$@"; do if [ "$prev" = "-o" ]; then out="$a"; fi; '
+        'prev="$a"; done\n'
+        'JAX_PLATFORMS=cpu setsid bash "$script" > "$out" 2>&1 &\n'
+        "echo $!\n",
+    )
+    write_stub(
+        os.path.join(bindir, "squeue"),
+        'pid="${@: -1}"\n'
+        'if kill -0 "$pid" 2>/dev/null; then echo "RUNNING"; fi\n'
+        "exit 0\n",
+    )
+    write_stub(
+        os.path.join(bindir, "scancel"),
+        'pid="${@: -1}"\n'
+        'kill -9 "-$pid" 2>/dev/null || kill -9 "$pid" 2>/dev/null\n'
+        "exit 0\n",
+    )
+    return bindir

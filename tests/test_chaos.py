@@ -1,9 +1,19 @@
-"""End-to-end chaos test (ISSUE 2 acceptance): the watershed -> graph ->
-multicut workflow under seeded fault injection — transient load errors,
-persistent store errors, a NaN-producing kernel, plus mid-run kills at both
-the block grain and the task grain — must complete on resume and produce a
-final segmentation BIT-IDENTICAL to a fault-free run, with every
-quarantined block recorded in ``failures.json``.
+"""End-to-end chaos tests.
+
+ISSUE 2 acceptance: the watershed -> graph -> multicut workflow under
+seeded fault injection — transient load errors, persistent store errors, a
+NaN-producing kernel, plus mid-run kills at both the block grain and the
+task grain — must complete on resume and produce a final segmentation
+BIT-IDENTICAL to a fault-free run, with every quarantined block recorded
+in ``failures.json``.
+
+ISSUE 3 acceptance (silent failures): the same workflow under an injected
+*hang* (stuck load past ``block_deadline_s``), *chunk corruption*
+(bit-flipped stored chunk behind its checksum sidecar), and *job loss*
+(scheduler swallows a submission, found only by heartbeat supervision,
+exercised on the stub-slurm cluster target) — must converge, bit-identical
+to fault-free, with every hung/corrupt/lost unit attributed in
+``failures.json``.
 
 Excluded from tier-1 via the markers; run with ``make chaos`` (fixed seed,
 overridable via ``CTT_CHAOS_SEED``).
@@ -20,6 +30,7 @@ import pytest
 from cluster_tools_tpu.runtime.faults import KILL_EXIT_CODE
 from cluster_tools_tpu.utils.volume_utils import file_reader
 
+from .helpers import stub_slurm_bins
 from .test_multicut_workflow import make_case, _write_ds
 
 pytestmark = [pytest.mark.chaos, pytest.mark.slow]
@@ -29,7 +40,7 @@ DRIVER = os.path.join(os.path.dirname(__file__), "chaos_driver.py")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_driver(spec_path, faults_cfg=None, timeout=600):
+def _run_driver(spec_path, faults_cfg=None, timeout=600, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
@@ -37,6 +48,8 @@ def _run_driver(spec_path, faults_cfg=None, timeout=600):
         env["CTT_FAULTS"] = json.dumps(faults_cfg)
     else:
         env.pop("CTT_FAULTS", None)
+    if extra_env:
+        env.update(extra_env)
     proc = subprocess.run(
         [sys.executable, DRIVER, spec_path],
         env=env,
@@ -47,21 +60,23 @@ def _run_driver(spec_path, faults_cfg=None, timeout=600):
     return proc
 
 
-def _workspace(root, name, bmap):
+def _workspace(root, name, bmap, target="local", global_cfg=None):
     """Per-run directories + data + workflow spec (identical inputs)."""
     base = os.path.join(root, name)
     tmp_folder = os.path.join(base, "tmp")
     config_dir = os.path.join(base, "config")
     os.makedirs(config_dir, exist_ok=True)
+    cfg = {"block_shape": [8, 8, 8]}
+    cfg.update(global_cfg or {})
     with open(os.path.join(config_dir, "global.config"), "w") as f:
-        json.dump({"block_shape": [8, 8, 8]}, f)
+        json.dump(cfg, f)
     path = os.path.join(base, "data.zarr")
     _write_ds(path, "bmap", bmap)
     spec = dict(
         tmp_folder=tmp_folder,
         config_dir=config_dir,
         max_jobs=4,
-        target="local",
+        target=target,
         input_path=path,
         input_key="bmap",
         ws_path=path,
@@ -76,6 +91,12 @@ def _workspace(root, name, bmap):
     with open(spec_path, "w") as f:
         json.dump(spec, f, indent=2)
     return spec_path, path, tmp_folder
+
+
+def _stub_slurm(root):
+    """Stub sbatch/squeue/scancel: jobs are detached local processes, job
+    id = pid (shared helper, see tests/helpers.py)."""
+    return stub_slurm_bins(os.path.join(root, "fakebin"))
 
 
 def test_chaos_workflow_survives_faults_and_kills(tmp_path):
@@ -149,3 +170,97 @@ def test_chaos_workflow_survives_faults_and_kills(tmp_path):
     assert "label" in (nan_rec["error"] or "") or "finite" in (
         nan_rec["error"] or ""
     )
+
+
+def test_chaos_silent_failures_supervised(tmp_path):
+    """ISSUE 3 acceptance: watershed -> graph -> multicut on the (stubbed)
+    slurm cluster target under an injected hang + chunk corruption + job
+    loss completes, is bit-identical to a fault-free local run, and
+    ``failures.json`` attributes each hung / corrupt / lost unit.  The lost
+    job is found by heartbeat supervision (the stub scheduler keeps
+    claiming a swallowed job runs) and resubmitted long before
+    ``submit_timeout_s``."""
+    root = str(tmp_path)
+    _, _, bmap = make_case(noise=0.02, seed=SEED)
+
+    # -- reference: fault-free local run ----------------------------------
+    ref_spec, ref_path, _ = _workspace(root, "ref", bmap)
+    proc = _run_driver(ref_spec)
+    assert proc.returncode == 0, f"fault-free run failed:\n{proc.stderr[-4000:]}"
+    ref = file_reader(ref_path, "r")
+    ref_ws, ref_seg = ref["ws"][...], ref["seg"][...]
+
+    # -- chaos run: cluster target + the three silent fault classes -------
+    supervision_cfg = {
+        # hung-block defense: the deadline must sit above a cold kernel
+        # compile (a false hang is benign — speculation is idempotent —
+        # but noisy) and below the injected 5 s hang
+        "block_deadline_s": 3.0,
+        "watchdog_period_s": 0.2,
+        # lost-job supervision: the batch script heartbeats at job start,
+        # so 8 s of silence while "running" means the scheduler is lying
+        "heartbeat_interval_s": 0.3,
+        "heartbeat_timeout_s": 8.0,
+        "max_resubmits": 2,
+        "poll_interval_s": 0.3,
+        "result_grace_s": 2.0,
+        "submit_timeout_s": 300,
+    }
+    chaos_spec, chaos_path, tmp_folder = _workspace(
+        root, "chaos_silent", bmap, target="slurm",
+        global_cfg=supervision_cfg,
+    )
+    bindir = _stub_slurm(root)
+    faults_cfg = {
+        "seed": SEED,
+        "faults": [
+            # hung block: watershed block 1's first load wedges for 5 s —
+            # past the 3 s deadline; the watchdog must quarantine it and a
+            # speculative duplicate must finish it
+            {"site": "load", "kind": "hang", "blocks": [1], "seconds": 5.0,
+             "fail_attempts": 1, "tasks": ["watershed"]},
+            # silent corruption: watershed block 2's stored chunk is
+            # bit-flipped after the write; only the checksum sidecar can
+            # tell, and the store-verify retry must repair it
+            {"site": "io_write", "kind": "corrupt", "blocks": [2],
+             "fail_attempts": 1, "tasks": ["watershed"]},
+            # lost job: the first scheduler submission is swallowed; the
+            # stub scheduler will keep reporting it as running
+            {"site": "submit", "kind": "job_loss", "fail_attempts": 1},
+        ],
+    }
+    proc = _run_driver(
+        chaos_spec, faults_cfg,
+        extra_env={"PATH": f"{bindir}:{os.environ['PATH']}"},
+    )
+    assert proc.returncode == 0, (
+        f"supervised chaos run failed:\n{proc.stderr[-6000:]}"
+    )
+
+    # -- bit-identical to the fault-free run ------------------------------
+    chaos = file_reader(chaos_path, "r")
+    np.testing.assert_array_equal(chaos["ws"][...], ref_ws)
+    np.testing.assert_array_equal(chaos["seg"][...], ref_seg)
+
+    # -- failures.json attributes every silent-fault unit -----------------
+    with open(os.path.join(tmp_folder, "failures.json")) as f:
+        recs = json.load(f)["records"]
+    ws_recs = {
+        r["block_id"]: r for r in recs if r["task"].startswith("watershed")
+    }
+    hung = ws_recs.get(1)
+    assert hung is not None, f"no hung-block record: {sorted(ws_recs)}"
+    assert hung["sites"].get("hung", 0) >= 1 and hung["resolved"]
+    corrupt = ws_recs.get(2)
+    assert corrupt is not None, f"no corrupt-block record: {sorted(ws_recs)}"
+    assert corrupt["sites"].get("corrupt", 0) >= 1 and corrupt["resolved"]
+    lost = [r for r in recs if r["sites"].get("job_loss")]
+    assert lost and all(r["resolved"] for r in lost), lost
+    assert any(
+        j.startswith("lost:") for r in lost for j in r.get("job_ids", [])
+    )
+
+    # the supervisor's audit trail names the loss and the resubmission
+    with open(os.path.join(tmp_folder, "cluster", "supervisor.log")) as f:
+        slog = f.read()
+    assert "declared lost" in slog and "resubmitting" in slog

@@ -34,31 +34,16 @@ def _write_stub(path, body):
 
 @pytest.fixture
 def fake_slurm(tmp_path, monkeypatch):
-    """Stub sbatch/squeue: sbatch launches the script detached and prints
-    its pid as the job id; squeue -h -j <pid> prints a row while the
-    process lives.  JAX_PLATFORMS=cpu is exported so the remote runner
-    pins cpu (the axon sitecustomize would otherwise grab the tunnel)."""
-    bindir = tmp_path / "fakebin"
-    bindir.mkdir()
-    _write_stub(
-        str(bindir / "sbatch"),
-        # last argument is the script; flags before it are accepted+ignored
-        'script="${@: -1}"\n'
-        "out=/dev/null\n"
-        'prev=""\n'
-        'for a in "$@"; do if [ "$prev" = "-o" ]; then out="$a"; fi; '
-        'prev="$a"; done\n'
-        'JAX_PLATFORMS=cpu setsid bash "$script" > "$out" 2>&1 &\n'
-        "echo $!\n",
-    )
-    _write_stub(
-        str(bindir / "squeue"),
-        'pid="${@: -1}"\n'
-        'if kill -0 "$pid" 2>/dev/null; then echo "RUNNING"; fi\n'
-        "exit 0\n",
-    )
+    """Stub sbatch/squeue/scancel (shared helper, tests/helpers.py): sbatch
+    launches the script detached and prints its pid as the job id; squeue
+    -h -j <pid> prints a row while the process lives.  JAX_PLATFORMS=cpu is
+    exported so the remote runner pins cpu (the axon sitecustomize would
+    otherwise grab the tunnel)."""
+    from .helpers import stub_slurm_bins
+
+    bindir = stub_slurm_bins(str(tmp_path / "fakebin"))
     monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
-    return str(bindir)
+    return bindir
 
 
 @pytest.fixture
