@@ -1,14 +1,28 @@
-# Developer entry points.  `test` = tier-1 (fast, chaos excluded via the
-# slow marker) followed by the chaos suite; `chaos` = the fault-injection
-# suite alone, fixed seed — kills/resume plus the silent-failure scenarios
-# (hang, chunk corruption, job loss) from ISSUE 3; `supervise-demo` = a
-# smoke-check recipe that runs a watershed workflow on the stub-slurm
-# cluster target under an injected job loss and prints the supervisor's
-# resubmission log (docs/ROBUSTNESS.md).
+# Developer entry points.
+#   test            = tier-1 (fast; chaos excluded via the slow marker)
+#                     followed by the full chaos suite
+#   tier1           = the fast suite alone
+#   chaos           = the whole fault-injection suite, fixed seed — kills/
+#                     resume, the silent-failure scenarios (hang, chunk
+#                     corruption, job loss), and the resource-exhaustion /
+#                     preemption scenario from the graceful-degradation layer
+#   chaos-resource  = only the resource chaos: watershed->graph->multicut
+#                     under seeded oom+enospc faults and a real SIGTERM
+#                     mid-run (drain -> requeue-exit -> resume), asserting a
+#                     bit-identical final segmentation (docs/ROBUSTNESS.md
+#                     "Graceful degradation"); tier-1 stays fast because the
+#                     chaos+slow markers keep it out of `tier1`
+#   failures-report = one-screen post-mortem of a run's failures.json
+#                     (pass TMP=/path/to/tmp_folder or .../failures.json)
+#   supervise-demo  = smoke-check recipe: watershed workflow on the
+#                     stub-slurm cluster target under an injected job loss,
+#                     printing the supervisor's resubmission log
 PY ?= python
 CTT_CHAOS_SEED ?= 7
+TMP ?= /tmp/ctt_run
 
-.PHONY: test tier1 chaos supervise-demo native clean
+.PHONY: test tier1 chaos chaos-resource failures-report supervise-demo \
+	native clean
 
 test: tier1 chaos
 
@@ -19,6 +33,14 @@ tier1:
 chaos:
 	JAX_PLATFORMS=cpu CTT_CHAOS_SEED=$(CTT_CHAOS_SEED) \
 		$(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+chaos-resource:
+	JAX_PLATFORMS=cpu CTT_CHAOS_SEED=$(CTT_CHAOS_SEED) \
+		$(PY) -m pytest tests/test_chaos.py -q -m chaos \
+		-k resource -p no:cacheprovider
+
+failures-report:
+	$(PY) scripts/failures_report.py $(TMP)
 
 supervise-demo:
 	JAX_PLATFORMS=cpu $(PY) scripts/supervise_demo.py

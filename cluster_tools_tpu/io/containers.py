@@ -67,16 +67,18 @@ def _faults():
     return _faults_mod
 
 
-def _inject(site: str) -> Optional[int]:
+def _inject(site: str, voxels: Optional[int] = None) -> Optional[int]:
     """Fault-injection hook for the container IO layer (sites ``io_read`` /
     ``io_write``; see runtime/faults.py).  A no-op unless an injector is
     configured — chaos tests exercise the executor's load/store retries
     against storage-level failures through this.  The block id is inherited
     from the executor's thread-local :func:`~...runtime.faults.block_context`
-    and returned so async completions can reuse it."""
+    and returned so async completions can reuse it.  ``voxels`` (the write's
+    element count, when the caller knows it) feeds the ``min_voxels`` gate
+    of resource faults — full-size writes fail, split sub-writes fit."""
     fm = _faults()
     block_id = fm.current_block_id()
-    fm.get_injector().maybe_fail(site, block_id)
+    fm.get_injector().maybe_fail(site, block_id, voxels=voxels)
     return block_id
 
 
@@ -378,7 +380,7 @@ class Dataset(_ChecksumOps):
         return arr
 
     def __setitem__(self, bb, value) -> None:
-        bid = _inject("io_write")
+        bid = _inject("io_write", voxels=getattr(value, "size", None))
         _hang("io_write", bid)
         value = np.asarray(value, dtype=self.dtype)
         self._store[bb].write(value).result()
@@ -400,7 +402,7 @@ class Dataset(_ChecksumOps):
         return _WrappedFuture(fut, finish)
 
     def write_async(self, bb, value):
-        bid = _inject("io_write")
+        bid = _inject("io_write", voxels=getattr(value, "size", None))
         value = np.asarray(value, dtype=self.dtype)
         fut = self._store[bb].write(value)
 
@@ -647,7 +649,7 @@ class _H5Dataset:
         return self._ds[bb]
 
     def __setitem__(self, bb, value):
-        bid = _inject("io_write")
+        bid = _inject("io_write", voxels=getattr(value, "size", None))
         _hang("io_write", bid)
         self._ds[bb] = value
 
@@ -657,7 +659,7 @@ class _H5Dataset:
         return _ImmediateFuture(self._ds[bb])
 
     def write_async(self, bb, value):
-        bid = _inject("io_write")
+        bid = _inject("io_write", voxels=getattr(value, "size", None))
         _hang("io_write", bid)
         self._ds[bb] = value
         return _ImmediateFuture(None)
@@ -794,7 +796,7 @@ class _MemDataset(_ChecksumOps):
         return arr
 
     def __setitem__(self, bb, value):
-        bid = _inject("io_write")
+        bid = _inject("io_write", voxels=getattr(value, "size", None))
         _hang("io_write", bid)
         value = np.asarray(value, dtype=self._arr.dtype)
         self._arr[bb] = value
@@ -808,7 +810,7 @@ class _MemDataset(_ChecksumOps):
         return _ImmediateFuture(arr)
 
     def write_async(self, bb, value):
-        bid = _inject("io_write")
+        bid = _inject("io_write", voxels=getattr(value, "size", None))
         _hang("io_write", bid)
         value = np.asarray(value, dtype=self._arr.dtype)
         self._arr[bb] = value

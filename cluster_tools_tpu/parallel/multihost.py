@@ -29,6 +29,7 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _ENV_COORD = "CT_MP_COORDINATOR"
@@ -152,37 +153,68 @@ def launch_workers(
     return collect_workers(procs, timeout)
 
 
-def _kill_process_group(p: subprocess.Popen) -> None:
-    """SIGKILL the worker's whole process group (workers are session
-    leaders via ``start_new_session=True``, so pgid == pid) — ``p.kill()``
-    alone would orphan grandchildren as zombies."""
+#: grace between SIGTERM and SIGKILL when tearing down timed-out workers:
+#: long enough to flush logs/heartbeats, short enough not to stall teardown
+TERM_GRACE_S = 5.0
+
+
+def _signal_process_group(p: subprocess.Popen, sig: int) -> None:
+    """Deliver ``sig`` to the worker's whole process group (workers are
+    session leaders via ``start_new_session=True``, so pgid == pid) —
+    signalling only the leader would orphan grandchildren as zombies."""
     try:
-        os.killpg(p.pid, signal.SIGKILL)
+        os.killpg(p.pid, sig)
     except (ProcessLookupError, PermissionError, OSError):
         try:
-            p.kill()
+            p.send_signal(sig)
         except OSError:
             pass
 
 
+def _kill_process_group(p: subprocess.Popen) -> None:
+    _signal_process_group(p, signal.SIGKILL)
+
+
+def _terminate_process_groups(
+    procs: List[subprocess.Popen], grace_s: float = TERM_GRACE_S
+) -> None:
+    """SIGTERM -> grace -> SIGKILL escalation for every live worker group:
+    workers get ``grace_s`` (collectively, not per worker) to flush logs
+    and heartbeats — a drain-aware worker exits cleanly here — before the
+    groups are killed hard.  The final SIGKILL goes to EVERY group, even
+    ones whose leader already exited: a grandchild that survived the
+    SIGTERM would otherwise keep the output pipes open forever (the
+    zombie-with-no-logs failure the escalation must not reintroduce)."""
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        _signal_process_group(p, signal.SIGTERM)
+    deadline = time.monotonic() + max(0.0, grace_s)
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in live):
+            break
+        time.sleep(0.05)
+    for p in live:
+        _kill_process_group(p)
+
+
 def collect_workers(
-    procs: List[subprocess.Popen], timeout: float
+    procs: List[subprocess.Popen], timeout: float,
+    term_grace_s: float = TERM_GRACE_S,
 ) -> List[Tuple[int, str, str]]:
     """Wait for every worker, returning ``(returncode, stdout, stderr)``
-    per process.  On timeout, every worker's *process group* is killed (no
-    zombie grandchildren keeping pipes open) and whatever partial
-    stdout/stderr the workers produced is collected and surfaced in the
-    raised ``TimeoutError`` — a hung pod must leave its logs behind, not
-    vanish into a bare ``TimeoutExpired``."""
+    per process.  On timeout, every worker's *process group* is terminated
+    with a SIGTERM -> ``term_grace_s`` -> SIGKILL escalation (workers get a
+    chance to flush logs and heartbeats; no zombie grandchildren keep the
+    pipes open) and whatever partial stdout/stderr the workers produced is
+    collected and surfaced in the raised ``TimeoutError`` — a hung pod must
+    leave its logs behind, not vanish into a bare ``TimeoutExpired``."""
     results = []
     try:
         for i, p in enumerate(procs):
             try:
                 out, err = p.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
-                for q in procs:
-                    if q.poll() is None:
-                        _kill_process_group(q)
+                _terminate_process_groups(procs, term_grace_s)
                 tails = []
                 for j, q in enumerate(procs):
                     try:

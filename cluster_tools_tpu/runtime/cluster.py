@@ -50,6 +50,16 @@ without waiting out ``submit_timeout_s`` — when any of these hold:
 
 Every loss is appended to ``cluster/supervisor.log`` and recorded in the
 run's ``failures.json`` (fault class ``job_loss``, job id, resolution).
+
+Preemption (docs/ROBUSTNESS.md "Graceful degradation"): a gracefully
+drained job (SIGTERM → drain latch → ``DrainInterrupt``) leaves a *requeue
+marker* (``cluster/<uid>.requeue.json``) instead of a result and exits with
+``REQUEUE_EXIT_CODE``.  The supervisor, finding the marker when the job
+leaves the queue, resubmits under a **separate** ``max_preempt_resubmits``
+budget — an eviction is the scheduler doing its job, and must not burn the
+failure-retry budget that guards against genuinely broken jobs.  Each
+preemption is recorded in ``failures.json`` with ``sites: {preempt: n}``
+and ``resolution: "requeued:preempt"``.
 """
 
 from __future__ import annotations
@@ -216,6 +226,12 @@ def supervisor_log_path(tmp_folder: str) -> str:
     return os.path.join(cluster_dir(tmp_folder), "supervisor.log")
 
 
+def requeue_marker_path(tmp_folder: str, uid: str) -> str:
+    """Where a gracefully-preempted job leaves its requeue marker
+    (``runtime/cluster_runner.py``) for the supervisor to find."""
+    return os.path.join(cluster_dir(tmp_folder), f"{uid}.requeue.json")
+
+
 def _sup_log(tmp_folder: str, msg: str) -> None:
     """Append one line to the run's supervisor log (the resubmission audit
     trail `make supervise-demo` prints)."""
@@ -259,9 +275,12 @@ def supervise_job(
     probe_grace = float(cfg.get("probe_failure_grace_s", 600.0))
     hb_timeout = float(cfg.get("heartbeat_timeout_s") or 0.0)
     max_resubmits = int(cfg.get("max_resubmits", 2))
+    max_preempt_resubmits = int(cfg.get("max_preempt_resubmits", 3))
     host = socket.gethostname()
+    rq_path = requeue_marker_path(tmp_folder, uid)
     job_ids: list = []
     resubmits = 0
+    preempt_resubmits = 0
     # heartbeat liveness is judged by CHANGE observed on the supervisor's
     # own clock, never by the timestamps inside the beat: worker nodes'
     # clocks skew, and a worker behind the supervisor would otherwise have
@@ -270,7 +289,13 @@ def supervise_job(
 
     def _submit():
         # snapshot the heartbeat BEFORE submitting: anything the new job
-        # writes afterwards registers as a change of this attempt's
+        # writes afterwards registers as a change of this attempt's.
+        # A leftover requeue marker must go too — only a marker written by
+        # THIS attempt may count as its preemption.
+        try:
+            os.unlink(rq_path)
+        except OSError:
+            pass
         submit_t = time.time()
         hb_seen["raw"] = read_heartbeat(tmp_folder, uid)
         hb_seen["at"] = submit_t
@@ -305,6 +330,25 @@ def supervise_job(
                 "job_id": job_id,
                 # full submission history: records merge by (task, block),
                 # so the final resolved record must still name the lost ids
+                "job_ids": list(job_ids),
+            }],
+        )
+
+    def _record_preempt(job_id, reason, resolved):
+        # keyed separately from the job_loss record ((task, block_id)
+        # merging would otherwise have evictions and losses overwrite each
+        # other): preemptions use the task's ".preempt" sub-key
+        fu.record_failures(
+            fu.failures_path(tmp_folder),
+            f"{uid}.preempt",
+            [{
+                "block_id": None,
+                "sites": {"preempt": preempt_resubmits},
+                "error": reason,
+                "quarantined": False,
+                "resolved": resolved,
+                "resolution": "requeued:preempt",
+                "job_id": job_id,
                 "job_ids": list(job_ids),
             }],
         )
@@ -374,6 +418,44 @@ def supervise_job(
             )
 
         if lost:
+            rq = fu.read_json_if_valid(rq_path)
+            if rq is not None:
+                # not a loss: the job drained gracefully for a preemption
+                # and asked to be requeued.  Separate budget — an eviction
+                # is the scheduler doing its job, not a broken task.
+                _cancel(job_id)
+                if preempt_resubmits >= max_preempt_resubmits:
+                    _sup_log(
+                        tmp_folder,
+                        f"{uid}: job {job_id} preempted again; "
+                        f"max_preempt_resubmits={max_preempt_resubmits} "
+                        "exhausted, giving up",
+                    )
+                    raise RuntimeError(
+                        f"{flavor} job for {uid} was preempted "
+                        f"{preempt_resubmits + 1} times "
+                        f"(max_preempt_resubmits={max_preempt_resubmits}) — "
+                        "giving up; the partial progress is markered and a "
+                        "re-run resumes at block grain"
+                    )
+                preempt_resubmits += 1
+                msg = (
+                    f"{uid}: job {job_id} preempted "
+                    f"({rq.get('reason', 'drained')}, "
+                    f"{rq.get('remaining_blocks', '?')} block(s) left); "
+                    f"requeueing ({preempt_resubmits}/{max_preempt_resubmits})"
+                )
+                if logger is not None:
+                    logger.warning(msg)
+                _sup_log(tmp_folder, msg)
+                _record_preempt(job_id, rq.get("reason"), resolved=False)
+                unknown_since = None
+                job_id, submit_t = _submit()
+                if logger is not None:
+                    logger.info(
+                        f"{flavor} job {job_id} requeued after preemption"
+                    )
+                continue
             _cancel(job_id)  # a zombie must not race the resubmission
             if resubmits >= max_resubmits:
                 tail = ""
@@ -415,7 +497,19 @@ def supervise_job(
             f"{uid}: job {job_id} delivered a result after {resubmits} "
             f"resubmission(s)",
         )
-    return {"job_id": job_id, "resubmits": resubmits, "job_ids": job_ids}
+    if preempt_resubmits:
+        _record_preempt(job_id, None, resolved=True)
+        _sup_log(
+            tmp_folder,
+            f"{uid}: job {job_id} delivered a result after "
+            f"{preempt_resubmits} preemption requeue(s)",
+        )
+    return {
+        "job_id": job_id,
+        "resubmits": resubmits,
+        "preempt_resubmits": preempt_resubmits,
+        "job_ids": job_ids,
+    }
 
 
 def _spec_default(obj):
@@ -466,6 +560,9 @@ def make_cluster_task(local_cls, flavor: str):
             # supervisor below can tell a lost job from a slow one
             "uid": self.uid,
             "heartbeat_interval_s": float(cfg.get("heartbeat_interval_s", 5.0)),
+            # graceful preemption: a drained job leaves this marker instead
+            # of a result, and the supervisor requeues it
+            "requeue_path": requeue_marker_path(self.tmp_folder, self.uid),
         }
         spec_path = os.path.join(cdir, f"{self.uid}.spec.json")
         with open(spec_path, "w") as f:

@@ -13,6 +13,15 @@ Liveness: for specs carrying a ``uid``, a heartbeat thread writes
 ``tmp_folder/heartbeats/<uid>.json`` every ``heartbeat_interval_s`` for
 the submitting supervisor's staleness/pid checks (the batch script wrote
 the first beat before Python started — see ``runtime/cluster.py``).
+
+Preemption (docs/ROBUSTNESS.md "Graceful degradation"): a SIGTERM/SIGUSR1
+(scheduler eviction, injected ``preempt`` fault) flips the drain latch
+instead of killing the job; the executor/task runtime finishes in-flight
+blocks, flushes markers, and raises ``DrainInterrupt``, which this runner
+turns into a *requeue marker* (``<uid>.requeue.json`` next to the result
+file) plus exit code ``REQUEUE_EXIT_CODE`` — no result file is written, so
+the supervisor sees the job leave the queue, finds the marker, and
+resubmits under its preemption budget instead of burning failure retries.
 """
 
 from __future__ import annotations
@@ -20,7 +29,9 @@ from __future__ import annotations
 import importlib
 import json
 import os
+import socket
 import sys
+import time
 import traceback
 
 
@@ -48,6 +59,17 @@ def main(spec_path: str) -> int:
             json.dump(payload, f, default=_default)
         os.replace(tmp, result_path)
 
+    from .supervision import (
+        REQUEUE_EXIT_CODE,
+        DrainInterrupt,
+        install_drain_handler,
+        write_heartbeat,
+    )
+
+    # arm graceful preemption BEFORE any work: the scheduler's eviction
+    # SIGTERM must flip the drain latch, not kill the interpreter mid-block
+    install_drain_handler()
+
     heartbeat = None
     if spec.get("uid"):
         from .supervision import HeartbeatWriter
@@ -73,6 +95,32 @@ def main(spec_path: str) -> int:
         result = task.run_impl()
         emit({"ok": True, "result": result})
         return 0
+    except DrainInterrupt as e:
+        # drained for preemption: markers/manifests are flushed, so leave a
+        # requeue marker (NOT a result — the work is unfinished) and exit
+        # with the requeue code; the supervisor resubmits under its
+        # preemption budget and the resumed job picks up at block grain
+        requeue_path = spec.get("requeue_path")
+        if requeue_path:
+            tmp = f"{requeue_path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({
+                    "preempted": True,
+                    "reason": e.reason,
+                    "remaining_blocks": len(e.remaining_ids),
+                    "time": time.time(),
+                    "host": socket.gethostname(),
+                    "pid": os.getpid(),
+                }, f)
+            os.replace(tmp, requeue_path)
+        if spec.get("uid"):
+            # one last beat so the supervisor's staleness clock sees the
+            # drain, not dead air, while the marker propagates over NFS
+            try:
+                write_heartbeat(spec["tmp_folder"], spec["uid"])
+            except OSError:
+                pass
+        return REQUEUE_EXIT_CODE
     except Exception as e:  # noqa: BLE001 - report ANY failure to the poller
         emit({
             "ok": False,

@@ -39,13 +39,33 @@ a bit-identity check when both copies complete.  ``store_verify_fn`` (built
 by :func:`region_verifier` from a checksummed dataset) re-reads each stored
 region so a chunk corrupted on storage is repaired by a re-store (retry) or
 a recompute (quarantine) while the writer still owns the block.
+
+Graceful degradation (docs/ROBUSTNESS.md "Graceful degradation"): resource
+exhaustion — host/device OOM (``MemoryError``, XLA ``RESOURCE_EXHAUSTED``)
+and a full filesystem (``ENOSPC``/``EDQUOT``) — is *classified*
+(:func:`classify_resource_error`) and routed to a degrade policy instead of
+same-size retries (re-running the exact allocation that just failed only
+burns the retry budget): the block waits for headroom and re-executes once
+at full size through the same compiled kernel (``degraded:backpressure``),
+then — for call sites that declare ``splittable=True`` — recursively
+re-executes as 2^d halo-correct sub-blocks through the same kernel down to
+``min_block_shape``, reassembled via the task's own store path
+(``degraded:split``).  A byte-budget admission controller additionally caps
+the bytes of in-flight batches and backpressures the store drain when
+host-memory or disk headroom runs low.  Preemption: SIGTERM/SIGUSR1 flip a
+process-wide drain latch; the sweep stops claiming batches, finishes
+in-flight work, flushes markers + ``failures.json``, and raises
+:class:`~cluster_tools_tpu.runtime.supervision.DrainInterrupt` so the entry
+point exits with ``REQUEUE_EXIT_CODE`` and the supervisor requeues the job.
 """
 
 from __future__ import annotations
 
 import contextlib
+import errno
 import itertools
 import math
+import os
 import threading
 import time
 import traceback
@@ -60,7 +80,18 @@ from ..io.containers import ChunkCorruptionError
 from ..utils import function_utils as fu
 from ..utils.volume_utils import Block, Blocking
 from . import faults as faults_mod
-from .supervision import FirstWins, Watchdog, array_digest
+from .supervision import (
+    DrainInterrupt,
+    FirstWins,
+    Watchdog,
+    array_digest,
+    disk_free_fraction,
+    drain_reason,
+    drain_requested,
+    host_mem_available_bytes,
+    host_mem_available_fraction,
+    install_drain_handler,
+)
 
 
 # canonical device-selection policy lives in parallel/mesh.py
@@ -74,6 +105,112 @@ def get_mesh(
 ) -> Mesh:
     devs = get_devices(target, n_devices)
     return Mesh(np.array(devs), (axis_name,))
+
+
+#: errnos that mean "storage is full", not "storage is broken"
+_DISK_FULL_ERRNOS = (errno.ENOSPC, errno.EDQUOT)
+
+
+def classify_resource_error(exc: BaseException) -> Optional[str]:
+    """``"oom"`` / ``"enospc"`` when ``exc`` (or anything on its
+    cause/context chain) is a resource-exhaustion failure, else None.
+
+    - ``MemoryError`` — host allocator failure (numpy, stacking, IO
+      buffers),
+    - XLA's ``RESOURCE_EXHAUSTED`` / out-of-memory runtime errors, matched
+      by type name + message so no jaxlib-version-specific import is
+      needed,
+    - ``OSError`` with ``ENOSPC``/``EDQUOT`` — shared filesystem full.
+
+    Retrying these at the same size re-runs the exact allocation that just
+    failed; callers route them to the degrade policy instead.
+    """
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, MemoryError):
+            return "oom"
+        if isinstance(exc, OSError) and exc.errno in _DISK_FULL_ERRNOS:
+            return "enospc"
+        msg = str(exc)
+        if type(exc).__name__ == "XlaRuntimeError" and (
+            "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+        ):
+            return "oom"
+        # older jaxlibs surface allocator failures as a plain RuntimeError
+        # carrying the status name; arbitrary exception types that merely
+        # MENTION the string are not classified
+        if isinstance(exc, RuntimeError) and "RESOURCE_EXHAUSTED" in msg:
+            return "oom"
+        exc = exc.__cause__ or exc.__context__
+    return None
+
+
+class SubBlock(Block):
+    """A degrade-split fragment of a parent block (same ``block_id``).
+    Load/store callbacks that pad to a static batch shape can detect these
+    (:func:`is_sub_block`) and size buffers per-block instead — sub-blocks
+    never enter a stacked batch, so the static-shape contract does not
+    apply to them."""
+
+
+def is_sub_block(block: Block) -> bool:
+    return isinstance(block, SubBlock)
+
+
+def split_block(
+    block: Block,
+    halo: Optional[Sequence[int]] = None,
+    min_shape: Optional[Sequence[int]] = None,
+) -> Optional[List[Block]]:
+    """Split ``block``'s inner region into up to 2^d halo-correct
+    sub-blocks (each axis halved where both halves stay >= ``min_shape``).
+
+    Sub-blocks keep the parent's ``block_id`` (markers, fault targeting and
+    failure attribution stay at the parent grain) and get outer boxes of
+    ``sub_inner ± halo`` clamped to the parent's outer box — which is the
+    volume clamp, since the parent's outer box is itself the volume-clamped
+    ``inner ± halo``.  ``halo`` defaults to the parent's own per-axis halo
+    (max over the two sides, so border clipping does not shrink it); pass
+    it explicitly for single-block axes, where both sides are clipped and
+    nothing can be derived.  Returns None when no axis can split.
+    """
+    nd = len(block.begin)
+    if halo is None:
+        halo = tuple(
+            max(b - ob, oe - e)
+            for b, ob, e, oe in zip(
+                block.begin, block.outer_begin, block.end, block.outer_end
+            )
+        )
+    halo = tuple(int(h) for h in halo)
+    min_shape = tuple(
+        max(1, int(m)) for m in (min_shape or (1,) * nd)
+    )
+    axes_intervals = []
+    any_cut = False
+    for ax in range(nd):
+        lo, hi = block.begin[ax], block.end[ax]
+        half = (hi - lo) // 2
+        if half >= min_shape[ax] and (hi - lo) - half >= min_shape[ax]:
+            axes_intervals.append([(lo, lo + half), (lo + half, hi)])
+            any_cut = True
+        else:
+            axes_intervals.append([(lo, hi)])
+    if not any_cut:
+        return None
+    subs = []
+    for combo in itertools.product(*axes_intervals):
+        begin = tuple(c[0] for c in combo)
+        end = tuple(c[1] for c in combo)
+        outer_begin = tuple(
+            max(ob, b - h) for ob, b, h in zip(block.outer_begin, begin, halo)
+        )
+        outer_end = tuple(
+            min(oe, e + h) for oe, e, h in zip(block.outer_end, end, halo)
+        )
+        subs.append(SubBlock(block.block_id, begin, end, outer_begin, outer_end))
+    return subs
 
 
 def check_finite_outputs(block: Block, out) -> Optional[str]:
@@ -163,16 +300,21 @@ class BlockwiseExecutor:
         on_error: Optional[Callable[[Exception], None]] = None,
     ):
         """Run ``fn`` with injection + retries.  Returns
-        ``(value, attempts, traceback_or_None)``; the caller quarantines on
-        a non-None traceback.  ``on_error`` observes each caught exception
-        (failure-class attribution, e.g. counting ChunkCorruptionErrors)."""
+        ``(value, attempts, traceback_or_None, resource_class_or_None)``;
+        the caller quarantines on a non-None traceback.  A resource-
+        classified failure (OOM / ENOSPC) short-circuits the retry loop —
+        re-running the same allocation at the same size only burns the
+        budget; the degrade policy owns it.  ``on_error`` observes each
+        caught exception (failure-class attribution, e.g. counting
+        ChunkCorruptionErrors)."""
         injector = faults_mod.get_injector()
+        voxels = int(np.prod(block.outer_shape))
         last_tb = None
         for k in range(self.max_retries + 1):
             try:
-                injector.maybe_fail(site, block.block_id)
+                injector.maybe_fail(site, block.block_id, voxels=voxels)
                 injector.maybe_hang(site, block.block_id)
-                return fn(), k + 1, None
+                return fn(), k + 1, None, None
             except Exception as e:
                 if on_error is not None:
                     try:
@@ -180,9 +322,12 @@ class BlockwiseExecutor:
                     except Exception:
                         pass
                 last_tb = fu.cap_traceback(traceback.format_exc())
+                resource = classify_resource_error(e)
+                if resource is not None:
+                    return None, k + 1, last_tb, resource
                 if k < self.max_retries:
                     time.sleep(self._backoff(k))
-        return None, self.max_retries + 1, last_tb
+        return None, self.max_retries + 1, last_tb, None
 
     def map_blocks(
         self,
@@ -201,6 +346,13 @@ class BlockwiseExecutor:
         watchdog_period_s: Optional[float] = None,
         speculate: bool = True,
         store_verify_fn: Optional[Callable[[Block], None]] = None,
+        splittable: bool = False,
+        split_halo: Optional[Sequence[int]] = None,
+        min_block_shape: Optional[Sequence[int]] = None,
+        degrade_wait_s: float = 5.0,
+        inflight_byte_budget: Optional[int] = None,
+        mem_headroom_fraction: float = 0.05,
+        disk_headroom_fraction: float = 0.02,
     ) -> Dict[str, int]:
         """Execute ``kernel`` over ``blocks``; see class docstring.
 
@@ -220,14 +372,41 @@ class BlockwiseExecutor:
         check (see :func:`region_verifier`); a ChunkCorruptionError it
         raises makes the store retry (re-write repairs the corrupt chunk),
         then quarantine (recompute repairs it).
+
+        Graceful degradation (module docstring): a resource-classified
+        failure (OOM / ENOSPC) skips same-size retries and enters the
+        degrade ladder — wait for memory/disk headroom (up to
+        ``degrade_wait_s``), re-execute once at full size, then, when
+        ``splittable``, recursively re-execute as halo-correct sub-blocks
+        down to ``min_block_shape`` through the same kernel (jitted per
+        sub-shape), stored via the task's own ``store_fn``.  ``splittable``
+        is a *contract*: ``load_fn``/``store_fn``/``kernel`` must be pure
+        functions of the block geometry at any shape (no fixed-shape
+        padding), and the kernel must be shape-local so sub-block outputs
+        tile to the unsplit result bit-identically (voxelwise/copy-like
+        kernels; NOT label-flood kernels whose encoding depends on the
+        outer shape).  ``split_halo`` defaults to the per-block derived
+        halo.  ``inflight_byte_budget`` caps the bytes of loaded-but-
+        unstored batches (None = 25% of MemAvailable at start, 0 =
+        disabled); ``mem_headroom_fraction`` / ``disk_headroom_fraction``
+        backpressure the store drain when host memory / the manifest
+        filesystem run low.
+
         Raises RuntimeError naming every block that stays failed after the
-        end-of-run quarantine pass.
+        end-of-run quarantine pass, and
+        :class:`~cluster_tools_tpu.runtime.supervision.DrainInterrupt`
+        when a drain (SIGTERM/SIGUSR1) was requested — in-flight work is
+        finished, markers and manifests flushed, remaining blocks left for
+        the resumed run.
         """
         if done_block_ids:
             done = {int(b) for b in done_block_ids}
             blocks = [b for b in blocks if int(b.block_id) not in done]
         if not blocks:
             return {"n_blocks": 0, "n_quarantined": 0, "n_failed": 0}
+        # preemption-aware draining: SIGTERM/SIGUSR1 flip a latch instead
+        # of killing us; the sweep checks it at batch boundaries
+        install_drain_handler()
         injector = faults_mod.get_injector()
         deadline = float(block_deadline_s or 0.0)
         block_by_id = {int(b.block_id): b for b in blocks}
@@ -243,7 +422,8 @@ class BlockwiseExecutor:
         fail_lock = threading.Lock()
         quarantined_ids: set = set()
 
-        def note_failure(block, site, attempts, error, quarantine):
+        def note_failure(block, site, attempts, error, quarantine,
+                         resource=None):
             with fail_lock:
                 rec = failures.setdefault(
                     int(block.block_id),
@@ -258,16 +438,23 @@ class BlockwiseExecutor:
                 rec["sites"][site] = rec["sites"].get(site, 0) + int(attempts)
                 if error is not None:
                     rec["error"] = error
+                if resource is not None:
+                    # the resource CLASS (oom/enospc), steering the degrade
+                    # ladder and counted per class for the post-mortem
+                    rec["resource"] = resource
+                    rec["sites"][resource] = rec["sites"].get(resource, 0) + 1
                 if quarantine:
                     rec["quarantined"] = True
                     rec["resolved"] = False
                     quarantined_ids.add(int(block.block_id))
 
-        def mark_resolved(block):
+        def mark_resolved(block, resolution=None):
             with fail_lock:
                 rec = failures.get(int(block.block_id))
                 if rec is not None:
                     rec["resolved"] = True
+                    if resolution is not None:
+                        rec["resolution"] = resolution
 
         def validate(block, out) -> Optional[str]:
             if check_finite:
@@ -312,12 +499,16 @@ class BlockwiseExecutor:
         class _PreIssueFailed(Exception):
             pass
 
-        def load_block(block, pre=None, pre_tb=None, origin="primary"):
+        def load_block(block, pre=None, pre_tb=None, pre_resource=None,
+                       origin="primary"):
             """Load one block with retries; returns arrays or None
             (quarantined).  ``pre`` is an already-issued load_fn result
             consumed by the first attempt (batch reads are issued together
-            so the storage layer runs the chunk IO concurrently)."""
+            so the storage layer runs the chunk IO concurrently).  Resource-
+            classified failures (OOM/ENOSPC) skip the same-size retries and
+            quarantine straight into the degrade ladder."""
             last_tb, attempts = None, 0
+            voxels = int(np.prod(block.outer_shape))
             with contextlib.ExitStack() as stack:
                 stack.enter_context(_watched(block, "load", origin))
                 stack.enter_context(
@@ -326,7 +517,9 @@ class BlockwiseExecutor:
                 for k in range(self.max_retries + 1):
                     attempts = k + 1
                     try:
-                        injector.maybe_fail("load", block.block_id)
+                        injector.maybe_fail(
+                            "load", block.block_id, voxels=voxels
+                        )
                         injector.maybe_hang("load", block.block_id)
                         if k == 0 and pre_tb is not None:
                             last_tb = pre_tb
@@ -336,10 +529,23 @@ class BlockwiseExecutor:
                             x.result() if hasattr(x, "result") else x for x in per
                         )
                     except _PreIssueFailed:
+                        if pre_resource is not None:
+                            note_failure(
+                                block, "load", attempts, last_tb,
+                                quarantine=True, resource=pre_resource,
+                            )
+                            return None
                         if k < self.max_retries:
                             time.sleep(self._backoff(k))
-                    except Exception:
+                    except Exception as e:
                         last_tb = fu.cap_traceback(traceback.format_exc())
+                        resource = classify_resource_error(e)
+                        if resource is not None:
+                            note_failure(
+                                block, "load", attempts, last_tb,
+                                quarantine=True, resource=resource,
+                            )
+                            return None
                         if k < self.max_retries:
                             time.sleep(self._backoff(k))
                     else:
@@ -358,17 +564,36 @@ class BlockwiseExecutor:
             for b in batch:
                 try:
                     with faults_mod.block_context(int(b.block_id)):
-                        issued.append((load_fn(b), None))
-                except Exception:
+                        issued.append((load_fn(b), None, None))
+                except Exception as e:
                     issued.append(
-                        (None, fu.cap_traceback(traceback.format_exc()))
+                        (None, fu.cap_traceback(traceback.format_exc()),
+                         classify_resource_error(e))
                     )
             ok_blocks, per_block = [], []
-            for b, (pre, pre_tb) in zip(batch, issued):
-                val = load_block(b, pre=pre, pre_tb=pre_tb)
-                if val is not None:
-                    ok_blocks.append(b)
-                    per_block.append(val)
+            for b, (pre, pre_tb, pre_res) in zip(batch, issued):
+                val = load_block(b, pre=pre, pre_tb=pre_tb, pre_resource=pre_res)
+                if val is None:
+                    continue
+                # kernel-dispatch fault hook (resource model: this block's
+                # share of the batch does not fit): an injected compute
+                # OOM routes the block to the degrade ladder pre-dispatch,
+                # keeping the rest of the batch intact
+                try:
+                    injector.maybe_fail(
+                        "compute", b.block_id,
+                        voxels=int(np.prod(b.outer_shape)),
+                    )
+                except Exception as e:
+                    note_failure(
+                        b, "compute", 1,
+                        fu.cap_traceback(traceback.format_exc()),
+                        quarantine=True,
+                        resource=classify_resource_error(e),
+                    )
+                    continue
+                ok_blocks.append(b)
+                per_block.append(val)
             if not ok_blocks:
                 return [], None
             n_args = len(per_block[0])
@@ -450,7 +675,7 @@ class BlockwiseExecutor:
                     with contextlib.ExitStack() as stack:
                         stack.enter_context(_watched(blk, "store", origin))
                         stack.enter_context(faults_mod.block_context(bid))
-                        _, attempts, tb = self._io_with_retries(
+                        _, attempts, tb, store_resource = self._io_with_retries(
                             "store", blk, _store_and_verify, on_error=_classify
                         )
                     if dup_state["verdict"] == FirstWins.AGREE:
@@ -491,7 +716,8 @@ class BlockwiseExecutor:
                             # so the quarantine recompute is not misread as
                             # a duplicate of a result that does not exist
                             commits.withdraw(bid, dup_state["digest"])
-                        note_failure(blk, "store", attempts, tb, quarantine=True)
+                        note_failure(blk, "store", attempts, tb,
+                                     quarantine=True, resource=store_resource)
                         return
                     if attempts > 1:
                         note_failure(
@@ -570,6 +796,53 @@ class BlockwiseExecutor:
                 _on_hung,
             ).start()
 
+        # -- byte-budget admission control + headroom backpressure ----------
+        # in-flight = loaded-but-not-yet-stored batch bytes; the budget caps
+        # it (default: a quarter of MemAvailable at sweep start), and low
+        # host-memory / manifest-filesystem headroom drains the pending
+        # store window before the next batch is admitted.
+        if inflight_byte_budget is None:
+            avail = host_mem_available_bytes()
+            budget = int(avail * 0.25) if avail else 0
+        else:
+            budget = int(inflight_byte_budget)
+        inflight = {"bytes": 0}
+        admission_lock = threading.Lock()
+        backpressure = {"waits": 0}
+        headroom_path = (
+            os.path.dirname(os.path.abspath(failures_path))
+            if failures_path else None
+        )
+        drained = False
+
+        def _release_inflight(nbytes):
+            with admission_lock:
+                inflight["bytes"] -= nbytes
+
+        def _admit(nbytes, write_futures):
+            """Admission gate for one loaded batch: drain pending stores
+            until the byte budget fits (the current batch is always
+            admitted — progress beats the cap) and while memory/disk
+            headroom is below threshold."""
+            waited = False
+            while write_futures:
+                with admission_lock:
+                    over = budget and inflight["bytes"] + nbytes > budget
+                mem = host_mem_available_fraction()
+                low_mem = mem is not None and mem < mem_headroom_fraction
+                disk = (
+                    disk_free_fraction(headroom_path) if headroom_path else None
+                )
+                low_disk = disk is not None and disk < disk_headroom_fraction
+                if not (over or low_mem or low_disk):
+                    break
+                waited = True
+                write_futures.pop(0).result()
+            if waited:
+                backpressure["waits"] += 1
+            with admission_lock:
+                inflight["bytes"] += nbytes
+
         try:
             with ThreadPoolExecutor(max_workers=self.io_threads) as pool:
                 pending_loads: List[Future] = [
@@ -577,6 +850,12 @@ class BlockwiseExecutor:
                 ]
                 write_futures: List[Future] = []
                 for i in range(n_batches):
+                    if drain_requested():
+                        # stop claiming batches; in-flight loads/stores are
+                        # finished below, markers+manifests flushed, and the
+                        # sweep exits through DrainInterrupt for a requeue
+                        drained = True
+                        break
                     batch, arrays = pending_loads.pop(0).result()
                     if i + prefetch < n_batches:
                         pending_loads.append(pool.submit(load_batch, i + prefetch))
@@ -587,6 +866,8 @@ class BlockwiseExecutor:
                         write_futures.pop(0).result()
                     if not batch:
                         continue  # every block of this batch was quarantined
+                    batch_bytes = sum(int(a.nbytes) for a in arrays)
+                    _admit(batch_bytes, write_futures)
                     arrays = tuple(jax.device_put(a, sharding) for a in arrays)
                     try:
                         # take the dispatch lock BEFORE starting the blocks'
@@ -597,30 +878,38 @@ class BlockwiseExecutor:
                             for blk in batch:
                                 stack.enter_context(_watched(blk, "compute"))
                             out = batched_kernel(*arrays)
-                    except Exception:
+                    except Exception as e:
                         # a compute failure poisons the whole batch; quarantine
-                        # all of it — the reduced-batch pass isolates the culprit
+                        # all of it — the reduced-batch pass isolates the
+                        # culprit, and a resource-classified failure (device
+                        # OOM) steers every member into the degrade ladder
                         tb = fu.cap_traceback(traceback.format_exc())
+                        resource = classify_resource_error(e)
                         for blk in batch:
-                            note_failure(blk, "compute", 1, tb, quarantine=True)
+                            note_failure(blk, "compute", 1, tb,
+                                         quarantine=True, resource=resource)
+                        _release_inflight(batch_bytes)
                         continue
 
-                    def store_batch(batch=batch, out=out):
+                    def store_batch(batch=batch, out=out, nbytes=batch_bytes):
                         # the device->host copy happens HERE, on the IO pool, so
                         # the dispatch loop is free to enqueue the next batch
                         # while this one's outputs stream back.  This copy is
                         # also where a kernel wedged at RUNTIME blocks (the
                         # jitted call above returns at dispatch — async), so
                         # it is the stage the compute watchdog must cover.
-                        with contextlib.ExitStack() as stack:
-                            for blk in batch:
-                                stack.enter_context(_watched(blk, "compute"))
-                            out_np = jax.tree_util.tree_map(np.asarray, out)
-                        for j, blk in enumerate(batch):
-                            block_out = jax.tree_util.tree_map(
-                                lambda a: a[j], out_np
-                            )
-                            handle_block_output(blk, block_out)
+                        try:
+                            with contextlib.ExitStack() as stack:
+                                for blk in batch:
+                                    stack.enter_context(_watched(blk, "compute"))
+                                out_np = jax.tree_util.tree_map(np.asarray, out)
+                            for j, blk in enumerate(batch):
+                                block_out = jax.tree_util.tree_map(
+                                    lambda a: a[j], out_np
+                                )
+                                handle_block_output(blk, block_out)
+                        finally:
+                            _release_inflight(nbytes)
 
                     write_futures.append(pool.submit(store_batch))
                     # backpressure: each pending store closure pins its batch's
@@ -644,33 +933,210 @@ class BlockwiseExecutor:
                 if spec_pool is not None:
                     spec_pool.shutdown(wait=True)
 
+                # -- degrade ladder: headroom wait + split machinery ------------
+
+                def _wait_for_headroom(resource):
+                    """Bounded backpressure before a degrade re-attempt:
+                    transient exhaustion (a sibling job's spike, a filling
+                    scratch disk being cleaned) often clears within
+                    seconds; a healthy (or unmeasurable) host returns
+                    immediately."""
+                    deadline_t = time.monotonic() + max(0.0, degrade_wait_s)
+                    while time.monotonic() < deadline_t:
+                        if resource == "enospc":
+                            frac = (
+                                disk_free_fraction(headroom_path)
+                                if headroom_path else None
+                            )
+                            if frac is None or frac > disk_headroom_fraction:
+                                return
+                        else:
+                            frac = host_mem_available_fraction()
+                            if frac is None or frac > mem_headroom_fraction:
+                                return
+                        time.sleep(min(0.2, max(0.01, degrade_wait_s / 20.0)))
+
+                # the SAME kernel function, unbatched + jitted: jit caches
+                # one compiled twin per distinct sub-block shape, each a
+                # smaller allocation than the batch program — the point
+                sub_jit = jax.jit(kernel)
+
+                def _sub_exec(val):
+                    with dispatch_lock:
+                        out = sub_jit(*val)
+                    return jax.tree_util.tree_map(np.asarray, out)
+
+                split_stats = {"splits": 0, "max_depth": 0, "sub_blocks": 0}
+
+                def _run_sub(sub, depth, tracker):
+                    """One sub-block through load -> kernel -> validate ->
+                    store(+verify); a resource failure at any stage recurses
+                    one level deeper.  Failures are attributed to the parent
+                    block id (sub-blocks carry it)."""
+                    voxels = int(np.prod(sub.outer_shape))
+                    with faults_mod.block_context(int(sub.block_id)):
+                        # load (retries for ordinary errors, recurse on oom)
+                        val, last_tb = None, None
+                        for k in range(self.max_retries + 1):
+                            try:
+                                injector.maybe_fail(
+                                    "load", sub.block_id, voxels=voxels
+                                )
+                                injector.maybe_hang("load", sub.block_id)
+                                per = load_fn(sub)
+                                val = tuple(
+                                    x.result() if hasattr(x, "result") else x
+                                    for x in per
+                                )
+                                break
+                            except Exception as e:
+                                last_tb = fu.cap_traceback(traceback.format_exc())
+                                if classify_resource_error(e) is not None:
+                                    return _split_and_run(sub, depth + 1,
+                                                          tracker)
+                                if k < self.max_retries:
+                                    time.sleep(self._backoff(k))
+                        if val is None:
+                            note_failure(sub, "load", 1, last_tb, quarantine=True)
+                            return False
+                        # compute at the sub shape
+                        try:
+                            injector.maybe_fail(
+                                "compute", sub.block_id, voxels=voxels
+                            )
+                            out = _sub_exec(val)
+                        except Exception as e:
+                            tb = fu.cap_traceback(traceback.format_exc())
+                            if classify_resource_error(e) is not None:
+                                return _split_and_run(sub, depth + 1,
+                                                      tracker)
+                            note_failure(sub, "compute", 1, tb, quarantine=True)
+                            return False
+                        err = validate(sub, out)
+                        if err is not None:
+                            note_failure(sub, "validate", 1, err, quarantine=True)
+                            return False
+                        if store_fn is None:
+                            return True
+                        # store (+ integrity verify) with retries
+                        def _store():
+                            store_fn(sub, out)
+                            if store_verify_fn is not None:
+                                store_verify_fn(sub)
+
+                        for k in range(self.max_retries + 1):
+                            try:
+                                injector.maybe_fail(
+                                    "store", sub.block_id, voxels=voxels
+                                )
+                                injector.maybe_hang("store", sub.block_id)
+                                _store()
+                                return True
+                            except Exception as e:
+                                last_tb = fu.cap_traceback(traceback.format_exc())
+                                resource = classify_resource_error(e)
+                                if resource is not None:
+                                    _wait_for_headroom(resource)
+                                    return _split_and_run(sub, depth + 1,
+                                                          tracker)
+                                if k < self.max_retries:
+                                    time.sleep(self._backoff(k))
+                        note_failure(sub, "store", 1, last_tb, quarantine=True)
+                        return False
+
+                def _split_and_run(blk, depth=1, tracker=None):
+                    """Recursive 2^d halo-correct split of ``blk``; True when
+                    every sub-block landed (the parent's stored region is then
+                    exactly the reassembled sub-results).  ``tracker`` records
+                    the depth THIS parent block actually reached (the sweep-
+                    wide maximum lives in ``split_stats``)."""
+                    subs = split_block(blk, halo=split_halo,
+                                       min_shape=min_block_shape)
+                    if subs is None:
+                        note_failure(
+                            blk, "split", 1,
+                            "resource exhaustion persisted at "
+                            f"min_block_shape={tuple(min_block_shape or ())} "
+                            "— cannot split further",
+                            quarantine=True,
+                        )
+                        return False
+                    split_stats["splits"] += 1
+                    split_stats["max_depth"] = max(split_stats["max_depth"], depth)
+                    split_stats["sub_blocks"] += len(subs)
+                    if tracker is not None:
+                        tracker["depth"] = max(tracker.get("depth", 0), depth)
+                    return all(_run_sub(sub, depth, tracker) for sub in subs)
+
                 # -- quarantine pass: reduced-batch re-attempts -----------------
                 # re-run each still-unresolved quarantined block alone,
                 # replicated to the batch width through the SAME compiled kernel
                 # — bit-identical results, and a batch-poisoning block is
                 # isolated to itself.  Blocks a speculative duplicate (or a
                 # late-finishing hung primary) already resolved are skipped.
+                # Resource-exhausted blocks enter here as the degrade ladder:
+                # wait for headroom, full-size re-attempt, then (splittable
+                # call sites) recursive sub-block re-execution.
                 with fail_lock:
                     unresolved_q = {
                         b for b in quarantined_ids if not failures[b]["resolved"]
                     }
+                degraded_ids: set = set()
                 for blk in [b for b in blocks if int(b.block_id) in unresolved_q]:
+                    if drained or drain_requested():
+                        drained = True
+                        break
+                    bid = int(blk.block_id)
+                    with fail_lock:
+                        resource = failures[bid].get("resource")
+                    if resource is not None:
+                        degraded_ids.add(bid)
+                        _wait_for_headroom(resource)
                     val = load_block(blk)
-                    if val is None:
-                        continue  # still failing; stays unresolved
-                    stacked = tuple(np.stack([x] * bs) for x in val)
-                    stacked = tuple(jax.device_put(a, sharding) for a in stacked)
-                    try:
-                        with dispatch_lock:
-                            out = batched_kernel(*stacked)
-                    except Exception:
-                        tb = fu.cap_traceback(traceback.format_exc())
-                        note_failure(blk, "compute", 1, tb, quarantine=True)
+                    if val is not None:
+                        ok = False
+                        try:
+                            injector.maybe_fail(
+                                "compute", blk.block_id,
+                                voxels=int(np.prod(blk.outer_shape)),
+                            )
+                            stacked = tuple(np.stack([x] * bs) for x in val)
+                            stacked = tuple(
+                                jax.device_put(a, sharding) for a in stacked
+                            )
+                            with dispatch_lock:
+                                out = batched_kernel(*stacked)
+                            ok = True
+                        except Exception as e:
+                            tb = fu.cap_traceback(traceback.format_exc())
+                            note_failure(
+                                blk, "compute", 1, tb, quarantine=True,
+                                resource=classify_resource_error(e),
+                            )
+                        if ok:
+                            out0 = jax.tree_util.tree_map(
+                                lambda a: np.asarray(a)[0], out
+                            )
+                            handle_block_output(blk, out0)
+                    # ladder outcome: a resolved resource block recovered via
+                    # the headroom wait; a still-unresolved one splits (when
+                    # the call site declared the kernel split-safe)
+                    with fail_lock:
+                        rec = failures[bid]
+                        resolved_now = rec["resolved"]
+                        resource = rec.get("resource")
+                    if resolved_now:
+                        if resource is not None:
+                            mark_resolved(blk, "degraded:backpressure")
                         continue
-                    out0 = jax.tree_util.tree_map(
-                        lambda a: np.asarray(a)[0], out
-                    )
-                    handle_block_output(blk, out0)
+                    if resource is not None and splittable:
+                        tracker = {"depth": 0}
+                        if _split_and_run(blk, tracker=tracker):
+                            mark_resolved(blk, "degraded:split")
+                            with fail_lock:
+                                rec = failures[bid]
+                                rec["split_depth"] = tracker["depth"]
+                            finish_block(blk)
 
         finally:
             # the watchdog and speculation pool must not outlive the
@@ -689,6 +1155,33 @@ class BlockwiseExecutor:
                 task_name,
                 [failures[b] for b in sorted(failures)],
             )
+        if drained:
+            # graceful drain: everything dispatched was finished and
+            # markered; what is left belongs to the requeued/resumed run.
+            reason = drain_reason() or "drain requested"
+            remaining = sorted(
+                int(b.block_id) for b in blocks
+                if int(b.block_id) not in finished_ids
+            )
+            if failures_path:
+                # keyed under "<task>.drain": records merge by
+                # (task, block_id), and (task, None) is already used by the
+                # supervisor's job_loss record (and "<task>.preempt" by its
+                # requeue record) — a drain must not overwrite either
+                fu.record_failures(
+                    failures_path,
+                    f"{task_name}.drain",
+                    [{
+                        "block_id": None,
+                        "sites": {"preempt": 1},
+                        "error": reason,
+                        "quarantined": False,
+                        "resolved": True,
+                        "resolution": "requeued:preempt",
+                        "remaining_blocks": len(remaining),
+                    }],
+                )
+            raise DrainInterrupt(reason, remaining)
         if unresolved:
             details = "\n".join(
                 f"-- block {b} (sites {failures[b]['sites']}) --\n"
@@ -712,4 +1205,10 @@ class BlockwiseExecutor:
                 1 for rec in failures.values() if "hung" in rec["sites"]
             )
             summary["n_speculated"] = len(speculated)
+        if degraded_ids or split_stats["splits"] or backpressure["waits"]:
+            summary["n_degraded"] = len(degraded_ids)
+            summary["n_split"] = split_stats["splits"]
+            summary["n_sub_blocks"] = split_stats["sub_blocks"]
+            summary["split_depth"] = split_stats["max_depth"]
+            summary["n_backpressure_waits"] = backpressure["waits"]
         return summary

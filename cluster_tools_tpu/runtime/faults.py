@@ -31,6 +31,25 @@ task runtime, and container IO layer call at their failure-relevant sites:
   scheduler keeps reporting it as running, but nothing ever executes —
   only heartbeat supervision (``runtime/cluster.py``) can find it.
 
+Resource-exhaustion and preemption classes (docs/ROBUSTNESS.md "Graceful
+degradation") ride the same hooks:
+
+- ``kind='oom'`` (sites ``load`` / ``store`` / ``io_read`` / ``io_write`` /
+  ``compute``) raises :class:`InjectedOOM` — a real ``MemoryError`` whose
+  message carries ``RESOURCE_EXHAUSTED``, so it exercises the executor's
+  *typed* resource classification, not a special-cased injection path.  An
+  optional ``min_voxels`` gate makes the fault fire only for work units at
+  least that large — the physical OOM model: full-size blocks fail, the
+  degrade path's smaller sub-blocks fit,
+- ``kind='enospc'`` (sites ``store`` / ``io_write``) raises
+  :class:`InjectedENOSPC` — an ``OSError`` with ``errno=ENOSPC``, the
+  shared-filesystem full condition,
+- ``kind='preempt'`` (sites ``block_done`` / ``task_done``, ``after`` like
+  kills) delivers a real ``SIGTERM`` to this process at the N-th crossing
+  (one-shot via the same ``state_dir`` latch): the drain handler
+  (``runtime/supervision.py``) must flip the latch and the runtime must
+  drain + exit ``REQUEUE_EXIT_CODE`` instead of dying.
+
 Config schema::
 
     {
@@ -56,7 +75,16 @@ Config schema::
         # lost scheduler job: the first submission is swallowed
         {"site": "submit", "kind": "job_loss", "fail_attempts": 1},
         # preemption: exit hard at the 3rd completed block
-        {"site": "block_done", "kind": "kill", "after": 3}
+        {"site": "block_done", "kind": "kill", "after": 3},
+        # host/device OOM: loads of >= 4096-voxel work units fail (smaller
+        # split sub-blocks pass) for the first 1e6 attempts
+        {"site": "load", "kind": "oom", "min_voxels": 4096,
+         "fail_attempts": 1000000},
+        # full filesystem: block 2's first two store attempts hit ENOSPC
+        {"site": "store", "kind": "enospc", "blocks": [2],
+         "fail_attempts": 2},
+        # graceful preemption: a real SIGTERM at the 5th completed block
+        {"site": "block_done", "kind": "preempt", "after": 5}
       ]
     }
 
@@ -78,8 +106,10 @@ target ``watershed`` blocks without also firing in ``graph``.
 from __future__ import annotations
 
 import contextlib
+import errno as errno_mod
 import json
 import os
+import signal
 import threading
 import time
 import zlib
@@ -96,6 +126,11 @@ ENV_VAR = "CTT_FAULTS"
 _ERROR_SITES = ("load", "store", "io_read", "io_write", "submit", "task")
 _KILL_SITES = ("block_done", "task_done")
 _HANG_SITES = ("load", "store", "io_read", "io_write")
+_OOM_SITES = ("load", "store", "io_read", "io_write", "compute")
+_ENOSPC_SITES = ("store", "io_write")
+#: maybe_fail kinds: all raise at the same hook, with their own exception
+#: types so the executor's *typed* classification is what gets exercised
+_FAIL_KINDS = ("error", "oom", "enospc")
 
 
 # -- fault-targeting context --------------------------------------------------
@@ -147,6 +182,40 @@ class InjectedFault(RuntimeError):
         )
 
 
+class InjectedOOM(MemoryError):
+    """``kind='oom'``: a real MemoryError (message mentions
+    RESOURCE_EXHAUSTED, like an XLA allocator failure) so the executor's
+    typed resource classification — not injection special-casing — routes
+    it to the degrade policy."""
+
+    def __init__(self, site: str, block_id: Optional[int], attempt: int):
+        self.site = site
+        self.block_id = block_id
+        self.attempt = attempt
+        super().__init__(
+            f"injected RESOURCE_EXHAUSTED (oom) at {site}"
+            + (f" on block {block_id}" if block_id is not None else "")
+            + f" (attempt {attempt})"
+        )
+
+
+class InjectedENOSPC(OSError):
+    """``kind='enospc'``: an OSError carrying ``errno=ENOSPC`` — the
+    shared-filesystem full condition, classified by errno like the real
+    thing."""
+
+    def __init__(self, site: str, block_id: Optional[int], attempt: int):
+        self.site = site
+        self.block_id = block_id
+        self.attempt = attempt
+        super().__init__(
+            errno_mod.ENOSPC,
+            f"injected ENOSPC at {site}"
+            + (f" on block {block_id}" if block_id is not None else "")
+            + f" (attempt {attempt}): no space left on device",
+        )
+
+
 def _poison_leaf(a):
     """Model a NaN-producing kernel: float leaves become NaN; integer
     leaves get the value a NaN cast yields (INT_MIN for signed, max for
@@ -177,16 +246,16 @@ class FaultInjector:
         for spec in self.specs:
             kind = spec.get("kind")
             site = spec.get("site")
-            if kind == "kill":
+            if kind in ("kill", "preempt"):
                 if site not in _KILL_SITES:
                     raise ValueError(
-                        f"kill fault site must be one of {_KILL_SITES}, "
+                        f"{kind} fault site must be one of {_KILL_SITES}, "
                         f"got {site!r}"
                     )
                 if not self.state_dir:
                     raise ValueError(
-                        "kill faults require 'state_dir' (the one-shot "
-                        "latch must survive the process they kill)"
+                        f"{kind} faults require 'state_dir' (the one-shot "
+                        "latch must survive the process they interrupt)"
                     )
             elif kind == "nan":
                 if site != "kernel":
@@ -195,6 +264,18 @@ class FaultInjector:
                 if site not in _ERROR_SITES:
                     raise ValueError(
                         f"error fault site must be one of {_ERROR_SITES}, "
+                        f"got {site!r}"
+                    )
+            elif kind == "oom":
+                if site not in _OOM_SITES:
+                    raise ValueError(
+                        f"oom fault site must be one of {_OOM_SITES}, "
+                        f"got {site!r}"
+                    )
+            elif kind == "enospc":
+                if site not in _ENOSPC_SITES:
+                    raise ValueError(
+                        f"enospc fault site must be one of {_ENOSPC_SITES}, "
                         f"got {site!r}"
                     )
             elif kind == "hang":
@@ -231,9 +312,14 @@ class FaultInjector:
             self._counts[key] = attempt
             return attempt
 
-    def _active(self, idx, spec, site, block_id, kind) -> Optional[int]:
+    def _active(
+        self, idx, spec, site, block_id, kind, voxels=None
+    ) -> Optional[int]:
         """Attempt number if this spec fires for (site, block), else None.
-        Calling this *counts* an attempt for matching specs."""
+        Calling this *counts* an attempt for matching specs.  ``min_voxels``
+        gates on the caller-reported work-unit size (resource faults: big
+        blocks fail, split sub-blocks fit) — unsized calls never match a
+        sized spec."""
         if spec.get("kind") != kind or spec.get("site") != site:
             return None
         blocks = spec.get("blocks")
@@ -245,6 +331,10 @@ class FaultInjector:
             cur = current_task() or ""
             if not any(cur.startswith(str(t)) for t in tasks):
                 return None
+        min_voxels = spec.get("min_voxels")
+        if min_voxels is not None:
+            if voxels is None or int(voxels) < int(min_voxels):
+                return None
         attempt = self._next_attempt(site, block_id, idx)
         if attempt > int(spec.get("fail_attempts", 1)):
             return None
@@ -254,14 +344,30 @@ class FaultInjector:
         return attempt
 
     # -- hook points ---------------------------------------------------------
-    def maybe_fail(self, site: str, block_id: Optional[int] = None) -> None:
-        """Raise :class:`InjectedFault` if an error fault fires here."""
+    def maybe_fail(
+        self,
+        site: str,
+        block_id: Optional[int] = None,
+        voxels: Optional[int] = None,
+    ) -> None:
+        """Raise :class:`InjectedFault` / :class:`InjectedOOM` /
+        :class:`InjectedENOSPC` if an error / oom / enospc fault fires here.
+        ``voxels`` is the caller's work-unit size, used by the ``min_voxels``
+        gate of resource faults."""
         if not self.enabled:
             return
         for idx, spec in enumerate(self.specs):
-            attempt = self._active(idx, spec, site, block_id, "error")
-            if attempt is not None:
-                raise InjectedFault(site, block_id, attempt)
+            kind = spec.get("kind")
+            if kind not in _FAIL_KINDS:
+                continue
+            attempt = self._active(idx, spec, site, block_id, kind, voxels)
+            if attempt is None:
+                continue
+            if kind == "oom":
+                raise InjectedOOM(site, block_id, attempt)
+            if kind == "enospc":
+                raise InjectedENOSPC(site, block_id, attempt)
+            raise InjectedFault(site, block_id, attempt)
 
     def corrupt(self, site: str, block_id: Optional[int], tree):
         """Return ``tree`` with every array leaf poisoned if a nan fault
@@ -309,12 +415,16 @@ class FaultInjector:
         return False
 
     def kill_point(self, site: str) -> None:
-        """Hard-exit (``os._exit``) at the configured crossing of ``site``.
-        One-shot per fault via a latch file in ``state_dir``."""
+        """Act at the configured crossing of ``site``: ``kind='kill'``
+        hard-exits (``os._exit``), ``kind='preempt'`` delivers a real
+        SIGTERM to this process (the drain handler must turn it into a
+        graceful drain + requeue exit).  One-shot per fault via a latch
+        file in ``state_dir``."""
         if not self.enabled:
             return
         for idx, spec in enumerate(self.specs):
-            if spec.get("kind") != "kill" or spec.get("site") != site:
+            kind = spec.get("kind")
+            if kind not in ("kill", "preempt") or spec.get("site") != site:
                 continue
             count = self._next_attempt(site, None, idx)
             if count != int(spec.get("after", 1)):
@@ -322,7 +432,7 @@ class FaultInjector:
             latch = os.path.join(self.state_dir, f"kill_{idx}.done")
             if os.path.exists(latch):
                 continue
-            # latch first (atomically), then die: the resumed run must not
+            # latch first (atomically), then act: the resumed run must not
             # re-fire even if the exit races other threads
             tmp = latch + f".tmp.{os.getpid()}"
             with open(tmp, "w") as f:
@@ -330,7 +440,10 @@ class FaultInjector:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, latch)
-            os._exit(KILL_EXIT_CODE)
+            if kind == "preempt":
+                os.kill(os.getpid(), signal.SIGTERM)
+            else:
+                os._exit(KILL_EXIT_CODE)
 
 
 # -- module-level singleton ---------------------------------------------------

@@ -19,6 +19,17 @@ supplies the building blocks for the *silent* ones (docs/ROBUSTNESS.md
   result wins and the second is checked for bit-identical agreement (a
   disagreement means a nondeterministic kernel or corrupted data, and is
   surfaced instead of silently picking one).
+- **Drain latch** (docs/ROBUSTNESS.md "Graceful degradation") — the
+  process-wide preemption protocol: :func:`install_drain_handler` arms
+  SIGTERM/SIGUSR1 to flip a latch instead of dying; the executor, the task
+  runner, and ``host_block_map`` poll :func:`drain_requested` at their block
+  / task boundaries, finish in-flight work, flush markers + manifests, and
+  raise :class:`DrainInterrupt` so the entry point can exit with
+  :data:`REQUEUE_EXIT_CODE` — the scheduler-visible "requeue me" signal a
+  preempted job sends instead of a crash.
+- **Headroom probes** — :func:`host_mem_available_fraction` /
+  :func:`disk_free_fraction`, the cheap measurements behind the executor's
+  byte-budget admission control and resource-exhaustion backpressure.
 
 A cluster job's first heartbeat is written by its *batch script* (a shell
 one-liner, before the Python interpreter even starts), so the supervisor's
@@ -28,6 +39,8 @@ staleness clock is not confused by slow jax imports on the worker node.
 from __future__ import annotations
 
 import os
+import shutil
+import signal
 import socket
 import threading
 import time
@@ -37,6 +50,136 @@ from typing import Any, Callable, Dict, Optional
 from ..utils import function_utils as fu
 
 HEARTBEAT_DIRNAME = "heartbeats"
+
+#: Exit code a gracefully-drained (preempted) process exits with, telling
+#: the submitting supervisor "requeue me, nothing is broken" — distinct from
+#: both a crash (1) and an injected hard kill (``faults.KILL_EXIT_CODE``).
+REQUEUE_EXIT_CODE = 114
+
+
+# -- preemption-aware draining ------------------------------------------------
+
+
+class DrainInterrupt(BaseException):
+    """Raised at a safe block/task boundary once a drain was requested
+    (SIGTERM/SIGUSR1): in-flight work has been finished or checkpointed,
+    markers and manifests are flushed, and the process should exit with
+    :data:`REQUEUE_EXIT_CODE` so the supervisor requeues it.
+
+    A ``BaseException`` on purpose: the task runtime's broad ``except
+    Exception`` retry/continue paths must never swallow a preemption and
+    burn failure retries on it.
+    """
+
+    def __init__(self, reason: str, remaining_ids=None):
+        self.reason = reason
+        self.remaining_ids = sorted(int(b) for b in (remaining_ids or []))
+        msg = f"drain requested ({reason})"
+        if self.remaining_ids:
+            msg += f"; {len(self.remaining_ids)} block(s) left for the resume"
+        super().__init__(msg)
+
+
+_drain_event = threading.Event()
+_drain_reason: Optional[str] = None
+_drain_installed = False
+_drain_lock = threading.Lock()
+
+
+def request_drain(reason: str = "drain requested") -> None:
+    """Flip the process-wide drain latch (idempotent; signal-safe)."""
+    global _drain_reason
+    if _drain_reason is None:
+        _drain_reason = reason
+    _drain_event.set()
+
+
+def drain_requested() -> bool:
+    return _drain_event.is_set()
+
+
+def drain_reason() -> Optional[str]:
+    return _drain_reason
+
+
+def reset_drain() -> None:
+    """Clear the latch (tests; a resumed run starts un-drained anyway
+    because it is a fresh process)."""
+    global _drain_reason
+    _drain_event.clear()
+    _drain_reason = None
+
+
+def install_drain_handler(signals=(signal.SIGTERM, signal.SIGUSR1)) -> bool:
+    """Arm SIGTERM/SIGUSR1 to flip the drain latch instead of killing the
+    process.  Idempotent; only replaces *default* dispositions (an embedder
+    who installed their own handler keeps it); a no-op off the main thread
+    (Python restricts ``signal.signal`` to it).  Returns True when the
+    latch is armed for at least one signal."""
+    global _drain_installed
+    with _drain_lock:
+        if _drain_installed:
+            return True
+        armed = False
+        for sig in signals:
+            try:
+                if signal.getsignal(sig) != signal.SIG_DFL:
+                    continue
+
+                def _handler(signum, frame, _name=signal.Signals(sig).name):
+                    request_drain(f"received {_name}")
+
+                signal.signal(sig, _handler)
+                armed = True
+            except (ValueError, OSError):  # non-main thread / exotic platform
+                return False
+        if armed:
+            _drain_installed = True
+        return armed
+
+
+# -- resource headroom probes -------------------------------------------------
+
+
+def host_mem_available_bytes() -> Optional[int]:
+    """``MemAvailable`` from /proc/meminfo, or None where unavailable —
+    callers treat None as "no admission control possible", never as 0."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def host_mem_available_fraction() -> Optional[float]:
+    """MemAvailable / MemTotal, or None where /proc/meminfo is absent."""
+    try:
+        avail = total = None
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1])
+        if avail is not None and total:
+            return avail / total
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def disk_free_fraction(path: str) -> Optional[float]:
+    """Free/total of the filesystem holding ``path``, or None."""
+    try:
+        usage = shutil.disk_usage(path)
+        if usage.total:
+            return usage.free / usage.total
+    except (OSError, ValueError):
+        pass
+    return None
 
 
 # -- heartbeats ---------------------------------------------------------------

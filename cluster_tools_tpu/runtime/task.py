@@ -121,9 +121,16 @@ class BaseTask:
         ``io_retries`` / ``io_backoff_s`` per-block load/store retries inside
         :class:`~cluster_tools_tpu.runtime.executor.BlockwiseExecutor`,
         ``block_deadline_s`` / ``watchdog_period_s`` the hung-block deadline
-        + speculative re-execution (None disables), and the cluster-target
-        supervision trio ``heartbeat_interval_s`` / ``heartbeat_timeout_s``
-        / ``max_resubmits`` (``runtime/cluster.py``)."""
+        + speculative re-execution (None disables), the cluster-target
+        supervision knobs ``heartbeat_interval_s`` / ``heartbeat_timeout_s``
+        / ``max_resubmits`` / ``max_preempt_resubmits``
+        (``runtime/cluster.py``), and the graceful-degradation knobs
+        ``allow_block_split`` (OOM'd blocks re-execute as halo-correct
+        sub-blocks — only for shape-local kernels, see the executor's
+        ``splittable`` contract), ``min_block_shape`` (split floor),
+        ``degrade_wait_s`` (bounded headroom wait before a degrade
+        re-attempt) and ``inflight_byte_budget`` (admission cap; None =
+        auto from MemAvailable, 0 = off)."""
         return {
             "max_retries": 0,
             "retry_backoff_s": 1.0,
@@ -134,6 +141,11 @@ class BaseTask:
             "heartbeat_interval_s": 5.0,
             "heartbeat_timeout_s": 0.0,
             "max_resubmits": 2,
+            "max_preempt_resubmits": 3,
+            "allow_block_split": False,
+            "min_block_shape": None,
+            "degrade_wait_s": 5.0,
+            "inflight_byte_budget": None,
         }
 
     @staticmethod
@@ -213,11 +225,19 @@ class BaseTask:
         tracebacks capped) and a RuntimeError lists every failed block id.
         Returns the number of blocks run.
         """
+        from .supervision import DrainInterrupt, drain_reason, drain_requested
+
         done = set(self.blocks_done())
         todo = [b for b in block_ids if b not in done]
         errors: List[tuple] = []
+        skipped_for_drain: List[int] = []
 
         def wrapped(block_id):
+            if drain_requested():
+                # drain latch flipped (SIGTERM): stop claiming blocks; the
+                # ones already processed keep their markers for the resume
+                skipped_for_drain.append(block_id)
+                return
             try:
                 process(block_id)
                 self.log_block_success(block_id)
@@ -231,7 +251,6 @@ class BaseTask:
         with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
             list(pool.map(wrapped, todo))
         if errors:
-            failed_ids = sorted(b for b, _ in errors)
             fu.record_failures(
                 self.failures_path,
                 self.uid,
@@ -246,6 +265,16 @@ class BaseTask:
                     for b, tb in sorted(errors)
                 ],
             )
+        if skipped_for_drain:
+            # a drain outranks block errors: the requeued run retries them
+            # anyway, and burning task-level retries on a preemption would
+            # turn a graceful eviction into a spurious failure
+            raise DrainInterrupt(
+                drain_reason() or "drain requested",
+                skipped_for_drain + [b for b, _ in errors],
+            )
+        if errors:
+            failed_ids = sorted(b for b, _ in errors)
             detail = "\n".join(
                 f"-- block {b} --\n{tb}" for b, tb in errors[:5]
             )
@@ -373,7 +402,15 @@ def build(tasks: Sequence[BaseTask], rerun: bool = False) -> bool:
     one bad branch no longer throws away hours of progress elsewhere, and
     the manifests it did produce still shrink the eventual re-run.  Returns
     True only if every task succeeded (matching luigi's boolean contract).
+
+    Preemption (docs/ROBUSTNESS.md "Graceful degradation"): once the drain
+    latch is flipped (SIGTERM/SIGUSR1), no further task starts and
+    :class:`~cluster_tools_tpu.runtime.supervision.DrainInterrupt`
+    propagates — it is a ``BaseException``, so the per-task retry loop
+    cannot mistake a preemption for a flaky task.  Finished tasks keep
+    their manifests; the requeued run resumes behind them.
     """
+    from .supervision import DrainInterrupt, drain_reason, drain_requested
     order: List[BaseTask] = []
     seen = set()
     deps_of: Dict[tuple, List[tuple]] = {}
@@ -413,6 +450,8 @@ def build(tasks: Sequence[BaseTask], rerun: bool = False) -> bool:
             )
             failed.add(key)
             continue
+        if drain_requested():
+            raise DrainInterrupt(drain_reason() or "drain requested")
         if _run_with_retries(task):
             from . import faults as faults_mod
 

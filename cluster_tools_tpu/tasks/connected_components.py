@@ -175,6 +175,12 @@ class BlockComponentsBase(BaseTask):
             block_deadline_s=cfg.get("block_deadline_s"),
             watchdog_period_s=cfg.get("watchdog_period_s"),
             store_verify_fn=region_verifier(out),
+            # degrade on OOM/ENOSPC; never splittable: the per-block CC
+            # decomposition (and the min-voxel label of a component crossing
+            # a would-be split plane) changes under sub-block re-execution
+            splittable=False,
+            degrade_wait_s=float(cfg.get("degrade_wait_s", 5.0)),
+            inflight_byte_budget=cfg.get("inflight_byte_budget"),
         )
         return {"n_blocks": len(block_ids), "shape": list(shape)}
 

@@ -25,7 +25,11 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.filters import gaussian_smooth, gradient_magnitude
-from ..runtime.executor import BlockwiseExecutor, region_verifier
+from ..runtime.executor import (
+    BlockwiseExecutor,
+    is_sub_block,
+    region_verifier,
+)
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader, pad_block_to
 
@@ -646,6 +650,11 @@ class IlastikPredictionBase(BaseTask):
 
         def load(block):
             data = np.asarray(inp[block.outer_bb]).astype(np.float32)
+            if is_sub_block(block):
+                # degrade-split fragment: keep its own (smaller) shape —
+                # sub-blocks never enter a stacked batch, and the smaller
+                # allocation is the point of the split
+                return (data,)
             return (pad_block_to(data, outer, mode="edge"),)
 
         def kernel(x):
@@ -691,6 +700,14 @@ class IlastikPredictionBase(BaseTask):
             store_verify_fn=region_verifier(
                 out, bb_of=lambda b: (slice(None),) + b.bb
             ),
+            # opt-in OOM split (config allow_block_split): filter-bank +
+            # per-voxel classifier is shape-local, so sub-block outputs tile
+            # the parent exactly when halo covers the largest filter support
+            splittable=bool(cfg.get("allow_block_split", False)),
+            split_halo=halo,
+            min_block_shape=cfg.get("min_block_shape"),
+            degrade_wait_s=float(cfg.get("degrade_wait_s", 5.0)),
+            inflight_byte_budget=cfg.get("inflight_byte_budget"),
         )
         return {"n_blocks": len(todo), "n_classes": int(n_classes)}
 

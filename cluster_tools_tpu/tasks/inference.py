@@ -25,7 +25,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..runtime.executor import BlockwiseExecutor, region_verifier
+from ..runtime.executor import (
+    BlockwiseExecutor,
+    is_sub_block,
+    region_verifier,
+)
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader, pad_block_to
 
@@ -149,6 +153,17 @@ class InferenceBase(BaseTask):
         rng_norm = cfg.get("normalize_range")
         activation = cfg.get("activation", "sigmoid")
 
+        allow_split = bool(cfg.get("allow_block_split", False))
+        if allow_split and rng_norm is None:
+            # per-block normalization statistics change with the read
+            # region, so a split sub-block would be normalized differently
+            # from its unsplit parent — only a fixed range is split-safe
+            raise ValueError(
+                "allow_block_split=True requires normalize_range "
+                "(per-block percentile/min-max normalization is not "
+                "split-safe)"
+            )
+
         def load(block):
             data = np.asarray(inp[block.outer_bb]).astype(np.float32)
             if rng_norm is not None:
@@ -158,7 +173,15 @@ class InferenceBase(BaseTask):
             else:
                 lo, hi = float(data.min()), float(data.max())
             data = (data - lo) / max(hi - lo, 1e-6)
-            return (pad_block_to(data, outer)[..., None],)
+            if is_sub_block(block):
+                # degrade-split fragment: pad to its OWN U-Net multiple —
+                # the smaller allocation is the point of the split (it
+                # never enters a stacked batch, so the static shape does
+                # not apply)
+                target = tuple(_round_up(s, mult) for s in data.shape)
+            else:
+                target = outer
+            return (pad_block_to(data, target)[..., None],)
 
         def kernel(x):
             logits = model.apply(variables, x[None])[0]
@@ -197,6 +220,15 @@ class InferenceBase(BaseTask):
             store_verify_fn=region_verifier(
                 out, bb_of=lambda b: (slice(None),) + b.bb
             ),
+            # opt-in OOM split (config allow_block_split): the conv kernel
+            # is shape-local, so sub-block outputs tile the parent's region
+            # exactly when halo covers the receptive field and the
+            # normalization range is fixed (enforced above)
+            splittable=allow_split,
+            split_halo=halo,
+            min_block_shape=cfg.get("min_block_shape"),
+            degrade_wait_s=float(cfg.get("degrade_wait_s", 5.0)),
+            inflight_byte_budget=cfg.get("inflight_byte_budget"),
         )
         return {
             "n_blocks": len(todo),

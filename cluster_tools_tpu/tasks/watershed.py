@@ -379,6 +379,15 @@ class WatershedBase(_WsTaskBase):
                 block_deadline_s=cfg.get("block_deadline_s"),
                 watchdog_period_s=cfg.get("watchdog_period_s"),
                 store_verify_fn=region_verifier(out),
+                # degrade policy: OOM/ENOSPC blocks wait for headroom and
+                # re-execute instead of burning same-size retries.  NEVER
+                # splittable: the label encoding (block_id * (n_outer+1) +
+                # flat index in the STATIC outer block) depends on the outer
+                # shape, so sub-block re-execution could not reproduce the
+                # unsplit labels bit-identically.
+                splittable=False,
+                degrade_wait_s=float(cfg.get("degrade_wait_s", 5.0)),
+                inflight_byte_budget=cfg.get("inflight_byte_budget"),
             )
         return {
             "n_blocks": len(block_ids),
@@ -568,6 +577,11 @@ class TwoPassWatershedBase(_WsTaskBase):
             block_deadline_s=cfg.get("block_deadline_s"),
             watchdog_period_s=cfg.get("watchdog_period_s"),
             store_verify_fn=region_verifier(out),
+            # same degrade policy as the single-pass task; never splittable
+            # (outer-shape-dependent label encoding, see WatershedBase)
+            splittable=False,
+            degrade_wait_s=float(cfg.get("degrade_wait_s", 5.0)),
+            inflight_byte_budget=cfg.get("inflight_byte_budget"),
         )
         return {
             "n_blocks": len(block_ids),

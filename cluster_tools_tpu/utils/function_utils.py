@@ -143,16 +143,30 @@ def file_lock(path: str, timeout_s: float = 30.0, stale_s: float = 60.0):
             pass
 
 
+#: failures.json record schema: 2 adds per-record ``schema_version`` /
+#: ``hostname`` / ``pid`` (records merged from concurrent cluster jobs stay
+#: attributable to the process that wrote them) and the optional
+#: ``resolution`` / ``resource`` degradation fields (docs/ROBUSTNESS.md).
+FAILURES_SCHEMA_VERSION = 2
+
+
 def record_failures(path: str, task_name: str, records) -> None:
     """Merge block-failure records into ``failures.json`` (atomic).
 
-    Schema: ``{"version": 1, "records": [{"task", "block_id",
-    "sites": {site: attempts}, "error", "quarantined", "resolved"}]}``.
+    Schema: ``{"version": 2, "records": [{"task", "block_id",
+    "sites": {site: attempts}, "error", "quarantined", "resolved",
+    "schema_version", "hostname", "pid", ...}]}`` (optional fields:
+    ``resolution``, ``resource``, ``job_id``/``job_ids``, ``duplicate``).
     Records are keyed by (task, block_id): a resumed run's record replaces
-    the stale one from before the restart.  The read-modify-write runs
-    under a lock file so two cluster jobs recording failures at the same
-    moment cannot drop each other's records.
+    the stale one from before the restart.  Each record is stamped with the
+    recording process's hostname + pid, so records merged from concurrent
+    cluster jobs stay attributable.  The read-modify-write runs under a
+    lock file so two cluster jobs recording failures at the same moment
+    cannot drop each other's records.
     """
+    import socket
+
+    host, pid = socket.gethostname(), os.getpid()
     with file_lock(path):
         doc = read_json_if_valid(path) or {}
         existing = {
@@ -162,12 +176,17 @@ def record_failures(path: str, task_name: str, records) -> None:
         for rec in records:
             rec = dict(rec)
             rec["task"] = task_name
+            rec.setdefault("schema_version", FAILURES_SCHEMA_VERSION)
+            rec.setdefault("hostname", host)
+            rec.setdefault("pid", pid)
             existing[(task_name, rec.get("block_id"))] = rec
         merged = sorted(
             existing.values(),
             key=lambda r: (str(r.get("task")), r.get("block_id") or 0),
         )
-        atomic_write_json(path, {"version": 1, "records": merged})
+        atomic_write_json(
+            path, {"version": FAILURES_SCHEMA_VERSION, "records": merged}
+        )
 
 
 def _marker_dir(tmp_folder: str, task_name: str) -> str:

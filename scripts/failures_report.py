@@ -1,0 +1,119 @@
+"""One-screen post-mortem of a run's ``failures.json`` (docs/ROBUSTNESS.md).
+
+Usage::
+
+    python scripts/failures_report.py <tmp_folder | failures.json>
+    make failures-report TMP=/path/to/tmp_folder
+
+Per task: block counts, per-site failed-attempt totals, resolutions
+(recovered / degraded:split / requeued:preempt / ...), quarantines, and the
+unresolved block ids an operator has to chase — plus host/pid attribution
+when records came from more than one process (schema v2).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import Counter, defaultdict
+
+
+def load_records(path: str):
+    if os.path.isdir(path):
+        path = os.path.join(path, "failures.json")
+    with open(path) as f:
+        doc = json.load(f)
+    return path, doc.get("version"), doc.get("records", [])
+
+
+def summarize(records):
+    """Per-task summary dicts, sorted by task name."""
+    by_task = defaultdict(list)
+    for rec in records:
+        by_task[str(rec.get("task"))].append(rec)
+    out = []
+    for task in sorted(by_task):
+        recs = by_task[task]
+        sites: Counter = Counter()
+        resolutions: Counter = Counter()
+        hosts = set()
+        unresolved = []
+        n_quarantined = 0
+        for r in recs:
+            for site, n in (r.get("sites") or {}).items():
+                sites[site] += int(n)
+            if r.get("quarantined"):
+                n_quarantined += 1
+            res = r.get("resolution")
+            if res:
+                resolutions[res] += 1
+            elif r.get("resolved"):
+                resolutions["recovered"] += 1
+            if not r.get("resolved"):
+                unresolved.append(r.get("block_id"))
+            if r.get("hostname"):
+                hosts.add(f"{r['hostname']}:{r.get('pid', '?')}")
+        out.append({
+            "task": task,
+            "n_records": len(recs),
+            "sites": dict(sites),
+            "resolutions": dict(resolutions),
+            "n_quarantined": n_quarantined,
+            "unresolved": sorted(
+                (b for b in unresolved if b is not None), key=int
+            ) + ([None] if None in unresolved else []),
+            "hosts": sorted(hosts),
+        })
+    return out
+
+
+def format_report(path, version, summaries) -> str:
+    lines = [f"failures report: {path} (schema v{version})", ""]
+    if not summaries:
+        lines.append("no failure records — clean run")
+        return "\n".join(lines)
+    n_unresolved = sum(len(s["unresolved"]) for s in summaries)
+    all_hosts = sorted({h for s in summaries for h in s["hosts"]})
+    for s in summaries:
+        lines.append(f"[{s['task']}]  {s['n_records']} record(s), "
+                     f"{s['n_quarantined']} quarantined")
+        if s["sites"]:
+            site_str = ", ".join(
+                f"{site}={n}" for site, n in sorted(s["sites"].items())
+            )
+            lines.append(f"  failed attempts by site: {site_str}")
+        if s["resolutions"]:
+            res_str = ", ".join(
+                f"{r}={n}" for r, n in sorted(s["resolutions"].items())
+            )
+            lines.append(f"  resolutions: {res_str}")
+        if s["unresolved"]:
+            lines.append(f"  UNRESOLVED blocks: {s['unresolved']}")
+        if len(all_hosts) > 1 and s["hosts"]:
+            lines.append(f"  recorded by: {', '.join(s['hosts'])}")
+        lines.append("")
+    verdict = (
+        "every failure was absorbed (retry / quarantine / degrade / requeue)"
+        if n_unresolved == 0
+        else f"{n_unresolved} unit(s) stayed UNRESOLVED — the run raised"
+    )
+    lines.append(verdict)
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    try:
+        path, version, records = load_records(argv[1])
+    except (OSError, ValueError) as e:
+        print(f"cannot read failures manifest: {e}", file=sys.stderr)
+        return 1
+    print(format_report(path, version, summarize(records)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

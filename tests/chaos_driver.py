@@ -4,7 +4,8 @@ from a JSON spec file.  Faults are injected via the ``CTT_FAULTS`` env var
 
 Usage: python chaos_driver.py <spec.json>
 Exit codes: 0 workflow ok, 1 workflow failed, KILL_EXIT_CODE (113) injected
-kill.
+kill, REQUEUE_EXIT_CODE (114) graceful drain after SIGTERM/preempt — rerun
+with the same spec to resume.
 """
 
 import json
@@ -19,11 +20,22 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    from cluster_tools_tpu.runtime.supervision import (
+        REQUEUE_EXIT_CODE,
+        DrainInterrupt,
+        install_drain_handler,
+    )
     from cluster_tools_tpu.runtime.task import build
     from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
 
+    install_drain_handler()
     wf = MulticutSegmentationWorkflow(**spec)
-    sys.exit(0 if build([wf]) else 1)
+    try:
+        ok = build([wf])
+    except DrainInterrupt as e:
+        print(f"drained for requeue: {e}", file=sys.stderr)
+        sys.exit(REQUEUE_EXIT_CODE)
+    sys.exit(0 if ok else 1)
 
 
 if __name__ == "__main__":

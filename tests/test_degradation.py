@@ -1,0 +1,698 @@
+"""Graceful-degradation unit tests (docs/ROBUSTNESS.md "Graceful
+degradation"): typed resource-error classification, oom/enospc/preempt
+fault injection, adaptive block splitting (halo-correct geometry + the
+executor's recursive split path, bit-identical to the unsplit run),
+byte-budget admission control, preemption-aware draining (executor /
+host_block_map / build / supervisor requeue), the SIGTERM->grace->SIGKILL
+worker escalation, the failures.json v2 schema fields, the post-mortem
+report script, and the retry-backoff bound guarantees."""
+
+import errno
+import json
+import os
+import signal
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime import faults
+from cluster_tools_tpu.runtime.executor import (
+    BlockwiseExecutor,
+    classify_resource_error,
+    is_sub_block,
+    split_block,
+)
+from cluster_tools_tpu.runtime.faults import (
+    FaultInjector,
+    InjectedENOSPC,
+    InjectedOOM,
+)
+from cluster_tools_tpu.runtime.supervision import (
+    DrainInterrupt,
+    drain_requested,
+    install_drain_handler,
+    request_drain,
+    reset_drain,
+)
+from cluster_tools_tpu.runtime.task import BaseTask, build
+from cluster_tools_tpu.utils import function_utils as fu
+from cluster_tools_tpu.utils.volume_utils import Blocking
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test starts and ends un-drained and without injected faults —
+    the drain latch and injector are process-global."""
+    reset_drain()
+    yield
+    reset_drain()
+    faults.reset()
+
+
+# -- typed resource-error classification --------------------------------------
+
+
+def test_classify_resource_errors():
+    assert classify_resource_error(MemoryError("boom")) == "oom"
+    assert classify_resource_error(OSError(errno.ENOSPC, "full")) == "enospc"
+    assert classify_resource_error(OSError(errno.EDQUOT, "quota")) == "enospc"
+    assert classify_resource_error(OSError(errno.EIO, "io")) is None
+    assert classify_resource_error(ValueError("nope")) is None
+    assert classify_resource_error(RuntimeError("harmless")) is None
+
+
+def test_classify_xla_resource_exhausted_by_name_and_message():
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert classify_resource_error(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to "
+                        "allocate 17179869184 bytes")
+    ) == "oom"
+    # message alone is not enough for arbitrary types
+    assert classify_resource_error(
+        KeyError("RESOURCE_EXHAUSTED mentioned in passing")
+    ) is None
+
+
+def test_classify_walks_cause_chain():
+    try:
+        try:
+            raise MemoryError("inner allocation")
+        except MemoryError as inner:
+            raise RuntimeError("store failed") from inner
+    except RuntimeError as wrapped:
+        assert classify_resource_error(wrapped) == "oom"
+
+
+# -- oom / enospc / preempt fault classes -------------------------------------
+
+
+def test_injector_oom_raises_memoryerror_with_min_voxels_gate():
+    inj = FaultInjector({"faults": [
+        {"site": "load", "kind": "oom", "min_voxels": 1000,
+         "fail_attempts": 10**6},
+    ]})
+    inj.maybe_fail("load", 0, voxels=999)      # under the gate: no fire
+    inj.maybe_fail("load", 0)                  # unsized call: no fire
+    with pytest.raises(MemoryError, match="RESOURCE_EXHAUSTED"):
+        inj.maybe_fail("load", 0, voxels=1000)
+    assert classify_resource_error(
+        InjectedOOM("load", 0, 1)
+    ) == "oom"
+
+
+def test_injector_enospc_raises_oserror_with_errno():
+    inj = FaultInjector({"faults": [
+        {"site": "store", "kind": "enospc", "blocks": [2],
+         "fail_attempts": 1},
+    ]})
+    inj.maybe_fail("store", 1)  # other blocks unaffected
+    with pytest.raises(OSError) as exc:
+        inj.maybe_fail("store", 2)
+    assert exc.value.errno == errno.ENOSPC
+    inj.maybe_fail("store", 2)  # transient: second attempt passes
+    assert classify_resource_error(InjectedENOSPC("store", 2, 1)) == "enospc"
+
+
+def test_injector_resource_site_validation():
+    with pytest.raises(ValueError, match="oom fault site"):
+        FaultInjector({"faults": [{"site": "submit", "kind": "oom"}]})
+    with pytest.raises(ValueError, match="enospc fault site"):
+        FaultInjector({"faults": [{"site": "load", "kind": "enospc"}]})
+    with pytest.raises(ValueError, match="state_dir"):
+        FaultInjector({"faults": [{"site": "block_done", "kind": "preempt"}]})
+    with pytest.raises(ValueError, match="preempt fault site"):
+        FaultInjector({
+            "state_dir": "/tmp",
+            "faults": [{"site": "load", "kind": "preempt"}],
+        })
+
+
+def test_preempt_fault_sends_sigterm_once(tmp_path, inject):
+    """kind='preempt' delivers a real SIGTERM that the drain handler turns
+    into a latch flip — and the state_dir latch makes it one-shot, so the
+    resumed run with the same CTT_FAULTS is not preempted again."""
+    install_drain_handler()
+    if not callable(signal.getsignal(signal.SIGTERM)):
+        pytest.skip("SIGTERM handler not installable in this environment")
+    cfg = {
+        "state_dir": str(tmp_path),
+        "faults": [{"site": "block_done", "kind": "preempt", "after": 2}],
+    }
+    inj = inject(cfg)
+    inj.kill_point("block_done")           # crossing 1: below 'after'
+    assert not drain_requested()
+    inj.kill_point("block_done")           # crossing 2: SIGTERM -> latch
+    deadline = time.monotonic() + 5.0
+    while not drain_requested() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert drain_requested()
+    # "resumed run": fresh injector, same config, same state_dir latch
+    reset_drain()
+    inj = inject(cfg)
+    inj.kill_point("block_done")
+    inj.kill_point("block_done")
+    time.sleep(0.05)
+    assert not drain_requested()
+
+
+# -- adaptive block splitting: geometry ---------------------------------------
+
+
+def test_split_block_tiles_inner_and_respects_halo():
+    blocking = Blocking((16, 16, 16), (16, 16, 16))
+    blk = blocking.get_block(0, halo=[2, 2, 2])
+    subs = split_block(blk, halo=(2, 2, 2), min_shape=(8, 8, 8))
+    assert len(subs) == 8 and all(is_sub_block(s) for s in subs)
+    assert all(int(s.block_id) == 0 for s in subs)
+    cover = np.zeros((16, 16, 16), int)
+    for s in subs:
+        cover[s.bb] += 1
+    assert (cover == 1).all(), "sub-blocks must tile the inner region exactly"
+    s0 = subs[0]
+    # volume faces stay clamped; interior split planes gain the halo
+    assert s0.outer_begin == (0, 0, 0)
+    assert s0.outer_end == (10, 10, 10)
+
+
+def test_split_block_min_shape_floor_and_derived_halo():
+    blocking = Blocking((16, 16, 16), (16, 16, 16))
+    blk = blocking.get_block(0, halo=[2, 2, 2])
+    subs = split_block(blk, min_shape=(8, 8, 8))  # halo derived from blk
+    assert len(subs) == 8
+    # halves below the floor do not split further
+    assert split_block(subs[0], halo=(2, 2, 2), min_shape=(8, 8, 8)) is None
+    # anisotropic floor: only the axes with room split
+    subs = split_block(blk, halo=(2, 2, 2), min_shape=(16, 8, 8))
+    assert len(subs) == 4
+    assert all(s.shape[0] == 16 for s in subs)
+
+
+# -- executor degrade ladder --------------------------------------------------
+
+
+def _run_degrade(inject_cfg, failures_path, splittable=False, **map_kw):
+    """x+1 over a 2-block halo'd volume; store crops inner from outer, so
+    split sub-results reassemble through the same path."""
+    if inject_cfg is not None:
+        faults.configure(inject_cfg)
+    shape, bshape = (32, 8, 8), (16, 8, 8)
+    data = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    out = np.zeros(shape, np.float32)
+    blocking = Blocking(shape, bshape)
+    blocks = [
+        blocking.get_block(i, halo=[2, 2, 2]) for i in range(blocking.n_blocks)
+    ]
+    ex = BlockwiseExecutor(target="local", backoff_base=1e-4)
+
+    summary = ex.map_blocks(
+        lambda x: x + 1,
+        blocks,
+        lambda b: (data[b.outer_bb],),
+        lambda b, raw: out.__setitem__(b.bb, np.asarray(raw)[b.inner_in_outer_bb]),
+        failures_path=failures_path,
+        task_name="unit",
+        splittable=splittable,
+        split_halo=(2, 2, 2),
+        min_block_shape=(2, 2, 2),
+        degrade_wait_s=0.05,
+        **map_kw,
+    )
+    return out, data, summary
+
+
+def test_oom_at_load_degrades_without_same_size_retries(tmp_path):
+    """A transient OOM is NOT retried at the same size inside the batch: it
+    quarantines straight into the degrade ladder, where the headroom-wait
+    re-attempt resolves it."""
+    fp = str(tmp_path / "failures.json")
+    out, data, summary = _run_degrade(
+        {"faults": [{"site": "load", "kind": "oom", "blocks": [1],
+                     "fail_attempts": 1}]}, fp,
+    )
+    np.testing.assert_array_equal(out, data + 1)
+    assert summary["n_degraded"] == 1 and summary["n_failed"] == 0
+    rec = json.load(open(fp))["records"][0]
+    assert rec["block_id"] == 1 and rec["resolved"]
+    assert rec["resolution"] == "degraded:backpressure"
+    assert rec["resource"] == "oom"
+    # exactly ONE failed load attempt before the degrade path took over
+    # (same-size in-batch retries would have burned io_retries+1 attempts)
+    assert rec["sites"]["load"] == 1 and rec["sites"]["oom"] >= 1
+
+
+def test_oom_block_splits_and_completes_bit_identically(tmp_path):
+    """ISSUE 4 acceptance: a persistently OOM'd block (min_voxels models
+    'the full block never fits') is automatically split into halo-correct
+    sub-blocks re-executed through the same kernel, completes WITHOUT
+    quarantine-failure, and the reassembled result is bit-identical to the
+    unsplit fault-free run."""
+    fp_ref = str(tmp_path / "ref_failures.json")
+    ref_out, data, _ = _run_degrade(None, fp_ref)
+
+    fp = str(tmp_path / "failures.json")
+    # full blocks are 1152 outer voxels, first-level halves ~360: the gate
+    # makes every full-size attempt fail and every sub-block attempt fit
+    out, _, summary = _run_degrade(
+        {"faults": [{"site": "load", "kind": "oom", "min_voxels": 1000,
+                     "fail_attempts": 10**6}]}, fp, splittable=True,
+    )
+    np.testing.assert_array_equal(out, ref_out)
+    np.testing.assert_array_equal(out, data + 1)
+    assert summary["n_failed"] == 0 and summary["n_split"] == 2
+    assert summary["n_sub_blocks"] == 16 and summary["split_depth"] == 1
+    recs = {r["block_id"]: r for r in json.load(open(fp))["records"]}
+    assert set(recs) == {0, 1}
+    for rec in recs.values():
+        assert rec["resolved"] and rec["resolution"] == "degraded:split"
+        assert rec["split_depth"] == 1
+
+
+def test_oom_split_recurses_to_smaller_sub_blocks(tmp_path):
+    """When first-level halves still exceed the (injected) memory, the
+    split recurses — sub-blocks of sub-blocks — until they fit."""
+    fp = str(tmp_path / "failures.json")
+    # gate at 300: full 1152 and first-level ~360-432 fail, second level fits
+    out, data, summary = _run_degrade(
+        {"faults": [{"site": "load", "kind": "oom", "min_voxels": 300,
+                     "fail_attempts": 10**6}]}, fp, splittable=True,
+    )
+    np.testing.assert_array_equal(out, data + 1)
+    assert summary["split_depth"] >= 2
+
+
+def test_persistent_oom_not_splittable_fails_attributed(tmp_path):
+    fp = str(tmp_path / "failures.json")
+    with pytest.raises(RuntimeError, match="failed"):
+        _run_degrade(
+            {"faults": [{"site": "load", "kind": "oom", "min_voxels": 1000,
+                         "fail_attempts": 10**6}]}, fp, splittable=False,
+        )
+    recs = json.load(open(fp))["records"]
+    assert all(r["resource"] == "oom" and not r["resolved"] for r in recs)
+
+
+def test_split_stops_at_min_block_shape(tmp_path):
+    """A gate below what splitting can reach fails loudly with the split
+    floor named, instead of recursing forever."""
+    fp = str(tmp_path / "failures.json")
+    with pytest.raises(RuntimeError):
+        # with halo 2, sub-blocks bottom out around 6^3 outer voxels: a
+        # 100-voxel gate is unreachable
+        _run_degrade(
+            {"faults": [{"site": "load", "kind": "oom", "min_voxels": 100,
+                         "fail_attempts": 10**6}]}, fp, splittable=True,
+        )
+    recs = json.load(open(fp))["records"]
+    assert any("cannot split further" in (r.get("error") or "") for r in recs)
+
+
+def test_enospc_at_store_degrades_with_backpressure(tmp_path):
+    fp = str(tmp_path / "failures.json")
+    out, data, summary = _run_degrade(
+        {"faults": [{"site": "store", "kind": "enospc", "blocks": [0],
+                     "fail_attempts": 1}]}, fp,
+    )
+    np.testing.assert_array_equal(out, data + 1)
+    rec = [r for r in json.load(open(fp))["records"] if r["block_id"] == 0][0]
+    assert rec["resolution"] == "degraded:backpressure"
+    assert rec["resource"] == "enospc" and rec["sites"]["enospc"] >= 1
+
+
+def test_persistent_enospc_splits_into_smaller_writes(tmp_path):
+    """ENOSPC that persists for full-block writes but clears for the
+    smaller sub-block writes (min_voxels models 'almost-full disk')."""
+    fp = str(tmp_path / "failures.json")
+    out, data, summary = _run_degrade(
+        {"faults": [{"site": "store", "kind": "enospc", "min_voxels": 1000,
+                     "fail_attempts": 10**6}]}, fp, splittable=True,
+    )
+    np.testing.assert_array_equal(out, data + 1)
+    recs = json.load(open(fp))["records"]
+    assert all(r["resolution"] == "degraded:split" for r in recs)
+
+
+def test_compute_oom_degrades(tmp_path):
+    fp = str(tmp_path / "failures.json")
+    out, data, summary = _run_degrade(
+        {"faults": [{"site": "compute", "kind": "oom", "blocks": [1],
+                     "fail_attempts": 1}]}, fp,
+    )
+    np.testing.assert_array_equal(out, data + 1)
+    rec = [r for r in json.load(open(fp))["records"] if r["block_id"] == 1][0]
+    assert rec["resolution"] == "degraded:backpressure"
+    assert "compute" in rec["sites"]
+
+
+def test_byte_budget_backpressure_still_completes(tmp_path):
+    """A 1-byte in-flight budget forces the admission gate to drain every
+    pending store before the next batch — slower, never wrong."""
+    fp = str(tmp_path / "failures.json")
+    out, data, summary = _run_degrade(None, fp, inflight_byte_budget=1)
+    np.testing.assert_array_equal(out, data + 1)
+    assert summary["n_failed"] == 0
+
+
+# -- preemption-aware draining ------------------------------------------------
+
+
+def test_executor_drain_finishes_inflight_and_resumes(tmp_path):
+    """Flipping the drain latch mid-sweep stops batch claiming, finishes
+    in-flight work, records the preemption, and raises DrainInterrupt; a
+    resumed run (done_block_ids from the markers) completes bit-identically."""
+    fp = str(tmp_path / "failures.json")
+    shape, bshape = (512, 8, 8), (8, 8, 8)
+    data = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    out = np.zeros(shape, np.float32)
+    blocking = Blocking(shape, bshape)
+    blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
+    done_ids = []
+
+    def on_done(b):
+        done_ids.append(int(b.block_id))
+        if len(done_ids) == 1:
+            request_drain("test preemption")
+
+    # io_threads=1 serializes loads/stores on one pool thread: the bounded
+    # store window forces the dispatch loop to wait on the first store (which
+    # flips the latch) before it can claim every batch — deterministic drain
+    ex = BlockwiseExecutor(target="local", backoff_base=1e-4, io_threads=1)
+    with pytest.raises(DrainInterrupt) as exc:
+        ex.map_blocks(
+            lambda x: x + 1, blocks,
+            lambda b: (data[b.bb],),
+            lambda b, raw: out.__setitem__(b.bb, np.asarray(raw)),
+            on_block_done=on_done, failures_path=fp, task_name="unit",
+        )
+    assert exc.value.remaining_ids  # something was left for the resume
+    assert set(exc.value.remaining_ids).isdisjoint(done_ids)
+    rec = [r for r in json.load(open(fp))["records"]
+           if r.get("resolution") == "requeued:preempt"]
+    assert rec and rec[0]["sites"] == {"preempt": 1}
+    # completed blocks are stored and markered; the resume finishes the rest
+    reset_drain()
+    ex.map_blocks(
+        lambda x: x + 1, blocks,
+        lambda b: (data[b.bb],),
+        lambda b, raw: out.__setitem__(b.bb, np.asarray(raw)),
+        done_block_ids=done_ids, task_name="unit",
+    )
+    np.testing.assert_array_equal(out, data + 1)
+
+
+def test_host_block_map_drains(tmp_path):
+    class T(BaseTask):
+        task_name = "drainmap"
+
+        def run_impl(self):
+            def process(block_id):
+                if block_id == 1:
+                    request_drain("eviction notice")
+
+            self.host_block_map(range(6), process)
+
+    t = T(str(tmp_path / "tmp"), "", max_jobs=1)
+    with pytest.raises(DrainInterrupt):
+        t.run()
+    # blocks before the drain kept their markers; the rest are left over
+    done = t.blocks_done()
+    assert 0 in done and len(done) < 6
+
+
+def test_build_stops_at_drain_latch(tmp_path):
+    ran = []
+
+    class A(BaseTask):
+        task_name = "drain_a"
+
+        def run_impl(self):
+            ran.append("a")
+            request_drain("preempted between tasks")
+            return {}
+
+    class B(BaseTask):
+        task_name = "drain_b"
+
+        def run_impl(self):
+            ran.append("b")
+            return {}
+
+    a = A(str(tmp_path / "tmp"), "")
+    b = B(str(tmp_path / "tmp"), "")
+    with pytest.raises(DrainInterrupt):
+        build([a, b])
+    assert ran == ["a"]
+    assert a.output().exists()      # the finished task keeps its manifest
+    assert not b.output().exists()  # the drained one never started
+
+
+# -- supervisor: preemption requeue budget ------------------------------------
+
+
+class _ScriptedSubmitter:
+    flavor = "scripted"
+
+    def __init__(self, behaviors):
+        self.behaviors = list(behaviors)
+        self.submits = 0
+        self.cancelled = []
+        self._running = {}
+
+    def submit(self, script_path, job_name, out_path, cfg):
+        b = self.behaviors[min(self.submits, len(self.behaviors) - 1)]
+        self.submits += 1
+        job_id = f"j{self.submits}"
+        self._running[job_id] = b.get("running", True)
+        if b.get("action"):
+            b["action"]()
+        return job_id
+
+    def is_running(self, job_id):
+        return self._running.get(job_id, False)
+
+    def cancel(self, job_id):
+        self.cancelled.append(job_id)
+
+
+def _write_requeue_marker(tmp_folder, uid, reason="received SIGTERM"):
+    from cluster_tools_tpu.runtime.cluster import requeue_marker_path
+
+    rq = requeue_marker_path(tmp_folder, uid)
+    with open(rq + ".t", "w") as f:
+        json.dump({"preempted": True, "reason": reason,
+                   "remaining_blocks": 3}, f)
+    os.replace(rq + ".t", rq)
+
+
+def test_supervisor_requeues_preempted_job_without_burning_loss_budget(tmp_path):
+    from cluster_tools_tpu.runtime.cluster import supervise_job
+
+    tmp_folder = str(tmp_path / "tmp")
+    os.makedirs(tmp_folder, exist_ok=True)
+    uid = "task.abcd1234"
+    result_path = os.path.join(tmp_folder, "result.json")
+
+    sub = _ScriptedSubmitter([
+        # incarnation 1: drains for preemption (marker + leaves the queue)
+        {"running": False,
+         "action": lambda: _write_requeue_marker(tmp_folder, uid)},
+        # incarnation 2: delivers the result
+        {"running": True,
+         "action": lambda: json.dump(
+             {"ok": True, "result": {}}, open(result_path, "w"))},
+    ])
+    sup = supervise_job(
+        sub, script_path="/dev/null", job_name=uid,
+        out_path=os.path.join(tmp_folder, "j.out"), result_path=result_path,
+        tmp_folder=tmp_folder, uid=uid,
+        cfg={"poll_interval_s": 0.05, "result_grace_s": 0.1,
+             # ZERO loss budget: only the preemption budget may requeue
+             "max_resubmits": 0, "max_preempt_resubmits": 2,
+             "submit_timeout_s": 60},
+        logger=None,
+    )
+    assert sup["preempt_resubmits"] == 1 and sup["resubmits"] == 0
+    doc = json.load(open(os.path.join(tmp_folder, "failures.json")))
+    recs = [r for r in doc["records"]
+            if r.get("resolution") == "requeued:preempt"]
+    assert recs and recs[-1]["resolved"]
+    assert recs[-1]["sites"] == {"preempt": 1}
+    with open(os.path.join(tmp_folder, "cluster", "supervisor.log")) as f:
+        log = f.read()
+    assert "preempted" in log and "requeueing (1/2)" in log
+
+
+def test_supervisor_preempt_budget_exhausted(tmp_path):
+    from cluster_tools_tpu.runtime.cluster import supervise_job
+
+    tmp_folder = str(tmp_path / "tmp")
+    os.makedirs(tmp_folder, exist_ok=True)
+    uid = "task.abcd1234"
+    sub = _ScriptedSubmitter([
+        {"running": False,
+         "action": lambda: _write_requeue_marker(tmp_folder, uid)},
+    ])
+    with pytest.raises(RuntimeError, match="preempted"):
+        supervise_job(
+            sub, script_path="/dev/null", job_name=uid,
+            out_path=os.path.join(tmp_folder, "j.out"),
+            result_path=os.path.join(tmp_folder, "result.json"),
+            tmp_folder=tmp_folder, uid=uid,
+            cfg={"poll_interval_s": 0.05, "result_grace_s": 0.1,
+                 "max_resubmits": 5, "max_preempt_resubmits": 1,
+                 "submit_timeout_s": 60},
+            logger=None,
+        )
+    assert sub.submits == 2  # original + exactly max_preempt_resubmits
+
+
+# -- worker teardown escalation -----------------------------------------------
+
+
+def test_collect_workers_sigterm_grace_lets_workers_flush(tmp_path):
+    """Timed-out workers get SIGTERM + a grace window to flush before the
+    SIGKILL: a trap-handling worker leaves its flush artifact behind."""
+    from cluster_tools_tpu.parallel.multihost import collect_workers
+
+    flush = str(tmp_path / "flushed")
+    procs = [subprocess.Popen(
+        ["bash", "-c",
+         f"trap 'echo clean > {flush}; exit 0' TERM; sleep 60"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )]
+    with pytest.raises(TimeoutError):
+        collect_workers(procs, timeout=0.5, term_grace_s=5.0)
+    assert os.path.exists(flush), "worker was killed before it could flush"
+    assert procs[0].poll() is not None
+
+
+def test_collect_workers_sigkill_after_grace():
+    """A worker that ignores SIGTERM is still killed after the grace."""
+    from cluster_tools_tpu.parallel.multihost import collect_workers
+
+    procs = [subprocess.Popen(
+        ["bash", "-c", "trap '' TERM; sleep 60"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )]
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        collect_workers(procs, timeout=0.5, term_grace_s=0.5)
+    assert time.monotonic() - t0 < 20.0
+    assert procs[0].poll() is not None
+
+
+# -- failures.json v2 schema + report -----------------------------------------
+
+
+def test_record_failures_stamps_schema_host_pid(tmp_path):
+    import socket
+
+    path = str(tmp_path / "failures.json")
+    fu.record_failures(path, "t", [{"block_id": 1, "resolved": False}])
+    doc = json.load(open(path))
+    assert doc["version"] == fu.FAILURES_SCHEMA_VERSION == 2
+    rec = doc["records"][0]
+    assert rec["schema_version"] == 2
+    assert rec["hostname"] == socket.gethostname()
+    assert rec["pid"] == os.getpid()
+    # records from other processes keep their own attribution on merge
+    fu.record_failures(path, "other", [
+        {"block_id": 1, "resolved": True, "hostname": "nodeA", "pid": 42},
+    ])
+    recs = {r["task"]: r for r in json.load(open(path))["records"]}
+    assert recs["other"]["hostname"] == "nodeA" and recs["other"]["pid"] == 42
+    assert recs["t"]["hostname"] == socket.gethostname()
+
+
+def test_failures_report_script(tmp_path, capsys):
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ))
+    try:
+        import failures_report
+    finally:
+        sys.path.pop(0)
+
+    folder = str(tmp_path)
+    path = os.path.join(folder, "failures.json")
+    fu.record_failures(path, "watershed.aa", [
+        {"block_id": 2, "sites": {"store": 5, "enospc": 2}, "quarantined": True,
+         "resolved": True, "resolution": "degraded:split"},
+        {"block_id": 7, "sites": {"load": 3}, "quarantined": True,
+         "resolved": False},
+    ])
+    fu.record_failures(path, "multicut.bb", [
+        {"block_id": None, "sites": {"preempt": 1}, "resolved": True,
+         "resolution": "requeued:preempt"},
+    ])
+    assert failures_report.main(["failures_report.py", folder]) == 0
+    out = capsys.readouterr().out
+    assert "watershed.aa" in out and "multicut.bb" in out
+    assert "degraded:split=1" in out and "requeued:preempt=1" in out
+    assert "UNRESOLVED blocks: [7]" in out
+    assert "enospc=2" in out
+    assert "stayed UNRESOLVED" in out
+
+
+# -- retry backoff bounds (regression guard) ----------------------------------
+
+
+def test_backoff_delay_capped_and_jittered():
+    """The shared policy: delay = min(cap, base*2^k) * U[0.5, 1.0] — always
+    within [raw/2, raw], never above the cap, and actually jittered."""
+    for base, cap in [(0.05, 5.0), (2.0, 30.0), (1.0, 0.5)]:
+        for attempt in range(24):
+            raw = min(cap, base * (2 ** attempt))
+            for _ in range(20):
+                d = fu.backoff_delay(attempt, base, cap)
+                assert 0.5 * raw <= d <= raw <= cap
+    assert len({fu.backoff_delay(3, 1.0, 60.0) for _ in range(64)}) > 1
+
+
+def test_executor_backoff_respects_cap():
+    ex = BlockwiseExecutor(target="local", backoff_base=0.01,
+                           backoff_max=0.04)
+    for k in range(16):
+        assert 0.005 <= ex._backoff(k) <= 0.04
+
+
+def test_submit_with_retries_delays_within_documented_bounds(monkeypatch):
+    from cluster_tools_tpu.runtime import cluster as cluster_mod
+    from cluster_tools_tpu.runtime.cluster import (
+        ClusterSubmitter,
+        submit_with_retries,
+    )
+
+    delays = []
+    monkeypatch.setattr(cluster_mod.time, "sleep", delays.append)
+
+    class Flaky(ClusterSubmitter):
+        flavor = "test"
+
+        def __init__(self):
+            self.calls = 0
+
+        def submit(self, script_path, job_name, out_path, cfg):
+            self.calls += 1
+            if self.calls <= 6:
+                raise RuntimeError("sbatch: Socket timed out")
+            return "42"
+
+    jid = submit_with_retries(
+        Flaky(), "/x.sh", "j", "/x.out",
+        {"submit_retries": 6, "submit_backoff_s": 0.01,
+         "submit_backoff_max_s": 0.04},
+    )
+    assert jid == "42" and len(delays) == 6
+    for k, d in enumerate(delays):
+        raw = min(0.04, 0.01 * (2 ** k))
+        assert 0.5 * raw <= d <= raw <= 0.04
+    # the cap bites: later delays stop growing
+    assert max(delays) <= 0.04
