@@ -13,7 +13,12 @@
 #                     "Graceful degradation"); tier-1 stays fast because the
 #                     chaos+slow markers keep it out of `tier1`
 #   failures-report = one-screen post-mortem of a run's failures.json
-#                     (pass TMP=/path/to/tmp_folder or .../failures.json)
+#                     (pass TMP=/path/to/tmp_folder or .../failures.json),
+#                     plus the per-task chunk-IO metrics when recorded
+#   bench-io        = IO-amplification bench (docs/PERFORMANCE.md
+#                     "Chunk-aware I/O"): the halo'd watershed sweep with
+#                     the decompressed-chunk cache off vs on, asserting
+#                     bit-identical outputs; cpu backend, <60 s
 #   supervise-demo  = smoke-check recipe: watershed workflow on the
 #                     stub-slurm cluster target under an injected job loss,
 #                     printing the supervisor's resubmission log
@@ -21,8 +26,8 @@ PY ?= python
 CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
-.PHONY: test tier1 chaos chaos-resource failures-report supervise-demo \
-	native clean
+.PHONY: test tier1 chaos chaos-resource failures-report bench-io \
+	supervise-demo native clean
 
 test: tier1 chaos
 
@@ -41,6 +46,9 @@ chaos-resource:
 
 failures-report:
 	$(PY) scripts/failures_report.py $(TMP)
+
+bench-io:
+	JAX_PLATFORMS=cpu $(PY) bench.py --io
 
 supervise-demo:
 	JAX_PLATFORMS=cpu $(PY) scripts/supervise_demo.py

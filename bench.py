@@ -230,6 +230,117 @@ def _solver_scale_bench(g=33, seed=0):
     }
 
 
+def io_bench():
+    """IO-amplification config (docs/PERFORMANCE.md "Chunk-aware I/O").
+
+    Runs the halo'd single-pass watershed sweep twice over the same on-disk
+    zarr volume — decompressed-chunk cache OFF, then ON — and records
+    bytes-read-from-storage, the amplification over the inner volume, the
+    off/on reduction, the cache counters (hit/miss/coalesce), and whether
+    the two label outputs are bit-identical (they must be: the cache is a
+    pure IO optimization).  cpu backend, sized for <60 s: ``make bench-io``.
+    Emits exactly one JSON line on stdout.
+    """
+    from __graft_entry__ import _force_cpu_platform
+
+    _force_cpu_platform(8)
+    import shutil
+    import tempfile
+
+    from scipy import ndimage
+
+    from cluster_tools_tpu.io import chunk_cache
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.watershed import WatershedLocal
+    from cluster_tools_tpu.utils.volume_utils import file_reader
+
+    ext = int(os.environ.get("CT_BENCH_IO_EXTENT", "64"))
+    block = int(os.environ.get("CT_BENCH_IO_BLOCK", "16"))
+    halo = int(os.environ.get("CT_BENCH_IO_HALO", "8"))
+    shape = (ext,) * 3
+    root = tempfile.mkdtemp(prefix="ctt_io_bench_")
+    log(
+        f"io bench: volume {shape}, blocks {block}^3 (= chunks), "
+        f"halo {halo} -> outer {(block + 2 * halo)}^3"
+    )
+    rng = np.random.default_rng(0)
+    vol = ndimage.gaussian_filter(rng.random(shape), 2.0)
+    vol = ((vol - vol.min()) / (vol.max() - vol.min())).astype(np.float32)
+    path = os.path.join(root, "io.zarr")
+    container = file_reader(path)
+    src = container.create_dataset(
+        "boundaries", shape=shape, chunks=(block,) * 3, dtype="float32"
+    )
+    src[...] = vol
+
+    inner_bytes = int(vol.nbytes)
+    env_before = os.environ.get("CTT_CHUNK_CACHE")
+    runs = {}
+    outs = {}
+    try:
+        for mode in ("off", "on"):
+            os.environ["CTT_CHUNK_CACHE"] = "1" if mode == "on" else "0"
+            # fresh cache per run: zeroed counters, nothing resident
+            chunk_cache.configure(max_bytes=64 << 20)
+            snap = chunk_cache.snapshot()
+            t0 = time.perf_counter()
+            task = WatershedLocal(
+                tmp_folder=os.path.join(root, f"tmp_{mode}"),
+                config_dir=os.path.join(root, "config"),
+                max_jobs=4,
+                input_path=path,
+                input_key="boundaries",
+                output_path=path,
+                output_key=f"ws_{mode}",
+                block_shape=[block] * 3,
+                halo=[halo] * 3,
+                threshold=0.5,
+                impl="legacy",
+            )
+            if not build([task]):
+                raise RuntimeError(f"io bench watershed run '{mode}' failed")
+            seconds = time.perf_counter() - t0
+            stats = chunk_cache.delta(snap)
+            runs[mode] = dict(stats, seconds=round(seconds, 3))
+            outs[mode] = np.asarray(file_reader(path)[f"ws_{mode}"][...])
+            log(
+                f"io bench cache={mode}: {seconds:.1f}s, "
+                f"{stats['bytes_from_storage'] / 1e6:.1f}MB from storage "
+                f"for {stats['bytes_served'] / 1e6:.1f}MB served "
+                f"(hits {stats['hits']}, misses {stats['misses']}, "
+                f"coalesced {stats['coalesced']})"
+            )
+    finally:
+        if env_before is None:
+            os.environ.pop("CTT_CHUNK_CACHE", None)
+        else:
+            os.environ["CTT_CHUNK_CACHE"] = env_before
+        chunk_cache.configure()
+        shutil.rmtree(root, ignore_errors=True)
+
+    off = runs["off"]["bytes_from_storage"]
+    on = max(1, runs["on"]["bytes_from_storage"])
+    rec = {
+        "metric": "io_amplification_halo_sweep",
+        "backend": "cpu",
+        "volume": list(shape),
+        "block_shape": [block] * 3,
+        "chunks": [block] * 3,
+        "halo": [halo] * 3,
+        "inner_bytes": inner_bytes,
+        "cache_off": runs["off"],
+        "cache_on": runs["on"],
+        "amplification_off": round(off / inner_bytes, 2),
+        "amplification_on": round(on / inner_bytes, 2),
+        "bytes_read_reduction": round(off / on, 2),
+        "bit_identical": bool(np.array_equal(outs["off"], outs["on"])),
+        "schedule": "morton",
+    }
+    print(json.dumps(rec), flush=True)
+    log("io bench done")
+    return rec
+
+
 def main():
     log(f"start; env JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}")
     probed = os.environ.get("CT_BENCH_ACCEL")
@@ -1184,7 +1295,9 @@ def orchestrate() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("CT_BENCH_IMPL"):
+    if "--io" in sys.argv or os.environ.get("CT_BENCH_IO"):
+        io_bench()
+    elif os.environ.get("CT_BENCH_IMPL"):
         main()
     else:
         orchestrate()

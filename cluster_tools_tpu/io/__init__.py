@@ -1,3 +1,8 @@
+from .chunk_cache import (
+    ChunkCache,
+    cache_enabled as chunk_cache_enabled,
+    get_chunk_cache,
+)
 from .containers import (
     ChunkCorruptionError,
     H5Container,

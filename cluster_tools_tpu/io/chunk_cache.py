@@ -1,0 +1,316 @@
+"""Process-wide decompressed-chunk cache with single-flight loads.
+
+The storage-amplification problem (docs/PERFORMANCE.md "Chunk-aware I/O"):
+halo'd block reads overlap their neighbors' chunks, so every boundary chunk
+of a sweep is read — and *decompressed* — once per neighboring block.  At
+the BASELINE config-2 geometry (64^3 inner blocks, halo=32, chunks =
+block_shape) each outer read covers 3^3 = 27 chunks for 1 chunk of inner
+volume, and interior chunks are decompressed up to 27 times per sweep.
+Bytes-read-from-storage, not compute, then dominates the IO-bound stages.
+
+This module is the fix: a byte-bounded, process-wide LRU of *decompressed*
+chunks keyed by ``(dataset, chunk_index)``.  ``Dataset.__getitem__`` /
+``read_async`` (:mod:`.containers`) assemble halo'd region reads from cached
+chunks and send only miss-chunks to tensorstore.  Two properties matter as
+much as the LRU itself:
+
+- **Single-flight**: concurrent loads of the same chunk (the executor's IO
+  pool reads many overlapping halos at once) share ONE in-flight storage
+  read.  The first caller becomes the *owner* and performs the read; later
+  callers *wait* on the owner's completion instead of racing a duplicate
+  read (counted as ``coalesced``).
+- **Coherence**: writes evict every overlapping chunk (after any injected
+  silent corruption has landed, so the cache never shadows what storage
+  holds), and a read that fails — an injected ``io_read`` fault, a storage
+  error, or a checksum mismatch against the PR-3 digest sidecars — never
+  populates the cache (corrupt assemblies are evicted before the error
+  propagates).  ``verify_region`` / the executor's ``region_verifier``
+  re-read raw storage bytes, bypassing the cache, so post-store integrity
+  checks always see the disk.
+
+Budget: ``CTT_CHUNK_CACHE_BYTES`` sets the byte bound explicitly; the
+default is ``min(1 GiB, MemAvailable/8)`` via the same
+:func:`~cluster_tools_tpu.runtime.supervision.host_mem_available_bytes`
+probe that drives PR-4's admission control — and the executor's automatic
+``inflight_byte_budget`` subtracts this cache budget, so cache + in-flight
+batches together stay inside the headroom envelope.  ``CTT_CHUNK_CACHE=0``
+is the kill switch: reads bypass the cache entirely (counted as
+``direct_reads``) and behave exactly as before this layer existed.
+
+Counters (``hits`` / ``misses`` / ``coalesced`` / ``evictions`` /
+``invalidations`` / ``bytes_from_storage`` / ``bytes_served`` /
+``direct_reads``) are process-wide; the task runtime snapshots them around
+each task and writes the per-task delta to ``io_metrics.json`` next to
+``failures.json`` (rendered by ``scripts/failures_report.py``), and
+``bench.py --io`` records the cache-off vs cache-on amplification.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+#: counter names, fixed so snapshots/deltas stay schema-stable
+STAT_KEYS = (
+    "hits",
+    "misses",
+    "coalesced",
+    "evictions",
+    "invalidations",
+    "bytes_from_storage",
+    "bytes_served",
+    "direct_reads",
+    "stall_fallbacks",
+)
+
+
+class ChunkWaitTimeout(Exception):
+    """A coalesced waiter outlived its patience for a shared in-flight
+    load (:func:`stall_wait_s`): the underlying storage read is stalled.
+    Callers fall back to an independent direct read so hung storage cannot
+    serialize every consumer of one chunk behind it — in particular the
+    hang defense's speculative re-execution must make progress that is
+    independent of the read it is routing around."""
+
+
+def cache_enabled() -> bool:
+    """Chunk caching on stored-region reads (default on);
+    ``CTT_CHUNK_CACHE=0`` is the kill switch — every read goes straight to
+    storage, exactly the pre-cache behavior."""
+    return os.environ.get("CTT_CHUNK_CACHE", "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def stall_wait_s() -> float:
+    """Patience for a coalesced wait on a shared in-flight chunk load
+    before falling back to an independent read (``CTT_CHUNK_CACHE_WAIT_S``,
+    default 30 s — generous for healthy storage, finite for a wedged
+    filesystem call)."""
+    try:
+        return float(os.environ.get("CTT_CHUNK_CACHE_WAIT_S", "30"))
+    except ValueError:
+        return 30.0
+
+
+def _default_budget() -> int:
+    env = os.environ.get("CTT_CHUNK_CACHE_BYTES")
+    if env:
+        return max(0, int(env))
+    avail = None
+    try:
+        from ..runtime.supervision import host_mem_available_bytes
+
+        avail = host_mem_available_bytes()
+    except Exception:  # pragma: no cover - probe is /proc-based
+        avail = None
+    if avail:
+        return int(min(1 << 30, avail // 8))
+    return 256 << 20
+
+
+class _InFlight:
+    """One in-flight chunk load shared by its owner and any waiters."""
+
+    __slots__ = ("event", "value", "exc", "doomed")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.exc: Optional[BaseException] = None
+        # a write raced this load: serve the value to waiters but do NOT
+        # cache it (the bytes read may predate the write)
+        self.doomed = False
+
+
+class ChunkCache:
+    """Byte-bounded LRU of decompressed chunk arrays + single-flight loads.
+
+    The protocol is a three-way ``get_or_begin``: ``HIT`` returns the cached
+    array, ``OWNER`` hands the caller a token — it must perform the storage
+    read and settle the token with :meth:`complete` or :meth:`fail` (waiters
+    block on it) — and ``WAIT`` hands back another owner's token to
+    :meth:`wait` on.  Cached arrays are shared read-only; callers must copy
+    out of them, never mutate them.
+    """
+
+    HIT, OWNER, WAIT = "hit", "owner", "wait"
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.max_bytes = int(
+            _default_budget() if max_bytes is None else max_bytes
+        )
+        self._lock = threading.Lock()
+        self._data: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self._bytes = 0
+        self._inflight: Dict[tuple, _InFlight] = {}
+        self.stats: Dict[str, int] = {k: 0 for k in STAT_KEYS}
+
+    # -- single-flight protocol -------------------------------------------
+    def get_or_begin(self, key: tuple):
+        """(HIT, array) | (OWNER, token) | (WAIT, token) for ``key``."""
+        with self._lock:
+            arr = self._data.get(key)
+            if arr is not None:
+                self._data.move_to_end(key)
+                self.stats["hits"] += 1
+                return self.HIT, arr
+            inf = self._inflight.get(key)
+            if inf is not None:
+                self.stats["coalesced"] += 1
+                return self.WAIT, inf
+            inf = _InFlight()
+            self._inflight[key] = inf
+            self.stats["misses"] += 1
+            return self.OWNER, inf
+
+    def complete(self, key: tuple, token: _InFlight, value: np.ndarray):
+        """Owner's storage read landed: publish to waiters and cache it
+        (unless a concurrent write doomed the load or it exceeds the
+        budget)."""
+        value = np.asarray(value)
+        with self._lock:
+            self.stats["bytes_from_storage"] += int(value.nbytes)
+            if (
+                not token.doomed
+                and 0 < value.nbytes <= self.max_bytes
+            ):
+                old = self._data.pop(key, None)
+                if old is not None:
+                    self._bytes -= int(old.nbytes)
+                self._data[key] = value
+                self._bytes += int(value.nbytes)
+                while self._bytes > self.max_bytes and self._data:
+                    _, evicted = self._data.popitem(last=False)
+                    self._bytes -= int(evicted.nbytes)
+                    self.stats["evictions"] += 1
+            self._inflight.pop(key, None)
+            token.value = value
+        token.event.set()
+
+    def fail(self, key: tuple, token: _InFlight, exc: BaseException):
+        """Owner's storage read failed: propagate to waiters, cache nothing."""
+        with self._lock:
+            self._inflight.pop(key, None)
+            token.exc = exc
+        token.event.set()
+
+    @staticmethod
+    def wait(
+        token: _InFlight, timeout: Optional[float] = None
+    ) -> np.ndarray:
+        """Block until the shared load settles; raises the owner's storage
+        error, or :class:`ChunkWaitTimeout` after ``timeout`` seconds (the
+        caller then reads independently)."""
+        if not token.event.wait(timeout):
+            raise ChunkWaitTimeout()
+        if token.exc is not None:
+            raise token.exc
+        return token.value
+
+    # -- coherence ---------------------------------------------------------
+    def invalidate(self, keys: Iterable[tuple]) -> None:
+        """Evict ``keys``; in-flight loads of them are doomed (served to
+        their waiters but not cached) — a racing read must not publish
+        pre-write bytes."""
+        with self._lock:
+            for key in keys:
+                arr = self._data.pop(key, None)
+                if arr is not None:
+                    self._bytes -= int(arr.nbytes)
+                    self.stats["invalidations"] += 1
+                inf = self._inflight.get(key)
+                if inf is not None:
+                    inf.doomed = True
+
+    def invalidate_dataset(self, dataset_id) -> None:
+        """Evict every chunk of one dataset (un-regionable writes)."""
+        with self._lock:
+            hits = [k for k in self._data if k[0] == dataset_id]
+            for key in hits:
+                self._bytes -= int(self._data.pop(key).nbytes)
+                self.stats["invalidations"] += 1
+            for key, inf in self._inflight.items():
+                if key[0] == dataset_id:
+                    inf.doomed = True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._bytes = 0
+
+    # -- accounting --------------------------------------------------------
+    def record_served(self, nbytes: int) -> None:
+        with self._lock:
+            self.stats["bytes_served"] += int(nbytes)
+
+    def record_direct(self, nbytes: int) -> None:
+        """An uncached region read (kill switch, fancy indexing, chunkless
+        dataset): bytes from storage == bytes served, by definition."""
+        with self._lock:
+            self.stats["direct_reads"] += 1
+            self.stats["bytes_from_storage"] += int(nbytes)
+            self.stats["bytes_served"] += int(nbytes)
+
+    def record_stall_fallback(self, nbytes: int) -> None:
+        """A waiter timed out on a stalled shared load and read the chunk
+        independently (:class:`ChunkWaitTimeout`)."""
+        with self._lock:
+            self.stats["stall_fallbacks"] += 1
+            self.stats["bytes_from_storage"] += int(nbytes)
+
+    @property
+    def cached_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+
+# -- module-level singleton ---------------------------------------------------
+
+_cache: Optional[ChunkCache] = None
+_singleton_lock = threading.Lock()
+
+
+def get_chunk_cache() -> ChunkCache:
+    """The process-wide cache (budget from ``CTT_CHUNK_CACHE_BYTES`` /
+    MemAvailable at first use)."""
+    global _cache
+    if _cache is None:
+        with _singleton_lock:
+            if _cache is None:
+                _cache = ChunkCache()
+    return _cache
+
+
+def configure(max_bytes: Optional[int] = None) -> ChunkCache:
+    """Install a fresh cache (tests / bench A-B runs): empties the cache
+    and zeroes the counters."""
+    global _cache
+    with _singleton_lock:
+        _cache = ChunkCache(max_bytes)
+    return _cache
+
+
+def snapshot() -> Dict[str, int]:
+    """Copy of the process-wide counters — pair with :func:`delta` to
+    attribute IO to one task/run."""
+    cache = get_chunk_cache()
+    with cache._lock:
+        return dict(cache.stats)
+
+
+def delta(snap: Dict[str, int]) -> Dict[str, int]:
+    """Counter movement since ``snap`` (non-negative; a ``configure``
+    between snapshots clamps to the new totals)."""
+    cache = get_chunk_cache()
+    with cache._lock:
+        cur = dict(cache.stats)
+    return {k: max(0, cur.get(k, 0) - snap.get(k, 0)) for k in STAT_KEYS}

@@ -29,10 +29,20 @@ same sites and accounting as the synchronous paths, so prefetched IO is not
 a hole in the fault model.  ``CTT_CHECKSUMS=0`` disables the whole layer
 (HDF5 never has it: a single shared file has no place for per-region
 sidecars).
+
+Chunk-aware reads (docs/PERFORMANCE.md "Chunk-aware I/O"): tensorstore
+``Dataset`` region reads are assembled from the process-wide decompressed-
+chunk cache (:mod:`.chunk_cache`) — only miss-chunks hit storage, with
+single-flight deduplication across concurrent halo reads.  Writes evict
+every overlapping chunk; faulted or corruption-failing reads never leave
+chunks resident; ``verify_region`` and the raw ``_read_back`` path bypass
+the cache so integrity checks always see storage bytes.  ``CTT_CHUNK_CACHE=0``
+restores the direct-read behavior exactly.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -40,6 +50,8 @@ import zlib
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
+
+from . import chunk_cache as _chunk_cache
 
 try:
     import tensorstore as ts
@@ -335,6 +347,18 @@ def _n5_dtype(dtype) -> str:
     return np.dtype(dtype).name
 
 
+class _CachedReadPlan:
+    """Phase-1 state of a chunk-assembled region read: the resolved region
+    plus one (key, chunk_box, kind, handle) step per covering chunk, where
+    owned miss-chunks carry their already-issued tensorstore futures."""
+
+    __slots__ = ("region", "steps")
+
+    def __init__(self, region, steps):
+        self.region = region
+        self.steps = steps
+
+
 class Dataset(_ChecksumOps):
     """A chunked dataset backed by tensorstore."""
 
@@ -349,6 +373,13 @@ class Dataset(_ChecksumOps):
                   if attrs_path else None)
         )
         self._label = label or (attrs_path or "<dataset>")
+        # chunk-cache identity: the container path + key, stable across
+        # handle instances in this process (two open_container calls on the
+        # same store must share — and mutually invalidate — cache entries);
+        # anonymous store-only datasets fall back to per-instance identity
+        self._cache_id = (
+            self._label if (label or attrs_path) else f"ts-anon-{id(self)}"
+        )
 
     @property
     def shape(self) -> Tuple[int, ...]:
@@ -367,51 +398,248 @@ class Dataset(_ChecksumOps):
         return len(self.shape)
 
     def _read_back(self, bb) -> np.ndarray:
+        # raw storage read, no cache: verify_region / region_verifier must
+        # check the bytes on DISK, not a resident copy
         return np.asarray(self._store[bb].read().result())
 
     def _write_raw(self, bb, value) -> None:
         self._store[bb].write(value).result()
 
+    # -- chunk-assembled reads (docs/PERFORMANCE.md "Chunk-aware I/O") ------
+    def _chunk_cover(self, region):
+        """[(cache_key, chunk_box), ...] covering ``region``, or None when
+        the dataset has no usable chunk grid."""
+        chunks = self.chunks
+        shape = self.shape
+        if (
+            not chunks
+            or len(chunks) != len(shape)
+            or any(int(c) <= 0 for c in chunks)
+        ):
+            return None
+        ranges = [
+            range(a // c, (b + c - 1) // c) if b > a else range(0)
+            for (a, b), c in zip(region, chunks)
+        ]
+        cover = []
+        for idx in itertools.product(*ranges):
+            box = tuple(
+                (i * c, min((i + 1) * c, s))
+                for i, c, s in zip(idx, chunks, shape)
+            )
+            cover.append(((self._cache_id, idx), box))
+        return cover
+
+    def _begin_cached_read(self, bb):
+        """Phase 1 (issue) of a cache-assembled read: take a HIT/OWNER/WAIT
+        ticket per covering chunk and issue one tensorstore read per owned
+        miss-chunk — every miss of the region is in flight together.
+        Returns None when the read cannot go through the cache (kill
+        switch, zero budget, fancy indexing, chunkless store).
+
+        Owner tokens are settled by a done-callback on the storage future —
+        when the READ lands, not when (or whether) anyone resolves the
+        plan.  A ``read_async`` future dropped without ``.result()`` (an
+        abandoned retry attempt, an early-exiting prefetch consumer) must
+        not strand later readers of the same chunks on an unsettled
+        in-flight token."""
+        if not _chunk_cache.cache_enabled():
+            return None
+        cache = _chunk_cache.get_chunk_cache()
+        if cache.max_bytes <= 0:
+            return None
+        region = _norm_region(bb, self.shape)
+        if region is None:
+            return None
+        # bulk-read bypass: a region that would consume over half the
+        # budget cannot be cached without flushing the resident halo
+        # working set the cache exists to keep (and gains nothing from
+        # per-chunk assembly) — serve it as one direct storage read
+        region_bytes = int(
+            np.prod([b - a for a, b in region], dtype=np.int64)
+        ) * self.dtype.itemsize
+        if region_bytes > cache.max_bytes // 2:
+            return None
+        cover = self._chunk_cover(region)
+        if cover is None:
+            return None
+        steps = []
+        for key, box in cover:
+            kind, handle = cache.get_or_begin(key)
+            if kind == cache.OWNER:
+                cbb = tuple(slice(a, b) for a, b in box)
+                try:
+                    fut = self._store[cbb].read()
+                except Exception as e:
+                    cache.fail(key, handle, e)
+                    raise
+
+                def _settle(f, key=key, token=handle):
+                    try:
+                        cache.complete(key, token, np.asarray(f.result()))
+                    except Exception as e:
+                        cache.fail(key, token, e)
+
+                fut.add_done_callback(_settle)
+            steps.append((key, box, kind, handle))
+        # an exception mid-loop leaves already-issued owners to their
+        # callbacks: every begun token settles itself, no waiter can hang
+        return _CachedReadPlan(region, steps)
+
+    def _finish_cached_read(self, plan: _CachedReadPlan) -> np.ndarray:
+        """Phase 2 (resolve): wait for the in-flight chunk loads (owned
+        ones settle via their storage-future callbacks) and assemble the
+        region from chunk slices.  A waiter stalled past the patience
+        window (:func:`~cluster_tools_tpu.io.chunk_cache.stall_wait_s`)
+        falls back to an independent direct read, so one wedged storage
+        call cannot serialize every consumer of a chunk behind it — the
+        hang defense's speculative re-execution stays independent of the
+        read it is routing around.  The first chunk failure is raised
+        after the loop, keeping shared tokens consistent."""
+        cache = _chunk_cache.get_chunk_cache()
+        region = plan.region
+        patience = _chunk_cache.stall_wait_s()
+        out = np.empty(_region_shape(region), self.dtype)
+        first_exc: Optional[BaseException] = None
+        for key, box, kind, handle in plan.steps:
+            if first_exc is not None:
+                # fail fast: owner tokens settle via their storage-future
+                # callbacks regardless, so there is nothing to wait out —
+                # waiting (or stall-fallback-reading) chunks whose bytes
+                # will be discarded only delays the error
+                continue
+            try:
+                if kind == cache.HIT:
+                    chunk = handle
+                else:
+                    try:
+                        chunk = cache.wait(handle, timeout=patience)
+                    except _chunk_cache.ChunkWaitTimeout:
+                        cbb = tuple(slice(a, b) for a, b in box)
+                        chunk = np.asarray(self._store[cbb].read().result())
+                        cache.record_stall_fallback(chunk.nbytes)
+            except Exception as e:
+                first_exc = e
+                continue
+            src, dst = [], []
+            for (ra, rb), (ca, cb) in zip(region, box):
+                lo, hi = max(ra, ca), min(rb, cb)
+                src.append(slice(lo - ca, hi - ca))
+                dst.append(slice(lo - ra, hi - ra))
+            out[tuple(dst)] = chunk[tuple(src)]
+        if first_exc is not None:
+            raise first_exc
+        cache.record_served(out.nbytes)
+        return out
+
+    def _evict_plan(self, plan: _CachedReadPlan) -> None:
+        _chunk_cache.get_chunk_cache().invalidate(
+            [key for key, _b, _k, _h in plan.steps]
+        )
+
+    def _invalidate_cached_region(self, bb) -> None:
+        """Write coherence: drop every cached chunk the write overlaps —
+        AFTER the write (and any injected silent corruption) landed, so the
+        cache never shadows what storage holds.  Runs even with the kill
+        switch flipped: entries cached while it was on must not survive a
+        write."""
+        cache = _chunk_cache.get_chunk_cache()
+        region = _norm_region(bb, self.shape)
+        cover = None if region is None else self._chunk_cover(region)
+        if cover is None:
+            cache.invalidate_dataset(self._cache_id)
+            return
+        cache.invalidate([key for key, _box in cover])
+
     def __getitem__(self, bb) -> np.ndarray:
         bid = _inject("io_read")
         _hang("io_read", bid)
-        arr = np.asarray(self._store[bb].read().result())
-        self._verify_read(bb, arr)
+        plan = self._begin_cached_read(bb)
+        if plan is None:
+            arr = np.asarray(self._store[bb].read().result())
+            _chunk_cache.get_chunk_cache().record_direct(arr.nbytes)
+            self._verify_read(bb, arr)
+            return arr
+        arr = self._finish_cached_read(plan)
+        try:
+            self._verify_read(bb, arr)
+        except ChunkCorruptionError:
+            # a failed digest verify must not leave the bad chunks resident
+            self._evict_plan(plan)
+            raise
         return arr
 
     def __setitem__(self, bb, value) -> None:
         bid = _inject("io_write", voxels=getattr(value, "size", None))
         _hang("io_write", bid)
         value = np.asarray(value, dtype=self.dtype)
-        self._store[bb].write(value).result()
-        self._after_write(bb, value, bid)
+        try:
+            self._store[bb].write(value).result()
+            self._after_write(bb, value, bid)
+        finally:
+            # in a finally: a write that RAISES may still have landed some
+            # chunks (partial multi-chunk store, ENOSPC mid-region, sidecar
+            # failure after the data landed) — stale pre-write entries must
+            # not outlive any of those either
+            self._invalidate_cached_region(bb)
 
     def read_async(self, bb):
         """Start an async read; returns a future with ``.result()`` -> numpy.
         Injection fires at issue (same accounting as ``__getitem__``);
-        digest verification runs on ``.result()``, where the data lands."""
+        digest verification runs on ``.result()``, where the data lands.
+        Cache-assembled reads issue their miss-chunk storage reads at call
+        time (so a batch's chunk IO is in flight together) and assemble +
+        verify on ``.result()``."""
         bid = _inject("io_read")
-        fut = self._store[bb].read()
+        plan = self._begin_cached_read(bb)
+        if plan is None:
+            fut = self._store[bb].read()
 
-        def finish(raw):
+            def finish(raw):
+                _hang("io_read", bid)
+                arr = np.asarray(raw)
+                _chunk_cache.get_chunk_cache().record_direct(arr.nbytes)
+                self._verify_read(bb, arr)
+                return arr
+
+            return _WrappedFuture(fut, finish)
+
+        def finish_cached(_):
             _hang("io_read", bid)
-            arr = np.asarray(raw)
-            self._verify_read(bb, arr)
+            arr = self._finish_cached_read(plan)
+            try:
+                self._verify_read(bb, arr)
+            except ChunkCorruptionError:
+                self._evict_plan(plan)
+                raise
             return arr
 
-        return _WrappedFuture(fut, finish)
+        return _WrappedFuture(_ImmediateFuture(None), finish_cached)
 
     def write_async(self, bb, value):
         bid = _inject("io_write", voxels=getattr(value, "size", None))
         value = np.asarray(value, dtype=self.dtype)
         fut = self._store[bb].write(value)
+        # evict when the STORAGE write lands, not when (or whether) the
+        # caller resolves the future — an abandoned write_async must not
+        # leave stale pre-write chunks resident (the write-side twin of
+        # the read path's owner-token callbacks)
+        fut.add_done_callback(lambda _f: self._invalidate_cached_region(bb))
 
         def finish(_):
             _hang("io_write", bid)
-            self._after_write(bb, value, bid)
+            try:
+                # resolve the storage write INSIDE the guarded region: a
+                # failed multi-chunk write may still have landed some
+                # chunks, and the sidecar/corruption hook can raise after
+                # the data landed — stale entries must survive neither
+                fut.result()
+                self._after_write(bb, value, bid)
+            finally:
+                self._invalidate_cached_region(bb)
             return None
 
-        return _WrappedFuture(fut, finish)
+        return _WrappedFuture(_ImmediateFuture(None), finish)
 
     # -- attributes (json sidecar, mirroring z5py/zarr .zattrs) -------------
     @property
@@ -574,6 +802,13 @@ class ZarrContainer:
             }
         try:
             store = self._open_store(key, metadata, create=True)
+            # a FRESH dataset now lives at this identity: chunks cached
+            # under it belong to a deleted/recreated predecessor (e.g. an
+            # output store torn down and rebuilt between in-process runs)
+            # and must not be served against the new data
+            _chunk_cache.get_chunk_cache().invalidate_dataset(
+                f"{self.path}:{key}"
+            )
         except ValueError:
             if not exist_ok:
                 raise

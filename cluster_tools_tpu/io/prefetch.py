@@ -36,6 +36,18 @@ def as_future(value):
     return value if hasattr(value, "result") else _Resolved(value)
 
 
+class _FailedFuture:
+    """Future-shim for a read whose *submission* already raised: the error
+    surfaces at that item's turn, not at submission time — so one bad item
+    cannot take down the reads already in flight behind it."""
+
+    def __init__(self, exc: BaseException):
+        self._exc = exc
+
+    def result(self):
+        raise self._exc
+
+
 class BlockPrefetcher:
     """Iterate ``(item, array)`` with a bounded window of in-flight reads.
 
@@ -43,6 +55,13 @@ class BlockPrefetcher:
     object with ``.result()`` (e.g. a tensorstore read future from
     ``Dataset.read_async``).  At any moment at most ``depth`` reads are in
     flight; results are yielded in submission order.
+
+    Failure isolation: a read that raises (at submission or at resolution)
+    raises from ``__next__`` for ITS item only.  The iterator is a
+    hand-written object, not a generator — a generator would be closed by
+    the raise and abandon every in-flight future behind it; here the window
+    survives, so a consumer that catches the error keeps receiving the
+    remaining items (and nothing past ``depth`` is ever in flight).
     """
 
     def __init__(
@@ -61,23 +80,52 @@ class BlockPrefetcher:
         return len(self._items)
 
     def __iter__(self) -> Iterator[Tuple[object, np.ndarray]]:
-        end = object()  # private sentinel: items may legitimately be None
-        window: deque = deque()
-        it = iter(self._items)
-        for item in it:
-            window.append((item, as_future(self._read_fn(item))))
-            if len(window) >= self._depth:
-                break
-        while window:
-            item, fut = window[0]
+        return _PrefetchIterator(self._read_fn, self._items, self._depth)
+
+
+class _PrefetchIterator:
+    """Iterator state of one :class:`BlockPrefetcher` pass (see its
+    docstring for the failure-isolation contract)."""
+
+    def __init__(self, read_fn, items, depth):
+        self._read_fn = read_fn
+        self._it = iter(items)
+        self._depth = depth
+        self._window: deque = deque()
+        self._fill()
+
+    def _submit_one(self) -> bool:
+        try:
+            item = next(self._it)
+        except StopIteration:
+            return False
+        try:
+            fut = as_future(self._read_fn(item))
+        except Exception as e:
+            fut = _FailedFuture(e)
+        self._window.append((item, fut))
+        return True
+
+    def _fill(self) -> None:
+        while len(self._window) < self._depth and self._submit_one():
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Tuple[object, np.ndarray]:
+        if not self._window:
+            raise StopIteration
+        item, fut = self._window.popleft()
+        try:
             arr = np.asarray(fut.result())
-            window.popleft()
-            # refill after the head resolves: exactly ``depth`` reads are in
-            # flight while waiting, and again while the consumer works
-            nxt = next(it, end)
-            if nxt is not end:
-                window.append((nxt, as_future(self._read_fn(nxt))))
-            yield item, arr
+        finally:
+            # refill after the head resolves: exactly ``depth`` reads are
+            # in flight while waiting, and again while the consumer works —
+            # including when the head FAILED (its slot refills, the window
+            # bound holds, and iteration can continue past the error)
+            self._fill()
+        return item, arr
 
 
 class _MappedFuture:

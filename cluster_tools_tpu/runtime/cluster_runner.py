@@ -92,7 +92,30 @@ def main(spec_path: str) -> int:
             max_jobs=int(spec["max_jobs"]),
             **spec["params"],
         )
-        result = task.run_impl()
+        # the chunk IO happens HERE, in the cluster worker process — the
+        # submitter only polls — so this process must record its own
+        # io_metrics delta into the shared manifest (additive merge, same
+        # discipline as BaseTask.run on the local target)
+        from ..io import chunk_cache
+        from ..utils import function_utils as fu
+
+        io_snap = chunk_cache.snapshot()
+        try:
+            result = task.run_impl()
+        finally:
+            io_metrics = chunk_cache.delta(io_snap)
+            if any(io_metrics.values()):
+                try:
+                    fu.record_io_metrics(
+                        fu.io_metrics_path(spec["tmp_folder"]),
+                        # the submitter-side uid (heartbeats, failure
+                        # records, scheduler artifacts all key on it) —
+                        # not the worker's re-derived local identity
+                        spec.get("uid") or task.uid,
+                        io_metrics,
+                    )
+                except OSError:
+                    pass
         emit({"ok": True, "result": result})
         return 0
     except DrainInterrupt as e:

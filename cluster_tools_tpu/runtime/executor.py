@@ -76,6 +76,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..io import chunk_cache as chunk_cache_mod
 from ..io.containers import ChunkCorruptionError
 from ..utils import function_utils as fu
 from ..utils.volume_utils import Block, Blocking
@@ -211,6 +212,47 @@ def split_block(
         )
         subs.append(SubBlock(block.block_id, begin, end, outer_begin, outer_end))
     return subs
+
+
+def morton_order(blocks: Sequence[Block]) -> List[Block]:
+    """Reorder ``blocks`` along a Morton/Z-order curve of the block grid.
+
+    Locality-aware sweep scheduling (docs/PERFORMANCE.md "Chunk-aware
+    I/O"): raster order walks a whole grid row before returning to a
+    neighborhood, so by the time the next row reads the shared boundary
+    chunks they have been evicted from the decompressed-chunk cache.
+    Z-order keeps consecutive blocks (and therefore consecutive executor
+    batches) spatially adjacent — every aligned 2x2x2 octant of the grid is
+    visited contiguously — so halo reads land while their neighbors'
+    chunks are still resident.
+
+    Grid positions are recovered from the blocks' own ``begin`` coordinates
+    (per-axis rank over the distinct values), so ROI-restricted and
+    parity-filtered block lists order correctly without a Blocking handle.
+    Deterministic: a pure permutation keyed on grid position.
+    """
+    blocks = list(blocks)
+    if len(blocks) < 3:
+        return blocks
+    nd = len(blocks[0].begin)
+    rank = []
+    for ax in range(nd):
+        values = sorted({int(b.begin[ax]) for b in blocks})
+        rank.append({v: i for i, v in enumerate(values)})
+    nbits = max(
+        1, max(len(r) - 1 for r in rank).bit_length()
+    )
+
+    def code(b: Block) -> int:
+        c = 0
+        for bit in range(nbits):
+            for ax in range(nd):
+                c |= ((rank[ax][int(b.begin[ax])] >> bit) & 1) << (
+                    bit * nd + ax
+                )
+        return c
+
+    return sorted(blocks, key=code)
 
 
 def check_finite_outputs(block: Block, out) -> Optional[str]:
@@ -353,6 +395,7 @@ class BlockwiseExecutor:
         inflight_byte_budget: Optional[int] = None,
         mem_headroom_fraction: float = 0.05,
         disk_headroom_fraction: float = 0.02,
+        schedule: str = "morton",
     ) -> Dict[str, int]:
         """Execute ``kernel`` over ``blocks``; see class docstring.
 
@@ -392,6 +435,13 @@ class BlockwiseExecutor:
         backpressure the store drain when host memory / the manifest
         filesystem run low.
 
+        ``schedule`` — sweep order: ``"morton"`` (default) reorders blocks
+        (and therefore the batches) along a Z-order curve of the block grid
+        so consecutive batches share boundary chunks while they are still
+        resident in the decompressed-chunk cache (:func:`morton_order`);
+        ``"given"`` keeps the caller's order.  Per-block outputs are
+        independent, so the order never changes results — only IO locality.
+
         Raises RuntimeError naming every block that stays failed after the
         end-of-run quarantine pass, and
         :class:`~cluster_tools_tpu.runtime.supervision.DrainInterrupt`
@@ -402,6 +452,12 @@ class BlockwiseExecutor:
         if done_block_ids:
             done = {int(b) for b in done_block_ids}
             blocks = [b for b in blocks if int(b.block_id) not in done]
+        if schedule == "morton":
+            blocks = morton_order(blocks)
+        elif schedule not in ("given", None):
+            raise ValueError(
+                f"unknown schedule {schedule!r} (expected 'morton' or 'given')"
+            )
         if not blocks:
             return {"n_blocks": 0, "n_quarantined": 0, "n_failed": 0}
         # preemption-aware draining: SIGTERM/SIGUSR1 flip a latch instead
@@ -804,6 +860,16 @@ class BlockwiseExecutor:
         if inflight_byte_budget is None:
             avail = host_mem_available_bytes()
             budget = int(avail * 0.25) if avail else 0
+            if budget and chunk_cache_mod.cache_enabled():
+                # the decompressed-chunk cache is co-resident host memory:
+                # subtract its byte budget from the same headroom probe so
+                # cache + in-flight batches together stay inside the
+                # 25%-of-MemAvailable envelope (floored at a quarter of the
+                # probe so tiny hosts keep making progress)
+                budget = max(
+                    budget - chunk_cache_mod.get_chunk_cache().max_bytes,
+                    budget // 4,
+                )
         else:
             budget = int(inflight_byte_budget)
         inflight = {"bytes": 0}

@@ -120,6 +120,10 @@ class BaseTask:
         ``retry_backoff_s`` base of the capped exponential task backoff,
         ``io_retries`` / ``io_backoff_s`` per-block load/store retries inside
         :class:`~cluster_tools_tpu.runtime.executor.BlockwiseExecutor`,
+        ``io_threads`` the executor's host IO pool width (None = derive
+        from ``max_jobs``, the historical default), ``block_schedule`` the
+        sweep order (``"morton"`` Z-order locality scheduling for the
+        decompressed-chunk cache, ``"given"`` to keep grid order),
         ``block_deadline_s`` / ``watchdog_period_s`` the hung-block deadline
         + speculative re-execution (None disables), the cluster-target
         supervision knobs ``heartbeat_interval_s`` / ``heartbeat_timeout_s``
@@ -136,6 +140,8 @@ class BaseTask:
             "retry_backoff_s": 1.0,
             "io_retries": 2,
             "io_backoff_s": 0.05,
+            "io_threads": None,
+            "block_schedule": "morton",
             "block_deadline_s": None,
             "watchdog_period_s": None,
             "heartbeat_interval_s": 5.0,
@@ -177,17 +183,34 @@ class BaseTask:
 
     def run(self):
         from . import faults as faults_mod
+        from ..io import chunk_cache
 
         t0 = time.time()
         self.logger.info(f"start {self.task_name} (target={self.target})")
         # fault specs with a "tasks" filter target the running task's uid
         faults_mod.set_current_task(self.uid)
+        io_snap = chunk_cache.snapshot()
         try:
             result = self.run_impl() or {}
         finally:
             faults_mod.set_current_task(None)
         result["runtime_s"] = time.time() - t0
         result["target"] = self.target
+        # chunk-IO attribution: the cache counters' movement during this
+        # task, surfaced in the success manifest AND merged (additively,
+        # across resumed runs and cluster job processes) into the run-wide
+        # io_metrics.json next to failures.json
+        io_metrics = chunk_cache.delta(io_snap)
+        if any(io_metrics.values()):
+            result["io_metrics"] = io_metrics
+            try:
+                fu.record_io_metrics(
+                    fu.io_metrics_path(self.tmp_folder), self.uid, io_metrics
+                )
+            except Exception:
+                self.logger.warning(
+                    f"io_metrics recording failed:\n{traceback.format_exc()}"
+                )
         self.output().write(result)
         self.logger.info(
             f"done {self.task_name} in {result['runtime_s']:.2f}s"

@@ -158,7 +158,7 @@ class BlockComponentsBase(BaseTask):
         executor = BlockwiseExecutor(
             target=self.target,
             device_batch=int(cfg.get("device_batch", 1)),
-            io_threads=max(1, self.max_jobs),
+            io_threads=int(cfg.get("io_threads") or max(1, self.max_jobs)),
             max_retries=int(cfg.get("io_retries", 2)),
             backoff_base=float(cfg.get("io_backoff_s", 0.05)),
         )
@@ -175,6 +175,7 @@ class BlockComponentsBase(BaseTask):
             block_deadline_s=cfg.get("block_deadline_s"),
             watchdog_period_s=cfg.get("watchdog_period_s"),
             store_verify_fn=region_verifier(out),
+            schedule=str(cfg.get("block_schedule") or "morton"),
             # degrade on OOM/ENOSPC; never splittable: the per-block CC
             # decomposition (and the min-voxel label of a component crossing
             # a would-be split plane) changes under sub-block re-execution

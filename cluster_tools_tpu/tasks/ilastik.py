@@ -680,7 +680,7 @@ class IlastikPredictionBase(BaseTask):
         executor = BlockwiseExecutor(
             target=self.target,
             device_batch=int(cfg.get("device_batch", 1)),
-            io_threads=max(1, self.max_jobs),
+            io_threads=int(cfg.get("io_threads") or max(1, self.max_jobs)),
             max_retries=int(cfg.get("io_retries", 2)),
             backoff_base=float(cfg.get("io_backoff_s", 0.05)),
         )
@@ -700,6 +700,7 @@ class IlastikPredictionBase(BaseTask):
             store_verify_fn=region_verifier(
                 out, bb_of=lambda b: (slice(None),) + b.bb
             ),
+            schedule=str(cfg.get("block_schedule") or "morton"),
             # opt-in OOM split (config allow_block_split): filter-bank +
             # per-voxel classifier is shape-local, so sub-block outputs tile
             # the parent exactly when halo covers the largest filter support

@@ -362,7 +362,7 @@ class WatershedBase(_WsTaskBase):
             executor = BlockwiseExecutor(
                 target=self.target,
                 device_batch=int(cfg.get("device_batch", 1)),
-                io_threads=max(1, self.max_jobs),
+                io_threads=int(cfg.get("io_threads") or max(1, self.max_jobs)),
                 max_retries=int(cfg.get("io_retries", 2)),
                 backoff_base=float(cfg.get("io_backoff_s", 0.05)),
             )
@@ -379,6 +379,7 @@ class WatershedBase(_WsTaskBase):
                 block_deadline_s=cfg.get("block_deadline_s"),
                 watchdog_period_s=cfg.get("watchdog_period_s"),
                 store_verify_fn=region_verifier(out),
+                schedule=str(cfg.get("block_schedule") or "morton"),
                 # degrade policy: OOM/ENOSPC blocks wait for headroom and
                 # re-execute instead of burning same-size retries.  NEVER
                 # splittable: the label encoding (block_id * (n_outer+1) +
@@ -560,7 +561,7 @@ class TwoPassWatershedBase(_WsTaskBase):
         executor = BlockwiseExecutor(
             target=self.target,
             device_batch=int(cfg.get("device_batch", 1)),
-            io_threads=max(1, self.max_jobs),
+            io_threads=int(cfg.get("io_threads") or max(1, self.max_jobs)),
             max_retries=int(cfg.get("io_retries", 2)),
             backoff_base=float(cfg.get("io_backoff_s", 0.05)),
         )
@@ -577,6 +578,7 @@ class TwoPassWatershedBase(_WsTaskBase):
             block_deadline_s=cfg.get("block_deadline_s"),
             watchdog_period_s=cfg.get("watchdog_period_s"),
             store_verify_fn=region_verifier(out),
+            schedule=str(cfg.get("block_schedule") or "morton"),
             # same degrade policy as the single-pass task; never splittable
             # (outer-shape-dependent label encoding, see WatershedBase)
             splittable=False,

@@ -189,6 +189,37 @@ def record_failures(path: str, task_name: str, records) -> None:
         )
 
 
+def io_metrics_path(tmp_folder: str) -> str:
+    """The per-run chunk-IO metrics manifest, next to ``failures.json``."""
+    return os.path.join(tmp_folder, "io_metrics.json")
+
+
+def record_io_metrics(path: str, task_name: str, metrics) -> None:
+    """Merge one task's chunk-IO counter deltas into ``io_metrics.json``.
+
+    Schema: ``{"version": 1, "tasks": {uid: {counter: total, ...}}}``.
+    Counters merge *additively* per task uid — a resumed run's second pass,
+    or concurrent cluster job processes writing over the shared filesystem,
+    accumulate into one total (same file-lock discipline as
+    :func:`record_failures`).  Derived figures (hit rate, bytes saved) are
+    computed at render time by ``scripts/failures_report.py``, never stored.
+    """
+    with file_lock(path):
+        doc = read_json_if_valid(path) or {}
+        doc.setdefault("version", 1)
+        tasks = doc.setdefault("tasks", {})
+        cur = dict(tasks.get(task_name) or {})
+        for k, v in dict(metrics).items():
+            if isinstance(v, (int, float)) and isinstance(
+                cur.get(k), (int, float)
+            ):
+                cur[k] = cur[k] + v
+            else:
+                cur[k] = v
+        tasks[task_name] = cur
+        atomic_write_json(path, doc)
+
+
 def _marker_dir(tmp_folder: str, task_name: str) -> str:
     d = os.path.join(tmp_folder, "markers", task_name)
     os.makedirs(d, exist_ok=True)

@@ -9,6 +9,11 @@ Per task: block counts, per-site failed-attempt totals, resolutions
 (recovered / degraded:split / requeued:preempt / ...), quarantines, and the
 unresolved block ids an operator has to chase — plus host/pid attribution
 when records came from more than one process (schema v2).
+
+When the run recorded chunk-IO metrics (``io_metrics.json``, written next
+to ``failures.json`` by the task runtime — docs/PERFORMANCE.md "Chunk-aware
+I/O"), a second section renders each task's cache hit rate, bytes read from
+storage vs bytes served, and the bytes the cache saved.
 """
 
 from __future__ import annotations
@@ -25,6 +30,54 @@ def load_records(path: str):
     with open(path) as f:
         doc = json.load(f)
     return path, doc.get("version"), doc.get("records", [])
+
+
+def load_io_metrics(failures_json_path: str):
+    """Per-task chunk-IO counters from the sibling ``io_metrics.json``
+    ({} when the run recorded none — the report stays failures-only)."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(failures_json_path)),
+        "io_metrics.json",
+    )
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return doc.get("tasks", {}) or {}
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"  # pragma: no cover - loop always returns
+
+
+def format_io_metrics(tasks) -> list:
+    """Render per-task cache effectiveness lines (hit rate, bytes saved)."""
+    lines = ["chunk-IO metrics (io_metrics.json):"]
+    for task in sorted(tasks):
+        m = tasks[task] or {}
+        hits = int(m.get("hits", 0))
+        misses = int(m.get("misses", 0))
+        looked = hits + misses
+        rate = f"{100.0 * hits / looked:.1f}%" if looked else "n/a"
+        stored = float(m.get("bytes_from_storage", 0))
+        served = float(m.get("bytes_served", 0))
+        saved = max(0.0, served - stored)
+        lines.append(
+            f"[{task}]  hit rate {rate} ({hits}/{looked}), "
+            f"coalesced {int(m.get('coalesced', 0))}, "
+            f"storage {_human_bytes(stored)} -> served "
+            f"{_human_bytes(served)} (saved {_human_bytes(saved)})"
+        )
+        if m.get("direct_reads"):
+            lines.append(
+                f"  uncached direct reads: {int(m['direct_reads'])}"
+            )
+    return lines
 
 
 def summarize(records):
@@ -68,10 +121,12 @@ def summarize(records):
     return out
 
 
-def format_report(path, version, summaries) -> str:
+def format_report(path, version, summaries, io_tasks=None) -> str:
     lines = [f"failures report: {path} (schema v{version})", ""]
     if not summaries:
         lines.append("no failure records — clean run")
+        if io_tasks:
+            lines.extend(["", *format_io_metrics(io_tasks)])
         return "\n".join(lines)
     n_unresolved = sum(len(s["unresolved"]) for s in summaries)
     all_hosts = sorted({h for s in summaries for h in s["hosts"]})
@@ -99,6 +154,8 @@ def format_report(path, version, summaries) -> str:
         else f"{n_unresolved} unit(s) stayed UNRESOLVED — the run raised"
     )
     lines.append(verdict)
+    if io_tasks:
+        lines.extend(["", *format_io_metrics(io_tasks)])
     return "\n".join(lines)
 
 
@@ -106,12 +163,31 @@ def main(argv) -> int:
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2
+    fpath = (
+        os.path.join(argv[1], "failures.json")
+        if os.path.isdir(argv[1])
+        else argv[1]
+    )
     try:
         path, version, records = load_records(argv[1])
     except (OSError, ValueError) as e:
+        # a clean run writes no failures.json but may still have recorded
+        # chunk-IO metrics worth a post-mortem.  Only a MISSING manifest is
+        # clean — a present-but-unparseable (torn) one is exactly the kind
+        # of crash evidence this report exists to surface, and must keep
+        # its error + nonzero exit
+        io_tasks = load_io_metrics(fpath)
+        if io_tasks and not os.path.exists(fpath):
+            print("no failures manifest — clean run")
+            print("\n".join(format_io_metrics(io_tasks)))
+            return 0
         print(f"cannot read failures manifest: {e}", file=sys.stderr)
         return 1
-    print(format_report(path, version, summarize(records)))
+    print(
+        format_report(
+            path, version, summarize(records), load_io_metrics(path)
+        )
+    )
     return 0
 
 

@@ -1,0 +1,501 @@
+"""Chunk-aware I/O engine (docs/PERFORMANCE.md "Chunk-aware I/O").
+
+Covers the ISSUE-5 contracts:
+
+- halo'd region reads are assembled from cached chunks (only miss-chunks
+  hit storage), sync and async, bit-identically to direct reads;
+- single-flight: concurrent loads of one chunk share one storage read;
+- coherence: a write evicts overlapping chunks (a later read returns the
+  new bytes), injected ``corrupt`` / ``io_read`` faults never populate the
+  cache, and ``CTT_CHUNK_CACHE=0`` bypasses everything;
+- per-task ``io_metrics`` are recorded next to ``failures.json``;
+- Morton sweep scheduling: a Z-order permutation that visits every aligned
+  2x2x2 octant of the block grid contiguously.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.io import chunk_cache
+from cluster_tools_tpu.io.chunk_cache import ChunkCache
+from cluster_tools_tpu.io.containers import ChunkCorruptionError, open_container
+from cluster_tools_tpu.runtime.executor import morton_order
+from cluster_tools_tpu.utils import function_utils as fu
+from cluster_tools_tpu.utils.volume_utils import Blocking
+
+
+@pytest.fixture
+def fresh_cache():
+    """A fresh, generously-sized cache for the duration of one test; the
+    default (env-budgeted) singleton is restored afterwards."""
+    cache = chunk_cache.configure(max_bytes=64 << 20)
+    yield cache
+    chunk_cache.configure()
+
+
+def _dataset(tmp_path, key="x", shape=(32, 32, 32), chunks=(8, 8, 8),
+             dtype="float32", seed=0):
+    f = open_container(str(tmp_path / "c.zarr"))
+    ds = f.create_dataset(key, shape=shape, chunks=chunks, dtype=dtype)
+    data = np.random.default_rng(seed).random(shape).astype(dtype)
+    ds[...] = data
+    return ds, data
+
+
+# -- assembly + hit accounting ------------------------------------------------
+
+
+def test_halo_reads_assemble_from_cache(tmp_path, fresh_cache):
+    """Overlapping halo reads: the shared chunks are decompressed once;
+    every read is bit-identical to the direct (uncached) read."""
+    ds, data = _dataset(tmp_path)
+    a = ds[0:16, 0:16, 0:16]  # chunks {0,1}^3: 8 misses
+    np.testing.assert_array_equal(a, data[0:16, 0:16, 0:16])
+    s0 = chunk_cache.snapshot()
+    # the enclosing halo'd read covers chunks {0,1,2}^3 = 27, of which the
+    # 8 already-resident ones hit and only the 19 new ones touch storage
+    b = ds[0:24, 0:24, 0:24]
+    np.testing.assert_array_equal(b, data[0:24, 0:24, 0:24])
+    d = chunk_cache.delta(s0)
+    assert d["hits"] == 8
+    assert d["misses"] == 19
+    assert d["direct_reads"] == 0
+    # a full repeat is all hits, zero storage bytes
+    s1 = chunk_cache.snapshot()
+    np.testing.assert_array_equal(ds[0:24, 0:24, 0:24], b)
+    d = chunk_cache.delta(s1)
+    assert d["misses"] == 0 and d["hits"] == 27
+    assert d["bytes_from_storage"] == 0
+    assert d["bytes_served"] == b.nbytes
+
+
+def test_read_async_goes_through_cache(tmp_path, fresh_cache):
+    ds, data = _dataset(tmp_path)
+    fut = ds.read_async((slice(0, 16),) * 3)
+    np.testing.assert_array_equal(fut.result(), data[0:16, 0:16, 0:16])
+    s0 = chunk_cache.snapshot()
+    fut = ds.read_async((slice(0, 16),) * 3)
+    np.testing.assert_array_equal(fut.result(), data[0:16, 0:16, 0:16])
+    d = chunk_cache.delta(s0)
+    assert d["misses"] == 0 and d["hits"] == 8
+
+
+def test_clipped_and_partial_regions(tmp_path, fresh_cache):
+    """Regions not aligned to the chunk grid (and clipped at the volume
+    border) assemble correctly."""
+    ds, data = _dataset(tmp_path, shape=(20, 20, 20), chunks=(8, 8, 8))
+    np.testing.assert_array_equal(ds[3:17, 5:20, 0:1],
+                                  data[3:17, 5:20, 0:1])
+    np.testing.assert_array_equal(ds[...], data)
+
+
+def test_cached_entries_are_not_corrupted_by_caller_mutation(
+    tmp_path, fresh_cache
+):
+    """Served arrays are fresh copies: mutating one must not poison later
+    reads of the same chunks."""
+    ds, data = _dataset(tmp_path)
+    a = ds[0:8, 0:8, 0:8]
+    a[:] = -1.0
+    np.testing.assert_array_equal(ds[0:8, 0:8, 0:8], data[0:8, 0:8, 0:8])
+
+
+# -- single-flight ------------------------------------------------------------
+
+
+def test_single_flight_coalesces_concurrent_loads(fresh_cache):
+    """N concurrent loaders of one in-flight chunk: exactly one storage
+    read, the rest coalesce onto it and observe the same value."""
+    cache = fresh_cache
+    key = ("ds", (0, 0, 0))
+    kind, token = cache.get_or_begin(key)
+    assert kind == cache.OWNER
+    kinds, results = [], []
+
+    def worker():
+        k, h = cache.get_or_begin(key)
+        kinds.append(k)
+        results.append(cache.wait(h) if k == cache.WAIT else h)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    # every worker registers against the single in-flight load before the
+    # owner's "storage read" lands
+    deadline = [40]
+    while cache.stats["coalesced"] < 4 and deadline[0] > 0:
+        threading.Event().wait(0.05)
+        deadline[0] -= 1
+    cache.complete(key, token, np.arange(8.0))
+    for t in threads:
+        t.join()
+    assert kinds == [cache.WAIT] * 4
+    assert cache.stats["misses"] == 1
+    assert cache.stats["coalesced"] == 4
+    for r in results:
+        np.testing.assert_array_equal(r, np.arange(8.0))
+
+
+def test_single_flight_failure_propagates_and_caches_nothing(fresh_cache):
+    cache = fresh_cache
+    key = ("ds", (1, 0, 0))
+    kind, token = cache.get_or_begin(key)
+    assert kind == cache.OWNER
+    kind2, waiter = cache.get_or_begin(key)
+    assert kind2 == cache.WAIT
+    cache.fail(key, token, OSError("storage down"))
+    with pytest.raises(OSError, match="storage down"):
+        cache.wait(waiter)
+    assert len(cache) == 0
+    # the key is loadable again afterwards (no stuck in-flight entry)
+    kind3, _ = cache.get_or_begin(key)
+    assert kind3 == cache.OWNER
+
+
+def test_dropped_read_async_future_does_not_strand_later_reads(
+    tmp_path, fresh_cache
+):
+    """An abandoned read_async future (retry paths and early-exiting
+    prefetch consumers drop them) must not leave unsettled owner tokens:
+    later reads of the same chunks settle via the storage-future callback
+    instead of deadlocking."""
+    ds, data = _dataset(tmp_path)
+    fut = ds.read_async((slice(0, 16),) * 3)
+    del fut  # never resolved
+    done = {"v": None}
+
+    def reader():
+        done["v"] = ds[0:16, 0:16, 0:16]
+
+    t = threading.Thread(target=reader)
+    t.start()
+    t.join(timeout=20)
+    assert not t.is_alive(), "read deadlocked on a leaked owner token"
+    np.testing.assert_array_equal(done["v"], data[0:16, 0:16, 0:16])
+
+
+def test_stalled_shared_load_falls_back_to_direct_read(
+    tmp_path, fresh_cache, monkeypatch
+):
+    """A waiter on a stalled in-flight load reads independently after the
+    patience window — hung storage cannot serialize its consumers."""
+    monkeypatch.setenv("CTT_CHUNK_CACHE_WAIT_S", "0.2")
+    ds, data = _dataset(tmp_path, chunks=(16, 16, 16))
+    cache = fresh_cache
+    # wedge the chunk's in-flight entry by owning it and never settling
+    key = (ds._cache_id, (0, 0, 0))
+    kind, token = cache.get_or_begin(key)
+    assert kind == cache.OWNER
+    s0 = chunk_cache.snapshot()
+    out = ds[0:16, 0:16, 0:16]  # coalesces, times out, reads directly
+    np.testing.assert_array_equal(out, data[0:16, 0:16, 0:16])
+    assert chunk_cache.delta(s0)["stall_fallbacks"] == 1
+    cache.fail(key, token, RuntimeError("abandoned"))  # tidy up
+
+
+# -- coherence ----------------------------------------------------------------
+
+
+def test_write_evicts_overlapping_chunks(tmp_path, fresh_cache):
+    """Write-then-overlapping-read returns the new bytes: the stale cached
+    chunks are evicted by the write."""
+    ds, data = _dataset(tmp_path)
+    np.testing.assert_array_equal(ds[...], data)  # whole volume resident
+    assert len(fresh_cache) == 4 * 4 * 4
+    new = data[0:16, 0:16, 0:16] + 1.0
+    s0 = chunk_cache.snapshot()
+    ds[0:16, 0:16, 0:16] = new
+    assert chunk_cache.delta(s0)["invalidations"] == 8
+    np.testing.assert_array_equal(ds[0:16, 0:16, 0:16], new)
+    np.testing.assert_array_equal(ds[16:32, 16:32, 16:32],
+                                  data[16:32, 16:32, 16:32])
+
+
+def test_write_async_evicts_too(tmp_path, fresh_cache):
+    ds, data = _dataset(tmp_path)
+    np.testing.assert_array_equal(ds[0:8, 0:8, 0:8], data[0:8, 0:8, 0:8])
+    new = data[0:8, 0:8, 0:8] * 2 + 3
+    ds.write_async((slice(0, 8),) * 3, new).result()
+    np.testing.assert_array_equal(ds[0:8, 0:8, 0:8], new)
+
+
+def test_abandoned_write_async_still_evicts(tmp_path, fresh_cache):
+    """A write_async future dropped without .result(): the storage write
+    still lands, and the done-callback eviction must land with it — later
+    reads return the new bytes, never stale cached ones."""
+    import time
+
+    ds, data = _dataset(tmp_path, chunks=(16, 16, 16))
+    bb = (slice(0, 16),) * 3
+    np.testing.assert_array_equal(ds[bb], data[0:16, 0:16, 0:16])  # resident
+    new = data[0:16, 0:16, 0:16] + 5
+    fut = ds.write_async(bb, new)
+    del fut  # never resolved
+    got = None
+    for _ in range(200):  # the write + eviction callback land asynchronously
+        got = ds[bb]
+        if np.array_equal(got, new):
+            break
+        time.sleep(0.05)
+    np.testing.assert_array_equal(got, new)
+
+
+def test_region_read_fails_fast_past_failed_chunk(tmp_path, fresh_cache):
+    """Once one chunk of a region has failed, the remaining (possibly
+    wedged) chunk waits are skipped: the error surfaces immediately, not
+    after per-chunk patience windows."""
+    import time
+
+    ds, data = _dataset(tmp_path, chunks=(16, 16, 16))
+    cache = fresh_cache
+    ka, kb = (ds._cache_id, (0, 0, 0)), (ds._cache_id, (1, 0, 0))
+    kind_a, tok_a = cache.get_or_begin(ka)
+    kind_b, tok_b = cache.get_or_begin(kb)
+    assert kind_a == kind_b == cache.OWNER
+    # a region read coalescing onto both in-flight loads...
+    plan = ds._begin_cached_read((slice(0, 32), slice(0, 16), slice(0, 16)))
+    assert [k for _k, _b, k, _h in plan.steps] == [cache.WAIT] * 2
+    # ...whose first chunk fails while the second stays wedged
+    cache.fail(ka, tok_a, RuntimeError("chunk A storage error"))
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="chunk A storage error"):
+        ds._finish_cached_read(plan)
+    assert time.monotonic() - t0 < 5.0  # no 30s patience burned on B
+    cache.fail(kb, tok_b, RuntimeError("abandoned"))  # tidy up
+
+
+def test_injected_io_read_fault_never_populates(tmp_path, fresh_cache, inject):
+    """A faulted read raises before any chunk lands in the cache; the retry
+    (second attempt) reads storage and only THEN populates."""
+    from cluster_tools_tpu.runtime.faults import InjectedFault
+
+    ds, data = _dataset(tmp_path)
+    inject({"faults": [{"site": "io_read", "kind": "error",
+                        "fail_attempts": 1}]})
+    with pytest.raises(InjectedFault):
+        ds[0:16, 0:16, 0:16]
+    assert len(fresh_cache) == 0
+    assert fresh_cache.stats["bytes_from_storage"] == 0
+    np.testing.assert_array_equal(ds[0:16, 0:16, 0:16],
+                                  data[0:16, 0:16, 0:16])
+    assert len(fresh_cache) == 8
+
+
+def test_injected_corruption_never_stays_cached(tmp_path, fresh_cache, inject):
+    """An injected silent bit-flip (PR-3 integrity layer) fails the digest
+    verify; the assembled chunks are evicted, and the repair write + clean
+    re-read leave only clean bytes resident."""
+    ds, data = _dataset(tmp_path, chunks=(16, 16, 16))
+    blk = data[0:16, 0:16, 0:16]
+    bb = (slice(0, 16),) * 3
+    inject({"faults": [{"site": "io_write", "kind": "corrupt",
+                        "fail_attempts": 1}]})
+    ds[bb] = blk  # silently bit-flipped on storage after the sidecar
+    with pytest.raises(ChunkCorruptionError):
+        ds[bb]
+    assert len(fresh_cache) == 0  # corrupt assembly evicted
+    with pytest.raises(ChunkCorruptionError):
+        ds.read_async(bb).result()
+    assert len(fresh_cache) == 0
+    ds[bb] = blk  # repair
+    np.testing.assert_array_equal(ds[bb], blk)
+
+
+def test_recreated_dataset_does_not_serve_predecessor_bytes(
+    tmp_path, fresh_cache
+):
+    """Deleting a container and re-creating a dataset at the same path must
+    evict the predecessor's cached chunks."""
+    import shutil
+
+    ds, data = _dataset(tmp_path, chunks=(16, 16, 16))
+    np.testing.assert_array_equal(ds[0:16, 0:16, 0:16],
+                                  data[0:16, 0:16, 0:16])
+    shutil.rmtree(str(tmp_path / "c.zarr"))
+    f = open_container(str(tmp_path / "c.zarr"))
+    ds2 = f.create_dataset(
+        "x", shape=(32, 32, 32), chunks=(16, 16, 16), dtype="float32"
+    )
+    np.testing.assert_array_equal(
+        ds2[0:16, 0:16, 0:16], np.zeros((16, 16, 16), np.float32)
+    )
+
+
+def test_kill_switch_bypasses_everything(tmp_path, fresh_cache, monkeypatch):
+    monkeypatch.setenv("CTT_CHUNK_CACHE", "0")
+    ds, data = _dataset(tmp_path)
+    s0 = chunk_cache.snapshot()
+    np.testing.assert_array_equal(ds[0:16, 0:16, 0:16],
+                                  data[0:16, 0:16, 0:16])
+    np.testing.assert_array_equal(
+        ds.read_async((slice(0, 16),) * 3).result(), data[0:16, 0:16, 0:16]
+    )
+    d = chunk_cache.delta(s0)
+    assert len(fresh_cache) == 0
+    assert d["hits"] == 0 and d["misses"] == 0
+    assert d["direct_reads"] == 2
+    # flipping the switch back on mid-process just starts caching
+    monkeypatch.setenv("CTT_CHUNK_CACHE", "1")
+    np.testing.assert_array_equal(ds[0:16, 0:16, 0:16],
+                                  data[0:16, 0:16, 0:16])
+    assert len(fresh_cache) == 8
+
+
+def test_lru_eviction_respects_byte_budget(tmp_path):
+    cache = chunk_cache.configure(max_bytes=3 * 8 * 8 * 8 * 4)  # 3 chunks
+    try:
+        ds, data = _dataset(tmp_path)
+        # five distinct single-chunk reads through a 3-chunk budget
+        for z, y in ((0, 0), (8, 0), (16, 0), (24, 0), (0, 8)):
+            np.testing.assert_array_equal(
+                ds[z:z + 8, y:y + 8, 0:8], data[z:z + 8, y:y + 8, 0:8]
+            )
+        assert len(cache) == 3
+        assert cache.cached_bytes <= cache.max_bytes
+        assert cache.stats["evictions"] == 2
+        # a region over half the budget bypasses the cache entirely: one
+        # direct storage read, resident set untouched (no thrash)
+        s0 = chunk_cache.snapshot()
+        np.testing.assert_array_equal(ds[...], data)
+        d = chunk_cache.delta(s0)
+        assert d["direct_reads"] == 1 and d["misses"] == 0
+        assert len(cache) == 3
+    finally:
+        chunk_cache.configure()
+
+
+# -- per-task io_metrics ------------------------------------------------------
+
+
+def test_task_records_io_metrics(tmp_path, fresh_cache):
+    """A task doing chunked reads writes its counter deltas to
+    io_metrics.json (next to failures.json) and into its success manifest."""
+    from cluster_tools_tpu.runtime.task import BaseTask, build
+
+    ds, data = _dataset(tmp_path)
+
+    class ReadTask(BaseTask):
+        task_name = "cache_probe"
+
+        def run_impl(self):
+            total = float(ds[0:16, 0:16, 0:16].sum())  # 8 misses
+            total += float(ds[0:24, 0:24, 0:24].sum())  # 8 hits, 19 misses
+            return {"total": total}
+
+    tmp_folder = str(tmp_path / "tmp")
+    task = ReadTask(tmp_folder=tmp_folder, config_dir=str(tmp_path / "cfg"))
+    assert build([task])
+    metrics_doc = json.loads(
+        open(fu.io_metrics_path(tmp_folder)).read()
+    )
+    m = metrics_doc["tasks"][task.uid]
+    assert m["hits"] == 8 and m["misses"] == 27
+    assert m["bytes_served"] > m["bytes_from_storage"] > 0
+    assert task.output().read()["io_metrics"]["hits"] == 8
+    # additive merge across a re-run of the same uid
+    fu.record_io_metrics(
+        fu.io_metrics_path(tmp_folder), task.uid, {"hits": 2, "misses": 1}
+    )
+    merged = json.loads(open(fu.io_metrics_path(tmp_folder)).read())
+    assert merged["tasks"][task.uid]["hits"] == 10
+    assert merged["tasks"][task.uid]["misses"] == 28
+
+
+def test_failures_report_renders_io_metrics(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "failures_report",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "failures_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fu.record_io_metrics(
+        str(tmp_path / "io_metrics.json"),
+        "ws.abc123",
+        {"hits": 90, "misses": 10, "coalesced": 4,
+         "bytes_from_storage": 1 << 20, "bytes_served": 5 << 20},
+    )
+    tasks = mod.load_io_metrics(str(tmp_path / "failures.json"))
+    lines = "\n".join(mod.format_io_metrics(tasks))
+    assert "ws.abc123" in lines
+    assert "90.0%" in lines
+    assert "saved 4.0MiB" in lines
+    # a MISSING failures.json renders the clean-run io section (rc 0)...
+    assert mod.main(["prog", str(tmp_path)]) == 0
+    # ...but a TORN one is crash evidence and must keep its error exit
+    with open(tmp_path / "failures.json", "w") as fh:
+        fh.write('{"version": 2, "records": [')
+    assert mod.main(["prog", str(tmp_path)]) == 1
+
+
+# -- locality scheduling ------------------------------------------------------
+
+
+def test_morton_order_visits_octants_contiguously():
+    """The defining Z-order property: every aligned 2x2x2 octant of a 4^3
+    grid occupies 8 consecutive slots of the sweep."""
+    blocking = Blocking((64, 64, 64), (16, 16, 16))
+    blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
+    ordered = morton_order(blocks)
+    assert sorted(b.block_id for b in ordered) == [b.block_id for b in blocks]
+    octant_of = [
+        tuple(p // 2 for p in blocking.block_grid_position(b.block_id))
+        for b in ordered
+    ]
+    for start in range(0, len(ordered), 8):
+        assert len(set(octant_of[start:start + 8])) == 1
+    # deterministic
+    assert [b.block_id for b in morton_order(blocks)] == [
+        b.block_id for b in ordered
+    ]
+
+
+def test_morton_order_handles_sparse_and_clipped_grids():
+    blocking = Blocking((40, 24, 8), (16, 16, 8))  # clipped edges
+    blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
+    sparse = blocks[::2]
+    ordered = morton_order(sparse)
+    assert sorted(b.block_id for b in ordered) == sorted(
+        b.block_id for b in sparse
+    )
+
+
+def test_map_blocks_schedule_given_and_morton_agree(tmp_path, fresh_cache):
+    """Both sweep orders produce identical stored results (order is pure IO
+    locality), and an unknown schedule is refused."""
+    from cluster_tools_tpu.runtime.executor import BlockwiseExecutor
+
+    f = open_container(str(tmp_path / "s.zarr"))
+    shape, bshape = (16, 16, 32), (8, 8, 8)
+    src = f.create_dataset("src", shape=shape, chunks=bshape, dtype="float32")
+    data = np.random.default_rng(3).random(shape).astype(np.float32)
+    src[...] = data
+    blocking = Blocking(shape, bshape)
+    blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
+    ex = BlockwiseExecutor(target="local", n_devices=2, device_batch=1)
+    outs = {}
+    for schedule in ("morton", "given"):
+        dst = f.create_dataset(
+            f"dst_{schedule}", shape=shape, chunks=bshape, dtype="float32"
+        )
+        ex.map_blocks(
+            lambda a: a * 2.0,
+            blocks,
+            lambda b: (data[b.bb],),
+            lambda b, o: dst.__setitem__(b.bb, np.asarray(o)),
+            schedule=schedule,
+        )
+        outs[schedule] = np.asarray(dst[...])
+    np.testing.assert_array_equal(outs["morton"], outs["given"])
+    np.testing.assert_array_equal(outs["morton"], data * 2.0)
+    with pytest.raises(ValueError, match="schedule"):
+        ex.map_blocks(
+            lambda a: a, blocks, lambda b: (data[b.bb],), None,
+            schedule="zigzag",
+        )

@@ -149,6 +149,15 @@ def test_threshold_task_on_slurm_target(rng, workspace, fake_slurm):
     assert scripts
     with open(os.path.join(cdir, scripts[0])) as fh:
         assert "cluster_runner" in fh.read()
+    # the chunk IO ran in the WORKER process, which must have recorded its
+    # own io_metrics delta into the shared manifest (the submitter only
+    # polls and has nothing to record)
+    import json as _json
+    from cluster_tools_tpu.utils import function_utils as fu
+
+    io_doc = _json.load(open(fu.io_metrics_path(tmp_folder)))
+    worker = io_doc["tasks"][t.uid]
+    assert worker["misses"] > 0 or worker["direct_reads"] > 0
 
 
 def test_cluster_remote_failure_surfaces(workspace, fake_slurm):

@@ -137,6 +137,68 @@ def test_async_loader_pads_clipped_edge_blocks(tmp_path):
     np.testing.assert_allclose(np.asarray(dst[...]), data + 3.0, rtol=1e-6)
 
 
+def test_prefetcher_failed_read_does_not_abandon_window():
+    """A read failing mid-window fails ITS item only: the consumer catches
+    the error and keeps receiving every other item, in order, and the
+    bounded window never exceeds ``depth`` reads in flight."""
+    in_flight = []
+    max_in_flight = [0]
+    lock = threading.Lock()
+    pool = ThreadPoolExecutor(4)
+
+    def read(item):
+        with lock:
+            in_flight.append(item)
+            max_in_flight[0] = max(max_in_flight[0], len(in_flight))
+
+        def work():
+            time.sleep(0.01)
+            with lock:
+                in_flight.remove(item)
+            if item == 4:
+                raise OSError(f"injected read failure on {item}")
+            return np.full((2,), item)
+
+        return pool.submit(work)
+
+    items = list(range(10))
+    it = iter(BlockPrefetcher(read, items, depth=3))
+    got, failed = [], []
+    while True:
+        try:
+            item, arr = next(it)
+        except StopIteration:
+            break
+        except OSError:
+            failed.append(4)
+            continue
+        got.append((item, arr))
+    assert failed == [4]
+    assert [i for i, _ in got] == [i for i in items if i != 4]
+    assert all((a == i).all() for i, a in got)
+    # the window bound must hold across the failure
+    assert max_in_flight[0] <= 3
+
+
+def test_prefetcher_submission_failure_is_per_item():
+    """read_fn raising synchronously at submission fails that item at ITS
+    turn — later submissions and in-flight futures are unaffected."""
+    submitted = []
+
+    def read(item):
+        submitted.append(item)
+        if item == 1:
+            raise ValueError("bad item")
+        return np.array([item])
+
+    it = iter(BlockPrefetcher(read, [0, 1, 2, 3], depth=2))
+    assert next(it)[0] == 0
+    with pytest.raises(ValueError, match="bad item"):
+        next(it)
+    assert [i for i, _ in it] == [2, 3]
+    assert submitted == [0, 1, 2, 3]
+
+
 def test_prefetcher_none_item_is_a_real_item():
     seen = []
 
