@@ -1,6 +1,10 @@
 # Developer entry points.
-#   test            = tier-1 (fast; chaos excluded via the slow marker)
-#                     followed by the full chaos suite
+#   test            = lint, then tier-1 (fast; chaos excluded via the slow
+#                     marker), then the full chaos suite
+#   lint            = ctlint static analysis (docs/ANALYSIS.md): the
+#                     executor-contract / atomic-write / lock-discipline /
+#                     fault-coverage / jit-hygiene / drain-safety rules;
+#                     exit 1 on findings (CI gate)
 #   tier1           = the fast suite alone
 #   chaos           = the whole fault-injection suite, fixed seed — kills/
 #                     resume, the silent-failure scenarios (hang, chunk
@@ -26,10 +30,13 @@ PY ?= python
 CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
-.PHONY: test tier1 chaos chaos-resource failures-report bench-io \
+.PHONY: test lint tier1 chaos chaos-resource failures-report bench-io \
 	supervise-demo native clean
 
-test: tier1 chaos
+test: lint tier1 chaos
+
+lint:
+	$(PY) -m cluster_tools_tpu.lint
 
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \

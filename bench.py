@@ -1295,9 +1295,23 @@ def orchestrate() -> None:
 
 
 if __name__ == "__main__":
-    if "--io" in sys.argv or os.environ.get("CT_BENCH_IO"):
-        io_bench()
-    elif os.environ.get("CT_BENCH_IMPL"):
-        main()
-    else:
-        orchestrate()
+    # drain safety (docs/ANALYSIS.md CT006): a scheduler SIGTERM mid-bench
+    # must exit with the requeue code, not a crash traceback — the bench
+    # drives real task DAGs whose markers/manifests the drain protocol
+    # flushes before DrainInterrupt reaches this frame
+    from cluster_tools_tpu.runtime.supervision import (
+        REQUEUE_EXIT_CODE,
+        DrainInterrupt,
+    )
+
+    try:
+        if "--io" in sys.argv or os.environ.get("CT_BENCH_IO"):
+            io_bench()
+        elif os.environ.get("CT_BENCH_IMPL"):
+            main()
+        else:
+            orchestrate()
+    except DrainInterrupt as e:
+        print(f"bench: DRAINED ({e.reason}); exiting {REQUEUE_EXIT_CODE}",
+              file=sys.stderr)
+        sys.exit(REQUEUE_EXIT_CODE)

@@ -70,6 +70,7 @@ def _resolve(name: str):
 
 
 def cmd_run(args) -> int:
+    from .runtime.supervision import REQUEUE_EXIT_CODE, DrainInterrupt
     from .runtime.task import build
 
     with open(args.config) as f:
@@ -94,7 +95,14 @@ def cmd_run(args) -> int:
         target=cfg.get("target", "local"),
         **cfg.get("params", {}),
     )
-    ok = build([wf], rerun=args.rerun)
+    try:
+        ok = build([wf], rerun=args.rerun)
+    except DrainInterrupt as e:
+        # graceful preemption (CT006): markers/manifests are flushed —
+        # exit with the requeue code so the scheduler resubmits us, and
+        # the resumed run picks up at block grain behind the markers
+        print(f"DRAINED ({e.reason}); exiting {REQUEUE_EXIT_CODE} for requeue")
+        return REQUEUE_EXIT_CODE
     print("SUCCESS" if ok else "FAILED (see logs in tmp_folder)")
     return 0 if ok else 1
 
@@ -129,12 +137,13 @@ def cmd_configs(args) -> int:
                 and "task_name" in vars(obj)
             ):
                 configs[obj.task_name] = obj.default_task_config()
+    from .utils.task_utils import dump_config
+
     for name, cfg in configs.items():
         path = os.path.join(
             args.out, "global.config" if name == "global" else f"{name}.config"
         )
-        with open(path, "w") as f:
-            json.dump(cfg, f, indent=2)
+        dump_config(path, cfg)
         print("wrote", path)
     return 0
 

@@ -737,10 +737,16 @@ class ZarrContainer:
                 self.path, "attributes.json" if self.is_n5 else ".zgroup"
             )
             if not os.path.exists(marker):
-                with open(marker, "w") as f:
+                # atomic (CT002): concurrent jobs opening the same container
+                # race this creation; a reader must see a whole marker
+                tmp = f"{marker}.tmp.{os.getpid()}.{threading.get_ident()}"
+                with open(tmp, "w") as f:
                     json.dump(
                         {"n5": "2.0.0"} if self.is_n5 else {"zarr_format": 2}, f
                     )
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, marker)
 
     # -- internal ----------------------------------------------------------
     def _spec(self, key: str, metadata: Optional[dict] = None, create: bool = False):

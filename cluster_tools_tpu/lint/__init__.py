@@ -1,0 +1,42 @@
+"""ctlint: repo-native static analysis for the runtime's contracts.
+
+PRs 2-5 built a reliability and IO stack whose guarantees hold only by
+convention: every executor call site must plumb the hardening knobs, every
+shared manifest must be written atomically, nothing may block while holding
+the XLA dispatch or chunk-cache locks, every storage boundary must carry a
+fault-injection hook, jitted code must stay pure, and no broad ``except``
+may swallow a preemption drain.  ``ctlint`` turns those conventions into
+machine-checked rules (docs/ANALYSIS.md), so refactors cannot silently drop
+a guarantee:
+
+- **CT001 executor-contract** — ``map_blocks`` / ``BlockwiseExecutor`` /
+  ``host_block_map`` call sites must plumb the hardening knobs.
+- **CT002 atomic-write discipline** — no bare ``json.dump`` to shared state
+  without the temp-file + ``os.replace`` idiom (``fu.atomic_write_json``).
+- **CT003 lock discipline** — no lock-order cycles across the runtime's
+  locks; no blocking calls under the XLA dispatch / chunk-cache locks.
+- **CT004 fault-site coverage** — storage/compute boundaries carry
+  injection hooks; every hooked site name is in ``faults.py``'s registry.
+- **CT005 jit hygiene** — no side effects, wall-clock, randomness, or
+  traced-value Python branches inside jitted code; hashable static args;
+  no jit benchmarking without synchronization.
+- **CT006 drain safety** — no handler that can swallow ``DrainInterrupt``;
+  ``os._exit`` only in ``faults.py``; DAG entry points map drains to
+  ``REQUEUE_EXIT_CODE``.
+
+Run ``python -m cluster_tools_tpu.lint`` (or ``make lint``); suppress a
+single finding with ``# ctlint: disable=CTnnn`` on (or immediately above)
+the offending line.  The module is pure stdlib/ast — it never imports jax
+or executes the code it checks.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    Finding,
+    LintModule,
+    collect_files,
+    findings_to_json,
+    run_lint,
+)
+from .rules import RULES  # noqa: F401

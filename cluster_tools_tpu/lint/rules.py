@@ -1,0 +1,907 @@
+"""The ctlint rule classes CT001-CT006 (docs/ANALYSIS.md).
+
+Every rule is derived from a *real* invariant of this codebase — the
+docstring of each checker names the file/contract it guards.  Rules are
+pure AST analyses: nothing here imports jax or executes checked code.
+
+Adding a rule: write ``def ctNNN_name(module: LintModule) -> list[Finding]``,
+document the invariant, register it in :data:`RULES`, add a firing fixture
++ a clean fixture under ``tests/lint_fixtures/`` and a case in
+``tests/test_lint.py`` (the repo-wide clean gate keeps it honest).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, LintModule
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_seg(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def call_attr(call: ast.Call) -> Optional[str]:
+    """Last attribute/name segment of a call target, resolving through
+    chained calls (``file_reader(p).require_dataset`` -> 'require_dataset'
+    where :func:`dotted` gives None)."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def kw_names(call: ast.Call) -> Tuple[Set[str], bool]:
+    """(explicit keyword names, has-**splat)."""
+    names, splat = set(), False
+    for kw in call.keywords:
+        if kw.arg is None:
+            splat = True
+        else:
+            names.add(kw.arg)
+    return names, splat
+
+
+def calls_in(node: ast.AST) -> Iterable[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _package_root(path: str) -> Optional[str]:
+    """Directory of the ``cluster_tools_tpu`` package containing ``path``
+    (for sibling-module resolution), or None outside the package."""
+    cur = os.path.dirname(os.path.abspath(path))
+    while True:
+        if os.path.basename(cur) == "cluster_tools_tpu":
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+# =============================================================================
+# CT001 - executor-contract
+# =============================================================================
+
+#: knobs every ``map_blocks`` call site must plumb (docs/ROBUSTNESS.md):
+#: without them the call silently runs without failure attribution, hang
+#: detection, post-store integrity verification, or locality scheduling.
+MAP_BLOCKS_KNOBS = frozenset({
+    "failures_path",
+    "task_name",
+    "block_deadline_s",
+    "watchdog_period_s",
+    "store_verify_fn",
+    "schedule",
+})
+
+#: constructor knobs: IO pool width and the per-block retry budget must be
+#: config-driven, not the hard-coded defaults.
+EXECUTOR_KNOBS = frozenset({"io_threads", "max_retries"})
+
+#: hardened host-path knobs: ``host_block_map`` derives the retry/deadline/
+#: schedule knobs from the task config itself, but the two wirings it cannot
+#: derive — the post-store integrity verifier and the blocking (which also
+#: enables the Morton schedule) — must come from the call site whenever the
+#: task owns a chunked output dataset (``require_dataset`` in scope).
+HOST_MAP_KNOBS = frozenset({"store_verify_fn", "blocking"})
+
+#: files that *define* the executor surface (call sites only are checked)
+_CT001_DEFINING = ("executor.py", "task.py")
+
+
+def ct001_executor_contract(module: LintModule) -> List[Finding]:
+    """Executor call sites must plumb the PR 2-5 hardening knobs.
+
+    Guards the hand-plumbed convention ROADMAP item 5 complains about:
+    every ``BlockwiseExecutor``/``map_blocks`` site must wire the retry /
+    deadline / verify / schedule knobs, and every ``host_block_map`` site
+    that owns a chunked store must wire ``store_verify_fn`` + ``blocking``.
+    Opt out with ``# ctlint: disable=CT001`` where a knob is genuinely
+    inapplicable (say why in the comment).
+    """
+    if module.name in _CT001_DEFINING and "lint_fixtures" not in module.path:
+        return []
+    out: List[Finding] = []
+    for call in calls_in(module.tree):
+        name = last_seg(dotted(call.func))
+        if name == "map_blocks":
+            required = MAP_BLOCKS_KNOBS
+        elif name == "BlockwiseExecutor":
+            required = EXECUTOR_KNOBS
+        elif name == "host_block_map":
+            fn = module.enclosing_function(call)
+            scope = fn if fn is not None else module.tree
+            if not any(
+                call_attr(c) == "require_dataset" for c in calls_in(scope)
+            ):
+                continue  # no chunked store owned here: nothing to verify
+            required = HOST_MAP_KNOBS
+        else:
+            continue
+        present, splat = kw_names(call)
+        if splat:
+            continue  # knobs forwarded wholesale; not statically checkable
+        missing = sorted(required - present)
+        if missing:
+            out.append(Finding(
+                "CT001", module.path, call.lineno, call.col_offset,
+                f"{name} call site does not plumb the hardened executor "
+                f"knob(s) {missing}; wire them from the task config or "
+                "opt out explicitly with a reasoned "
+                "'# ctlint: disable=CT001'",
+            ))
+    return out
+
+
+# =============================================================================
+# CT002 - atomic-write discipline
+# =============================================================================
+
+def _scope_is_atomic(module: LintModule, node: ast.AST) -> bool:
+    """The enclosing scope *calls* the crash-safe idiom: ``os.replace`` /
+    ``os.rename`` on the write path, or the shared helper.  Bare attribute
+    mentions do not count — ``path.replace('a', 'b')`` is ``str.replace``,
+    not an atomic rename."""
+    fn = module.enclosing_function(node)
+    scope = fn if fn is not None else module.tree
+    for c in calls_in(scope):
+        name = dotted(c.func)
+        if name in ("os.replace", "os.rename"):
+            return True
+        if last_seg(name) == "atomic_write_json":
+            return True
+    return False
+
+
+def ct002_atomic_writes(module: LintModule) -> List[Finding]:
+    """Shared JSON state must be written atomically.
+
+    ``failures.json`` / ``io_metrics.json`` / markers / configs / task
+    reports are read by concurrent jobs and by resumed runs; a kill
+    mid-write must leave the old document or nothing, never half a
+    manifest (``fu.atomic_write_json``: temp file + fsync + ``os.replace``).
+    Flags ``json.dump`` (and ``f.write(json.dumps(...))``) in any scope
+    with no ``os.replace``/``os.rename``/``atomic_write_json`` evidence.
+    """
+    out: List[Finding] = []
+    for call in calls_in(module.tree):
+        name = dotted(call.func)
+        is_dump = last_seg(name) == "dump" and (
+            name or ""
+        ).split(".")[0] in ("json", "ujson")
+        is_write_dumps = (
+            last_seg(name) == "write"
+            and call.args
+            and isinstance(call.args[0], ast.Call)
+            and last_seg(dotted(call.args[0].func)) == "dumps"
+        )
+        if not (is_dump or is_write_dumps):
+            continue
+        if _scope_is_atomic(module, call):
+            continue
+        out.append(Finding(
+            "CT002", module.path, call.lineno, call.col_offset,
+            "non-atomic JSON write: a kill mid-write leaves a torn "
+            "document for concurrent/resumed readers; use "
+            "fu.atomic_write_json (temp file + os.replace) or write to a "
+            "temp path and os.replace it",
+        ))
+    return out
+
+
+# =============================================================================
+# CT003 - lock discipline
+# =============================================================================
+
+#: modules participating in the runtime's lock graph
+_CT003_SCOPE = (
+    "executor.py", "chunk_cache.py", "supervision.py",
+    "function_utils.py", "containers.py",
+)
+
+#: method/function names that block the calling thread (never allowed
+#: while holding any tracked lock: a stuck callee freezes every other
+#: thread contending for it)
+_BLOCKING_CALLS = {"sleep", "result", "wait", "join"}
+
+#: additionally forbidden under the *hot* locks: the XLA dispatch lock
+#: serializes every kernel launch, and the chunk-cache lock serializes
+#: every cached read — filesystem or (de)serialization work under either
+#: stalls the whole pipeline
+_HOT_BLOCKING = {
+    "open", "dump", "dumps", "load", "loads", "listdir", "replace",
+    "unlink", "remove", "save", "fsync", "makedirs", "read", "write",
+}
+
+
+def _is_hot_lock(module: LintModule, lock_key: str) -> bool:
+    if lock_key.endswith("dispatch_lock"):
+        return True
+    if module.name == "chunk_cache.py" and lock_key == "ChunkCache._lock":
+        return True
+    return False
+
+
+def _lock_key(module: LintModule, node: ast.AST) -> Optional[str]:
+    """Identity of a lock expression: ``Class.attr`` for ``self.X`` locks,
+    the bare name for local/module locks, the callee name for lock-factory
+    context managers (``with file_lock(path):``)."""
+    name = dotted(node)
+    if name is None and isinstance(node, ast.Call):
+        name = dotted(node.func)
+    if name is None:
+        return None
+    seg = last_seg(name)
+    # a lock is something *named* like one ('_LOCK', 'fail_lock',
+    # 'lock_a'); 'block_context' / 'block' / 'blocking' are not locks
+    if seg is None:
+        return None
+    low = seg.lower()
+    if not (low.endswith("lock") or low.startswith("lock")) \
+            or low.endswith("block"):
+        return None
+    if name.startswith("self."):
+        cls = module.enclosing_class(node)
+        return f"{cls.name}.{seg}" if cls is not None else seg
+    return seg
+
+
+class _FnInfo:
+    __slots__ = ("node", "locks", "calls")
+
+    def __init__(self, node):
+        self.node = node
+        self.locks: Set[str] = set()   # locks this function acquires
+        self.calls: Set[str] = set()   # last-segment names it calls
+
+
+def ct003_lock_discipline(module: LintModule) -> List[Finding]:
+    """No blocking calls under the runtime's locks; no lock-order cycles.
+
+    The executor's ``dispatch_lock`` exists because two concurrent
+    multi-device dispatches deadlock XLA's collective rendezvous; anything
+    slow under it (or under the chunk cache's LRU lock) serializes the
+    sweep, and any pair of locks taken in opposite orders across
+    ``executor.py`` / ``chunk_cache.py`` / ``supervision.py`` /
+    ``function_utils.py`` / ``containers.py`` is a latent deadlock.
+    Builds a static lock-acquisition graph (with one level of local call
+    resolution) and flags (a) blocking calls made while a lock is held,
+    (b) cycles in the lock-order graph.
+    """
+    is_fixture = "ct003" in module.name
+    if module.name not in _CT003_SCOPE and not is_fixture:
+        return []
+    out: List[Finding] = []
+
+    # function table (qualified by class where applicable)
+    fns: Dict[str, _FnInfo] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = module.enclosing_class(node)
+            qual = f"{cls.name}.{node.name}" if cls else node.name
+            info = _FnInfo(node)
+            for c in calls_in(node):
+                seg = last_seg(dotted(c.func))
+                if seg:
+                    info.calls.add(seg)
+            fns[qual] = info
+            fns.setdefault(node.name, info)
+
+    # direct acquisitions + per-with-body analysis
+    edges: Set[Tuple[str, str, int]] = set()
+
+    def with_lock_items(w: ast.With) -> List[str]:
+        keys = []
+        for item in w.items:
+            key = _lock_key(module, item.context_expr)
+            if key is not None:
+                keys.append(key)
+        return keys
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.With):
+            continue
+        keys = with_lock_items(node)
+        if not keys:
+            continue
+        fn = module.enclosing_function(node)
+        if fn is not None:
+            cls = module.enclosing_class(node)
+            qual = f"{cls.name}.{fn.name}" if cls else fn.name
+            if qual in fns:
+                fns[qual].locks.update(keys)
+        # ordered acquisition within one `with a, b:` statement
+        for a, b in zip(keys, keys[1:]):
+            edges.add((a, b, node.lineno))
+        held = keys[-1]
+        hot = any(_is_hot_lock(module, k) for k in keys)
+        for stmt in node.body:
+            for inner in ast.walk(stmt):
+                if isinstance(inner, ast.With):
+                    for k in with_lock_items(inner):
+                        for h in keys:
+                            if k != h:
+                                edges.add((h, k, inner.lineno))
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = dotted(inner.func)
+                seg = last_seg(name)
+                if seg is None:
+                    continue
+                blocking = seg in _BLOCKING_CALLS or (
+                    name or ""
+                ).startswith("subprocess.")
+                if seg == "join" and isinstance(
+                    inner.func, ast.Attribute
+                ) and isinstance(inner.func.value, ast.Constant):
+                    blocking = False  # "sep".join(...) is not a thread join
+                if blocking:
+                    out.append(Finding(
+                        "CT003", module.path, inner.lineno, inner.col_offset,
+                        f"blocking call '{name}' while holding lock "
+                        f"'{held}': a stuck callee freezes every thread "
+                        "contending for the lock — move the wait outside "
+                        "the critical section",
+                    ))
+                elif hot and (seg in _HOT_BLOCKING or seg == "open"):
+                    out.append(Finding(
+                        "CT003", module.path, inner.lineno, inner.col_offset,
+                        f"IO/serialization call '{name}' under hot lock "
+                        f"'{held}' (XLA dispatch / chunk-cache LRU): this "
+                        "serializes the whole sweep behind one filesystem "
+                        "call — stage the data outside the lock",
+                    ))
+                # call to a local function that itself takes locks
+                callee = fns.get(seg)
+                if callee is not None:
+                    for k in callee.locks:
+                        for h in keys:
+                            if k != h:
+                                edges.add((h, k, inner.lineno))
+
+    # cycle detection over the lock-order graph
+    graph: Dict[str, Set[str]] = {}
+    at_line: Dict[Tuple[str, str], int] = {}
+    for a, b, line in edges:
+        graph.setdefault(a, set()).add(b)
+        at_line.setdefault((a, b), line)
+
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {k: WHITE for k in set(graph) | {v for vs in graph.values() for v in vs}}
+    reported: Set[frozenset] = set()
+
+    def visit(u: str, stack: List[str]) -> None:
+        color[u] = GREY
+        stack.append(u)
+        for v in sorted(graph.get(u, ())):
+            if color[v] == GREY:
+                cycle = stack[stack.index(v):] + [v]
+                key = frozenset(cycle)
+                if key not in reported:
+                    reported.add(key)
+                    line = at_line.get((u, v), 1)
+                    out.append(Finding(
+                        "CT003", module.path, line, 0,
+                        "lock-order cycle "
+                        + " -> ".join(cycle)
+                        + ": two threads taking these locks in opposite "
+                        "orders deadlock; pick one global order",
+                    ))
+            elif color[v] == WHITE:
+                visit(v, stack)
+        stack.pop()
+        color[u] = BLACK
+
+    for u in sorted(color):
+        if color[u] == WHITE:
+            visit(u, [])
+    return out
+
+
+# =============================================================================
+# CT004 - fault-site coverage
+# =============================================================================
+
+#: fallback registry (kept in sync with runtime/faults.py; the rule reads
+#: the real module when it is reachable on disk)
+_DEFAULT_SITES = frozenset({
+    "load", "store", "io_read", "io_write", "submit", "task",
+    "block_done", "task_done", "compute", "kernel",
+})
+_DEFAULT_KINDS = frozenset({
+    "error", "oom", "enospc", "hang", "corrupt", "nan",
+    "job_loss", "kill", "preempt",
+})
+
+#: hook callables whose first positional arg is a site name
+_SITE_HOOKS = {
+    "maybe_fail", "maybe_hang", "chunk_corrupt", "kill_point",
+    "corrupt", "_inject", "_hang",
+}
+
+#: dataset IO boundary methods that must carry an injection hook
+_BOUNDARY_METHODS = ("__getitem__", "__setitem__", "read_async", "write_async")
+
+
+def _load_fault_registry(module: LintModule) -> Tuple[Set[str], Set[str]]:
+    """(sites, kinds) parsed from the real ``runtime/faults.py`` when
+    resolvable from ``module``'s location, else the pinned defaults."""
+    root = _package_root(module.path)
+    path = os.path.join(root, "runtime", "faults.py") if root else None
+    if module.name == "faults.py":
+        path = module.path
+    if not path or not os.path.isfile(path):
+        return set(_DEFAULT_SITES), set(_DEFAULT_KINDS)
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return set(_DEFAULT_SITES), set(_DEFAULT_KINDS)
+    sites: Set[str] = set()
+    kinds: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not targets:
+                continue
+            name = targets[0]
+            if name.endswith("_SITES") and isinstance(node.value, ast.Tuple):
+                for el in node.value.elts:
+                    s = str_const(el)
+                    if s:
+                        sites.add(s)
+            if name == "_FAIL_KINDS" and isinstance(node.value, ast.Tuple):
+                for el in node.value.elts:
+                    s = str_const(el)
+                    if s:
+                        kinds.add(s)
+        # kind literals in the validation chain:  kind == "nan"  /
+        # kind in ("kill", "preempt")
+        if isinstance(node, ast.Compare):
+            left = dotted(node.left)
+            if last_seg(left) != "kind":
+                continue
+            for comp in node.comparators:
+                s = str_const(comp)
+                if s:
+                    kinds.add(s)
+                if isinstance(comp, (ast.Tuple, ast.List)):
+                    for el in comp.elts:
+                        s = str_const(el)
+                        if s:
+                            kinds.add(s)
+    sites |= {"kernel", "compute"}  # corrupt-hook + executor compute site
+    return (sites or set(_DEFAULT_SITES)), (kinds or set(_DEFAULT_KINDS))
+
+
+def ct004_fault_site_coverage(module: LintModule) -> List[Finding]:
+    """Every IO/compute boundary carries a fault hook; site names and the
+    fault-class registry stay consistent.
+
+    The chaos suite only proves what the hooks reach: a Dataset method
+    without ``_inject``/``_hang`` is a storage boundary chaos cannot
+    exercise, a typo'd site string is a hook that never fires, and a
+    shrunken fault-kind registry silently un-tests recovery paths.
+    """
+    is_fixture = "ct004" in module.name
+    sites, kinds = _load_fault_registry(module)
+    out: List[Finding] = []
+
+    # (a) site-name vocabulary at every hook call
+    for call in calls_in(module.tree):
+        seg = last_seg(dotted(call.func))
+        if seg not in _SITE_HOOKS or not call.args:
+            continue
+        site = str_const(call.args[0])
+        if site is not None and site not in sites:
+            out.append(Finding(
+                "CT004", module.path, call.lineno, call.col_offset,
+                f"unknown fault site {site!r} passed to {seg} (registry: "
+                f"{sorted(sites)}): this hook can never fire — typo, or "
+                "register the site in runtime/faults.py",
+            ))
+
+    # (b) dataset boundary coverage (container layer + fixtures)
+    if module.name == "containers.py" or is_fixture:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef) or "Dataset" not in node.name:
+                continue
+            for item in node.body:
+                if not isinstance(item, ast.FunctionDef):
+                    continue
+                if item.name not in _BOUNDARY_METHODS:
+                    continue
+                hooked = any(
+                    last_seg(dotted(c.func)) in ("_inject", "maybe_fail")
+                    for c in calls_in(item)
+                )
+                if not hooked:
+                    out.append(Finding(
+                        "CT004", module.path, item.lineno, item.col_offset,
+                        f"storage boundary {node.name}.{item.name} has no "
+                        "fault-injection hook (_inject/maybe_fail): chaos "
+                        "tests cannot exercise failures at this IO path",
+                    ))
+
+    # (c) executor compute/load/store coverage
+    if module.name == "executor.py" and "lint_fixtures" not in module.path:
+        seen_sites: Set[str] = set()
+        kill_sites: Set[str] = set()
+        for call in calls_in(module.tree):
+            seg = last_seg(dotted(call.func))
+            if seg in ("maybe_fail", "maybe_hang") and call.args:
+                s = str_const(call.args[0])
+                if s:
+                    seen_sites.add(s)
+            if seg == "kill_point" and call.args:
+                s = str_const(call.args[0])
+                if s:
+                    kill_sites.add(s)
+        for required in ("load", "store", "compute"):
+            if required not in seen_sites:
+                out.append(Finding(
+                    "CT004", module.path, 1, 0,
+                    f"executor no longer injects faults at site "
+                    f"{required!r}: the {required} boundary is chaos-blind",
+                ))
+        if "block_done" not in kill_sites:
+            out.append(Finding(
+                "CT004", module.path, 1, 0,
+                "executor lost its kill_point('block_done') crossing: "
+                "preemption chaos cannot target block completion",
+            ))
+
+    # (d) the 9-class registry itself
+    if module.name == "faults.py" and "lint_fixtures" not in module.path:
+        missing = _DEFAULT_KINDS - kinds
+        if missing:
+            out.append(Finding(
+                "CT004", module.path, 1, 0,
+                f"fault-class registry lost kind(s) {sorted(missing)} "
+                f"(now: {sorted(kinds)}): recovery paths for them are "
+                "untestable",
+            ))
+    return out
+
+
+# =============================================================================
+# CT005 - jit hygiene
+# =============================================================================
+
+#: call prefixes that are side effects / nondeterminism inside a traced
+#: function: they run once at trace time, not per execution
+_IMPURE_PREFIXES = (
+    "time.", "datetime.", "random.", "np.random.", "numpy.random.",
+    "os.", "subprocess.", "socket.",
+)
+_IMPURE_NAMES = {"print", "open", "input", "breakpoint"}
+
+_SYNC_MARKERS = ("block_until_ready", ".item(", "np.asarray", "np.array(",
+                 "device_get", "float(")
+
+
+def _jit_target_names(call: ast.Call) -> List[Tuple[str, Set[str]]]:
+    """``(function name, partial-bound arg names)`` for every local
+    function wrapped by a ``jax.jit(...)``/``shard_map(...)`` call,
+    unwrapping ``jax.vmap``/``functools.partial`` layers.  Args bound by
+    keyword through ``partial`` are compile-time constants, so they count
+    as static for the traced-branch check."""
+    names: List[Tuple[str, Set[str]]] = []
+    stack: List[Tuple[ast.AST, Set[str]]] = [
+        (a, set()) for a in call.args[:1]
+    ]
+    while stack:
+        arg, bound = stack.pop()
+        if isinstance(arg, ast.Name):
+            names.append((arg.id, bound))
+        elif isinstance(arg, ast.Call):
+            inner_bound = set(bound)
+            if last_seg(dotted(arg.func)) == "partial":
+                inner_bound |= {
+                    kw.arg for kw in arg.keywords if kw.arg is not None
+                }
+            stack.extend((a, inner_bound) for a in arg.args[:1])
+    return names
+
+
+def _collect_jitted(module: LintModule) -> Dict[str, Dict]:
+    """name -> {"node": FunctionDef|Lambda, "static": set[str]} for every
+    function statically known to be jitted/shard_mapped in this module."""
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+    jitted: Dict[str, Dict] = {}
+
+    def static_names(call: ast.Call, target: Optional[ast.FunctionDef]) -> Set[str]:
+        names: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                vals = [kw.value]
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    vals = list(kw.value.elts)
+                for v in vals:
+                    s = str_const(v)
+                    if s:
+                        names.add(s)
+            if kw.arg == "static_argnums" and target is not None:
+                nums = [kw.value]
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    nums = list(kw.value.elts)
+                params = [a.arg for a in target.args.args]
+                for v in nums:
+                    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                        if 0 <= v.value < len(params):
+                            names.add(params[v.value])
+        return names
+
+    def mark(name: str, node: ast.AST, static: Set[str], call: ast.Call):
+        entry = jitted.setdefault(
+            name, {"node": node, "static": set(), "call": call}
+        )
+        entry["static"] |= static
+
+    for node in ast.walk(module.tree):
+        # decorator form: @jax.jit / @jit / @partial(jax.jit, ...)
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                dname = dotted(dec)
+                if dname and last_seg(dname) == "jit":
+                    mark(node.name, node, set(), None)
+                elif isinstance(dec, ast.Call):
+                    fname = dotted(dec.func)
+                    if fname and last_seg(fname) == "jit":
+                        mark(node.name, node, static_names(dec, node), dec)
+                    elif fname and last_seg(fname) == "partial" and dec.args:
+                        inner = dotted(dec.args[0])
+                        if inner and last_seg(inner) in ("jit", "shard_map"):
+                            mark(node.name, node, static_names(dec, node), dec)
+        # wrapper form: g = jax.jit(f) / jax.jit(vmap(f)) / shard_map(f, ...)
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname and last_seg(fname) in ("jit", "shard_map"):
+                if node.args and isinstance(node.args[0], ast.Lambda):
+                    mark(f"<lambda:{node.lineno}>", node.args[0], set(), node)
+                for target, bound in _jit_target_names(node):
+                    if target in defs:
+                        mark(
+                            target, defs[target],
+                            static_names(node, defs[target]) | bound, node,
+                        )
+    return jitted
+
+
+def ct005_jit_hygiene(module: LintModule) -> List[Finding]:
+    """Jitted/shard_mapped functions must be pure and benchmarkable.
+
+    Side effects, wall-clock reads, and host randomness inside a traced
+    function run once at trace time and silently freeze into the compiled
+    program; a Python branch on a traced value raises (or worse, bakes in
+    one path) at runtime; an unhashable static arg fails at dispatch; and
+    timing a jitted call without synchronization measures dispatch, not
+    compute (jax dispatch is async).
+    """
+    out: List[Finding] = []
+    jitted = _collect_jitted(module)
+
+    for name, entry in jitted.items():
+        node = entry["node"]
+        static = entry["static"]
+        node_args = getattr(node, "args", None)
+        params = (
+            {a.arg for a in node_args.args} if node_args is not None else set()
+        ) - static - {"self"}
+
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Call):
+                cname = dotted(inner.func)
+                if cname is None:
+                    continue
+                if cname in _IMPURE_NAMES or any(
+                    cname.startswith(p) for p in _IMPURE_PREFIXES
+                ):
+                    out.append(Finding(
+                        "CT005", module.path, inner.lineno, inner.col_offset,
+                        f"impure call '{cname}' inside jitted function "
+                        f"'{name}': it executes once at trace time and "
+                        "freezes into the compiled program — hoist it out "
+                        "of the traced scope",
+                    ))
+            # Python control flow on a traced parameter
+            if isinstance(inner, (ast.If, ast.While)):
+                test = inner.test
+                flagged_name: Optional[str] = None
+                if isinstance(test, ast.Name) and test.id in params:
+                    flagged_name = test.id
+                elif isinstance(test, ast.Compare):
+                    is_identity = all(
+                        isinstance(op, (ast.Is, ast.IsNot))
+                        for op in test.ops
+                    )
+                    if not is_identity:
+                        for side in [test.left] + list(test.comparators):
+                            if isinstance(side, ast.Name) and side.id in params:
+                                flagged_name = side.id
+                                break
+                if flagged_name is not None:
+                    out.append(Finding(
+                        "CT005", module.path, inner.lineno, inner.col_offset,
+                        f"Python branch on traced value '{flagged_name}' "
+                        f"inside jitted function '{name}': tracing cannot "
+                        "evaluate it — use jnp.where/lax.cond, or mark the "
+                        "argument static",
+                    ))
+        # non-hashable static-arg defaults
+        if static and isinstance(node, ast.FunctionDef):
+            args = node.args
+            defaults = dict(
+                zip([a.arg for a in args.args][-len(args.defaults):],
+                    args.defaults)
+            ) if args.defaults else {}
+            for pname in sorted(static):
+                d = defaults.get(pname)
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    out.append(Finding(
+                        "CT005", module.path, d.lineno, d.col_offset,
+                        f"static arg '{pname}' of jitted function '{name}' "
+                        "defaults to an unhashable container: jit static "
+                        "args must be hashable (use a tuple / frozenset)",
+                    ))
+
+    # timing a jitted call without synchronization
+    clock_calls = {"time.perf_counter", "time.monotonic", "time.time"}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        clocks = [
+            c for c in calls_in(node)
+            if (dotted(c.func) or "") in clock_calls
+        ]
+        if len(clocks) < 2:
+            continue
+        calls_jitted = any(
+            last_seg(dotted(c.func)) in jitted for c in calls_in(node)
+        )
+        if not calls_jitted:
+            continue
+        try:
+            segment = ast.get_source_segment(module.source, node) or ""
+        except Exception:  # pragma: no cover - malformed coords
+            segment = ""
+        if any(marker in segment for marker in _SYNC_MARKERS):
+            continue
+        out.append(Finding(
+            "CT005", module.path, clocks[0].lineno, clocks[0].col_offset,
+            f"'{node.name}' times a jitted call without synchronization "
+            "(jax dispatch is async): call block_until_ready (or fetch a "
+            "scalar) before reading the clock",
+        ))
+    return out
+
+
+# =============================================================================
+# CT006 - drain safety
+# =============================================================================
+
+
+def _handler_catches_base(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    names: List[Optional[str]] = []
+    if isinstance(handler.type, ast.Tuple):
+        names = [last_seg(dotted(el)) for el in handler.type.elts]
+    else:
+        names = [last_seg(dotted(handler.type))]
+    return any(n in ("BaseException", "KeyboardInterrupt") for n in names)
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    """Only an actual ``raise`` statement counts — a handler that merely
+    *inspects* DrainInterrupt (``if isinstance(e, DrainInterrupt): log()``)
+    still swallows the drain."""
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def ct006_drain_safety(module: LintModule) -> List[Finding]:
+    """Preemption drains must reach the exit code, never a retry loop.
+
+    ``DrainInterrupt`` is a ``BaseException`` precisely so broad ``except
+    Exception`` recovery paths cannot swallow a preemption — but a bare
+    ``except:`` / ``except BaseException:`` without a re-raise still can,
+    ``os._exit`` outside the fault injector skips every flush the drain
+    protocol relies on, and an entry point that builds a task DAG without
+    mapping ``DrainInterrupt`` to ``REQUEUE_EXIT_CODE`` turns a graceful
+    eviction into a crash the scheduler won't requeue.
+    """
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ExceptHandler):
+            if _handler_catches_base(node) and not _handler_reraises(node):
+                what = (
+                    "bare 'except:'" if node.type is None
+                    else "'except BaseException'"
+                )
+                out.append(Finding(
+                    "CT006", module.path, node.lineno, node.col_offset,
+                    f"{what} swallows DrainInterrupt (a BaseException): a "
+                    "preemption drain dies here instead of reaching the "
+                    "requeue exit — catch Exception, or re-raise "
+                    "BaseException/DrainInterrupt",
+                ))
+        if isinstance(node, ast.Call):
+            if dotted(node.func) == "os._exit" and module.name != "faults.py":
+                out.append(Finding(
+                    "CT006", module.path, node.lineno, node.col_offset,
+                    "os._exit outside runtime/faults.py: skips marker/"
+                    "manifest flushes and the drain protocol — raise, or "
+                    "sys.exit through the entry point",
+                ))
+
+    # entry-point contract: __main__ + build() must speak the drain protocol
+    has_main_guard = any(
+        isinstance(n, ast.If)
+        and isinstance(n.test, ast.Compare)
+        and isinstance(n.test.left, ast.Name)
+        and n.test.left.id == "__name__"
+        for n in ast.walk(module.tree)
+    )
+    if has_main_guard:
+        build_calls = [
+            c for c in calls_in(module.tree)
+            if isinstance(c.func, ast.Name) and c.func.id == "build"
+        ]
+        if build_calls and not (
+            "DrainInterrupt" in module.source
+            and "REQUEUE_EXIT_CODE" in module.source
+        ):
+            c = build_calls[0]
+            out.append(Finding(
+                "CT006", module.path, c.lineno, c.col_offset,
+                "entry point runs a task DAG but never maps DrainInterrupt "
+                "to REQUEUE_EXIT_CODE: a SIGTERM mid-run exits as a crash "
+                "instead of a scheduler requeue — wrap the build in "
+                "'except DrainInterrupt: sys.exit(REQUEUE_EXIT_CODE)'",
+            ))
+    return out
+
+
+# =============================================================================
+# registry
+# =============================================================================
+
+RULES = {
+    "CT001": ct001_executor_contract,
+    "CT002": ct002_atomic_writes,
+    "CT003": ct003_lock_discipline,
+    "CT004": ct004_fault_site_coverage,
+    "CT005": ct005_jit_hygiene,
+    "CT006": ct006_drain_safety,
+}

@@ -565,8 +565,9 @@ def make_cluster_task(local_cls, flavor: str):
             "requeue_path": requeue_marker_path(self.tmp_folder, self.uid),
         }
         spec_path = os.path.join(cdir, f"{self.uid}.spec.json")
-        with open(spec_path, "w") as f:
-            json.dump(spec, f, indent=2, default=_spec_default)
+        # atomic (CT002): the spec is read by the remote worker over the
+        # shared filesystem; it must never observe a torn document
+        fu.atomic_write_json(spec_path, spec, default=_spec_default)
         script_path = os.path.join(cdir, f"{self.uid}.sh")
         out_path = os.path.join(cdir, f"{self.uid}.out")
         # the remote interpreter must find this package regardless of the

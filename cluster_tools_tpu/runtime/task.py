@@ -236,7 +236,13 @@ class BaseTask:
         fu.clean_up_for_retry(self.tmp_folder, self.uid)
         self.blocks_done()
 
-    def host_block_map(self, block_ids: Sequence[int], process) -> int:
+    def host_block_map(
+        self,
+        block_ids: Sequence[int],
+        process,
+        store_verify_fn=None,
+        blocking=None,
+    ) -> int:
         """Run ``process(block_id)`` for every block without a success
         marker, on the host IO thread pool, marking each success.
 
@@ -247,13 +253,68 @@ class BaseTask:
         is recorded in ``failures.json`` (same schema as the executor's,
         tracebacks capped) and a RuntimeError lists every failed block id.
         Returns the number of blocks run.
+
+        Hardened-executor knobs (docs/ROBUSTNESS.md, docs/ANALYSIS.md
+        CT001): the per-block retry budget (``io_retries`` /
+        ``io_backoff_s``), the hung-block deadline (``block_deadline_s`` /
+        ``watchdog_period_s``) and the sweep order (``block_schedule``) are
+        *derived from the task config* — call sites never re-plumb them
+        (the declarative-wiring direction of ROADMAP item 5).  The two
+        wirings that cannot be derived come from the call site: a
+        ``store_verify_fn(block)`` post-store integrity check (build it
+        with :func:`~cluster_tools_tpu.runtime.executor.region_verifier`;
+        verification failures retry, so a corrupt chunk is repaired by the
+        re-run while the writer still owns the block) and the ``blocking``
+        (which resolves block ids to geometry for the verifier and enables
+        the Morton locality schedule).  Resource-classified failures
+        (OOM/ENOSPC) skip the same-size retries — re-running the exact
+        allocation that just failed only burns the budget.
         """
-        from .supervision import DrainInterrupt, drain_reason, drain_requested
+        from .supervision import (
+            DrainInterrupt,
+            Watchdog,
+            drain_reason,
+            drain_requested,
+        )
+        from .executor import classify_resource_error, morton_order
+
+        try:
+            cfg = self.get_config()
+        except Exception:
+            cfg = {}
+        io_retries = max(0, int(cfg.get("io_retries", 2) or 0))
+        io_backoff = float(cfg.get("io_backoff_s", 0.05) or 0.0)
+        deadline = float(cfg.get("block_deadline_s") or 0.0)
+        period = cfg.get("watchdog_period_s")
+        schedule = str(cfg.get("block_schedule") or "morton")
 
         done = set(self.blocks_done())
         todo = [b for b in block_ids if b not in done]
+        if blocking is not None and schedule == "morton":
+            # same Z-order locality scheduling as the device executor:
+            # consecutive blocks share boundary chunks while they are
+            # still resident in the decompressed-chunk cache
+            todo = [
+                int(b.block_id)
+                for b in morton_order([blocking.get_block(i) for i in todo])
+            ]
         errors: List[tuple] = []
         skipped_for_drain: List[int] = []
+        hung: Dict[int, str] = {}
+        completed: set = set()
+        watchdog: Optional[Watchdog] = None
+        if deadline > 0:
+            def _on_hung(token, info, elapsed):
+                hung[int(info["block_id"])] = (
+                    f"block exceeded block_deadline_s={deadline:g}s on the "
+                    f"host path ({elapsed:.2f}s elapsed)"
+                )
+
+            watchdog = Watchdog(
+                deadline,
+                float(period) if period else max(0.02, deadline / 4.0),
+                _on_hung,
+            ).start()
 
         def wrapped(block_id):
             if drain_requested():
@@ -261,45 +322,80 @@ class BaseTask:
                 # ones already processed keep their markers for the resume
                 skipped_for_drain.append(block_id)
                 return
-            try:
-                process(block_id)
-                self.log_block_success(block_id)
-            except Exception:
-                errors.append(
-                    (block_id, fu.cap_traceback(traceback.format_exc()))
-                )
+            last_tb, attempts = None, 0
+            for k in range(io_retries + 1):
+                attempts = k + 1
+                if watchdog is not None:
+                    watchdog.register(
+                        (block_id, k), block_id=int(block_id), stage="host"
+                    )
+                try:
+                    process(block_id)
+                    if store_verify_fn is not None and blocking is not None:
+                        # post-store integrity check: a corruption raises,
+                        # and the retry re-runs process -> re-writes the
+                        # block -> repairs the corrupt chunk
+                        store_verify_fn(blocking.get_block(block_id))
+                except Exception as e:
+                    last_tb = fu.cap_traceback(traceback.format_exc())
+                    if classify_resource_error(e) is not None:
+                        break  # same-size retries re-run the failed alloc
+                    if k < io_retries:
+                        time.sleep(fu.backoff_delay(k, io_backoff, 5.0))
+                else:
+                    completed.add(block_id)
+                    self.log_block_success(block_id)
+                    return
+                finally:
+                    if watchdog is not None:
+                        watchdog.clear((block_id, k))
+            errors.append((block_id, last_tb, attempts))
 
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
-            list(pool.map(wrapped, todo))
-        if errors:
-            fu.record_failures(
-                self.failures_path,
-                self.uid,
-                [
-                    {
-                        "block_id": int(b),
-                        "sites": {"host": 1},
-                        "error": tb,
-                        "quarantined": False,
-                        "resolved": False,
-                    }
-                    for b, tb in sorted(errors)
-                ],
-            )
+        try:
+            with ThreadPoolExecutor(max_workers=max(1, self.max_jobs)) as pool:
+                list(pool.map(wrapped, todo))
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+        records = [
+            {
+                "block_id": int(b),
+                "sites": {"host": int(attempts)},
+                "error": tb,
+                "quarantined": False,
+                "resolved": False,
+            }
+            for b, tb, attempts in sorted(errors)
+        ]
+        records += [
+            {
+                "block_id": int(b),
+                "sites": {"hung": 1},
+                "error": msg,
+                "quarantined": False,
+                # a hung block that eventually finished (and markered) is
+                # resolved; one that never did is the operator's to chase
+                "resolved": b in completed,
+            }
+            for b, msg in sorted(hung.items())
+            if not any(b == eb for eb, _, _ in errors)
+        ]
+        if records:
+            fu.record_failures(self.failures_path, self.uid, records)
         if skipped_for_drain:
             # a drain outranks block errors: the requeued run retries them
             # anyway, and burning task-level retries on a preemption would
             # turn a graceful eviction into a spurious failure
             raise DrainInterrupt(
                 drain_reason() or "drain requested",
-                skipped_for_drain + [b for b, _ in errors],
+                skipped_for_drain + [b for b, _, _ in errors],
             )
         if errors:
-            failed_ids = sorted(b for b, _ in errors)
+            failed_ids = sorted(b for b, _, _ in errors)
             detail = "\n".join(
-                f"-- block {b} --\n{tb}" for b, tb in errors[:5]
+                f"-- block {b} --\n{tb}" for b, tb, _ in errors[:5]
             )
             raise RuntimeError(
                 f"{self.task_name}: {len(errors)}/{len(todo)} blocks failed "

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -103,7 +104,22 @@ class CopyVolumeBase(BaseTask):
             )
             out[out_bb] = _convert(data)
 
-        n = self.host_block_map(block_ids, process)
+        def _out_bb(block):
+            # the region process() actually wrote: ROI-clipped, shifted to
+            # the output's origin — verifying block.bb would miss the digest
+            bb = tuple(
+                slice(max(b.start, lo), min(b.stop, hi))
+                for b, lo, hi in zip(block.bb, roi_lo, roi_hi)
+            )
+            return tuple(
+                slice(b.start - s, b.stop - s) for b, s in zip(bb, shift)
+            )
+
+        n = self.host_block_map(
+            block_ids, process,
+            store_verify_fn=region_verifier(out, bb_of=_out_bb),
+            blocking=blocking,
+        )
         return {"n_blocks": n, "shape": list(out_shape), "dtype": dtype}
 
 

@@ -28,6 +28,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 from ..ops.rag import block_rag
 from ..runtime.task import BaseTask
+from ..utils import function_utils as fu
 
 
 def _scan_all(task, block_ids, process):
@@ -78,10 +79,10 @@ class CheckSubGraphsBase(BaseTask):
 
         _scan_all(self, block_ids, process)
         report = {"n_blocks": len(block_ids), "violations": bad}
-        with open(
-            os.path.join(self.tmp_folder, "check_sub_graphs.json"), "w"
-        ) as f:
-            json.dump(report, f, indent=2)
+        # atomic (CT002): the report is a shared tmp_folder manifest
+        fu.atomic_write_json(
+            os.path.join(self.tmp_folder, "check_sub_graphs.json"), report
+        )
         if bad and not cfg.get("warn_only", False):
             raise RuntimeError(
                 f"sub-graph check failed for {len(bad)} blocks "
@@ -135,8 +136,10 @@ class CheckBlocksBase(BaseTask):
 
         _scan_all(self, block_ids, process)
         report = {"n_blocks": len(block_ids), "violations": bad}
-        with open(os.path.join(self.tmp_folder, "check_blocks.json"), "w") as f:
-            json.dump(report, f, indent=2)
+        # atomic (CT002): the report is a shared tmp_folder manifest
+        fu.atomic_write_json(
+            os.path.join(self.tmp_folder, "check_blocks.json"), report
+        )
         if bad and not cfg.get("warn_only", False):
             raise RuntimeError(
                 f"block check failed for {len(bad)} blocks (see check_blocks.json)"

@@ -11,6 +11,7 @@ from typing import List, Sequence
 
 import numpy as np
 
+from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -97,7 +98,10 @@ class DownscalingBase(BaseTask):
             )
             out[block.bb] = _reduce_block(inp[in_bb], factor, mode).astype(dtype)
 
-        n = self.host_block_map(block_ids, process)
+        n = self.host_block_map(
+            block_ids, process,
+            store_verify_fn=region_verifier(out), blocking=blocking,
+        )
         # per-step factor; workflows overwrite with the cumulative factor
         out.update_attrs(downsamplingFactors=list(factor), downscalingMode=mode)
         return {"n_blocks": n, "out_shape": list(out_shape)}

@@ -24,6 +24,7 @@ from typing import Dict, Tuple
 import numpy as np
 
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils import function_utils as fu
 from .node_labels import BlockNodeLabelsBase, _nl_dir
 from ..utils.volume_utils import blocks_in_volume, file_reader
 
@@ -127,8 +128,10 @@ class MeasuresBase(BaseTask):
         else:
             uv, merged = pairs, counts
         metrics = contingency_metrics(uv, merged)
-        with open(os.path.join(self.tmp_folder, "evaluation.json"), "w") as f:
-            json.dump(metrics, f, indent=2)
+        # atomic (CT002): the report is a shared tmp_folder manifest
+        fu.atomic_write_json(
+            os.path.join(self.tmp_folder, "evaluation.json"), metrics
+        )
         return metrics
 
 

@@ -24,6 +24,7 @@ from typing import List, Sequence
 
 import numpy as np
 
+from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -122,7 +123,10 @@ class CreateMultisetBase(BaseTask):
             )
             out[block.bb] = argmax
 
-        n = self.host_block_map(block_ids, process)
+        n = self.host_block_map(
+            block_ids, process,
+            store_verify_fn=region_verifier(out), blocking=blocking,
+        )
         out.update_attrs(
             downsamplingFactors=list(factor), isLabelMultiset=True
         )

@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops.mws import mutex_watershed
+from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -93,7 +94,15 @@ class MwsBlocksBase(BaseTask):
             )
             out[block.bb] = glob
 
-        n = self.host_block_map(block_ids, process)
+        # hardened host path (docs/ANALYSIS.md CT001): retries, deadline
+        # watchdog and Morton schedule come from the task config inside
+        # host_block_map; the store verifier re-reads each block's written
+        # region against its digest sidecar so a corrupt chunk is repaired
+        # by the retry re-run while this task still owns the block
+        n = self.host_block_map(
+            block_ids, process,
+            store_verify_fn=region_verifier(out), blocking=blocking,
+        )
         return {"n_blocks": n}
 
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import numpy as np
 
+from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -354,7 +355,10 @@ class FillHolesWriteBase(BaseTask):
             filled = apply_assignment_np(cc, keys, values)
             out[bb] = np.where(seg > 0, seg, filled)
 
-        n = self.host_block_map(block_ids, process)
+        n = self.host_block_map(
+            block_ids, process,
+            store_verify_fn=region_verifier(out), blocking=blocking,
+        )
         return {"n_blocks": n}
 
 

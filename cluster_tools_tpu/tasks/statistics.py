@@ -9,6 +9,7 @@ import os
 import numpy as np
 
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
+from ..utils import function_utils as fu
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
 
@@ -81,8 +82,10 @@ class MergeStatisticsBase(BaseTask):
             "min": float(parts[:, 3].min()),
             "max": float(parts[:, 4].max()),
         }
-        with open(os.path.join(self.tmp_folder, "statistics.json"), "w") as f:
-            json.dump(stats, f, indent=2)
+        # atomic (CT002): the report is a shared tmp_folder manifest
+        fu.atomic_write_json(
+            os.path.join(self.tmp_folder, "statistics.json"), stats
+        )
         return stats
 
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -56,7 +57,13 @@ class ThresholdBase(BaseTask):
             bb = blocking.get_block(block_id).bb
             out[bb] = ops[mode](inp[bb]).astype(np.uint8)
 
-        n = self.host_block_map(block_ids, process)
+        # hardened host path (docs/ANALYSIS.md CT001): config-derived
+        # retries/deadline/schedule plus per-block post-store integrity
+        # verification against the digest sidecars
+        n = self.host_block_map(
+            block_ids, process,
+            store_verify_fn=region_verifier(out), blocking=blocking,
+        )
         return {"n_blocks": n}
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -188,7 +189,10 @@ class AffineTransformBase(BaseTask):
                 samples = np.clip(np.round(samples), info.min, info.max)
             out[bb] = samples.astype(inp.dtype)
 
-        n = self.host_block_map(block_ids, process)
+        n = self.host_block_map(
+            block_ids, process,
+            store_verify_fn=region_verifier(out), blocking=blocking,
+        )
         return {"n_blocks": n, "out_shape": list(out_shape), "order": order}
 
 

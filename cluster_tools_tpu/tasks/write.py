@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import numpy as np
 
+from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -60,7 +61,10 @@ class WriteBase(BaseTask):
             labels = inp[block.bb]
             out[block.bb] = apply_assignment_np(labels, keys, values)
 
-        n = self.host_block_map(block_ids, process)
+        n = self.host_block_map(
+            block_ids, process,
+            store_verify_fn=region_verifier(out), blocking=blocking,
+        )
         return {"n_blocks": n}
 
 

@@ -26,8 +26,13 @@ def _default(o):
 
 def dump_config(path: str, config: Dict[str, Any]):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
+    # atomic (docs/ANALYSIS.md CT002): configs live in a shared config_dir
+    # read by concurrent cluster jobs — a kill mid-write must leave the old
+    # config or nothing, never half a JSON document
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(config, f, indent=2, sort_keys=True, default=_default)
+    os.replace(tmp, path)
 
 
 def load_config(path: str) -> Dict[str, Any]:

@@ -3,6 +3,7 @@
 Usage::
 
     python scripts/failures_report.py <tmp_folder | failures.json>
+    python scripts/failures_report.py --lint <lint.json | ->
     make failures-report TMP=/path/to/tmp_folder
 
 Per task: block counts, per-site failed-attempt totals, resolutions
@@ -14,6 +15,11 @@ When the run recorded chunk-IO metrics (``io_metrics.json``, written next
 to ``failures.json`` by the task runtime — docs/PERFORMANCE.md "Chunk-aware
 I/O"), a second section renders each task's cache hit rate, bytes read from
 storage vs bytes served, and the bytes the cache saved.
+
+``--lint`` renders a ctlint findings document (docs/ANALYSIS.md) instead:
+``python -m cluster_tools_tpu.lint --json > lint.json`` then point this at
+it (or pipe with ``-``).  Exit code 1 when the document carries findings —
+same contract as the linter itself.
 """
 
 from __future__ import annotations
@@ -159,7 +165,57 @@ def format_report(path, version, summaries, io_tasks=None) -> str:
     return "\n".join(lines)
 
 
+def format_lint_report(doc) -> str:
+    """Render a ctlint ``--json`` document: per-rule counts, findings
+    grouped by file, and the suppression debt."""
+    findings = doc.get("findings", []) or []
+    counts = doc.get("counts", {}) or {}
+    lines = [
+        f"ctlint report (schema v{doc.get('version')}): "
+        f"{len(findings)} finding(s) in {doc.get('n_files', '?')} file(s)"
+    ]
+    if counts:
+        lines.append(
+            "  by rule: " + ", ".join(
+                f"{rule}={n}" for rule, n in sorted(counts.items())
+            )
+        )
+    if doc.get("n_suppressed"):
+        lines.append(
+            f"  suppressed (visible debt): {int(doc['n_suppressed'])}"
+        )
+    by_file = defaultdict(list)
+    for f in findings:
+        by_file[str(f.get("file"))].append(f)
+    for path in sorted(by_file):
+        lines.append("")
+        lines.append(f"[{path}]")
+        for f in sorted(by_file[path], key=lambda r: int(r.get("line", 0))):
+            lines.append(
+                f"  {f.get('line')}:{f.get('col')} {f.get('rule')} "
+                f"{f.get('message')}"
+            )
+    if not findings:
+        lines.append("  clean — every contract holds")
+    return "\n".join(lines)
+
+
 def main(argv) -> int:
+    if len(argv) > 1 and argv[1] == "--lint":
+        if len(argv) != 3:
+            print(__doc__.strip(), file=sys.stderr)
+            return 2
+        try:
+            raw = (
+                sys.stdin.read() if argv[2] == "-"
+                else open(argv[2]).read()
+            )
+            doc = json.loads(raw)
+        except (OSError, ValueError) as e:
+            print(f"cannot read lint document: {e}", file=sys.stderr)
+            return 2
+        print(format_lint_report(doc))
+        return 1 if doc.get("findings") else 0
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2

@@ -29,7 +29,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 from cluster_tools_tpu.runtime import faults  # noqa: E402
+from cluster_tools_tpu.runtime.supervision import (  # noqa: E402
+    REQUEUE_EXIT_CODE,
+    DrainInterrupt,
+)
 from cluster_tools_tpu.runtime.task import build, get_task_cls  # noqa: E402
+from cluster_tools_tpu.utils import function_utils as fu  # noqa: E402
 from cluster_tools_tpu.utils.volume_utils import file_reader  # noqa: E402
 from tests.helpers import stub_slurm_bins  # noqa: E402
 
@@ -42,22 +47,21 @@ def main():
     bindir = stub_slurm_bins(os.path.join(root, "fakebin"))
     os.environ["PATH"] = f"{bindir}:{os.environ['PATH']}"
 
-    with open(os.path.join(config_dir, "global.config"), "w") as f:
-        json.dump(
-            {
-                "block_shape": [8, 8, 8],
-                # supervision knobs: the batch script heartbeats the moment
-                # the job starts, so 6 s of silence while the scheduler
-                # claims RUNNING means the job is lost
-                "heartbeat_interval_s": 0.3,
-                "heartbeat_timeout_s": 6.0,
-                "max_resubmits": 2,
-                "poll_interval_s": 0.3,
-                "result_grace_s": 2.0,
-                "submit_timeout_s": 300,
-            },
-            f,
-        )
+    fu.atomic_write_json(
+        os.path.join(config_dir, "global.config"),
+        {
+            "block_shape": [8, 8, 8],
+            # supervision knobs: the batch script heartbeats the moment
+            # the job starts, so 6 s of silence while the scheduler
+            # claims RUNNING means the job is lost
+            "heartbeat_interval_s": 0.3,
+            "heartbeat_timeout_s": 6.0,
+            "max_resubmits": 2,
+            "poll_interval_s": 0.3,
+            "result_grace_s": 2.0,
+            "submit_timeout_s": 300,
+        },
+    )
 
     # synthetic boundary map with a clear membrane
     rng = np.random.default_rng(7)
@@ -93,7 +97,13 @@ def main():
     print(f"demo workspace: {root}")
     print("submitting watershed to the stub scheduler with one injected "
           "job loss ...\n")
-    ok = build([task])
+    try:
+        ok = build([task])
+    except DrainInterrupt as e:
+        # drain safety (CT006): a SIGTERM mid-demo exits with the requeue
+        # code, same protocol as the production entry points
+        print(f"DRAINED ({e.reason}); exiting {REQUEUE_EXIT_CODE}")
+        return REQUEUE_EXIT_CODE
 
     print("=" * 72)
     print("supervisor resubmission log "

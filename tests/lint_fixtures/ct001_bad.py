@@ -1,0 +1,19 @@
+"""CT001 fixture: executor call sites that drop the hardening knobs."""
+
+from cluster_tools_tpu.runtime.executor import BlockwiseExecutor, region_verifier
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+
+def unhardened_map_blocks(kernel, blocks, load, store, self):
+    # missing block_deadline_s / watchdog_period_s / store_verify_fn /
+    # schedule / failures_path / task_name
+    executor = BlockwiseExecutor(target="local")  # missing io_threads/max_retries
+    executor.map_blocks(kernel, blocks, load, store)
+
+
+def unhardened_host_map(self, cfg, blocking, block_ids, process):
+    out = file_reader(cfg["output_path"]).require_dataset(
+        cfg["output_key"], shape=(8, 8, 8), chunks=(4, 4, 4), dtype="uint8"
+    )
+    del out
+    self.host_block_map(block_ids, process)  # missing store_verify_fn/blocking

@@ -1,0 +1,20 @@
+"""CT002 fixture: torn-write hazards on shared JSON state."""
+
+import json
+
+
+def torn_manifest(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)  # kill here -> half a manifest
+
+
+def torn_dumps(path, doc):
+    with open(path, "w") as f:
+        f.write(json.dumps(doc))
+
+
+def str_replace_is_not_atomic(path, doc):
+    # regression: str.replace must NOT count as os.replace evidence
+    path = path.replace("\\", "/")
+    with open(path, "w") as f:
+        json.dump(doc, f)

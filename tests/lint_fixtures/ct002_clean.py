@@ -1,0 +1,17 @@
+"""CT002 fixture: crash-safe JSON writes (zero findings)."""
+
+import json
+import os
+
+from cluster_tools_tpu.utils import function_utils as fu
+
+
+def atomic_inline(path, doc):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+
+
+def atomic_helper(path, doc):
+    fu.atomic_write_json(path, doc)

@@ -1,0 +1,43 @@
+"""CT003 fixture: lock-order cycle, blocking + IO under locks."""
+
+import json
+import threading
+import time
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+dispatch_lock = threading.Lock()
+
+
+def takes_a_then_b():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def takes_b_then_a():
+    with lock_b:
+        with lock_a:  # opposite order: deadlock with takes_a_then_b
+            pass
+
+
+def sleeps_under_lock():
+    with lock_a:
+        time.sleep(1.0)  # blocks every thread contending for lock_a
+
+
+def waits_under_lock(fut):
+    with lock_b:
+        return fut.result()  # a stuck future freezes the lock
+
+
+def io_under_dispatch_lock(path, doc):
+    with dispatch_lock:
+        with open(path, "w") as f:  # filesystem IO under the hot lock
+            json.dump(doc, f)
+
+
+def indirect_cycle():
+    # interprocedural edge: holds lock_b, calls a function acquiring lock_a
+    with lock_b:
+        takes_a_then_b()

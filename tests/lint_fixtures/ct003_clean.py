@@ -1,0 +1,37 @@
+"""CT003 fixture: consistent lock order, waits staged outside (clean)."""
+
+import threading
+import time
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+dispatch_lock = threading.Lock()
+
+
+def takes_a_then_b():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def also_a_then_b():
+    with lock_a, lock_b:
+        pass
+
+
+def wait_outside_lock(fut):
+    value = fut.result()  # settle the future first ...
+    with lock_a:
+        return value  # ... then take the lock for the cheap part
+
+
+def sleep_outside_lock():
+    with lock_b:
+        snapshot = 1
+    time.sleep(0.01)
+    return snapshot
+
+
+def dispatch_only(batched_kernel, arrays):
+    with dispatch_lock:
+        return batched_kernel(*arrays)  # async dispatch: returns promptly

@@ -1,0 +1,30 @@
+"""CT004 fixture: chaos-blind storage boundary + typo'd fault site."""
+
+import numpy as np
+
+from cluster_tools_tpu.io.containers import _hang, _inject
+
+
+class NakedDataset:
+    """A dataset whose write path carries no injection hook."""
+
+    def __getitem__(self, bb):
+        bid = _inject("io_read")
+        _hang("io_read", bid)
+        return np.zeros((4, 4, 4))
+
+    def __setitem__(self, bb, value):
+        # no _inject/maybe_fail: io_write faults can never fire here
+        self._store(bb, value)
+
+    def read_async(self, bb):
+        _inject("io_raed")  # typo'd site: this hook never matches a spec
+        return self[bb]
+
+    def write_async(self, bb, value):
+        bid = _inject("io_write", voxels=value.size)
+        _hang("io_write", bid)
+        self._store(bb, value)
+
+    def _store(self, bb, value):
+        pass

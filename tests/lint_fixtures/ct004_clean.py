@@ -1,0 +1,30 @@
+"""CT004 fixture: every boundary hooked, sites from the registry."""
+
+import numpy as np
+
+from cluster_tools_tpu.io.containers import _hang, _inject
+
+
+class HookedDataset:
+    def __getitem__(self, bb):
+        bid = _inject("io_read")
+        _hang("io_read", bid)
+        return np.zeros((4, 4, 4))
+
+    def __setitem__(self, bb, value):
+        bid = _inject("io_write", voxels=value.size)
+        _hang("io_write", bid)
+        self._store(bb, value)
+
+    def read_async(self, bb):
+        bid = _inject("io_read")
+        _hang("io_read", bid)
+        return self[bb]
+
+    def write_async(self, bb, value):
+        bid = _inject("io_write", voxels=value.size)
+        _hang("io_write", bid)
+        self._store(bb, value)
+
+    def _store(self, bb, value):
+        pass

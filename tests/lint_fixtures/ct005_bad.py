@@ -1,0 +1,35 @@
+"""CT005 fixture: impure jitted code, traced branches, bad statics,
+unsynchronized benchmarking."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def impure_kernel(x):
+    t0 = time.time()  # frozen at trace time, not per call
+    noise = np.random.rand(*x.shape)  # host randomness baked into the trace
+    print("tracing!", t0)  # side effect
+    return x + jnp.asarray(noise)
+
+
+@jax.jit
+def traced_branch(x, threshold):
+    if threshold > 0:  # Python branch on a traced value
+        return x * 2
+    return x
+
+
+@partial(jax.jit, static_argnames=("weights",))
+def bad_static(x, weights=[1.0, 2.0]):  # unhashable static default
+    return x * weights[0]
+
+
+def bench_without_sync(x):
+    t0 = time.perf_counter()
+    y = impure_kernel(x)  # dispatch is async: this measures enqueue
+    return y, time.perf_counter() - t0

@@ -1,0 +1,45 @@
+"""CT005 fixture: pure jitted code, static branches, synced timing."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure_kernel(x):
+    key = jax.random.PRNGKey(0)  # traced randomness, not host randomness
+    return x + jax.random.normal(key, x.shape)
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def static_branch(x, threshold=0.5):
+    if threshold > 0:  # static arg: the branch resolves at trace time
+        return x * 2
+    return x
+
+
+def reshard_axis(x, axis_name, from_axis, to_axis):
+    # the partial-bound args below are compile-time constants, so this
+    # Python branch is legal when wrapped (regression for a false
+    # positive on parallel/reshard.py)
+    if from_axis == to_axis:
+        return x
+    return x
+
+
+def build_resharder(mesh_fn):
+    return mesh_fn(
+        partial(reshard_axis, axis_name="sp", from_axis=0, to_axis=2)
+    )
+
+
+wrapped = jax.jit(partial(reshard_axis, axis_name="sp", from_axis=0, to_axis=2))
+
+
+def bench_with_sync(x):
+    t0 = time.perf_counter()
+    y = pure_kernel(x)
+    jax.block_until_ready(y)  # measure compute, not dispatch
+    return y, time.perf_counter() - t0

@@ -1,0 +1,42 @@
+"""CT006 fixture: drain-swallowing handlers, raw os._exit, deaf entry
+point."""
+
+import os
+import sys
+
+from cluster_tools_tpu.runtime.task import build
+
+
+def swallow_everything(task):
+    try:
+        task.run()
+    except:  # bare except: eats DrainInterrupt, drain never reaches exit
+        pass
+
+
+def swallow_base(task):
+    try:
+        task.run()
+    except BaseException:
+        return None  # no re-raise: preemption becomes a silent no-op
+
+
+def inspect_but_swallow(task, DrainInterrupt, log):
+    # regression: mentioning DrainInterrupt without raising still swallows
+    try:
+        task.run()
+    except BaseException as e:
+        if isinstance(e, DrainInterrupt):
+            log("drained")  # ... and then eats it
+
+
+def hard_exit():
+    os._exit(3)  # skips marker/manifest flushes
+
+
+def main():
+    return 0 if build([]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""CT006 fixture: drain-correct handlers and entry point (clean)."""
+
+import sys
+
+from cluster_tools_tpu.runtime.supervision import (
+    REQUEUE_EXIT_CODE,
+    DrainInterrupt,
+)
+from cluster_tools_tpu.runtime.task import build
+
+
+def narrow_handler(task):
+    try:
+        task.run()
+    except Exception:  # DrainInterrupt is a BaseException: it passes through
+        return None
+
+
+def base_with_reraise(task):
+    try:
+        task.run()
+    except BaseException:
+        raise  # broad cleanup is fine when the drain keeps propagating
+
+
+def main():
+    try:
+        ok = build([])
+    except DrainInterrupt:
+        return REQUEUE_EXIT_CODE
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,316 @@
+"""ctlint (docs/ANALYSIS.md): per-rule fixtures, suppressions, JSON
+schema, the repo-wide clean gate, and regression tests for the findings
+the suite surfaced and this PR fixed (tier-1; pure AST, no device)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cluster_tools_tpu.lint import RULES, findings_to_json, run_lint
+from cluster_tools_tpu.lint.__main__ import default_paths, main as lint_main
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_fixture(fname, **kw):
+    return run_lint([os.path.join(FIXDIR, fname)], **kw)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- every rule fires on its fixture and stays quiet on the clean twin --------
+
+ALL_RULES = sorted(RULES)
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_rule_fires_on_bad_fixture(rule):
+    findings, _ = lint_fixture(f"{rule.lower()}_bad.py")
+    mine = [f for f in findings if f.rule == rule]
+    assert mine, f"{rule} did not fire on its fixture"
+    for f in mine:
+        assert f.line > 0 and f.message
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_rule_quiet_on_clean_fixture(rule):
+    findings, _ = lint_fixture(f"{rule.lower()}_clean.py")
+    assert [f for f in findings if f.rule == rule] == []
+
+
+def test_ct001_covers_all_three_call_forms():
+    findings, _ = lint_fixture("ct001_bad.py")
+    msgs = "\n".join(f.message for f in findings if f.rule == "CT001")
+    for form in ("map_blocks", "BlockwiseExecutor", "host_block_map"):
+        assert form in msgs
+
+
+def test_ct003_finds_cycle_blocking_and_hot_io():
+    findings, _ = lint_fixture("ct003_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT003"]
+    assert any("lock-order cycle" in m for m in msgs)
+    assert any("time.sleep" in m for m in msgs)
+    assert any("fut.result" in m for m in msgs)
+    assert any("hot lock 'dispatch_lock'" in m for m in msgs)
+
+
+def test_ct004_typo_site_and_unhooked_boundary():
+    findings, _ = lint_fixture("ct004_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT004"]
+    assert any("io_raed" in m for m in msgs)
+    assert any("__setitem__" in m for m in msgs)
+
+
+def test_ct005_branch_static_and_timing():
+    findings, _ = lint_fixture("ct005_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT005"]
+    assert any("branch on traced value" in m for m in msgs)
+    assert any("unhashable container" in m for m in msgs)
+    assert any("without synchronization" in m for m in msgs)
+    assert any("impure call" in m for m in msgs)
+
+
+def test_ct006_all_violation_classes():
+    findings, _ = lint_fixture("ct006_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT006"]
+    assert any("bare 'except:'" in m for m in msgs)
+    assert any("except BaseException" in m for m in msgs)
+    assert any("os._exit" in m for m in msgs)
+    assert any("REQUEUE_EXIT_CODE" in m for m in msgs)
+
+
+# -- suppressions -------------------------------------------------------------
+
+
+def test_suppression_counts_not_reports():
+    findings, stats = lint_fixture("ct002_suppressed.py")
+    assert [f for f in findings if f.rule == "CT002"] == []
+    assert stats["n_suppressed"] == 2  # debt stays visible
+
+
+def test_rule_selection_and_unknown_rule():
+    findings, _ = lint_fixture("ct006_bad.py", select=["CT002"])
+    assert rules_of(findings) <= {"CT002"}
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint_fixture("ct006_bad.py", select=["CT999"])
+
+
+def test_syntax_error_is_ct000(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings, _ = run_lint([str(bad)])
+    assert rules_of(findings) == {"CT000"}
+
+
+# -- output schema ------------------------------------------------------------
+
+
+def test_json_document_schema():
+    findings, stats = lint_fixture("ct002_bad.py")
+    doc = findings_to_json(findings, stats)
+    assert doc["version"] == 1
+    assert doc["n_files"] == 1
+    assert doc["counts"]["CT002"] == len(findings)
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "file", "line", "col", "message"}
+        assert isinstance(f["line"], int) and f["rule"].startswith("CT")
+
+
+def test_cli_exit_codes_and_json(capsys):
+    rc = lint_main([os.path.join(FIXDIR, "ct002_bad.py"), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["counts"]["CT002"] >= 1
+    rc = lint_main([os.path.join(FIXDIR, "ct002_clean.py")])
+    assert rc == 0
+    assert lint_main(["--list-rules"]) == 0
+    assert "CT003" in capsys.readouterr().out
+    assert lint_main(["--rules", "CT999"]) == 2
+
+
+def test_failures_report_renders_lint_json(tmp_path):
+    findings, stats = lint_fixture("ct002_bad.py")
+    doc_path = tmp_path / "lint.json"
+    doc_path.write_text(json.dumps(findings_to_json(findings, stats)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "failures_report.py"),
+         "--lint", str(doc_path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1  # findings present -> linter contract
+    assert "CT002=3" in proc.stdout and "ct002_bad.py" in proc.stdout
+
+
+# -- the repo-wide clean gate -------------------------------------------------
+
+
+def test_repo_lints_clean():
+    """The real codebase satisfies every contract (the acceptance gate:
+    ``make lint`` exits 0).  A finding here means a regression dropped one
+    of the PR 2-5 guarantees — fix it, don't suppress it."""
+    findings, stats = run_lint(default_paths())
+    assert stats["n_files"] > 80  # the walk really covered the repo
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_hardened_longtail_tasks_pass_ct001_unsuppressed():
+    """The two newly-hardened long-tail tasks (ROADMAP item 5, first
+    step) pass the executor contract on merit, not via opt-out."""
+    pkg = os.path.join(REPO_ROOT, "cluster_tools_tpu", "tasks")
+    for fname in ("mutex_watershed.py", "thresholded_components.py"):
+        path = os.path.join(pkg, fname)
+        findings, _ = run_lint([path])
+        assert [f for f in findings if f.rule == "CT001"] == []
+        assert "ctlint: disable=CT001" not in open(path).read()
+
+
+# -- regressions for the findings this PR fixed -------------------------------
+
+
+def test_dump_config_is_atomic(tmp_path):
+    """CT002 fix: config writes go through temp + os.replace."""
+    from cluster_tools_tpu.utils.task_utils import dump_config
+
+    path = tmp_path / "cfg" / "global.config"
+    dump_config(str(path), {"b": 2, "a": 1})
+    assert json.loads(path.read_text()) == {"a": 1, "b": 2}
+    leftovers = [p for p in os.listdir(path.parent) if ".tmp" in p]
+    assert leftovers == []
+
+
+def test_cli_maps_drain_to_requeue_exit(tmp_path, monkeypatch):
+    """CT006 fix: a drain mid-DAG exits the CLI with REQUEUE_EXIT_CODE."""
+    from cluster_tools_tpu import cli
+    from cluster_tools_tpu.runtime import task as task_mod
+    from cluster_tools_tpu.runtime.supervision import (
+        REQUEUE_EXIT_CODE,
+        DrainInterrupt,
+    )
+
+    def draining_build(tasks, rerun=False):
+        raise DrainInterrupt("received SIGTERM", [1, 2])
+
+    monkeypatch.setattr(task_mod, "build", draining_build)
+    cfg = tmp_path / "run.json"
+    cfg.write_text(json.dumps({
+        "tmp_folder": str(tmp_path / "tmp"),
+        "config_dir": str(tmp_path / "tmp"),
+        "params": {"input_path": "x", "input_key": "k",
+                   "output_path": "y", "output_key": "k"},
+    }))
+    rc = cli.main(["run", "relabel", "--config", str(cfg)])
+    assert rc == REQUEUE_EXIT_CODE
+
+
+def test_debug_reports_written_atomically(tmp_path, monkeypatch):
+    """CT002 fix: a torn half-written report can never be observed —
+    the report lands via os.replace, so mid-write the path either does
+    not exist or parses."""
+    from cluster_tools_tpu.utils import function_utils as fu
+
+    calls = []
+    real_replace = os.replace
+
+    def spy_replace(src, dst):
+        calls.append(dst)
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", spy_replace)
+    target = tmp_path / "statistics.json"
+    fu.atomic_write_json(str(target), {"count": 1})
+    assert str(target) in calls
+    assert json.loads(target.read_text()) == {"count": 1}
+
+
+# -- hardened host_block_map (the CT001 machinery itself) ---------------------
+
+
+def _task_cls():
+    from cluster_tools_tpu.runtime.task import BaseTask
+
+    class T(BaseTask):
+        task_name = "lint_hosttask"
+
+        def __init__(self, *a, **kw):
+            self.body = kw.pop("body")
+            self.knobs = kw.pop("knobs", {})
+            super().__init__(*a, **kw)
+
+        def run_impl(self):
+            return {"n": self.host_block_map(
+                range(self.knobs.pop("n_blocks", 4)),
+                self.body, **self.knobs
+            )}
+
+    return T
+
+
+def test_host_block_map_retries_transient_failures(tmp_path):
+    """A block that fails once recovers within the config retry budget
+    (io_retries default 2) instead of failing the task."""
+    attempts = {}
+
+    def flaky(block_id):
+        attempts[block_id] = attempts.get(block_id, 0) + 1
+        if block_id == 1 and attempts[block_id] == 1:
+            raise OSError("transient storage hiccup")
+
+    t = _task_cls()(str(tmp_path / "tmp"), "", max_jobs=2, body=flaky)
+    t.run()
+    assert attempts[1] == 2  # failed once, recovered on retry
+    assert t.blocks_done() == [0, 1, 2, 3]
+    assert not os.path.exists(t.failures_path)  # nothing left to report
+
+
+def test_host_block_map_verify_retry_repairs(tmp_path):
+    """A store-verify failure (chunk corruption) retries process ->
+    re-write -> re-verify, repairing the chunk while the task owns it."""
+    from cluster_tools_tpu.utils.volume_utils import Blocking
+
+    blocking = Blocking((4, 4, 4), (2, 2, 2))
+    wrote, verified = [], {}
+
+    def process(block_id):
+        wrote.append(block_id)
+
+    def verify(block):
+        n = verified.get(block.block_id, 0) + 1
+        verified[block.block_id] = n
+        if block.block_id == 2 and n == 1:
+            raise RuntimeError("digest mismatch (corrupt chunk)")
+
+    t = _task_cls()(
+        str(tmp_path / "tmp"), "", max_jobs=1, body=process,
+        knobs={"n_blocks": 8, "store_verify_fn": verify,
+               "blocking": blocking},
+    )
+    t.run()
+    assert wrote.count(2) == 2  # re-written after the verify failure
+    assert len(t.blocks_done()) == 8
+
+
+def test_host_block_map_morton_schedule(tmp_path):
+    """With a blocking wired, the sweep follows the same Z-order the
+    device executor uses (chunk-cache locality)."""
+    from cluster_tools_tpu.runtime.executor import morton_order
+    from cluster_tools_tpu.utils.volume_utils import Blocking
+
+    blocking = Blocking((4, 4, 4), (2, 2, 2))
+    order = []
+
+    t = _task_cls()(
+        str(tmp_path / "tmp"), "", max_jobs=1, body=order.append,
+        knobs={"n_blocks": 8, "blocking": blocking},
+    )
+    t.run()
+    expected = [
+        int(b.block_id)
+        for b in morton_order([blocking.get_block(i) for i in range(8)])
+    ]
+    assert order == expected

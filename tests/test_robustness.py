@@ -379,7 +379,9 @@ def test_host_block_map_records_failures_capped(tmp_path):
     recs = {r["block_id"]: r for r in doc["records"]}
     assert set(recs) == {2, 4}
     for r in recs.values():
-        assert r["sites"] == {"host": 1} and not r["resolved"]
+        # the hardened host path retries with the config budget (default
+        # io_retries=2 -> 3 recorded attempts) before declaring failure
+        assert r["sites"] == {"host": 3} and not r["resolved"]
         assert len(r["error"]) < 2200  # capped traceback
     # successful blocks got markers; failed ones did not
     assert t.blocks_done() == [0, 1, 3, 5]
