@@ -23,6 +23,12 @@
 #                     "Chunk-aware I/O"): the halo'd watershed sweep with
 #                     the decompressed-chunk cache off vs on, asserting
 #                     bit-identical outputs; cpu backend, <60 s
+#   bench-sweep     = dispatch-amortization bench (docs/PERFORMANCE.md
+#                     "Sharded sweeps"): per-block dispatch vs one sharded
+#                     program per Morton batch at 64^3/16^3, recording
+#                     throughput, dispatch counts, and bit-identity into
+#                     BENCH_r07.json; cpu backend, <30 s (a <10 s smoke
+#                     twin runs inside tier1 via tests/test_sharded.py)
 #   supervise-demo  = smoke-check recipe: watershed workflow on the
 #                     stub-slurm cluster target under an injected job loss,
 #                     printing the supervisor's resubmission log
@@ -31,7 +37,7 @@ CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
 .PHONY: test lint tier1 chaos chaos-resource failures-report bench-io \
-	supervise-demo native clean
+	bench-sweep supervise-demo native clean
 
 test: lint tier1 chaos
 
@@ -56,6 +62,9 @@ failures-report:
 
 bench-io:
 	JAX_PLATFORMS=cpu $(PY) bench.py --io
+
+bench-sweep:
+	JAX_PLATFORMS=cpu $(PY) bench.py --sweep
 
 supervise-demo:
 	JAX_PLATFORMS=cpu $(PY) scripts/supervise_demo.py

@@ -53,15 +53,29 @@ class BlockPrefetcher:
 
     ``read_fn(item)`` must return either a numpy array or a future-like
     object with ``.result()`` (e.g. a tensorstore read future from
-    ``Dataset.read_async``).  At any moment at most ``depth`` reads are in
-    flight; results are yielded in submission order.
+    ``Dataset.read_async``).  At any moment at most ``depth * batch_size``
+    reads are in flight; results are yielded in submission order.
+
+    Batch granularity (docs/PERFORMANCE.md "Sharded sweeps"): a *streaming*
+    consumer that drains whole batches (one compiled program per
+    ``batch_size`` items — host-side sweeps built on this iterator; the
+    BlockwiseExecutor prefetches whole batches through its own pipeline and
+    does not use this class) sets ``batch_size`` so the window holds
+    ``depth`` batches — batch N+1's reads are all in flight while batch N
+    computes.  The bound follows the LIVE batch size: when the consumer
+    switches mid-sweep (e.g. degrading from wide batches to per-item
+    grain), :meth:`set_batch_size` re-bounds the window at once — already
+    in-flight reads are drained, but no new read is submitted until the
+    window is back under ``depth * new_batch_size``.  Without it a consumer
+    degrading from 16-item batches to single items would keep ``depth * 16``
+    reads pinned against a byte budget sized for ``depth * 1``.
 
     Failure isolation: a read that raises (at submission or at resolution)
     raises from ``__next__`` for ITS item only.  The iterator is a
     hand-written object, not a generator — a generator would be closed by
     the raise and abandon every in-flight future behind it; here the window
     survives, so a consumer that catches the error keeps receiving the
-    remaining items (and nothing past ``depth`` is ever in flight).
+    remaining items (and nothing past the window bound is ever in flight).
     """
 
     def __init__(
@@ -69,28 +83,45 @@ class BlockPrefetcher:
         read_fn: Callable,
         items: Sequence,
         depth: int = 2,
+        batch_size: int = 1,
     ):
         if depth < 1:
             raise ValueError("prefetch depth must be >= 1")
+        if batch_size < 1:
+            raise ValueError("prefetch batch_size must be >= 1")
         self._read_fn = read_fn
         self._items = list(items)
         self._depth = depth
+        self._batch_size = int(batch_size)
+
+    def set_batch_size(self, batch_size: int) -> None:
+        """Re-bound the window to ``depth * batch_size`` for this and every
+        live iterator (the consumer's batch size changed mid-sweep)."""
+        if batch_size < 1:
+            raise ValueError("prefetch batch_size must be >= 1")
+        self._batch_size = int(batch_size)
+
+    @property
+    def window_bound(self) -> int:
+        return self._depth * self._batch_size
 
     def __len__(self) -> int:
         return len(self._items)
 
     def __iter__(self) -> Iterator[Tuple[object, np.ndarray]]:
-        return _PrefetchIterator(self._read_fn, self._items, self._depth)
+        return _PrefetchIterator(self)
 
 
 class _PrefetchIterator:
     """Iterator state of one :class:`BlockPrefetcher` pass (see its
-    docstring for the failure-isolation contract)."""
+    docstring for the failure-isolation and live-bound contracts).  The
+    window bound is read from the owning prefetcher on every refill, so
+    ``set_batch_size`` takes effect immediately."""
 
-    def __init__(self, read_fn, items, depth):
-        self._read_fn = read_fn
-        self._it = iter(items)
-        self._depth = depth
+    def __init__(self, prefetcher: BlockPrefetcher):
+        self._owner = prefetcher
+        self._read_fn = prefetcher._read_fn
+        self._it = iter(prefetcher._items)
         self._window: deque = deque()
         self._fill()
 
@@ -107,7 +138,7 @@ class _PrefetchIterator:
         return True
 
     def _fill(self) -> None:
-        while len(self._window) < self._depth and self._submit_one():
+        while len(self._window) < self._owner.window_bound and self._submit_one():
             pass
 
     def __iter__(self):
@@ -115,15 +146,16 @@ class _PrefetchIterator:
 
     def __next__(self) -> Tuple[object, np.ndarray]:
         if not self._window:
-            raise StopIteration
+            if not self._submit_one():
+                raise StopIteration
         item, fut = self._window.popleft()
         try:
             arr = np.asarray(fut.result())
         finally:
-            # refill after the head resolves: exactly ``depth`` reads are
-            # in flight while waiting, and again while the consumer works —
-            # including when the head FAILED (its slot refills, the window
-            # bound holds, and iteration can continue past the error)
+            # refill after the head resolves: the LIVE bound of in-flight
+            # reads holds while waiting, and again while the consumer works
+            # — including when the head FAILED (its slot refills, the bound
+            # holds, and iteration can continue past the error)
             self._fill()
         return item, arr
 

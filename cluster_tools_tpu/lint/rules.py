@@ -93,6 +93,10 @@ def _package_root(path: str) -> Optional[str]:
 #: knobs every ``map_blocks`` call site must plumb (docs/ROBUSTNESS.md):
 #: without them the call silently runs without failure attribution, hang
 #: detection, post-store integrity verification, or locality scheduling.
+#: ``sweep_mode`` selects the sharded executor path (one compiled program
+#: per Morton batch, docs/PERFORMANCE.md "Sharded sweeps") — enforcing it
+#: here means the new path is only reachable through config-plumbed call
+#: sites, exactly like the per-block knobs.
 MAP_BLOCKS_KNOBS = frozenset({
     "failures_path",
     "task_name",
@@ -100,6 +104,7 @@ MAP_BLOCKS_KNOBS = frozenset({
     "watchdog_period_s",
     "store_verify_fn",
     "schedule",
+    "sweep_mode",
 })
 
 #: constructor knobs: IO pool width and the per-block retry budget must be
@@ -607,13 +612,21 @@ _IMPURE_NAMES = {"print", "open", "input", "breakpoint"}
 _SYNC_MARKERS = ("block_until_ready", ".item(", "np.asarray", "np.array(",
                  "device_get", "float(")
 
+#: call names that trace their first argument into a compiled program:
+#: ``jit`` / ``shard_map`` directly, and the batched shard_map wrapper of
+#: the sharded sweep (``parallel/batch_shard.py``) — a kernel passed into
+#: it is vmapped inside one ``shard_map`` program, so the same purity
+#: contract applies.
+_JIT_WRAPPERS = ("jit", "shard_map", "batched_shard_map")
+
 
 def _jit_target_names(call: ast.Call) -> List[Tuple[str, Set[str]]]:
     """``(function name, partial-bound arg names)`` for every local
-    function wrapped by a ``jax.jit(...)``/``shard_map(...)`` call,
-    unwrapping ``jax.vmap``/``functools.partial`` layers.  Args bound by
-    keyword through ``partial`` are compile-time constants, so they count
-    as static for the traced-branch check."""
+    function wrapped by a ``jax.jit(...)``/``shard_map(...)``/
+    ``batched_shard_map(...)`` call, unwrapping ``jax.vmap``/
+    ``functools.partial`` layers.  Args bound by keyword through
+    ``partial`` are compile-time constants, so they count as static for
+    the traced-branch check."""
     names: List[Tuple[str, Set[str]]] = []
     stack: List[Tuple[ast.AST, Set[str]]] = [
         (a, set()) for a in call.args[:1]
@@ -682,12 +695,13 @@ def _collect_jitted(module: LintModule) -> Dict[str, Dict]:
                         mark(node.name, node, static_names(dec, node), dec)
                     elif fname and last_seg(fname) == "partial" and dec.args:
                         inner = dotted(dec.args[0])
-                        if inner and last_seg(inner) in ("jit", "shard_map"):
+                        if inner and last_seg(inner) in _JIT_WRAPPERS:
                             mark(node.name, node, static_names(dec, node), dec)
         # wrapper form: g = jax.jit(f) / jax.jit(vmap(f)) / shard_map(f, ...)
+        # / batched_shard_map(f, mesh, batch)
         if isinstance(node, ast.Call):
             fname = dotted(node.func)
-            if fname and last_seg(fname) in ("jit", "shard_map"):
+            if fname and last_seg(fname) in _JIT_WRAPPERS:
                 if node.args and isinstance(node.args[0], ast.Lambda):
                     mark(f"<lambda:{node.lineno}>", node.args[0], set(), node)
                 for target, bound in _jit_target_names(node):

@@ -11,12 +11,30 @@ The pipeline per batch:
 
     host threads: read blocks (+halo) from chunked storage, pad to the
                   static outer shape                               [IO bound]
-    device:       jit(vmap(kernel)) over the batch, batch axis sharded
+    device:       one compiled program over the batch, batch axis sharded
                   across devices                                   [compute]
     host threads: crop inner blocks, write to chunked storage      [IO bound]
 
 Reads for batch i+1 overlap compute for batch i (prefetch depth 2); writes
 are fire-and-forget futures drained promptly in a bounded window.
+
+Sweep modes (docs/PERFORMANCE.md "Sharded sweeps"): the historical
+``per_block`` path compiles ``jit(vmap(kernel))`` at width ``n_devices *
+device_batch`` — one dispatch per block on a single-device host, each
+paying dispatch + host-sync overhead behind the dispatch lock.  The
+``sharded`` mode instead executes a whole Morton batch of blocks as ONE
+``shard_map`` program over the device mesh
+(:func:`~cluster_tools_tpu.parallel.batch_shard.batched_shard_map`): the
+stacked batch axis is split across devices, each device vmaps the kernel
+over its sub-batch, and the dispatch lock is held once per batch.  The
+default ``sweep_mode="auto"`` picks sharded when the mesh has >= 2 devices
+or the sweep has at least one full sharded batch.  Sharded output is
+bit-identical to the per-block path (per-lane vmap numerics are width-
+independent; asserted by tests/test_sharded.py and ``bench.py --sweep``),
+and the per-block program remains the degrade/speculation fallback: a
+sharded batch that hits a device OOM or a hung device falls back to
+per-block execution for its blocks, attributed in ``failures.json`` as
+``resolution="degraded:unsharded"``.
 
 Fault tolerance (docs/ROBUSTNESS.md): per-block loads and stores retry with
 exponential backoff + jitter; blocks that exhaust their retries (or whose
@@ -69,6 +87,7 @@ import os
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor, Future
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -97,6 +116,55 @@ from .supervision import (
 
 # canonical device-selection policy lives in parallel/mesh.py
 from ..parallel.mesh import backend_devices as get_devices
+from ..parallel.batch_shard import (
+    batched_shard_map,
+    resolve_sharded_batch,
+    use_sharded_sweep,
+)
+
+
+# -- process-wide dispatch metrics -------------------------------------------
+# Mirrors io/chunk_cache.py's snapshot/delta counters: the task runtime
+# snapshots around run_impl and merges the delta into io_metrics.json, so
+# the dispatch-amortization win of the sharded sweep is observable per task
+# (docs/PERFORMANCE.md "Sharded sweeps"), not just in bench.
+
+_METRICS_LOCK = threading.Lock()
+_DISPATCH_COUNTERS = {
+    "batches_dispatched": 0,   # compiled-program executions (batch grain)
+    "blocks_dispatched": 0,    # blocks carried by those executions
+    "dispatch_wait_s": 0.0,    # dispatch loop stalled on un-overlapped loads
+    "sweep_s": 0.0,            # total map_blocks wall time
+}
+
+
+def dispatch_snapshot() -> Dict[str, float]:
+    """Current process-wide dispatch counters (monotonic; diff two
+    snapshots with :func:`dispatch_delta` to attribute a task's share)."""
+    with _METRICS_LOCK:
+        return dict(_DISPATCH_COUNTERS)
+
+
+def dispatch_delta(snapshot: Dict[str, float]) -> Dict[str, float]:
+    """Counter movement since ``snapshot`` (same keys)."""
+    cur = dispatch_snapshot()
+    return {k: cur[k] - snapshot.get(k, 0) for k in cur}
+
+
+def _record_dispatch_metrics(batches: int, blocks: int, wait_s: float,
+                             sweep_s: float) -> None:
+    with _METRICS_LOCK:
+        _DISPATCH_COUNTERS["batches_dispatched"] += int(batches)
+        _DISPATCH_COUNTERS["blocks_dispatched"] += int(blocks)
+        _DISPATCH_COUNTERS["dispatch_wait_s"] += float(wait_s)
+        _DISPATCH_COUNTERS["sweep_s"] += float(sweep_s)
+
+
+#: bound on one executor's compiled-program cache (see
+#: :meth:`BlockwiseExecutor._cached_program`); a sweep holds at most a few
+#: programs (sharded, per-block fallback, sub-block), the rest is headroom
+#: for executors reused across many kernels.
+_PROGRAM_CACHE_SIZE = 16
 
 
 def get_mesh(
@@ -332,6 +400,36 @@ class BlockwiseExecutor:
         self.max_retries = int(max_retries)
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
+        # compiled-program reuse across sweeps OF THIS EXECUTOR: repeated
+        # map_blocks calls with the same kernel callable (bench re-sweeps,
+        # resident service workers holding a warm executor) skip the
+        # per-shape compile — the same 10x cold-vs-warm tax ROADMAP item 4
+        # records for the solver.  Instance-scoped on purpose: the cached
+        # wrapper strongly references its kernel closure (which can pin a
+        # task's captured state, e.g. a model checkpoint), so the cache
+        # must die with the executor, not outlive the task process-wide.
+        self._program_cache: "OrderedDict" = OrderedDict()
+        self._program_cache_lock = threading.Lock()
+
+    def _cached_program(self, kernel: Callable, key: tuple,
+                        builder: Callable):
+        cache_key = (id(kernel), key)
+        with self._program_cache_lock:
+            hit = self._program_cache.get(cache_key)
+            if hit is not None:
+                self._program_cache.move_to_end(cache_key)
+                return hit[1]
+        # compile outside the lock (it can take seconds); a racing builder
+        # of the same program is harmless — last one in wins the slot.  The
+        # entry holds a strong ref to the kernel, which also keeps its id()
+        # component valid for the entry's lifetime.
+        prog = builder()
+        with self._program_cache_lock:
+            self._program_cache[cache_key] = (kernel, prog)
+            self._program_cache.move_to_end(cache_key)
+            while len(self._program_cache) > _PROGRAM_CACHE_SIZE:
+                self._program_cache.popitem(last=False)
+        return prog
 
     # -- retry/backoff machinery ------------------------------------------
     def _backoff(self, attempt: int) -> float:
@@ -396,6 +494,8 @@ class BlockwiseExecutor:
         mem_headroom_fraction: float = 0.05,
         disk_headroom_fraction: float = 0.02,
         schedule: str = "morton",
+        sweep_mode: str = "auto",
+        sharded_batch: Optional[int] = None,
     ) -> Dict[str, int]:
         """Execute ``kernel`` over ``blocks``; see class docstring.
 
@@ -442,6 +542,20 @@ class BlockwiseExecutor:
         ``"given"`` keeps the caller's order.  Per-block outputs are
         independent, so the order never changes results — only IO locality.
 
+        ``sweep_mode`` — ``"per_block"`` (the historical path: one
+        ``jit(vmap)`` dispatch per ``n_devices * device_batch`` blocks —
+        per *block* on a single-device host), ``"sharded"`` (one
+        ``shard_map`` program per Morton batch of ``sharded_batch`` blocks
+        over the mesh, holding the dispatch lock once per batch — see the
+        module docstring), or ``"auto"`` (default: sharded when the mesh
+        has >= 2 devices or the sweep fills at least one sharded batch).
+        ``sharded_batch`` — blocks per sharded program (None = ``max(2 *
+        n_devices * device_batch, 8)``, rounded up to a device multiple).
+        Sharded output is bit-identical to the per-block path; a sharded
+        batch that fails with a resource/device error (site ``dispatch``)
+        or hangs falls its blocks back to per-block execution, attributed
+        ``resolution="degraded:unsharded"``.
+
         Raises RuntimeError naming every block that stays failed after the
         end-of-run quarantine pass, and
         :class:`~cluster_tools_tpu.runtime.supervision.DrainInterrupt`
@@ -458,6 +572,12 @@ class BlockwiseExecutor:
             raise ValueError(
                 f"unknown schedule {schedule!r} (expected 'morton' or 'given')"
             )
+        sharded_width = resolve_sharded_batch(
+            self.n_devices, self.batch_size, sharded_batch
+        )
+        use_sharded = use_sharded_sweep(
+            sweep_mode, self.n_devices, len(blocks), sharded_width
+        )
         if not blocks:
             return {"n_blocks": 0, "n_quarantined": 0, "n_failed": 0}
         # preemption-aware draining: SIGTERM/SIGUSR1 flip a latch instead
@@ -466,17 +586,45 @@ class BlockwiseExecutor:
         injector = faults_mod.get_injector()
         deadline = float(block_deadline_s or 0.0)
         block_by_id = {int(b.block_id): b for b in blocks}
-        bs = self.batch_size
+        bs0 = self.batch_size
+        bs = sharded_width if use_sharded else bs0
         n_batches = math.ceil(len(blocks) / bs)
         sharding = NamedSharding(self.mesh, P("blocks"))
-        batched_kernel = jax.jit(
-            jax.vmap(kernel), in_shardings=sharding, out_shardings=sharding
-        )
+        dev_key = tuple(d.id for d in self.devices)
+
+        def _vmap_program():
+            return jax.jit(
+                jax.vmap(kernel), in_shardings=sharding, out_shardings=sharding
+            )
+
+        if use_sharded:
+            batched_kernel = self._cached_program(
+                kernel, ("sharded", bs, dev_key),
+                lambda: batched_shard_map(kernel, self.mesh, bs),
+            )
+        else:
+            # width is carried by the input shapes, not the wrapper: one
+            # cached jit(vmap) serves every batch width of this kernel
+            batched_kernel = self._cached_program(
+                kernel, ("vmap", dev_key), _vmap_program
+            )
+        t_sweep = time.perf_counter()
+        dispatch_stats = {"batches": 0, "blocks": 0, "wait_s": 0.0}
+        stats_lock = threading.Lock()
+
+        def _note_dispatch(n_blocks_dispatched: int) -> None:
+            with stats_lock:
+                dispatch_stats["batches"] += 1
+                dispatch_stats["blocks"] += int(n_blocks_dispatched)
 
         # per-block failure bookkeeping (threads: IO pool + dispatch loop)
         failures: Dict[int, Dict[str, Any]] = {}
         fail_lock = threading.Lock()
         quarantined_ids: set = set()
+        # blocks whose SHARDED batch failed (device OOM at the dispatch, or
+        # hung in the compute stage): they fall back to per-block execution
+        # and are attributed "degraded:unsharded" when that resolves them
+        sharded_failed_ids: set = set()
 
         def note_failure(block, site, attempts, error, quarantine,
                          resource=None):
@@ -512,6 +660,17 @@ class BlockwiseExecutor:
                     if resolution is not None:
                         rec["resolution"] = resolution
 
+        def unsharded_tag(block, resolved_by_fallback):
+            """``"degraded:unsharded"`` when the PER-BLOCK path actually
+            resolved a block whose sharded batch failed — a late-finishing
+            sharded primary that wins its own commit is NOT a fallback, so
+            a transient hang must not misreport one."""
+            if not use_sharded or not resolved_by_fallback:
+                return None
+            with fail_lock:
+                fell = int(block.block_id) in sharded_failed_ids
+            return "degraded:unsharded" if fell else None
+
         def validate(block, out) -> Optional[str]:
             if check_finite:
                 err = check_finite_outputs(block, out)
@@ -533,6 +692,39 @@ class BlockwiseExecutor:
         dispatch_lock = threading.Lock()
         speculated: set = set()
         commits = FirstWins()
+
+        # the per-block program: in per_block mode it IS the main program
+        # (quarantine re-attempts replicate the block to the batch width
+        # through the same compiled kernel); in sharded mode it is the
+        # degrade/speculation fallback — one block's share of the batch,
+        # a strictly smaller allocation than the sharded program, compiled
+        # lazily because a clean sharded sweep never needs it.  Per-lane
+        # vmap numerics are width-independent, so recovery through it stays
+        # bit-identical to the sharded result (tests/test_sharded.py).
+        fallback_state: Dict[str, Any] = {}
+
+        def _per_block_kernel():
+            if not use_sharded:
+                return batched_kernel, bs
+            kern = fallback_state.get("kernel")
+            if kern is None:
+                kern = self._cached_program(
+                    kernel, ("vmap", dev_key), _vmap_program
+                )
+                fallback_state["kernel"] = kern
+            return kern, bs0
+
+        def _exec_single(val):
+            """One block through the per-block program; returns its output
+            tree as numpy arrays."""
+            kern, width = _per_block_kernel()
+            stacked = tuple(np.stack([x] * width) for x in val)
+            stacked = tuple(jax.device_put(a, sharding) for a in stacked)
+            with dispatch_lock:
+                out = kern(*stacked)
+            _note_dispatch(1)
+            return jax.tree_util.tree_map(lambda a: np.asarray(a)[0], out)
+
         spec_pool: Optional[ThreadPoolExecutor] = None
         spec_futures: List[Future] = []
         watchdog: Optional[Watchdog] = None
@@ -743,7 +935,13 @@ class BlockwiseExecutor:
                         # effects to this settling point; finish_block
                         # de-duplicates against a winner that already ran
                         # them (it looked uncontended when it decided).
-                        mark_resolved(blk)
+                        # The stored winner is the OTHER copy: when this
+                        # agreeing copy is the primary, a speculative
+                        # per-block duplicate won — that is the sharded ->
+                        # per-block fallback, attributed as such.
+                        mark_resolved(
+                            blk, unsharded_tag(blk, origin == "primary")
+                        )
                         with fail_lock:
                             rec = failures.get(bid)
                             if rec is not None:
@@ -779,7 +977,11 @@ class BlockwiseExecutor:
                         note_failure(
                             blk, "store", attempts - 1, None, quarantine=False
                         )
-                    mark_resolved(blk)
+                    # this copy stored the result: only a SPECULATIVE win
+                    # came through the per-block fallback program
+                    mark_resolved(
+                        blk, unsharded_tag(blk, origin == "speculative")
+                    )
                     if not dup_state["contended"]:
                         # a contended winner defers the success marker to the
                         # duplicate's AGREE above: a mismatch must not leave
@@ -804,18 +1006,15 @@ class BlockwiseExecutor:
                 return
 
         def speculative_rerun(blk):
-            """Duplicate execution of a hung block: fresh load, the SAME
-            compiled kernel on the reduced-batch path, and a first-wins
+            """Duplicate execution of a hung block: fresh load, the
+            per-block program (the same compiled kernel in per_block mode;
+            the per-block fallback twin in sharded mode), and a first-wins
             commit against the (possibly still stuck) original."""
             try:
                 val = load_block(blk, origin="speculative")
                 if val is None:
                     return
-                stacked = tuple(np.stack([x] * bs) for x in val)
-                stacked = tuple(jax.device_put(a, sharding) for a in stacked)
-                with dispatch_lock:
-                    out = batched_kernel(*stacked)
-                out0 = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], out)
+                out0 = _exec_single(val)
                 handle_block_output(blk, out0, origin="speculative")
             except Exception:
                 note_failure(
@@ -841,6 +1040,11 @@ class BlockwiseExecutor:
                 if not speculate or info.get("origin") != "primary":
                     return
                 with fail_lock:
+                    if use_sharded and info.get("stage") == "compute":
+                        # a hung device stalls the whole sharded program:
+                        # this block's recovery is a sharded -> per-block
+                        # fallback, attributed degraded:unsharded
+                        sharded_failed_ids.add(bid)
                     if bid in speculated:
                         return
                     speculated.add(bid)
@@ -922,7 +1126,14 @@ class BlockwiseExecutor:
                         # sweep exits through DrainInterrupt for a requeue
                         drained = True
                         break
+                    t_wait = time.perf_counter()
                     batch, arrays = pending_loads.pop(0).result()
+                    with stats_lock:
+                        # dispatch loop stalled on un-overlapped loads: the
+                        # IO the double-buffering failed to hide
+                        dispatch_stats["wait_s"] += (
+                            time.perf_counter() - t_wait
+                        )
                     if i + prefetch < n_batches:
                         pending_loads.append(pool.submit(load_batch, i + prefetch))
                     # prompt drain: surface finished stores (and any programming
@@ -936,6 +1147,28 @@ class BlockwiseExecutor:
                     _admit(batch_bytes, write_futures)
                     arrays = tuple(jax.device_put(a, sharding) for a in arrays)
                     try:
+                        if use_sharded:
+                            # batch-grain fault surface: a device OOM or a
+                            # wedged device takes down the whole sharded
+                            # program, not one block — the 'dispatch' site
+                            # models it (registered as compute so the
+                            # watchdog's hung-batch detection covers it)
+                            with contextlib.ExitStack() as stack:
+                                for blk in batch:
+                                    stack.enter_context(
+                                        _watched(blk, "compute")
+                                    )
+                                batch_voxels = sum(
+                                    int(np.prod(b.outer_shape))
+                                    for b in batch
+                                )
+                                injector.maybe_fail(
+                                    "dispatch", batch[0].block_id,
+                                    voxels=batch_voxels,
+                                )
+                                injector.maybe_hang(
+                                    "dispatch", batch[0].block_id
+                                )
                         # take the dispatch lock BEFORE starting the blocks'
                         # compute clocks: waiting behind a (possibly cold-
                         # compiling) speculative dispatch is not this batch's
@@ -944,16 +1177,25 @@ class BlockwiseExecutor:
                             for blk in batch:
                                 stack.enter_context(_watched(blk, "compute"))
                             out = batched_kernel(*arrays)
+                        _note_dispatch(len(batch))
                     except Exception as e:
                         # a compute failure poisons the whole batch; quarantine
                         # all of it — the reduced-batch pass isolates the
                         # culprit, and a resource-classified failure (device
-                        # OOM) steers every member into the degrade ladder
+                        # OOM) steers every member into the degrade ladder.
+                        # In sharded mode the batch falls back to per-block
+                        # execution (site 'dispatch', degraded:unsharded).
                         tb = fu.cap_traceback(traceback.format_exc())
                         resource = classify_resource_error(e)
+                        site = "dispatch" if use_sharded else "compute"
                         for blk in batch:
-                            note_failure(blk, "compute", 1, tb,
+                            note_failure(blk, site, 1, tb,
                                          quarantine=True, resource=resource)
+                        if use_sharded:
+                            with fail_lock:
+                                sharded_failed_ids.update(
+                                    int(b.block_id) for b in batch
+                                )
                         _release_inflight(batch_bytes)
                         continue
 
@@ -1025,11 +1267,14 @@ class BlockwiseExecutor:
                 # the SAME kernel function, unbatched + jitted: jit caches
                 # one compiled twin per distinct sub-block shape, each a
                 # smaller allocation than the batch program — the point
-                sub_jit = jax.jit(kernel)
+                sub_jit = self._cached_program(
+                    kernel, ("sub",), lambda: jax.jit(kernel)
+                )
 
                 def _sub_exec(val):
                     with dispatch_lock:
                         out = sub_jit(*val)
+                    _note_dispatch(1)
                     return jax.tree_util.tree_map(np.asarray, out)
 
                 split_stats = {"splits": 0, "max_depth": 0, "sub_blocks": 0}
@@ -1166,12 +1411,7 @@ class BlockwiseExecutor:
                                 "compute", blk.block_id,
                                 voxels=int(np.prod(blk.outer_shape)),
                             )
-                            stacked = tuple(np.stack([x] * bs) for x in val)
-                            stacked = tuple(
-                                jax.device_put(a, sharding) for a in stacked
-                            )
-                            with dispatch_lock:
-                                out = batched_kernel(*stacked)
+                            out0 = _exec_single(val)
                             ok = True
                         except Exception as e:
                             tb = fu.cap_traceback(traceback.format_exc())
@@ -1180,19 +1420,21 @@ class BlockwiseExecutor:
                                 resource=classify_resource_error(e),
                             )
                         if ok:
-                            out0 = jax.tree_util.tree_map(
-                                lambda a: np.asarray(a)[0], out
-                            )
                             handle_block_output(blk, out0)
                     # ladder outcome: a resolved resource block recovered via
                     # the headroom wait; a still-unresolved one splits (when
-                    # the call site declared the kernel split-safe)
+                    # the call site declared the kernel split-safe).  A block
+                    # whose SHARDED batch failed resolved through the
+                    # per-block fallback — attribute that, not backpressure.
                     with fail_lock:
                         rec = failures[bid]
                         resolved_now = rec["resolved"]
                         resource = rec.get("resource")
+                        fell_back = bid in sharded_failed_ids
                     if resolved_now:
-                        if resource is not None:
+                        if fell_back:
+                            mark_resolved(blk, "degraded:unsharded")
+                        elif resource is not None:
                             mark_resolved(blk, "degraded:backpressure")
                         continue
                     if resource is not None and splittable:
@@ -1211,6 +1453,12 @@ class BlockwiseExecutor:
                 watchdog.stop()
             if spec_pool is not None:
                 spec_pool.shutdown(wait=True)
+            _record_dispatch_metrics(
+                dispatch_stats["batches"],
+                dispatch_stats["blocks"],
+                dispatch_stats["wait_s"],
+                time.perf_counter() - t_sweep,
+            )
 
         unresolved = sorted(
             b for b, rec in failures.items() if not rec["resolved"]
@@ -1265,7 +1513,11 @@ class BlockwiseExecutor:
             "n_blocks": len(blocks),
             "n_quarantined": len(quarantined_ids),
             "n_failed": 0,
+            "sweep_mode": "sharded" if use_sharded else "per_block",
+            "n_dispatches": dispatch_stats["batches"],
         }
+        if sharded_failed_ids:
+            summary["n_unsharded"] = len(sharded_failed_ids)
         if deadline > 0:
             summary["n_hung"] = sum(
                 1 for rec in failures.values() if "hung" in rec["sites"]

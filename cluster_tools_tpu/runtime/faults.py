@@ -125,8 +125,13 @@ ENV_VAR = "CTT_FAULTS"
 
 _ERROR_SITES = ("load", "store", "io_read", "io_write", "submit", "task")
 _KILL_SITES = ("block_done", "task_done")
-_HANG_SITES = ("load", "store", "io_read", "io_write")
-_OOM_SITES = ("load", "store", "io_read", "io_write", "compute")
+#: "dispatch" is the batch-grain site of the sharded sweep (one compiled
+#: program per Morton batch, docs/PERFORMANCE.md "Sharded sweeps"): an oom
+#: there models the whole sharded program exceeding device memory, a hang a
+#: wedged device stalling it — either must fall the batch back to per-block
+#: execution (resolution "degraded:unsharded"), which this site exercises.
+_HANG_SITES = ("load", "store", "io_read", "io_write", "dispatch")
+_OOM_SITES = ("load", "store", "io_read", "io_write", "compute", "dispatch")
 _ENOSPC_SITES = ("store", "io_write")
 #: maybe_fail kinds: all raise at the same hook, with their own exception
 #: types so the executor's *typed* classification is what gets exercised

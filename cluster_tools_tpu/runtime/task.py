@@ -124,6 +124,13 @@ class BaseTask:
         from ``max_jobs``, the historical default), ``block_schedule`` the
         sweep order (``"morton"`` Z-order locality scheduling for the
         decompressed-chunk cache, ``"given"`` to keep grid order),
+        ``sweep_mode`` the executor dispatch shape (``"auto"`` — sharded
+        when the mesh has >= 2 devices or the sweep fills a sharded batch —
+        ``"sharded"``: one compiled program per Morton batch over the
+        device mesh, or ``"per_block"``: the historical
+        one-dispatch-per-block path; docs/PERFORMANCE.md "Sharded
+        sweeps") with ``sharded_batch`` the blocks per sharded program
+        (None = auto),
         ``block_deadline_s`` / ``watchdog_period_s`` the hung-block deadline
         + speculative re-execution (None disables), the cluster-target
         supervision knobs ``heartbeat_interval_s`` / ``heartbeat_timeout_s``
@@ -142,6 +149,8 @@ class BaseTask:
             "io_backoff_s": 0.05,
             "io_threads": None,
             "block_schedule": "morton",
+            "sweep_mode": "auto",
+            "sharded_batch": None,
             "block_deadline_s": None,
             "watchdog_period_s": None,
             "heartbeat_interval_s": 5.0,
@@ -185,22 +194,30 @@ class BaseTask:
         from . import faults as faults_mod
         from ..io import chunk_cache
 
+        from . import executor as executor_mod
+
         t0 = time.time()
         self.logger.info(f"start {self.task_name} (target={self.target})")
         # fault specs with a "tasks" filter target the running task's uid
         faults_mod.set_current_task(self.uid)
         io_snap = chunk_cache.snapshot()
+        disp_snap = executor_mod.dispatch_snapshot()
         try:
             result = self.run_impl() or {}
         finally:
             faults_mod.set_current_task(None)
         result["runtime_s"] = time.time() - t0
         result["target"] = self.target
-        # chunk-IO attribution: the cache counters' movement during this
-        # task, surfaced in the success manifest AND merged (additively,
-        # across resumed runs and cluster job processes) into the run-wide
-        # io_metrics.json next to failures.json
+        # chunk-IO + dispatch attribution: the cache and compiled-dispatch
+        # counters' movement during this task, surfaced in the success
+        # manifest AND merged (additively, across resumed runs and cluster
+        # job processes) into the run-wide io_metrics.json next to
+        # failures.json — so the sharded sweep's dispatch amortization is
+        # observable per task (docs/PERFORMANCE.md "Sharded sweeps")
         io_metrics = chunk_cache.delta(io_snap)
+        dispatch_metrics = executor_mod.dispatch_delta(disp_snap)
+        if any(dispatch_metrics.values()):
+            io_metrics.update(dispatch_metrics)
         if any(io_metrics.values()):
             result["io_metrics"] = io_metrics
             try:

@@ -176,6 +176,8 @@ class BlockComponentsBase(BaseTask):
             watchdog_period_s=cfg.get("watchdog_period_s"),
             store_verify_fn=region_verifier(out),
             schedule=str(cfg.get("block_schedule") or "morton"),
+            sweep_mode=str(cfg.get("sweep_mode") or "auto"),
+            sharded_batch=cfg.get("sharded_batch"),
             # degrade on OOM/ENOSPC; never splittable: the per-block CC
             # decomposition (and the min-voxel label of a component crossing
             # a would-be split plane) changes under sub-block re-execution

@@ -701,6 +701,8 @@ class IlastikPredictionBase(BaseTask):
                 out, bb_of=lambda b: (slice(None),) + b.bb
             ),
             schedule=str(cfg.get("block_schedule") or "morton"),
+            sweep_mode=str(cfg.get("sweep_mode") or "auto"),
+            sharded_batch=cfg.get("sharded_batch"),
             # opt-in OOM split (config allow_block_split): filter-bank +
             # per-voxel classifier is shape-local, so sub-block outputs tile
             # the parent exactly when halo covers the largest filter support

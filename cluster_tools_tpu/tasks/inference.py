@@ -221,6 +221,8 @@ class InferenceBase(BaseTask):
                 out, bb_of=lambda b: (slice(None),) + b.bb
             ),
             schedule=str(cfg.get("block_schedule") or "morton"),
+            sweep_mode=str(cfg.get("sweep_mode") or "auto"),
+            sharded_batch=cfg.get("sharded_batch"),
             # opt-in OOM split (config allow_block_split): the conv kernel
             # is shape-local, so sub-block outputs tile the parent's region
             # exactly when halo covers the receptive field and the

@@ -380,6 +380,12 @@ class WatershedBase(_WsTaskBase):
                 watchdog_period_s=cfg.get("watchdog_period_s"),
                 store_verify_fn=region_verifier(out),
                 schedule=str(cfg.get("block_schedule") or "morton"),
+                # one sharded program per Morton batch when the mesh/sweep
+                # is big enough (docs/PERFORMANCE.md "Sharded sweeps");
+                # bit-identical to per-block dispatch, which stays the
+                # degrade fallback
+                sweep_mode=str(cfg.get("sweep_mode") or "auto"),
+                sharded_batch=cfg.get("sharded_batch"),
                 # degrade policy: OOM/ENOSPC blocks wait for headroom and
                 # re-execute instead of burning same-size retries.  NEVER
                 # splittable: the label encoding (block_id * (n_outer+1) +
@@ -579,6 +585,8 @@ class TwoPassWatershedBase(_WsTaskBase):
             watchdog_period_s=cfg.get("watchdog_period_s"),
             store_verify_fn=region_verifier(out),
             schedule=str(cfg.get("block_schedule") or "morton"),
+            sweep_mode=str(cfg.get("sweep_mode") or "auto"),
+            sharded_batch=cfg.get("sharded_batch"),
             # same degrade policy as the single-pass task; never splittable
             # (outer-shape-dependent label encoding, see WatershedBase)
             splittable=False,

@@ -62,26 +62,50 @@ def _human_bytes(n: float) -> str:
 
 
 def format_io_metrics(tasks) -> list:
-    """Render per-task cache effectiveness lines (hit rate, bytes saved)."""
+    """Render per-task cache effectiveness lines (hit rate, bytes saved)
+    and, when the task ran compiled sweeps, the dispatch-amortization
+    figures of the sharded executor (docs/PERFORMANCE.md "Sharded
+    sweeps"): batches dispatched, blocks per dispatch, the time the
+    dispatch loop stalled on un-overlapped loads, and the overlap
+    efficiency (1 - stall / sweep wall time)."""
     lines = ["chunk-IO metrics (io_metrics.json):"]
     for task in sorted(tasks):
         m = tasks[task] or {}
         hits = int(m.get("hits", 0))
         misses = int(m.get("misses", 0))
         looked = hits + misses
-        rate = f"{100.0 * hits / looked:.1f}%" if looked else "n/a"
-        stored = float(m.get("bytes_from_storage", 0))
-        served = float(m.get("bytes_served", 0))
-        saved = max(0.0, served - stored)
-        lines.append(
-            f"[{task}]  hit rate {rate} ({hits}/{looked}), "
-            f"coalesced {int(m.get('coalesced', 0))}, "
-            f"storage {_human_bytes(stored)} -> served "
-            f"{_human_bytes(served)} (saved {_human_bytes(saved)})"
-        )
+        has_cache = looked or m.get("bytes_served") or m.get("direct_reads")
+        if has_cache:
+            rate = f"{100.0 * hits / looked:.1f}%" if looked else "n/a"
+            stored = float(m.get("bytes_from_storage", 0))
+            served = float(m.get("bytes_served", 0))
+            saved = max(0.0, served - stored)
+            lines.append(
+                f"[{task}]  hit rate {rate} ({hits}/{looked}), "
+                f"coalesced {int(m.get('coalesced', 0))}, "
+                f"storage {_human_bytes(stored)} -> served "
+                f"{_human_bytes(served)} (saved {_human_bytes(saved)})"
+            )
+        else:
+            lines.append(f"[{task}]")
         if m.get("direct_reads"):
             lines.append(
                 f"  uncached direct reads: {int(m['direct_reads'])}"
+            )
+        batches = int(m.get("batches_dispatched", 0))
+        if batches:
+            blocks = int(m.get("blocks_dispatched", 0))
+            wait = float(m.get("dispatch_wait_s", 0.0))
+            sweep = float(m.get("sweep_s", 0.0))
+            per = blocks / batches
+            overlap = (
+                f"{100.0 * max(0.0, 1.0 - wait / sweep):.1f}%"
+                if sweep > 0 else "n/a"
+            )
+            lines.append(
+                f"  dispatches: {batches} batch(es), "
+                f"{per:.1f} blocks/dispatch, "
+                f"dispatch wait {wait:.2f}s, overlap efficiency {overlap}"
             )
     return lines
 

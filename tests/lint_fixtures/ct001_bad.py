@@ -6,9 +6,31 @@ from cluster_tools_tpu.utils.volume_utils import file_reader
 
 def unhardened_map_blocks(kernel, blocks, load, store, self):
     # missing block_deadline_s / watchdog_period_s / store_verify_fn /
-    # schedule / failures_path / task_name
+    # schedule / sweep_mode / failures_path / task_name
     executor = BlockwiseExecutor(target="local")  # missing io_threads/max_retries
     executor.map_blocks(kernel, blocks, load, store)
+
+
+def sharded_path_without_knob(kernel, blocks, load, store, self, cfg, out):
+    # plumbs everything EXCEPT sweep_mode: the sharded executor path must
+    # be selected from config at every call site, not left to defaults
+    executor = BlockwiseExecutor(
+        target="local",
+        io_threads=int(cfg.get("io_threads") or 4),
+        max_retries=int(cfg.get("io_retries", 2)),
+    )
+    executor.map_blocks(
+        kernel,
+        blocks,
+        load,
+        store,
+        failures_path=self.failures_path,
+        task_name=self.uid,
+        block_deadline_s=cfg.get("block_deadline_s"),
+        watchdog_period_s=cfg.get("watchdog_period_s"),
+        store_verify_fn=None,
+        schedule="morton",
+    )
 
 
 def unhardened_host_map(self, cfg, blocking, block_ids, process):

@@ -21,6 +21,8 @@ def hardened_map_blocks(kernel, blocks, load, store, cfg, self, out):
         watchdog_period_s=cfg.get("watchdog_period_s"),
         store_verify_fn=region_verifier(out),
         schedule=str(cfg.get("block_schedule") or "morton"),
+        sweep_mode=str(cfg.get("sweep_mode") or "auto"),
+        sharded_batch=cfg.get("sharded_batch"),
     )
 
 

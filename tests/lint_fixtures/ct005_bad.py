@@ -33,3 +33,14 @@ def bench_without_sync(x):
     t0 = time.perf_counter()
     y = impure_kernel(x)  # dispatch is async: this measures enqueue
     return y, time.perf_counter() - t0
+
+
+def impure_sharded_kernel(b):
+    seed = np.random.rand()  # host randomness baked into the traced batch
+    return b + seed
+
+
+def build_sharded(batched_shard_map, mesh):
+    # the batched shard_map wrapper traces its kernel like jit/shard_map:
+    # the impure call above must be resolved through it
+    return batched_shard_map(impure_sharded_kernel, mesh, 16)

@@ -43,3 +43,12 @@ def bench_with_sync(x):
     y = pure_kernel(x)
     jax.block_until_ready(y)  # measure compute, not dispatch
     return y, time.perf_counter() - t0
+
+
+def pure_sharded_kernel(b):
+    return b * 2 + jnp.roll(b, 1, 0)
+
+
+def build_sharded(batched_shard_map, mesh):
+    # pure kernel through the batched shard_map wrapper: no findings
+    return batched_shard_map(pure_sharded_kernel, mesh, 16)

@@ -67,6 +67,14 @@ def test_ct004_typo_site_and_unhooked_boundary():
     assert any("__setitem__" in m for m in msgs)
 
 
+def test_ct001_sharded_path_requires_sweep_mode_knob():
+    """The sharded executor entry (sweep_mode) is enforced like the
+    per-block knobs: a call site plumbing everything else still fires."""
+    findings, _ = lint_fixture("ct001_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT001"]
+    assert any("['sweep_mode']" in m for m in msgs)
+
+
 def test_ct005_branch_static_and_timing():
     findings, _ = lint_fixture("ct005_bad.py")
     msgs = [f.message for f in findings if f.rule == "CT005"]
@@ -74,6 +82,14 @@ def test_ct005_branch_static_and_timing():
     assert any("unhashable container" in m for m in msgs)
     assert any("without synchronization" in m for m in msgs)
     assert any("impure call" in m for m in msgs)
+
+
+def test_ct005_resolves_batched_shard_map_kernels():
+    """Functions passed into the batched shard_map wrapper (the sharded
+    sweep's compiled program) are traced like jit/shard_map targets."""
+    findings, _ = lint_fixture("ct005_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT005"]
+    assert any("impure_sharded_kernel" in m for m in msgs)
 
 
 def test_ct006_all_violation_classes():

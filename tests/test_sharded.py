@@ -1,0 +1,458 @@
+"""Batch-sharded sweep execution (docs/PERFORMANCE.md "Sharded sweeps").
+
+Covers the sharded executor mode (one compiled program per Morton batch):
+bit-identity with the per-block path on the cpu backend — volume-edge
+blocks, non-power-of-two block grids, ragged final batches — the
+device-side halo exchange of ``parallel/batch_shard.py``, the forced
+sharded -> per-block fallback (``degraded:unsharded``), the batch-aware
+prefetch window bound, the per-task dispatch metrics, and the bench smoke
+twin of ``make bench-sweep``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cluster_tools_tpu.parallel.batch_shard import (
+    batched_shard_map,
+    exchange_batch_halo,  # noqa: F401 - exercised through sharded_slab_sweep
+    resolve_sharded_batch,
+    sharded_slab_sweep,
+    use_sharded_sweep,
+)
+from cluster_tools_tpu.runtime import executor as executor_mod
+from cluster_tools_tpu.runtime.executor import (
+    BlockwiseExecutor,
+    get_mesh,
+    morton_order,
+)
+from cluster_tools_tpu.utils import function_utils as fu
+from cluster_tools_tpu.utils.volume_utils import Blocking, pad_block_to
+
+
+def smooth_kernel(b):
+    x = (b + jnp.roll(b, 1, 0) + jnp.roll(b, -1, 0)) / 3.0
+    return jnp.where(x < jnp.float32(0.5), x, jnp.float32(1.0))
+
+
+# -- the batched shard_map wrapper -------------------------------------------
+
+
+def test_batched_shard_map_matches_per_block_vmap(rng):
+    mesh = get_mesh("local")
+    n_dev = int(np.prod(mesh.devices.shape))
+    batch = 2 * n_dev
+    stack = rng.random((batch, 6, 5), np.float32).astype(np.float32)
+    prog = batched_shard_map(smooth_kernel, mesh, batch)
+    out = np.asarray(prog(stack))
+    per_block = jax.jit(jax.vmap(smooth_kernel))
+    ref = np.concatenate(
+        [np.asarray(per_block(stack[i:i + 1])) for i in range(batch)]
+    )
+    assert np.array_equal(out, ref)
+
+
+def test_batched_shard_map_rejects_indivisible_batch():
+    mesh = get_mesh("local")
+    n_dev = int(np.prod(mesh.devices.shape))
+    if n_dev == 1:
+        pytest.skip("needs a multi-device mesh")
+    with pytest.raises(ValueError, match="not divisible"):
+        batched_shard_map(smooth_kernel, mesh, n_dev + 1)
+
+
+def test_resolve_sharded_batch_and_auto_policy():
+    # default: 2x the per-block width, floored at 8, device-aligned
+    assert resolve_sharded_batch(1, 1, None) == 8
+    assert resolve_sharded_batch(8, 8, None) == 16
+    assert resolve_sharded_batch(8, 8, 20) == 24  # rounded up to a multiple
+    assert resolve_sharded_batch(4, 4, 2) == 4    # floored at the mesh size
+    # auto: sharded on a multi-device mesh or a batch-filling sweep
+    assert use_sharded_sweep("auto", 8, 64, 16)
+    assert use_sharded_sweep("auto", 1, 64, 16)
+    assert not use_sharded_sweep("auto", 1, 8, 16)
+    assert not use_sharded_sweep("auto", 8, 1, 16)  # single block
+    assert use_sharded_sweep("sharded", 1, 1, 16)
+    assert not use_sharded_sweep("per_block", 8, 64, 16)
+    with pytest.raises(ValueError, match="sweep_mode"):
+        use_sharded_sweep("both", 1, 1, 16)
+
+
+# -- device-side halo exchange (slab runs) -----------------------------------
+
+
+@pytest.mark.parametrize(
+    "n_slabs,batch,n_devices",
+    [
+        (4, 4, 2),   # one full batch across two devices (ppermute crossing)
+        (6, 4, 2),   # non-power-of-two run + RAGGED final batch (padded)
+        (5, 4, 1),   # single device: local slicing only, ragged tail
+        (8, 4, 4),   # one slab per device within each batch
+    ],
+)
+def test_slab_sweep_halo_exchange_parity(rng, n_slabs, batch, n_devices):
+    """Device-rebuilt halos are bit-identical to the per-block path
+    (width-1 vmap over overlapped reads) — including the volume-edge
+    slabs, whose halo is the border fill."""
+    extent, halo = 6, 2
+    vol = rng.random((n_slabs * extent, 5, 4), np.float32).astype(np.float32)
+    padded = np.pad(
+        vol, ((halo, halo), (0, 0), (0, 0)), constant_values=1.0
+    )
+    mesh = get_mesh("local", n_devices=n_devices)
+    dev = sharded_slab_sweep(
+        vol, smooth_kernel, mesh, extent=extent, halo=halo,
+        batch=batch, fill=1.0,
+    )
+    per_block = jax.jit(jax.vmap(smooth_kernel))
+    ref = np.concatenate([
+        np.asarray(
+            per_block(padded[None, i * extent:(i + 1) * extent + 2 * halo])
+        )
+        for i in range(n_slabs)
+    ])
+    assert np.array_equal(dev, ref)
+
+
+def test_slab_sweep_rejects_bad_geometry(rng):
+    vol = rng.random((20, 4, 4), np.float32).astype(np.float32)
+    mesh = get_mesh("local", n_devices=1)
+    with pytest.raises(ValueError, match="multiple of the slab extent"):
+        sharded_slab_sweep(vol, smooth_kernel, mesh, extent=6, halo=1)
+    with pytest.raises(ValueError, match="halo"):
+        sharded_slab_sweep(vol, smooth_kernel, mesh, extent=4, halo=5)
+
+
+# -- executor sharded mode ----------------------------------------------------
+
+
+def _sweep(vol, blocks, outer, mode, tmp_path=None, **kw):
+    out = np.zeros(vol.shape, np.float32)
+
+    def load(b):
+        return (pad_block_to(vol[b.outer_bb], outer, constant_values=1.0),)
+
+    def store(b, raw):
+        out[b.bb] = np.asarray(raw)[b.inner_in_outer_bb]
+
+    ex = BlockwiseExecutor(
+        target="local", io_threads=4, max_retries=2, **kw.pop("ctor", {})
+    )
+    snap = executor_mod.dispatch_snapshot()
+    summary = ex.map_blocks(
+        smooth_kernel,
+        blocks,
+        load,
+        store,
+        failures_path=(
+            os.path.join(str(tmp_path), "failures.json") if tmp_path else None
+        ),
+        task_name=f"sweep_{mode}",
+        block_deadline_s=kw.pop("block_deadline_s", None),
+        watchdog_period_s=kw.pop("watchdog_period_s", None),
+        store_verify_fn=None,
+        schedule="morton",
+        sweep_mode=mode,
+        **kw,
+    )
+    return out, summary, executor_mod.dispatch_delta(snap)
+
+
+def test_sharded_bit_identical_nonpow2_grid_with_edges(rng):
+    """48^3 volume, 16^3 blocks (3^3 grid — non-power-of-two), halo 4:
+    every face block is volume-edge-clipped and the 27 blocks make a
+    ragged final sharded batch.  Sharded output must be bit-identical to
+    the per-block path, with fewer compiled dispatches."""
+    vol = rng.random((48, 48, 48), np.float32).astype(np.float32)
+    blocking = Blocking(vol.shape, (16, 16, 16))
+    halo = (4, 4, 4)
+    blocks = [
+        blocking.get_block(i, halo=halo) for i in range(blocking.n_blocks)
+    ]
+    outer = (24, 24, 24)
+    out_pb, sum_pb, d_pb = _sweep(vol, blocks, outer, "per_block")
+    out_sh, sum_sh, d_sh = _sweep(
+        vol, blocks, outer, "sharded", sharded_batch=16
+    )
+    assert np.array_equal(out_pb, out_sh)
+    assert sum_pb["sweep_mode"] == "per_block"
+    assert sum_sh["sweep_mode"] == "sharded"
+    assert sum_sh["n_dispatches"] < sum_pb["n_dispatches"]
+    assert d_sh["blocks_dispatched"] == len(blocks)
+    assert d_sh["batches_dispatched"] == sum_sh["n_dispatches"]
+
+
+def test_sharded_auto_uses_mesh_and_is_identical(rng):
+    """sweep_mode='auto' on the multi-device test mesh selects sharded and
+    stays bit-identical to a forced per-block run."""
+    vol = rng.random((32, 32, 32), np.float32).astype(np.float32)
+    blocking = Blocking(vol.shape, (16, 16, 16))
+    blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
+    outer = (16, 16, 16)
+    out_pb, _, _ = _sweep(vol, blocks, outer, "per_block")
+    out_auto, summary, _ = _sweep(vol, blocks, outer, "auto")
+    assert summary["sweep_mode"] == "sharded"  # conftest mesh has 8 devices
+    assert np.array_equal(out_pb, out_auto)
+
+
+def test_sharded_dispatch_oom_falls_back_per_block(rng, inject, tmp_path):
+    """A sharded batch that OOMs at the dispatch falls its blocks back to
+    per-block execution: the sweep completes bit-identically and every
+    affected block is attributed resolution='degraded:unsharded'."""
+    vol = rng.random((32, 32, 32), np.float32).astype(np.float32)
+    blocking = Blocking(vol.shape, (16, 16, 16))
+    blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
+    outer = (16, 16, 16)
+    out_pb, _, _ = _sweep(vol, blocks, outer, "per_block")
+
+    first = int(morton_order(blocks)[0].block_id)
+    inject({
+        "seed": 3,
+        "faults": [{
+            "site": "dispatch", "kind": "oom",
+            "blocks": [first], "fail_attempts": 1,
+        }],
+    })
+    out_sh, summary, _ = _sweep(
+        vol, blocks, outer, "sharded", sharded_batch=8, tmp_path=tmp_path
+    )
+    assert np.array_equal(out_pb, out_sh)
+    assert summary["n_unsharded"] == len(blocks)
+    doc = json.loads((tmp_path / "failures.json").read_text())
+    recs = [r for r in doc["records"] if r["task"] == "sweep_sharded"]
+    assert len(recs) == len(blocks)
+    for rec in recs:
+        assert rec["resolved"]
+        assert rec["resolution"] == "degraded:unsharded"
+        assert "dispatch" in rec["sites"]
+        assert rec["resource"] == "oom"
+
+
+def test_sharded_hung_batch_speculates_per_block(rng, inject, tmp_path):
+    """A wedged device (hang at the sharded dispatch) trips the hung-block
+    watchdog; speculative re-execution through the per-block program
+    resolves the batch, attributed degraded:unsharded."""
+    vol = rng.random((32, 32, 32), np.float32).astype(np.float32)
+    blocking = Blocking(vol.shape, (16, 16, 16))
+    blocks = [blocking.get_block(i) for i in range(blocking.n_blocks)]
+    outer = (16, 16, 16)
+    out_pb, _, _ = _sweep(vol, blocks, outer, "per_block")
+
+    first = int(morton_order(blocks)[0].block_id)
+    inject({
+        "seed": 3,
+        "faults": [{
+            "site": "dispatch", "kind": "hang",
+            "blocks": [first], "seconds": 1.5,
+        }],
+    })
+    out_sh, summary, _ = _sweep(
+        vol, blocks, outer, "sharded", sharded_batch=8, tmp_path=tmp_path,
+        block_deadline_s=0.25, watchdog_period_s=0.05,
+    )
+    assert np.array_equal(out_pb, out_sh)
+    assert summary["n_hung"] >= 1
+    doc = json.loads((tmp_path / "failures.json").read_text())
+    recs = [r for r in doc["records"] if r["task"] == "sweep_sharded"]
+    assert recs and all(r["resolved"] for r in recs)
+    assert any(
+        r.get("resolution") == "degraded:unsharded" and "hung" in r["sites"]
+        for r in recs
+    )
+
+
+# -- batch-aware prefetch window ---------------------------------------------
+
+
+class _SpyReads:
+    """read_fn that tracks how many reads are unresolved at once."""
+
+    def __init__(self):
+        self.in_flight = 0
+        self.max_in_flight = 0
+
+    def __call__(self, item):
+        self.in_flight += 1
+        self.max_in_flight = max(self.max_in_flight, self.in_flight)
+        spy = self
+
+        class _Fut:
+            def result(self):
+                spy.in_flight -= 1
+                return np.full((2,), item)
+
+        return _Fut()
+
+
+def test_prefetcher_window_follows_live_batch_size():
+    """Regression (sharded degrade fallback): when the consumer shrinks
+    its batch size mid-sweep, the in-flight window bound must follow the
+    LIVE batch size — not keep depth * old_batch reads pinned."""
+    from cluster_tools_tpu.io.prefetch import BlockPrefetcher
+
+    spy = _SpyReads()
+    pf = BlockPrefetcher(spy, list(range(40)), depth=2, batch_size=4)
+    it = iter(pf)
+    for _ in range(8):  # one "batch" at the wide grain
+        next(it)
+    assert spy.max_in_flight <= 2 * 4
+    # degrade fallback: per-block batches from here on
+    pf.set_batch_size(1)
+    spy.max_in_flight = 0
+    consumed = 8
+    for _ in range(it_len(pf) - consumed):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)
+    assert spy.max_in_flight <= 2  # depth * live batch size, not * 4
+    assert spy.in_flight == 0
+
+
+def it_len(pf):
+    return len(pf)
+
+
+def test_prefetcher_batch_size_validation():
+    from cluster_tools_tpu.io.prefetch import BlockPrefetcher
+
+    with pytest.raises(ValueError):
+        BlockPrefetcher(lambda i: i, [1], depth=2, batch_size=0)
+    pf = BlockPrefetcher(lambda i: np.asarray(i), [1, 2], depth=1)
+    with pytest.raises(ValueError):
+        pf.set_batch_size(0)
+    assert [i for i, _ in pf] == [1, 2]  # default grain unchanged
+
+
+# -- per-task dispatch metrics ------------------------------------------------
+
+
+def test_dispatch_metrics_recorded_and_rendered(rng, tmp_path):
+    """The executor's dispatch counters land in io_metrics.json per task
+    and failures_report renders the amortization line."""
+    from cluster_tools_tpu.runtime.task import BaseTask
+
+    vol = rng.random((32, 32, 32), np.float32).astype(np.float32)
+
+    class SweepTask(BaseTask):
+        task_name = "sharded_metrics_task"
+
+        def run_impl(self):
+            blocking = Blocking(vol.shape, (16, 16, 16))
+            blocks = [
+                blocking.get_block(i) for i in range(blocking.n_blocks)
+            ]
+            out, summary, _ = _sweep(
+                vol, blocks, (16, 16, 16), "sharded", sharded_batch=8
+            )
+            return {"n": summary["n_blocks"]}
+
+    task = SweepTask(str(tmp_path / "tmp"), "")
+    task.run()
+    doc = json.loads(
+        open(fu.io_metrics_path(str(tmp_path / "tmp"))).read()
+    )
+    metrics = doc["tasks"][task.uid]
+    assert metrics["batches_dispatched"] >= 1
+    assert metrics["blocks_dispatched"] == 8
+    assert "sweep_s" in metrics and "dispatch_wait_s" in metrics
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "failures_report",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "failures_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    lines = "\n".join(mod.format_io_metrics(doc["tasks"]))
+    assert "dispatches:" in lines
+    assert "blocks/dispatch" in lines
+    assert "overlap efficiency" in lines
+
+
+# -- bench smoke (the <10 s twin of `make bench-sweep`) ----------------------
+
+
+def test_sweep_bench_smoke():
+    import bench
+
+    rec = bench.sweep_bench(smoke=True)
+    assert rec["bit_identical"] is True
+    assert rec["device_halo_slab_identical"] is True
+    assert rec["sharded"]["blocks_per_dispatch"] > 1  # multi-block dispatch
+    assert rec["dispatch_reduction"] > 1
+    assert rec["per_block"]["dispatches"] > rec["sharded"]["dispatches"]
+
+
+# -- chaos: forced sharded -> per-block fallback in a real task e2e ----------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_sharded_fallback_in_watershed(tmp_path, inject):
+    """Watershed e2e with sweep_mode=auto (sharded on the test mesh): a
+    dispatch OOM mid-sweep falls the batch back to per-block execution,
+    the final labels stay bit-identical to a fault-free run, and the
+    degrade is attributed in failures.json."""
+    from scipy import ndimage
+
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.watershed import WatershedLocal
+    from cluster_tools_tpu.utils.volume_utils import file_reader
+
+    rng = np.random.default_rng(7)
+    vol = ndimage.gaussian_filter(rng.random((32, 32, 32)), 2.0)
+    vol = ((vol - vol.min()) / (vol.max() - vol.min())).astype(np.float32)
+    path = str(tmp_path / "v.zarr")
+    c = file_reader(path)
+    src = c.create_dataset(
+        "boundaries", shape=vol.shape, chunks=(16, 16, 16), dtype="float32"
+    )
+    src[...] = vol
+
+    def run(tag, faults=None):
+        if faults is not None:
+            inject(faults)
+        task = WatershedLocal(
+            tmp_folder=str(tmp_path / f"tmp_{tag}"),
+            config_dir=str(tmp_path / "cfg"),
+            max_jobs=4,
+            input_path=path,
+            input_key="boundaries",
+            output_path=path,
+            output_key=f"ws_{tag}",
+            block_shape=[16, 16, 16],
+            halo=[4, 4, 4],
+            threshold=0.5,
+            impl="legacy",
+        )
+        assert build([task])
+        if faults is not None:
+            inject(None)
+        return np.asarray(c[f"ws_{tag}"][...]), task
+
+    clean, _ = run("clean")
+    blocking = Blocking(vol.shape, (16, 16, 16))
+    first = int(morton_order(
+        [blocking.get_block(i, halo=(4, 4, 4)) for i in range(8)]
+    )[0].block_id)
+    faulted, task = run("fault", {
+        "seed": 7,
+        "faults": [{
+            "site": "dispatch", "kind": "oom",
+            "blocks": [first], "fail_attempts": 1,
+        }],
+    })
+    assert np.array_equal(clean, faulted)
+    doc = json.loads((tmp_path / "tmp_fault" / "failures.json").read_text())
+    recs = [r for r in doc["records"] if r["task"].startswith("watershed")]
+    assert recs and all(r["resolved"] for r in recs)
+    assert any(
+        r.get("resolution") == "degraded:unsharded" for r in recs
+    )
