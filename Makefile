@@ -23,6 +23,13 @@
 #                     "Chunk-aware I/O"): the halo'd watershed sweep with
 #                     the decompressed-chunk cache off vs on, asserting
 #                     bit-identical outputs; cpu backend, <60 s
+#   bench-fuse      = task-graph-fusion bench (docs/PERFORMANCE.md
+#                     "Task-graph fusion"): the watershed->graph->costs->
+#                     multicut workflow with in-memory handoffs off vs on,
+#                     recording intermediate bytes written, wall time, and
+#                     bit-identity into BENCH_r08.json; cpu backend (a
+#                     <10 s correctness smoke twin runs inside tier1 via
+#                     tests/test_handoff.py)
 #   bench-sweep     = dispatch-amortization bench (docs/PERFORMANCE.md
 #                     "Sharded sweeps"): per-block dispatch vs one sharded
 #                     program per Morton batch at 64^3/16^3, recording
@@ -37,7 +44,7 @@ CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
 .PHONY: test lint tier1 chaos chaos-resource failures-report bench-io \
-	bench-sweep supervise-demo native clean
+	bench-sweep bench-fuse supervise-demo native clean
 
 test: lint tier1 chaos
 
@@ -65,6 +72,9 @@ bench-io:
 
 bench-sweep:
 	JAX_PLATFORMS=cpu $(PY) bench.py --sweep
+
+bench-fuse:
+	JAX_PLATFORMS=cpu $(PY) bench.py --fuse
 
 supervise-demo:
 	JAX_PLATFORMS=cpu $(PY) scripts/supervise_demo.py

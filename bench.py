@@ -341,6 +341,142 @@ def io_bench():
     return rec
 
 
+def fuse_bench(smoke=False):
+    """Task-graph-fusion config (docs/PERFORMANCE.md "Task-graph fusion").
+
+    Runs the watershed -> graph -> features -> costs -> multicut -> write
+    workflow twice over the same on-disk boundary volume — in-memory
+    handoffs OFF (every producer->consumer hop pays a store+load
+    round-trip, today's baseline), then ON (intermediates live in host RAM,
+    spill-to-storage as the fallback) — and records the intermediate bytes
+    written to storage, end-to-end wall time, the handoff counters, and
+    whether the final segmentations are bit-identical (they must be: the
+    fusion layer is a pure IO optimization).  cpu backend; ``make
+    bench-fuse`` writes BENCH_r08.json.  ``smoke=True`` is the <10 s
+    tier-1 variant (16^3 volume, no file output).  Emits exactly one JSON
+    line on stdout and returns the record.
+    """
+    from __graft_entry__ import _force_cpu_platform
+
+    _force_cpu_platform(8)
+    import shutil
+    import tempfile
+
+    from scipy import ndimage
+
+    from cluster_tools_tpu.runtime import handoff
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.utils.volume_utils import file_reader
+    from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+
+    ext = 16 if smoke else int(os.environ.get("CT_BENCH_FUSE_EXTENT", "32"))
+    block = 8
+    root = tempfile.mkdtemp(prefix="ctt_fuse_bench_")
+    shape = (ext,) * 3
+    log(f"fuse bench: volume {shape}, blocks {block}^3, handoffs off vs on")
+    rng = np.random.default_rng(0)
+    vol = ndimage.gaussian_filter(rng.random(shape), 2.0)
+    vol = ((vol - vol.min()) / (vol.max() - vol.min())).astype(np.float32)
+
+    def _tree_bytes(*paths):
+        total = 0
+        for p in paths:
+            if not os.path.isdir(p):
+                continue
+            for dirpath, _dirs, files in os.walk(p):
+                for f in files:
+                    try:
+                        total += os.path.getsize(os.path.join(dirpath, f))
+                    except OSError:
+                        pass
+        return total
+
+    runs, segs = {}, {}
+    # a discarded warmup run compiles every kernel shape first, so the
+    # off/on timings compare IO paths, not compile caches (the smoke twin
+    # skips it — it asserts correctness, not timing)
+    modes = ("on", "off") if smoke else ("warmup", "on", "off")
+    for mode in modes:
+        base = os.path.join(root, mode)
+        cdir = os.path.join(base, "config")
+        os.makedirs(cdir, exist_ok=True)
+        with open(f"{cdir}/global.config.tmp", "w") as f:
+            json.dump(
+                {"block_shape": [block] * 3,
+                 "memory_handoffs": mode == "on"},
+                f,
+            )
+        os.replace(f"{cdir}/global.config.tmp", f"{cdir}/global.config")
+        path = os.path.join(base, "data.zarr")
+        src = file_reader(path).create_dataset(
+            "bmap", shape=shape, chunks=(block,) * 3, dtype="float32"
+        )
+        src[...] = vol
+        tmp_folder = os.path.join(base, "tmp")
+        snap = handoff.snapshot()
+        t0 = time.perf_counter()
+        wf = MulticutSegmentationWorkflow(
+            tmp_folder=tmp_folder, config_dir=cdir, max_jobs=4,
+            target="local", input_path=path, input_key="bmap",
+            ws_path=path, ws_key="ws", output_path=path, output_key="seg",
+            threshold=0.5, halo=[2] * 3, beta=0.5,
+        )
+        if not build([wf]):
+            raise RuntimeError(f"fuse bench workflow run '{mode}' failed")
+        seconds = time.perf_counter() - t0
+        if mode == "warmup":
+            continue
+        # intermediate storage footprint: the supervoxel dataset plus the
+        # graph/multicut artifact dirs (solver checkpoints excluded: they
+        # are crash-resume state, not a producer->consumer hop)
+        inter_bytes = _tree_bytes(
+            os.path.join(path, "ws"),
+            os.path.join(tmp_folder, "graph"),
+            os.path.join(tmp_folder, "multicut"),
+        )
+        stats = handoff.delta(snap)
+        runs[mode] = dict(
+            {k: int(v) for k, v in stats.items()},
+            seconds=round(seconds, 3),
+            intermediate_bytes_written=int(inter_bytes),
+        )
+        segs[mode] = np.asarray(file_reader(path)["seg"][...])
+        log(
+            f"fuse bench handoffs={mode}: {seconds:.1f}s, "
+            f"{inter_bytes / 1e6:.2f}MB intermediate storage, "
+            f"{stats['handoffs_served']:.0f} served in-memory, "
+            f"{stats['bytes_not_stored'] / 1e6:.2f}MB never stored"
+        )
+
+    rec = {
+        "metric": "task_graph_fusion_workflow",
+        "backend": "cpu",
+        "volume": list(shape),
+        "block_shape": [block] * 3,
+        "handoffs_off": runs["off"],
+        "handoffs_on": runs["on"],
+        "bit_identical": bool(np.array_equal(segs["off"], segs["on"])),
+        "zero_intermediate_writes": runs["on"]["intermediate_bytes_written"] == 0,
+        # smoke runs skip the warmup pass, so their timings still carry
+        # compile noise — the smoke twin asserts correctness, not speed
+        "speedup": None if smoke else round(
+            runs["off"]["seconds"] / max(runs["on"]["seconds"], 1e-9), 2
+        ),
+    }
+    shutil.rmtree(root, ignore_errors=True)
+    if not smoke:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r08.json"
+        )
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps(rec), flush=True)
+    log("fuse bench done")
+    return rec
+
+
 def sweep_bench(smoke=False, n_devices=1):
     """Dispatch-amortization config (docs/PERFORMANCE.md "Sharded sweeps").
 
@@ -1484,6 +1620,8 @@ if __name__ == "__main__":
             io_bench()
         elif "--sweep" in sys.argv or os.environ.get("CT_BENCH_SWEEP"):
             sweep_bench()
+        elif "--fuse" in sys.argv or os.environ.get("CT_BENCH_FUSE"):
+            fuse_bench()
         elif os.environ.get("CT_BENCH_IMPL"):
             main()
         else:

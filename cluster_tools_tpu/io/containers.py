@@ -1066,6 +1066,198 @@ class _MemDataset(_ChecksumOps):
         self._attrs.update(kwargs)
 
 
+class HandoffDataset(_ChecksumOps):
+    """A ``memory://``-backed handoff twin of a chunked storage dataset
+    (docs/PERFORMANCE.md "Task-graph fusion").
+
+    Producer tasks write blocks into host RAM through the same numpy
+    dataset surface the storage-backed :class:`Dataset` exposes, and
+    consumer tasks resolve the live handle through
+    :mod:`cluster_tools_tpu.runtime.handoff` instead of opening the store —
+    the producer->consumer hop skips the storage round-trip entirely.
+
+    Contracts preserved from the storage path:
+
+    - **fault hooks** — every boundary method carries the ``io_read`` /
+      ``io_write`` injection + hang hooks (CT004), so chaos reaches the
+      in-memory data plane exactly like the storage one,
+    - **integrity** — writes record in-memory CRC32 region digests
+      (``verify_region`` / the executor's ``region_verifier`` work
+      unchanged, including the injected silent-corruption path),
+    - **spill** — :meth:`spill` flushes the array chunk-by-chunk through
+      the real dataset's write path (digest sidecars recorded per region,
+      each region verified back), then delegates every subsequent access
+      to the stored copy and releases the RAM.  After a spill, storage is
+      the single source of truth.
+    """
+
+    def __init__(self, shape, chunks, dtype, store_factory, label: str,
+                 fill_value: int = 0):
+        shape = tuple(int(s) for s in shape)
+        self._arr = np.full(shape, fill_value, dtype=np.dtype(dtype))
+        self.chunks = _clamp_chunks(chunks, shape)
+        self._checksums = _ChecksumIndex(None)
+        self._label = label
+        self._store_factory = store_factory
+        self._spilled_ds = None
+        self._spill_state_lock = threading.Lock()
+        self._spill_started = False
+        # accumulated bytes counted into the process-wide bytes_not_stored
+        # counter; a later spill reconciles them (they DID reach storage)
+        self.not_stored_bytes = 0
+
+    # every accessor SNAPSHOTS self._arr before branching: a concurrent
+    # spill publishes the storage delegate and then drops the array, so a
+    # reader must hold its own reference (the snapshot's bytes stay valid
+    # under GC) instead of re-reading the attribute after the check
+
+    @property
+    def shape(self):
+        arr = self._arr
+        return tuple(arr.shape) if arr is not None else self._spilled_ds.shape
+
+    @property
+    def dtype(self):
+        arr = self._arr
+        return arr.dtype if arr is not None else self._spilled_ds.dtype
+
+    ndim = property(lambda self: len(self.shape))
+
+    @property
+    def nbytes(self) -> int:
+        arr = self._arr
+        return 0 if arr is None else int(arr.nbytes)
+
+    def _handoff_counters(self):
+        from ..runtime import handoff as _h
+
+        return _h.get_registry()
+
+    def _read_back(self, bb):
+        arr = self._arr
+        if arr is None:
+            return self._spilled_ds._read_back(bb)
+        return arr[bb].copy()
+
+    def _write_raw(self, bb, value):
+        arr = self._arr
+        if arr is None:
+            self._spilled_ds._write_raw(bb, value)
+        else:
+            arr[bb] = value
+
+    def __getitem__(self, bb):
+        arr = self._arr
+        if arr is None:
+            return self._spilled_ds[bb]
+        bid = _inject("io_read")
+        _hang("io_read", bid)
+        out = arr[bb].copy()
+        self._verify_read(bb, out)
+        return out
+
+    def __setitem__(self, bb, value):
+        arr = self._arr
+        if arr is None:
+            self._spilled_ds[bb] = value
+            return
+        bid = _inject("io_write", voxels=getattr(value, "size", None))
+        _hang("io_write", bid)
+        value = np.asarray(value, dtype=arr.dtype)
+        arr[bb] = value
+        self._after_write(bb, value, bid)
+        self.not_stored_bytes += int(value.nbytes)
+        self._handoff_counters().bump("bytes_not_stored", int(value.nbytes))
+
+    def read_async(self, bb):
+        arr = self._arr
+        if arr is None:
+            return self._spilled_ds.read_async(bb)
+        bid = _inject("io_read")
+        _hang("io_read", bid)
+        out = arr[bb].copy()
+        self._verify_read(bb, out)
+        return _ImmediateFuture(out)
+
+    def write_async(self, bb, value):
+        arr = self._arr
+        if arr is None:
+            return self._spilled_ds.write_async(bb, value)
+        bid = _inject("io_write", voxels=getattr(value, "size", None))
+        _hang("io_write", bid)
+        value = np.asarray(value, dtype=arr.dtype)
+        arr[bb] = value
+        self._after_write(bb, value, bid)
+        self.not_stored_bytes += int(value.nbytes)
+        self._handoff_counters().bump("bytes_not_stored", int(value.nbytes))
+        return _ImmediateFuture(None)
+
+    def verify_region(self, bb) -> None:
+        if self._arr is None:
+            verify = getattr(self._spilled_ds, "verify_region", None)
+            if verify is not None:
+                verify(bb)
+            return
+        super().verify_region(bb)
+
+    def spill(self) -> int:
+        """Flush to the storage spill path and delegate from now on.
+        Chunk-aligned regions go through the real dataset's write path (one
+        digest sidecar per region, like any block store) and are verified
+        back, so the stored copy is checksummed before the RAM is released.
+        Returns the bytes freed (0 when already spilled/spilling)."""
+        with self._spill_state_lock:
+            if self._spill_started:
+                return 0
+            self._spill_started = True
+        try:
+            arr = self._arr
+            ds = self._store_factory()
+            regions = []
+            ranges = [
+                range(0, s, c) for s, c in zip(arr.shape, self.chunks)
+            ]
+            for begin in itertools.product(*ranges):
+                bb = tuple(
+                    slice(b, min(b + c, s))
+                    for b, c, s in zip(begin, self.chunks, arr.shape)
+                )
+                ds[bb] = arr[bb]
+                regions.append(bb)
+            verify = getattr(ds, "verify_region", None)
+            if verify is not None:
+                for bb in regions:
+                    verify(bb)
+        except BaseException:
+            # a half-written flush must stay retriable: release the guard
+            # so the NEXT attempt re-writes every region — otherwise a
+            # retry would short-circuit to "done" over a storage copy with
+            # fill-value holes
+            with self._spill_state_lock:
+                self._spill_started = False
+            raise
+        freed = int(arr.nbytes)
+        # publish the delegate before dropping the array: concurrent
+        # readers hold either the array ref (still valid bytes) or see the
+        # stored copy — never neither
+        self._spilled_ds = ds
+        self._arr = None
+        return freed
+
+    @property
+    def attrs(self) -> Dict:
+        ds = self._spilled_ds
+        return ds.attrs if ds is not None else {}
+
+    def update_attrs(self, **kwargs) -> None:
+        ds = self._spilled_ds
+        if ds is None:
+            raise RuntimeError(
+                "in-memory handoff datasets carry no attribute store"
+            )
+        ds.update_attrs(**kwargs)
+
+
 def open_container(path: str, mode: str = "a"):
     """Open a container by extension (SURVEY.md: ``vu.file_reader``)."""
     if path.startswith("memory://"):

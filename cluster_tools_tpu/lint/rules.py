@@ -1,4 +1,4 @@
-"""The ctlint rule classes CT001-CT006 (docs/ANALYSIS.md).
+"""The ctlint rule classes CT001-CT007 (docs/ANALYSIS.md).
 
 Every rule is derived from a *real* invariant of this codebase — the
 docstring of each checker names the file/contract it guards.  Rules are
@@ -229,7 +229,7 @@ def ct002_atomic_writes(module: LintModule) -> List[Finding]:
 #: modules participating in the runtime's lock graph
 _CT003_SCOPE = (
     "executor.py", "chunk_cache.py", "supervision.py",
-    "function_utils.py", "containers.py",
+    "function_utils.py", "containers.py", "handoff.py",
 )
 
 #: method/function names that block the calling thread (never allowed
@@ -443,7 +443,7 @@ _DEFAULT_SITES = frozenset({
 })
 _DEFAULT_KINDS = frozenset({
     "error", "oom", "enospc", "hang", "corrupt", "nan",
-    "job_loss", "kill", "preempt",
+    "job_loss", "kill", "preempt", "spill",
 })
 
 #: hook callables whose first positional arg is a site name
@@ -584,7 +584,7 @@ def ct004_fault_site_coverage(module: LintModule) -> List[Finding]:
                 "preemption chaos cannot target block completion",
             ))
 
-    # (d) the 9-class registry itself
+    # (d) the 10-class registry itself
     if module.name == "faults.py" and "lint_fixtures" not in module.path:
         missing = _DEFAULT_KINDS - kinds
         if missing:
@@ -908,6 +908,105 @@ def ct006_drain_safety(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT007 - memory-target spill contract
+# =============================================================================
+
+#: creation kwargs a handoff_dataset declaration must carry so the storage
+#: spill twin can be created (positionally: path, key, shape, chunks, dtype)
+_CT007_CREATE_KWS = ("shape", "chunks", "dtype")
+
+
+def ct007_memory_target_contract(module: LintModule) -> List[Finding]:
+    """A task that declares a ``MemoryTarget`` output must wire the spill
+    path (docs/PERFORMANCE.md "Task-graph fusion").
+
+    An in-memory handoff is only safe because spill-to-storage is the
+    universal fallback: every ``handoff_dataset`` declaration must pass the
+    full storage wiring (``path``/``key`` plus ``shape``/``chunks``/
+    ``dtype``, or the spill twin cannot be created when admission, headroom
+    pressure, or a forced ``spill`` fault demands it), and the returned
+    handle must be wired into a post-store ``region_verifier`` somewhere in
+    the module so integrity verification covers the in-memory data plane —
+    a handoff without a verifier is a storage boundary the PR-3 corruption
+    defense cannot see.
+    """
+    if module.name in ("task.py", "handoff.py") \
+            and "lint_fixtures" not in module.path:
+        return []  # the defining surface, not a call site
+    out: List[Finding] = []
+    verified: Set[str] = set()
+    for call in calls_in(module.tree):
+        if last_seg(dotted(call.func)) == "region_verifier" and call.args:
+            name = dotted(call.args[0])
+            if name:
+                verified.add(last_seg(name))
+
+    def _check(call: ast.Call, bound: Optional[str]) -> None:
+        present, splat = kw_names(call)
+        if splat:
+            return  # wiring forwarded wholesale; not statically checkable
+        pos = len(call.args)
+        missing = []
+        # positional args fill path then key (in that order); either may
+        # equally come as a keyword — a positional path + key= kwarg is
+        # fully wired
+        if pos == 0 and not {"path", "key"} <= present:
+            missing.append("path/key")
+        elif pos == 1 and "key" not in present:
+            missing.append("key")
+        need = max(0, 5 - pos)
+        if need:
+            # with pos < 2 the path/key slots are also unfilled; the slice
+            # start clamps at 0 so ALL creation kwargs stay required
+            # (a negative start would wrap and silently drop 'shape')
+            start = max(0, len(_CT007_CREATE_KWS) - need)
+            missing += [
+                k for k in _CT007_CREATE_KWS[start:]
+                if k not in present
+            ]
+        if missing:
+            out.append(Finding(
+                "CT007", module.path, call.lineno, call.col_offset,
+                f"handoff_dataset declaration misses spill wiring "
+                f"{missing}: without the full storage twin spec the "
+                "MemoryTarget cannot spill under admission/headroom/fault "
+                "pressure and the fallback contract is broken",
+            ))
+        if bound is None:
+            out.append(Finding(
+                "CT007", module.path, call.lineno, call.col_offset,
+                "handoff_dataset result is not bound to a name: the handle "
+                "cannot be wired into a region_verifier, so the in-memory "
+                "data plane is invisible to integrity verification",
+            ))
+        elif bound not in verified:
+            out.append(Finding(
+                "CT007", module.path, call.lineno, call.col_offset,
+                f"handoff handle {bound!r} is never passed to "
+                "region_verifier(...) in this module: wire "
+                "store_verify_fn=region_verifier(...) so post-store "
+                "integrity checks cover the in-memory target too",
+            ))
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign):
+            call = node.value
+            if isinstance(call, ast.Call) \
+                    and last_seg(dotted(call.func)) == "handoff_dataset":
+                bound = None
+                if len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    bound = node.targets[0].id
+                _check(call, bound)
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            if last_seg(dotted(call.func)) == "handoff_dataset":
+                _check(call, None)
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -918,4 +1017,5 @@ RULES = {
     "CT004": ct004_fault_site_coverage,
     "CT005": ct005_jit_hygiene,
     "CT006": ct006_drain_safety,
+    "CT007": ct007_memory_target_contract,
 }

@@ -581,6 +581,11 @@ def make_cluster_task(local_cls, flavor: str):
             f.write(
                 "#!/bin/bash\n"
                 f"export PYTHONPATH={pkg_root}:$PYTHONPATH\n"
+                # no in-memory handoffs across a host boundary: the worker
+                # process's memory dies before the submitter-side consumer
+                # runs, so its intermediate outputs must hit storage
+                # (docs/PERFORMANCE.md "Task-graph fusion")
+                "export CTT_HANDOFF=0\n"
                 # boot heartbeat from the shell, BEFORE the interpreter
                 # starts: the supervisor's staleness clock must not count
                 # queue exit -> first Python beat (slow jax imports) as

@@ -97,6 +97,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io import chunk_cache as chunk_cache_mod
 from ..io.containers import ChunkCorruptionError
+from . import handoff as handoff_mod
 from ..utils import function_utils as fu
 from ..utils.volume_utils import Block, Blocking
 from . import faults as faults_mod
@@ -1074,6 +1075,12 @@ class BlockwiseExecutor:
                     budget - chunk_cache_mod.get_chunk_cache().max_bytes,
                     budget // 4,
                 )
+            live_handoff = handoff_mod.live_bytes()
+            if budget and live_handoff:
+                # in-memory handoff targets (docs/PERFORMANCE.md
+                # "Task-graph fusion") are co-resident too — same envelope,
+                # same floor
+                budget = max(budget - live_handoff, budget // 4)
         else:
             budget = int(inflight_byte_budget)
         inflight = {"bytes": 0}
@@ -1093,8 +1100,20 @@ class BlockwiseExecutor:
             """Admission gate for one loaded batch: drain pending stores
             until the byte budget fits (the current batch is always
             admitted — progress beats the cap) and while memory/disk
-            headroom is below threshold."""
+            headroom is below threshold.  Low host memory additionally
+            flushes completed in-memory handoff targets to their storage
+            spill paths (docs/PERFORMANCE.md "Task-graph fusion") — the
+            degrade ladder prefers releasing recoverable resident bytes
+            over stalling the sweep."""
             waited = False
+            mem = host_mem_available_fraction()
+            if mem is not None and mem < mem_headroom_fraction:
+                # BEFORE the pending-store drain (which may be empty —
+                # in-memory sinks complete their stores immediately):
+                # completed handoffs are safe to flush (storage becomes
+                # the source of truth; consumers fall back transparently)
+                # and free real headroom
+                handoff_mod.spill_for_headroom()
             while write_futures:
                 with admission_lock:
                     over = budget and inflight["bytes"] + nbytes > budget

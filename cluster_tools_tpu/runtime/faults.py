@@ -29,7 +29,14 @@ task runtime, and container IO layer call at their failure-relevant sites:
 - :meth:`FaultInjector.lose_job` — swallow a scheduler submission
   (``kind='job_loss'``, site ``submit``): the submitter gets a job id, the
   scheduler keeps reporting it as running, but nothing ever executes —
-  only heartbeat supervision (``runtime/cluster.py``) can find it.
+  only heartbeat supervision (``runtime/cluster.py``) can find it,
+- :meth:`FaultInjector.force_spill` — report that an in-memory handoff
+  target (``kind='spill'``, site ``publish``; docs/PERFORMANCE.md
+  "Task-graph fusion") must be written through to its storage spill path
+  instead of living only in host RAM.  The handoff layer
+  (``runtime/handoff.py``) queries this at every dataset acquire / array
+  publish, so chaos can force the consumer-side fallback-to-storage path
+  (and crash-resume from the spilled, checksummed copy) on demand.
 
 Resource-exhaustion and preemption classes (docs/ROBUSTNESS.md "Graceful
 degradation") ride the same hooks:
@@ -84,7 +91,12 @@ Config schema::
         {"site": "store", "kind": "enospc", "blocks": [2],
          "fail_attempts": 2},
         # graceful preemption: a real SIGTERM at the 5th completed block
-        {"site": "block_done", "kind": "preempt", "after": 5}
+        {"site": "block_done", "kind": "preempt", "after": 5},
+        # forced handoff spill: every in-memory handoff target of watershed
+        # tasks is written through to its storage spill path (set
+        # fail_attempts high — the hook counts one attempt per publish)
+        {"site": "publish", "kind": "spill", "fail_attempts": 1000000,
+         "tasks": ["watershed"]}
       ]
     }
 
@@ -133,6 +145,12 @@ _KILL_SITES = ("block_done", "task_done")
 _HANG_SITES = ("load", "store", "io_read", "io_write", "dispatch")
 _OOM_SITES = ("load", "store", "io_read", "io_write", "compute", "dispatch")
 _ENOSPC_SITES = ("store", "io_write")
+#: "publish" is the handoff-layer site (runtime/handoff.py): the moment a
+#: task declares an in-memory target for a dataset or artifact.  A spill
+#: fault there forces the write-through to the storage spill path, so chaos
+#: can prove consumers fall back to the stored (checksummed) copy and that
+#: crash-resume consumes it bit-identically.
+_SPILL_SITES = ("publish",)
 #: maybe_fail kinds: all raise at the same hook, with their own exception
 #: types so the executor's *typed* classification is what gets exercised
 _FAIL_KINDS = ("error", "oom", "enospc")
@@ -283,6 +301,12 @@ class FaultInjector:
                         f"enospc fault site must be one of {_ENOSPC_SITES}, "
                         f"got {site!r}"
                     )
+            elif kind == "spill":
+                if site not in _SPILL_SITES:
+                    raise ValueError(
+                        f"spill fault site must be one of {_SPILL_SITES}, "
+                        f"got {site!r}"
+                    )
             elif kind == "hang":
                 if site not in _HANG_SITES:
                     raise ValueError(
@@ -405,6 +429,20 @@ class FaultInjector:
             return False
         for idx, spec in enumerate(self.specs):
             if self._active(idx, spec, site, block_id, "corrupt") is not None:
+                return True
+        return False
+
+    def force_spill(self) -> bool:
+        """True if an in-memory handoff target being declared right now
+        (site ``publish``) must be written through to its storage spill
+        path (``kind='spill'``).  The attempt counter ticks once per
+        publish, so ``fail_attempts`` bounds how many targets spill; use a
+        large value to force every handoff of a run.  ``tasks`` gates on
+        the producing task's uid prefix as usual."""
+        if not self.enabled:
+            return False
+        for idx, spec in enumerate(self.specs):
+            if self._active(idx, spec, "publish", None, "spill") is not None:
                 return True
         return False
 

@@ -1,0 +1,764 @@
+"""Typed in-memory targets: task-graph fusion without a storage round-trip.
+
+Every producer -> consumer hop of a workflow DAG historically paid a full
+store+load round-trip through chunked storage — watershed stored its label
+volume so graph extraction could read it back, graph stored npz artifacts so
+costs could load them, and so on ("Composing Distributed Computations
+Through Task and Kernel Fusion", PAPERS.md, names these materialization
+boundaries as where distributed-runtime speedups live).  This module is the
+registry behind the :class:`~cluster_tools_tpu.runtime.task.MemoryTarget`
+layer (docs/PERFORMANCE.md "Task-graph fusion"): a producer task declares an
+output as in-memory, publishes the array(s) here keyed by the *dataset
+identity* the consumer would have opened from storage, and a downstream task
+resolves the identity to the live host-RAM handle instead of reading the
+store — zero intermediate storage writes on the happy path.
+
+Spill-to-storage is the universal fallback, routed through the PR-4 degrade
+ladder:
+
+- **byte-budget admission** — a handoff whose bytes do not fit the process
+  budget (``CTT_HANDOFF_BYTES``, default ``min(2 GiB, MemAvailable/4)`` off
+  the same headroom probe as the executor's admission control) is written
+  through to its storage spill path from birth,
+- **headroom pressure** — the executor's admission gate calls
+  :func:`spill_for_headroom` when host memory runs low; completed handoffs
+  are flushed to storage oldest-first and their RAM is released,
+- **forced spill** — a ``kind='spill'`` fault at site ``publish``
+  (``runtime/faults.py``) forces the write-through, so chaos can prove the
+  fallback on demand.
+
+Spilled bytes go through the ordinary container write path, so they get the
+PR-3 CRC32 digest sidecars like any chunk write (artifact spills get a
+``.crc.json`` sidecar verified on fallback loads), and every spill is
+attributed in ``failures.json`` as ``resolution="degraded:spilled"``.  A
+consumer that finds no live handle — process restart, spill, a cluster
+target crossing a host boundary — transparently falls back to the stored
+copy; a producer whose success manifest records a *memory-only* output that
+is no longer live is treated as not-done by the DAG engine and re-runs
+(:meth:`~cluster_tools_tpu.runtime.task.BaseTask.complete`).
+
+``CTT_HANDOFF=0`` is the kill switch; the per-task ``memory_handoffs``
+config knob (default off) is what call sites gate on.  Counters
+(``handoffs_published`` / ``handoffs_served`` / ``handoffs_spilled`` /
+``handoff_fallbacks`` / ``bytes_not_stored`` / ``bytes_spilled``) follow the
+chunk-cache snapshot/delta pattern: the task runtime snapshots around each
+task and merges the delta into ``io_metrics.json``, rendered by
+``scripts/failures_report.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import function_utils as fu
+
+#: identity of THIS process for the marker-epoch sentinel: block markers
+#: written alongside a live in-memory output are only trustworthy inside
+#: the process that holds the memory (pid alone is reuse-prone)
+_PROCESS_TOKEN = f"{os.getpid()}.{uuid.uuid4().hex[:12]}"
+
+#: counter names, fixed so snapshots/deltas stay schema-stable
+STAT_KEYS = (
+    "handoffs_published",
+    "handoffs_served",
+    "handoffs_spilled",
+    "handoff_fallbacks",
+    "bytes_not_stored",
+    "bytes_spilled",
+)
+
+
+def handoff_enabled() -> bool:
+    """In-memory handoff targets (default on at the process level;
+    ``CTT_HANDOFF=0`` is the kill switch).  Tasks additionally gate on
+    their ``memory_handoffs`` config knob, which defaults to off — the
+    process switch exists so cluster workers (whose memory dies with them
+    before the submitter-side consumer runs) can be forced to storage
+    regardless of config."""
+    return os.environ.get("CTT_HANDOFF", "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def handoff_budget() -> int:
+    """Byte budget for live in-memory handoffs (``CTT_HANDOFF_BYTES``,
+    default ``min(2 GiB, MemAvailable/4)`` via the PR-4 headroom probe)."""
+    env = os.environ.get("CTT_HANDOFF_BYTES")
+    if env:
+        return max(0, int(env))
+    avail = None
+    try:
+        from .supervision import host_mem_available_bytes
+
+        avail = host_mem_available_bytes()
+    except Exception:  # pragma: no cover - probe is /proc-based
+        avail = None
+    if avail:
+        return int(min(2 << 30, avail // 4))
+    return 512 << 20
+
+
+def dataset_identity(path: str, key: str) -> str:
+    """Stable identity of a chunked dataset handoff: the same (container
+    path, key) a storage consumer would open."""
+    return f"{os.path.abspath(path)}:{key}"
+
+
+def artifact_identity(path: str) -> str:
+    """Stable identity of an array-artifact handoff (an npz/npy path)."""
+    return os.path.abspath(path)
+
+
+class _Entry:
+    """One live or spilled handoff.  ``obj`` is the in-memory payload (a
+    HandoffDataset, or a dict of read-only arrays) and is dropped on spill
+    — after a spill, storage is the single source of truth."""
+
+    __slots__ = (
+        "kind", "identity", "path", "key", "obj", "nbytes", "complete",
+        "spilled", "spilling", "spill_reason", "producer", "failures_path",
+        "recorded",
+    )
+
+    def __init__(self, kind, identity, path, key, obj, nbytes, producer,
+                 failures_path):
+        self.kind = kind                # "dataset" | "arrays"
+        self.identity = identity
+        self.path = path
+        self.key = key
+        self.obj = obj
+        self.nbytes = int(nbytes)
+        self.complete = False
+        self.spilled = False
+        self.spilling = False        # claimed by an in-progress spill
+        self.spill_reason: Optional[str] = None
+        self.producer = producer
+        self.failures_path = failures_path
+        self.recorded = False           # degraded:spilled written once
+
+
+class HandoffRegistry:
+    """Process-wide registry of in-memory handoff targets."""
+
+    def __init__(self):
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._stats: Dict[str, float] = {k: 0 for k in STAT_KEYS}
+
+    # -- counters ----------------------------------------------------------
+    def bump(self, key: str, n: float = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._stats)
+
+    # -- bookkeeping -------------------------------------------------------
+    def live_bytes(self) -> int:
+        """Bytes of payloads currently resident in host RAM."""
+        with self._lock:
+            return sum(
+                e.nbytes for e in self._entries.values() if not e.spilled
+            )
+
+    def claim_spill(self, entry: _Entry) -> bool:
+        """Atomically claim ``entry`` for spilling.  Exactly one caller
+        wins; everyone else sees an in-progress or finished spill and
+        backs off — ``spilled`` must never be observable before the
+        storage copy is actually complete."""
+        with self._lock:
+            if entry.spilled or entry.spilling or entry.obj is None \
+                    or not entry.complete:
+                # incomplete = a producer owns (or re-acquired) the
+                # payload and is still writing: spilling now would copy a
+                # torn snapshot
+                return False
+            entry.spilling = True
+            return True
+
+    def finish_spill(self, entry: _Entry, ok: bool, reason: str) -> None:
+        """Release a spill claim: on success the entry flips to spilled
+        (payload dropped, storage is the truth); on failure it stays live
+        — the memory copy is still the only copy."""
+        with self._lock:
+            entry.spilling = False
+            if ok:
+                entry.spilled = True
+                entry.spill_reason = reason
+                entry.obj = None
+
+    def is_live(self, identity: str) -> bool:
+        with self._lock:
+            e = self._entries.get(identity)
+            return e is not None and e.complete and not e.spilled
+
+    def get(self, identity: str) -> Optional[_Entry]:
+        with self._lock:
+            return self._entries.get(identity)
+
+    def put(self, entry: _Entry) -> None:
+        with self._lock:
+            self._entries[entry.identity] = entry
+            self._entries.move_to_end(entry.identity)
+
+    def entries_of(self, producer: str) -> List[_Entry]:
+        with self._lock:
+            return [
+                e for e in self._entries.values() if e.producer == producer
+            ]
+
+    def spill_candidates(self) -> List[_Entry]:
+        """Complete, still-resident, unclaimed entries, oldest first (the
+        LRU order a headroom spill should flush)."""
+        with self._lock:
+            return [
+                e for e in self._entries.values()
+                if e.complete and not e.spilled and not e.spilling
+            ]
+
+
+_registry: Optional[HandoffRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> HandoffRegistry:
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = HandoffRegistry()
+    return _registry
+
+
+def reset() -> None:
+    """Drop every live handoff and producer registration (tests)."""
+    global _registry
+    with _registry_lock:
+        _registry = HandoffRegistry()
+
+
+def snapshot() -> Dict[str, float]:
+    """Current process-wide handoff counters (monotonic; diff two
+    snapshots with :func:`delta`)."""
+    return get_registry().snapshot()
+
+
+def delta(snap: Dict[str, float]) -> Dict[str, float]:
+    cur = snapshot()
+    return {k: cur[k] - snap.get(k, 0) for k in cur}
+
+
+def live_bytes() -> int:
+    return get_registry().live_bytes()
+
+
+# -- marker-epoch sentinel ----------------------------------------------------
+# A producer whose output lives in THIS process's memory stamps its marker
+# directory with the process token; any later run (same knob, knob off,
+# spill-at-birth — whatever path it takes) that finds a sentinel from a
+# DIFFERENT process clears the block markers before trusting them: they
+# describe data that died with that process.
+
+
+def _sentinel_path(tmp_folder: str, uid: str) -> str:
+    return os.path.join(
+        tmp_folder, "markers", uid, ".memory_outputs.json"
+    )
+
+
+def mark_memory_producer(tmp_folder: str, uid: str) -> None:
+    """Stamp ``uid``'s markers as backed by this process's memory.  Call
+    AFTER :func:`invalidate_stale_markers` — the stamp makes this process's
+    own markers look current."""
+    path = _sentinel_path(tmp_folder, uid)
+    doc = fu.read_json_if_valid(path)
+    if doc and doc.get("token") == _PROCESS_TOKEN:
+        return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fu.atomic_write_json(path, {"token": _PROCESS_TOKEN})
+
+
+def invalidate_stale_markers(tmp_folder: str, uid: str) -> bool:
+    """Clear ``uid``'s block markers if they were stamped by ANOTHER
+    process's in-memory run (the data is gone with that process).  The
+    sentinel is removed too, so a storage-backed re-run does not keep
+    re-clearing.  Returns whether markers were invalidated.  Cheap no-op
+    when no sentinel exists — called from ``BaseTask.blocks_done``."""
+    path = _sentinel_path(tmp_folder, uid)
+    doc = fu.read_json_if_valid(path)
+    if doc is None:
+        if os.path.exists(path):
+            # torn sentinel: provenance unknown, treat as stale
+            doc = {}
+        else:
+            return False
+    if doc.get("token") == _PROCESS_TOKEN:
+        return False
+    fu.clear_block_markers(tmp_folder, uid)
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+    return True
+
+
+def is_live(identity: str) -> bool:
+    return get_registry().is_live(identity)
+
+
+def discard(identity: str) -> None:
+    """Drop a registry entry outright: a producer about to (re)write the
+    same identity through the STORAGE path (handoffs off for this run)
+    must not leave a previous run's live payload shadowing the fresh
+    bytes for consumers."""
+    reg = get_registry()
+    with reg._lock:
+        reg._entries.pop(identity, None)
+
+
+def is_resolvable(identity: str) -> bool:
+    """True when a consumer CAN resolve ``identity`` in this process: a
+    completed registry entry — live in memory, or spilled (storage holds
+    the checksummed copy consumers fall back to).  A producer manifest
+    recording a memory-only output stays valid in either state; only a
+    missing/incomplete entry (process restart) means the data is gone."""
+    entry = get_registry().get(identity)
+    return entry is not None and entry.complete
+
+
+def _file_reader(path: str, mode: str = "a"):
+    from ..io import open_container
+
+    return open_container(path, mode=mode)
+
+
+def _force_spill() -> bool:
+    from . import faults as faults_mod
+
+    return faults_mod.get_injector().force_spill()
+
+
+def _mem_headroom_ok(nbytes: int) -> bool:
+    """Admission headroom probe: a handoff bigger than half of what the
+    host has available cannot responsibly live in RAM."""
+    try:
+        from .supervision import host_mem_available_bytes
+
+        avail = host_mem_available_bytes()
+    except Exception:  # pragma: no cover
+        avail = None
+    return avail is None or nbytes <= avail // 2
+
+
+def _admit(nbytes: int) -> Optional[str]:
+    """None when ``nbytes`` may live in memory, else the spill reason.
+    Tries to make room by flushing completed elders first — the byte-budget
+    admission leg of the PR-4 degrade ladder."""
+    budget = handoff_budget()
+    if budget <= 0 or nbytes > budget:
+        return "admission:budget"
+    if not _mem_headroom_ok(nbytes):
+        return "admission:headroom"
+    if live_bytes() + nbytes > budget:
+        spill_for_headroom(need_bytes=nbytes)
+        if live_bytes() + nbytes > budget:
+            return "admission:budget"
+    return None
+
+
+# -- dataset handoffs ---------------------------------------------------------
+
+
+def acquire_dataset(
+    path: str,
+    key: str,
+    shape,
+    chunks,
+    dtype,
+    producer: str,
+    failures_path: Optional[str] = None,
+    fill_value: int = 0,
+) -> Tuple[Any, _Entry]:
+    """Producer-side acquire of a dataset handoff target.
+
+    Returns ``(dataset, entry)``: the dataset the task should write
+    through (an in-memory
+    :class:`~cluster_tools_tpu.io.containers.HandoffDataset`, or the real
+    storage dataset when the target spills at birth — admission rejection,
+    a forced ``spill`` fault, or a spilled predecessor at the same
+    identity) and the registry entry backing the declared target.
+    """
+    from ..io.containers import HandoffDataset, _check_existing
+
+    reg = get_registry()
+    identity = dataset_identity(path, key)
+    entry = reg.get(identity)
+    if entry is not None and entry.kind == "dataset":
+        # an in-flight headroom spill owns the payload: wait it out (the
+        # flush is bounded) instead of handing the producer a memory
+        # handle whose already-copied regions would silently lose writes
+        while entry.spilling:
+            time.sleep(0.01)
+        if not entry.spilled and entry.obj is not None:
+            ds = entry.obj
+            _check_existing(
+                key, ds.shape, ds.dtype, shape, dtype,
+                have_chunks=ds.chunks, want_chunks=chunks,
+            )
+            entry.producer = producer
+            entry.complete = False  # a new producer is writing again
+            if failures_path:
+                entry.failures_path = failures_path
+            return ds, entry
+        # spilled predecessor: storage is the source of truth now — the new
+        # producer writes through (pass-one spilled => pass-two must too,
+        # or pass-two reads of pass-one labels would see zeros)
+        store = _file_reader(path).require_dataset(
+            key, shape=shape, chunks=chunks, dtype=dtype
+        )
+        entry.producer = producer
+        if failures_path:
+            entry.failures_path = failures_path
+        return store, entry
+
+    nbytes = int(np.prod([int(s) for s in shape], dtype=np.int64)) * np.dtype(
+        dtype
+    ).itemsize
+    reason = "fault" if _force_spill() else _admit(nbytes)
+    if reason is not None:
+        # spill-at-birth: every block lands on storage through the normal
+        # (checksummed) write path; block-grain resume stays valid
+        store = _file_reader(path).require_dataset(
+            key, shape=shape, chunks=chunks, dtype=dtype
+        )
+        entry = _Entry("dataset", identity, path, key, None, nbytes,
+                       producer, failures_path)
+        entry.spilled = True
+        entry.spill_reason = reason
+        reg.put(entry)
+        reg.bump("handoffs_published")
+        reg.bump("handoffs_spilled")
+        reg.bump("bytes_spilled", nbytes)
+        return store, entry
+
+    def _store_factory():
+        return _file_reader(path).require_dataset(
+            key, shape=shape, chunks=chunks, dtype=dtype
+        )
+
+    ds = HandoffDataset(
+        shape=shape, chunks=chunks, dtype=dtype,
+        store_factory=_store_factory, label=f"handoff://{identity}",
+        fill_value=fill_value,
+    )
+    entry = _Entry("dataset", identity, path, key, ds, nbytes, producer,
+                   failures_path)
+    reg.put(entry)
+    reg.bump("handoffs_published")
+    return ds, entry
+
+
+def resolve_dataset(path: str, key: str):
+    """Consumer-side resolve: the live in-memory handle when a completed
+    handoff exists for ``(path, key)`` (counted ``handoffs_served``), the
+    stored copy when it spilled (counted ``handoff_fallbacks``), else the
+    plain storage dataset."""
+    reg = get_registry()
+    entry = reg.get(dataset_identity(path, key))
+    if entry is not None and entry.kind == "dataset":
+        obj = entry.obj
+        if not entry.spilled and obj is not None:
+            reg.bump("handoffs_served")
+            return obj
+        reg.bump("handoff_fallbacks")
+    return _file_reader(path)[key]
+
+
+# -- array-artifact handoffs --------------------------------------------------
+
+
+def _freeze(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    out = {}
+    for name, a in arrays.items():
+        a = np.asarray(a).copy()
+        a.setflags(write=False)
+        out[name] = a
+    return out
+
+
+def _views(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    # views of read-only arrays stay read-only: consumers cannot mutate a
+    # producer's published payload in place
+    return {name: a.view() for name, a in arrays.items()}
+
+
+def _crc_sidecar_path(path: str) -> str:
+    return path + ".crc.json"
+
+
+def _is_npy(path: str) -> bool:
+    return path.endswith(".npy")
+
+
+def _write_artifact(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    """Spill one artifact: atomic npz/npy write + a CRC32 sidecar, so a
+    fallback load can verify the stored bytes like any chunk read."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        if _is_npy(path):
+            (arr,) = arrays.values()
+            np.save(f, arr)
+        else:
+            np.savez(f, **arrays)
+    os.replace(tmp, path)
+    fu.atomic_write_json(
+        _crc_sidecar_path(path),
+        {
+            "algo": "crc32",
+            "arrays": {
+                name: zlib.crc32(np.ascontiguousarray(a).tobytes())
+                for name, a in arrays.items()
+            },
+        },
+    )
+
+
+def _verify_artifact(path: str, arrays: Dict[str, np.ndarray]) -> bool:
+    """CRC-check ``arrays`` against the spill sidecar; True when a sidecar
+    was present (i.e. the file is a spilled handoff artifact).  No sidecar
+    (a pre-handoff plain file) verifies vacuously as False."""
+    doc = fu.read_json_if_valid(_crc_sidecar_path(path))
+    if not doc:
+        return False
+    from ..io.containers import ChunkCorruptionError
+
+    want = doc.get("arrays") or {}
+    for name, a in arrays.items():
+        if name not in want:
+            continue
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes())
+        if crc != want[name]:
+            raise ChunkCorruptionError(
+                f"{path}[{name}]", (), want[name], crc
+            )
+    return True
+
+
+def publish_arrays(
+    path: str,
+    arrays: Dict[str, np.ndarray],
+    producer: str,
+    failures_path: Optional[str] = None,
+) -> _Entry:
+    """Producer-side publish of named arrays under an artifact path (the
+    npz/npy file a storage consumer would have loaded).  Arrays are frozen
+    read-only; a forced ``spill`` fault or admission rejection writes the
+    file (+ CRC sidecar) instead and keeps storage as the source of truth.
+    Complete immediately — artifacts have no block grain."""
+    reg = get_registry()
+    identity = artifact_identity(path)
+    frozen = _freeze(arrays)
+    nbytes = sum(a.nbytes for a in frozen.values())
+    reason = "fault" if _force_spill() else _admit(nbytes)
+    entry = _Entry("arrays", identity, path, None, None, nbytes, producer,
+                   failures_path)
+    entry.complete = True
+    if reason is not None:
+        _write_artifact(path, frozen)
+        entry.spilled = True
+        entry.spill_reason = reason
+        reg.put(entry)
+        reg.bump("handoffs_published")
+        reg.bump("handoffs_spilled")
+        reg.bump("bytes_spilled", nbytes)
+        return entry
+    entry.obj = frozen
+    reg.put(entry)
+    reg.bump("handoffs_published")
+    reg.bump("bytes_not_stored", nbytes)
+    return entry
+
+
+def load_arrays(path: str) -> Dict[str, np.ndarray]:
+    """Consumer-side load of an array artifact: the live in-memory payload
+    when one exists (``handoffs_served``), else the file — verified against
+    its CRC sidecar when the artifact was spilled (``handoff_fallbacks``).
+    Plain files published before the handoff layer load unchanged."""
+    reg = get_registry()
+    entry = reg.get(artifact_identity(path))
+    if entry is not None and entry.kind == "arrays":
+        obj = entry.obj
+        if not entry.spilled and obj is not None:
+            reg.bump("handoffs_served")
+            return _views(obj)
+        reg.bump("handoff_fallbacks")
+    if _is_npy(path):
+        arr = np.load(path)
+        out = {"data": arr}
+    else:
+        with np.load(path) as f:
+            out = {name: f[name] for name in f.files}
+    # verify UNCONDITIONALLY: a crash-resumed process has an empty
+    # registry, and the restart case is exactly what the sidecar exists
+    # for.  A sidecar also identifies the file as a spilled handoff, so
+    # restart-time fallback reads are counted too.
+    was_spilled = _verify_artifact(path, out)
+    if entry is None and was_spilled:
+        reg.bump("handoff_fallbacks")
+    return out
+
+
+def load_array(path: str) -> np.ndarray:
+    """Single-array twin of :func:`load_arrays` for ``.npy`` artifacts."""
+    arrays = load_arrays(path)
+    (arr,) = arrays.values()
+    return arr
+
+
+def forget_artifact(path: str) -> None:
+    """A producer is about to write ``path`` as a PLAIN file (handoffs off
+    for this run): drop any previous run's live payload and the spill CRC
+    sidecar — a stale sidecar would flag the fresh bytes as corruption."""
+    discard(artifact_identity(path))
+    try:
+        os.remove(_crc_sidecar_path(path))
+    except OSError:
+        pass
+
+
+def array_exists(path: str) -> bool:
+    """True when the artifact is resolvable — live in memory or on disk."""
+    entry = get_registry().get(artifact_identity(path))
+    if entry is not None and entry.kind == "arrays" and not entry.spilled \
+            and entry.obj is not None:
+        return True
+    return os.path.exists(path)
+
+
+# -- spill machinery ----------------------------------------------------------
+
+
+def _spill_entry(entry: _Entry, reason: str) -> int:
+    """Flush one live entry to its storage spill path and release its RAM.
+    Returns the bytes freed (0 when the entry was already spilled/being
+    spilled by another thread, or the spill failed — a failed spill keeps
+    the memory copy, which is still the only copy).
+
+    The claim protocol matters: ``spilled`` must never be observable
+    before the storage copy is COMPLETE, or a concurrent consumer's
+    fallback would read a half-written dataset.  Exactly one thread wins
+    the claim; the flags flip (under the registry lock) only after the
+    copy landed."""
+    reg = get_registry()
+    if not reg.claim_spill(entry):
+        return 0
+    obj = entry.obj
+    freed = 0
+    ok = False
+    try:
+        if entry.kind == "dataset":
+            freed = obj.spill()
+        else:
+            _write_artifact(entry.path, obj)
+            freed = entry.nbytes
+        ok = True
+    except Exception:
+        ok = False
+    finally:
+        reg.finish_spill(entry, ok, reason)
+    if not ok:
+        return 0
+    reg.bump("handoffs_spilled")
+    reg.bump("bytes_spilled", freed)
+    # reconcile the "never stored" figure: these bytes DID reach storage
+    # after all (datasets track their accumulated write bytes; artifacts
+    # counted their payload once at publish)
+    if entry.kind == "dataset":
+        not_stored = int(getattr(obj, "not_stored_bytes", 0))
+    else:
+        not_stored = entry.nbytes
+    if not_stored:
+        reg.bump("bytes_not_stored", -not_stored)
+    _record_spill(entry)
+    return freed
+
+
+def _record_spill(entry: _Entry) -> None:
+    """One ``degraded:spilled`` failures.json record per spilled target —
+    the degrade ladder's attribution contract (docs/ROBUSTNESS.md)."""
+    if entry.recorded or not entry.failures_path:
+        return
+    entry.recorded = True
+    try:
+        fu.record_failures(
+            entry.failures_path,
+            f"{entry.producer}.handoff",
+            [{
+                "block_id": None,
+                "sites": {"spill": 1},
+                "error": None,
+                "quarantined": False,
+                "resolved": True,
+                "resolution": "degraded:spilled",
+                "handoff": entry.identity,
+                "reason": entry.spill_reason,
+            }],
+        )
+    except Exception:
+        pass  # attribution is best-effort; the spill itself already landed
+
+
+def spill_for_headroom(need_bytes: Optional[int] = None) -> int:
+    """Flush completed in-memory handoffs to storage, oldest first.
+    Called by the executor's admission gate when host-memory headroom runs
+    low (no ``need_bytes``: flush everything — the pressure is real RAM)
+    and by :func:`_admit` to make room for one new target (``need_bytes``:
+    stop as soon as it fits the budget, so one marginal admission does not
+    force every remaining consumer onto the fallback-read path).  Returns
+    bytes freed."""
+    budget = handoff_budget()
+    freed = 0
+    for entry in get_registry().spill_candidates():
+        if need_bytes is not None and live_bytes() + need_bytes <= budget:
+            break
+        freed += _spill_entry(entry, "headroom")
+    return freed
+
+
+def finalize_task(targets, uid: str) -> List[Dict[str, Any]]:
+    """Producer-task completion: mark this task's targets complete and emit
+    the success-manifest records the DAG engine validates on resume
+    (:meth:`~cluster_tools_tpu.runtime.task.BaseTask.complete`).  Spilled
+    targets (at-birth or since) get their ``degraded:spilled`` attribution
+    here if not already recorded."""
+    records = []
+    seen = set()
+    for target in targets:
+        entry = target.entry
+        if entry.identity in seen:
+            continue
+        seen.add(entry.identity)
+        entry.complete = True
+        if entry.spilled:
+            _record_spill(entry)
+        records.append({
+            "identity": entry.identity,
+            "path": entry.path,
+            "key": entry.key,
+            "kind": entry.kind,
+            "stored": bool(entry.spilled),
+            "bytes": int(entry.nbytes),
+        })
+    return records

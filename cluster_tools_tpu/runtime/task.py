@@ -68,6 +68,37 @@ class SuccessTarget:
         return doc
 
 
+class MemoryTarget:
+    """A typed in-memory output target (docs/PERFORMANCE.md "Task-graph
+    fusion"): the declaration that a task's output lives in host RAM,
+    keyed by the dataset/artifact identity a storage consumer would have
+    opened, with spill-to-storage as the universal fallback.
+
+    Declared through :meth:`BaseTask.handoff_dataset` (chunked volumes) or
+    :meth:`BaseTask.save_handoff_arrays` (npz/npy artifacts); backed by the
+    process-wide registry in :mod:`cluster_tools_tpu.runtime.handoff`.  The
+    task's success manifest records one entry per target (``stored`` True
+    when it spilled), and :meth:`BaseTask.complete` treats a memory-only
+    manifest whose handle is gone — a process restart — as NOT done, so the
+    DAG re-runs the producer instead of handing consumers a hole.
+    """
+
+    def __init__(self, entry):
+        self.entry = entry
+
+    @property
+    def identity(self) -> str:
+        return self.entry.identity
+
+    def live(self) -> bool:
+        """True while the payload is resident (and not spilled)."""
+        return not self.entry.spilled and self.entry.obj is not None
+
+    def stored(self) -> bool:
+        """True once the payload has a storage copy (spilled)."""
+        return bool(self.entry.spilled)
+
+
 class BaseTask:
     """Base of all tasks.  Subclasses set ``task_name`` and define
     ``run_impl()``; backend subclasses (``<Op>Local`` / ``<Op>TPU``) only pin
@@ -107,6 +138,10 @@ class BaseTask:
         self.logger = fu.get_logger(
             self.uid, os.path.join(tmp_folder, f"{self.uid}.log")
         )
+        # in-memory output targets declared during run_impl (docs/
+        # PERFORMANCE.md "Task-graph fusion"); finalized into the success
+        # manifest by run()
+        self._memory_targets: List[MemoryTarget] = []
 
     # -- config ------------------------------------------------------------
     @staticmethod
@@ -141,7 +176,13 @@ class BaseTask:
         ``splittable`` contract), ``min_block_shape`` (split floor),
         ``degrade_wait_s`` (bounded headroom wait before a degrade
         re-attempt) and ``inflight_byte_budget`` (admission cap; None =
-        auto from MemAvailable, 0 = off)."""
+        auto from MemAvailable, 0 = off).  ``memory_handoffs`` (default
+        off) enables task-graph fusion (docs/PERFORMANCE.md): intermediate
+        outputs declared through :meth:`handoff_dataset` /
+        :meth:`save_handoff_arrays` stay in host RAM and downstream tasks
+        consume them without a storage round-trip, with spill-to-storage
+        (byte-budget admission, headroom probes, forced ``spill`` faults)
+        as the universal fallback."""
         return {
             "max_retries": 0,
             "retry_backoff_s": 1.0,
@@ -161,6 +202,7 @@ class BaseTask:
             "min_block_shape": None,
             "degrade_wait_s": 5.0,
             "inflight_byte_budget": None,
+            "memory_handoffs": False,
         }
 
     @staticmethod
@@ -192,6 +234,7 @@ class BaseTask:
 
     def run(self):
         from . import faults as faults_mod
+        from . import handoff as handoff_mod
         from ..io import chunk_cache
 
         from . import executor as executor_mod
@@ -202,22 +245,34 @@ class BaseTask:
         faults_mod.set_current_task(self.uid)
         io_snap = chunk_cache.snapshot()
         disp_snap = executor_mod.dispatch_snapshot()
+        handoff_snap = handoff_mod.snapshot()
         try:
             result = self.run_impl() or {}
+            # finalize in-memory targets INSIDE the task context: forced
+            # `spill` faults filter on the producing task's uid
+            handoff_records = self._finalize_handoffs()
         finally:
             faults_mod.set_current_task(None)
         result["runtime_s"] = time.time() - t0
         result["target"] = self.target
-        # chunk-IO + dispatch attribution: the cache and compiled-dispatch
-        # counters' movement during this task, surfaced in the success
-        # manifest AND merged (additively, across resumed runs and cluster
-        # job processes) into the run-wide io_metrics.json next to
-        # failures.json — so the sharded sweep's dispatch amortization is
-        # observable per task (docs/PERFORMANCE.md "Sharded sweeps")
+        if handoff_records:
+            # the DAG engine's resume contract (complete()): a memory-only
+            # record whose handle died with this process re-runs the task
+            result["handoffs"] = handoff_records
+        # chunk-IO + dispatch + handoff attribution: the counters' movement
+        # during this task, surfaced in the success manifest AND merged
+        # (additively, across resumed runs and cluster job processes) into
+        # the run-wide io_metrics.json next to failures.json — so the
+        # sharded sweep's dispatch amortization and the fusion layer's
+        # avoided storage round-trips are observable per task
+        # (docs/PERFORMANCE.md "Sharded sweeps" / "Task-graph fusion")
         io_metrics = chunk_cache.delta(io_snap)
         dispatch_metrics = executor_mod.dispatch_delta(disp_snap)
         if any(dispatch_metrics.values()):
             io_metrics.update(dispatch_metrics)
+        handoff_metrics = handoff_mod.delta(handoff_snap)
+        if any(handoff_metrics.values()):
+            io_metrics.update(handoff_metrics)
         if any(io_metrics.values()):
             result["io_metrics"] = io_metrics
             try:
@@ -235,6 +290,16 @@ class BaseTask:
 
     # -- block-level resume helpers ---------------------------------------
     def blocks_done(self) -> List[int]:
+        # markers stamped by ANOTHER process's in-memory run describe data
+        # that died with it (docs/PERFORMANCE.md "Task-graph fusion") —
+        # cleared here regardless of how THIS run stores its output
+        from . import handoff
+
+        if handoff.invalidate_stale_markers(self.tmp_folder, self.uid):
+            self.logger.info(
+                f"{self.task_name}: cleared block markers from a previous "
+                "process's in-memory run (outputs no longer exist)"
+            )
         return fu.blocks_done(self.tmp_folder, self.uid)
 
     def log_block_success(self, block_id: int):
@@ -252,6 +317,179 @@ class BaseTask:
         markers are pruned as a side effect of :meth:`blocks_done`."""
         fu.clean_up_for_retry(self.tmp_folder, self.uid)
         self.blocks_done()
+
+    # -- in-memory handoff targets (docs/PERFORMANCE.md "Task-graph fusion") --
+    def _handoffs_on(self) -> bool:
+        """Task-graph fusion applies when the ``memory_handoffs`` config
+        knob is set, the process-level kill switch (``CTT_HANDOFF``) is on,
+        and the task does not cross a host boundary (cluster targets run
+        their payload in a separate process whose memory dies before the
+        submitter-side consumer runs)."""
+        if self.target in _CLUSTER_TARGETS:
+            return False
+        from . import handoff
+
+        if not handoff.handoff_enabled():
+            return False
+        try:
+            cfg = self.get_config()
+        except Exception:
+            return False
+        return bool(cfg.get("memory_handoffs", False))
+
+    def declare_handoff_producer(self) -> bool:
+        """Call at the top of ``run_impl`` in tasks that publish
+        *artifact* handoffs from block-grain work (per-block npz/npy
+        writers under :meth:`host_block_map`): returns whether handoffs
+        are on, and stamps this task's marker directory with the process
+        token — any later run in a different process (whatever its knob
+        or spill path) clears the markers before trusting them, because
+        the data they describe dies with this process
+        (:func:`~cluster_tools_tpu.runtime.handoff.invalidate_stale_markers`,
+        checked inside :meth:`blocks_done`).  Dataset producers get the
+        same guard from :meth:`handoff_dataset`.
+        """
+        if not self._handoffs_on():
+            return False
+        from . import handoff
+
+        handoff.invalidate_stale_markers(self.tmp_folder, self.uid)
+        handoff.mark_memory_producer(self.tmp_folder, self.uid)
+        return True
+
+    def handoff_dataset(self, path, key, shape, chunks, dtype,
+                        fill_value: int = 0):
+        """Declare a chunked-volume output as a :class:`MemoryTarget` and
+        return the dataset to write through.
+
+        With handoffs off (the default) this is exactly
+        ``file_reader(path).require_dataset(...)`` — the storage path,
+        bit-for-bit.  With handoffs on, the returned dataset is the
+        in-memory ``memory://`` twin
+        (:class:`~cluster_tools_tpu.io.containers.HandoffDataset`) unless
+        the target spills at birth (byte-budget admission, a forced
+        ``spill`` fault, or a spilled predecessor at the same identity) —
+        then it is the real storage dataset and every write lands
+        checksummed as usual.
+
+        Contract (docs/ANALYSIS.md CT007): a declaring call site must pass
+        the full spill wiring — ``path``/``key`` plus the ``shape`` /
+        ``chunks`` / ``dtype`` needed to create the storage twin — and the
+        module must wire the returned handle into a post-store
+        ``region_verifier`` so integrity verification covers the in-memory
+        data plane too.
+        """
+        from ..utils.volume_utils import file_reader
+        from . import handoff
+
+        if not self._handoffs_on():
+            # a previous run's live payload at this identity must not
+            # shadow the fresh STORAGE bytes this run is about to write
+            handoff.discard(handoff.dataset_identity(path, key))
+            return file_reader(path).require_dataset(
+                key, shape=shape, chunks=chunks, dtype=dtype
+            )
+
+        # markers stamped by a previous process's in-memory run are stale
+        # on EVERY acquire path — including spill-at-birth, whose storage
+        # twin starts empty where those markers claim blocks are done
+        handoff.invalidate_stale_markers(self.tmp_folder, self.uid)
+        ds, entry = handoff.acquire_dataset(
+            path, key, shape=shape, chunks=chunks, dtype=dtype,
+            producer=self.uid, failures_path=self.failures_path,
+            fill_value=fill_value,
+        )
+        self._memory_targets.append(MemoryTarget(entry))
+        if not entry.spilled:
+            # output lives in THIS process's memory: stamp the markers so
+            # any later process invalidates them before trusting them
+            handoff.mark_memory_producer(self.tmp_folder, self.uid)
+        return ds
+
+    def save_handoff_arrays(self, path, **arrays):
+        """Publish named arrays as the artifact a storage consumer would
+        have loaded from ``path`` (npz).  With handoffs off this is a plain
+        ``np.savez`` — today's behavior.  With handoffs on the arrays stay
+        in host RAM (read-only) unless admission or a forced ``spill``
+        fault writes the file (+ CRC sidecar) through."""
+        from . import handoff
+
+        if not self._handoffs_on():
+            import numpy as np
+
+            # drop any previous run's live payload AND spill sidecar for
+            # this identity: the plain file this run writes is the truth,
+            # and a stale CRC would flag the fresh bytes as corruption
+            handoff.forget_artifact(path)
+            np.savez(path, **arrays)
+            return
+        entry = handoff.publish_arrays(
+            path, arrays, producer=self.uid,
+            failures_path=self.failures_path,
+        )
+        self._memory_targets.append(MemoryTarget(entry))
+
+    def save_handoff_array(self, path, array):
+        """Single-array (`.npy`) twin of :meth:`save_handoff_arrays`."""
+        from . import handoff
+
+        if not self._handoffs_on():
+            import numpy as np
+
+            handoff.forget_artifact(path)
+            np.save(path, array)
+            return
+        entry = handoff.publish_arrays(
+            path, {"data": array}, producer=self.uid,
+            failures_path=self.failures_path,
+        )
+        self._memory_targets.append(MemoryTarget(entry))
+
+    def _finalize_handoffs(self) -> List[Dict[str, Any]]:
+        """Mark this run's declared targets complete; returns the manifest
+        records :meth:`complete` validates on resume.  Runs while the fault
+        injector's current-task context is still set, so ``spill`` faults
+        can target tasks."""
+        if not self._memory_targets:
+            return []
+        from . import handoff
+
+        return handoff.finalize_task(self._memory_targets, self.uid)
+
+    def complete(self) -> bool:
+        """Luigi-style completeness with handoff resolution: the success
+        manifest must exist AND every memory-only output it records must
+        still be live in this process's registry.  A memory-only manifest
+        whose handle is gone (process restart) is invalidated — manifest
+        and block markers removed — so the DAG re-runs the producer
+        instead of handing consumers a hole; spilled outputs stay complete
+        because storage holds the (checksummed) truth."""
+        doc = fu.read_json_if_valid(self.output().path)
+        if doc is None:
+            return False
+        stale = [h for h in doc.get("handoffs", []) if not h.get("stored")]
+        if stale:
+            from . import handoff
+
+            # resolvable = live in memory OR spilled since the manifest
+            # was written (a post-completion headroom spill leaves a valid
+            # checksummed storage copy — not a reason to recompute)
+            stale = [
+                h for h in stale
+                if not handoff.is_resolvable(h.get("identity"))
+            ]
+        if not stale:
+            return True
+        self.logger.info(
+            f"{self.task_name}: {len(stale)} memory-only handoff output(s) "
+            "no longer live (process restart?) — re-running the task"
+        )
+        try:
+            os.remove(self.output().path)
+        except OSError:
+            pass
+        fu.clear_block_markers(self.tmp_folder, self.uid)
+        return False
 
     def host_block_map(
         self,
@@ -574,8 +812,11 @@ def build(tasks: Sequence[BaseTask], rerun: bool = False) -> bool:
         key = _key(task)
         # completeness first: a task whose target already exists is done,
         # even when an upstream failed (luigi semantics) — its own
-        # dependents still get their real input
-        if task.output().exists() and not rerun:
+        # dependents still get their real input.  complete() additionally
+        # validates in-memory handoff outputs: a memory-only manifest
+        # whose handle died with its process re-runs (docs/PERFORMANCE.md
+        # "Task-graph fusion")
+        if task.complete() and not rerun:
             task.logger.info(f"skip {task.task_name}: target exists")
             continue
         blocked = [d for d in deps_of[key] if d in failed]

@@ -46,8 +46,10 @@ class AgglomerativeClusteringBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
+        from ..runtime import handoff
+
         nodes, _, edges, sizes = load_global_graph(self.tmp_folder)
-        feats = np.load(features_path(self.tmp_folder))
+        feats = handoff.load_array(features_path(self.tmp_folder))
         labels = average_parallel(
             len(nodes),
             edges.astype(np.int64),

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..ops.ccl import label_components, label_components_keyed
 from ..ops.unionfind import union_find, union_find_host
+from ..runtime import handoff
 from ..runtime.executor import (
     BlockwiseExecutor,
     region_verifier,
@@ -82,23 +83,28 @@ class BlockComponentsBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # fusable input edge: a producer's live in-memory handoff (e.g. an
+        # inference probability map) is consumed without a storage read
+        inp = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = inp.shape
         block_shape = tuple(cfg["block_shape"])
         blocking = Blocking(shape, block_shape)
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
+        # MemoryTarget output: label volume stays in RAM for the faces /
+        # write consumers, spill-to-storage under the degrade ladder
+        out = self.handoff_dataset(
+            cfg["output_path"], cfg["output_key"],
+            shape=shape, chunks=block_shape, dtype="uint64",
+        )
+        # the per-block uniques below are block-grain ARTIFACT handoffs:
+        # stamp the marker epoch even when the dataset itself spilled at
+        # birth, or a resumed process would trust markers whose uniques
+        # died in this process's RAM
+        self.declare_handoff_producer()
         done = set(self.blocks_done())
         blocks_all = [blocking.get_block(b) for b in block_ids]
-
-        out_f = file_reader(cfg["output_path"])
-        out = out_f.require_dataset(
-            cfg["output_key"],
-            shape=shape,
-            chunks=block_shape,
-            dtype="uint64",
-        )
 
         threshold = cfg.get("threshold")
         mode = cfg.get("threshold_mode", "greater")
@@ -153,7 +159,9 @@ class BlockComponentsBase(BaseTask):
             labels = np.zeros(bs, np.uint64)
             labels[fg] = glob
             out[block.bb] = labels
-            np.save(_uniques_path(self.tmp_folder, block.block_id), np.unique(glob))
+            self.save_handoff_array(
+                _uniques_path(self.tmp_folder, block.block_id), np.unique(glob)
+            )
 
         executor = BlockwiseExecutor(
             target=self.target,
@@ -208,21 +216,25 @@ class MergeLabelsBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        shape = file_reader(cfg["input_path"])[cfg["input_key"]].shape
+        shape = handoff.resolve_dataset(
+            cfg["input_path"], cfg["input_key"]
+        ).shape
         block_ids = blocks_in_volume(
             shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
         )
         uniques = [
-            np.load(_uniques_path(self.tmp_folder, b))
+            handoff.load_array(_uniques_path(self.tmp_folder, b))
             for b in block_ids
-            if os.path.exists(_uniques_path(self.tmp_folder, b))
+            if handoff.array_exists(_uniques_path(self.tmp_folder, b))
         ]
         table = (
             np.unique(np.concatenate(uniques))
             if uniques
             else np.zeros(0, np.uint64)
         )
-        np.save(os.path.join(self.tmp_folder, "cc_label_table.npy"), table)
+        self.save_handoff_array(
+            os.path.join(self.tmp_folder, "cc_label_table.npy"), table
+        )
         return {"n_labels": len(table)}
 
 
@@ -273,9 +285,12 @@ class BlockFacesBase(BaseTask):
         connectivity = int(cfg.get("connectivity", 1))
         keyed = bool(cfg.get("keyed", False))
         inp_ds = (
-            file_reader(cfg["input_path"])[cfg["input_key"]] if keyed else None
+            handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
+            if keyed else None
         )
-        ds = file_reader(cfg["output_path"])[cfg["output_key"]]
+        # fusable edge (block_components -> block_faces): slab reads come
+        # from the live in-memory label volume when one exists
+        ds = handoff.resolve_dataset(cfg["output_path"], cfg["output_key"])
         shape = ds.shape
         ndim = len(shape)
         block_shape = tuple(cfg["block_shape"])
@@ -290,6 +305,7 @@ class BlockFacesBase(BaseTask):
         # block-direction list (each adjacent block pair scanned once);
         # {-1,0,1} offsets make sum(|o|) == nnz, so the budgets coincide
         directions = _neighbor_offsets(ndim, connectivity)
+        self.declare_handoff_producer()
 
         def slab_bbs(block, d):
             """(our-side bb, neighbor-side bb) of the shared boundary."""
@@ -340,7 +356,7 @@ class BlockFacesBase(BaseTask):
                 if pairs
                 else np.zeros((0, 2), np.uint64)
             )
-            np.save(_faces_path(self.tmp_folder, block_id), result)
+            self.save_handoff_array(_faces_path(self.tmp_folder, block_id), result)
 
         n = self.host_block_map(block_ids, process)
         return {"n_blocks": n}
@@ -370,18 +386,22 @@ class MergeAssignmentsBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        shape = file_reader(cfg["input_path"])[cfg["input_key"]].shape
-        table = np.load(os.path.join(self.tmp_folder, "cc_label_table.npy"))
+        shape = handoff.resolve_dataset(
+            cfg["input_path"], cfg["input_key"]
+        ).shape
+        table = handoff.load_array(
+            os.path.join(self.tmp_folder, "cc_label_table.npy")
+        )
         block_ids = blocks_in_volume(
             shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
         )
         pair_files = [
             _faces_path(self.tmp_folder, b)
             for b in block_ids
-            if os.path.exists(_faces_path(self.tmp_folder, b))
+            if handoff.array_exists(_faces_path(self.tmp_folder, b))
         ]
         pairs = (
-            np.concatenate([np.load(f) for f in pair_files])
+            np.concatenate([handoff.load_array(f) for f in pair_files])
             if pair_files
             else np.zeros((0, 2), np.uint64)
         )
@@ -399,7 +419,7 @@ class MergeAssignmentsBase(BaseTask):
         # renumber roots consecutively 1..K
         uniq_roots, assignment = np.unique(roots, return_inverse=True)
         assignment = (assignment + 1).astype(np.uint64)
-        np.savez(
+        self.save_handoff_arrays(
             os.path.join(self.tmp_folder, "cc_assignments.npz"),
             keys=table,
             values=assignment,

@@ -21,6 +21,7 @@ import os
 import numpy as np
 import jax.numpy as jnp
 
+from ..runtime import handoff
 from ..runtime.task import BaseTask
 from .features import features_path
 from .graph import graph_dir, load_global_graph
@@ -73,7 +74,9 @@ class ProbsToCostsBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        feats = np.load(features_path(self.tmp_folder))
+        # fusable edges (features -> costs, graph -> costs): consume the
+        # merged features and edge sizes from live in-memory handoffs
+        feats = handoff.load_array(features_path(self.tmp_folder))
         _, _, _, sizes = load_global_graph(self.tmp_folder)
         probs = feats[:, 0]
         use_sizes = cfg.get("weighting_scheme") == "size"
@@ -83,7 +86,7 @@ class ProbsToCostsBase(BaseTask):
             edge_sizes=sizes if use_sizes else None,
             weighting_exponent=float(cfg.get("weighting_exponent", 1.0)),
         )
-        np.save(costs_path(self.tmp_folder), costs)
+        self.save_handoff_array(costs_path(self.tmp_folder), costs)
         return {"n_edges": len(costs), "n_attractive": int((costs > 0).sum())}
 
 

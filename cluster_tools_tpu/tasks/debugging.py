@@ -27,6 +27,7 @@ import numpy as np
 from concurrent.futures import ThreadPoolExecutor
 
 from ..ops.rag import block_rag
+from ..runtime import handoff
 from ..runtime.task import BaseTask
 from ..utils import function_utils as fu
 
@@ -51,7 +52,8 @@ class CheckSubGraphsBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        ds = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # the volume under validation may live only in a handoff handle
+        ds = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = ds.shape
         block_shape = tuple(cfg["block_shape"])
         blocking = Blocking(shape, block_shape)
@@ -62,18 +64,18 @@ class CheckSubGraphsBase(BaseTask):
 
         def process(block_id):
             p = block_graph_path(self.tmp_folder, block_id)
-            if not os.path.exists(p):
+            if not handoff.array_exists(p):
                 bad.append({"block": block_id, "error": "missing graph artifact"})
                 return
             block = blocking.get_block(block_id)
             seg = np.asarray(ds[_upper_halo_bb(block, shape)])
             uv, sizes, _ = block_rag(seg, inner_shape=block.shape)
-            with np.load(p) as f:
-                ok = (
-                    f["uv"].shape == uv.shape
-                    and (f["uv"] == uv).all()
-                    and (f["sizes"] == sizes).all()
-                )
+            f = handoff.load_arrays(p)
+            ok = (
+                f["uv"].shape == uv.shape
+                and (f["uv"] == uv).all()
+                and (f["sizes"] == sizes).all()
+            )
             if not ok:
                 bad.append({"block": block_id, "error": "graph mismatch"})
 
@@ -115,7 +117,8 @@ class CheckBlocksBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        ds = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # the volume under validation may live only in a handoff handle
+        ds = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = ds.shape
         block_shape = tuple(cfg["block_shape"])
         blocking = Blocking(shape, block_shape)

@@ -21,6 +21,7 @@ import os
 import numpy as np
 
 from ..ops.rag import block_rag, merge_feature_lists
+from ..runtime import handoff
 from ..runtime.task import BaseTask, WorkflowBase
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 from .graph import _upper_halo_bb, graph_dir, load_global_graph
@@ -66,8 +67,11 @@ class BlockEdgeFeaturesBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        ds_in = file_reader(cfg["input_path"])[cfg["input_key"]]
-        ds_labels = file_reader(cfg["labels_path"])[cfg["labels_key"]]
+        # fusable edges: the boundary map may itself be a live in-memory
+        # handoff (inference/ilastik output), and the supervoxels come
+        # from the watershed producer's handle when one exists
+        ds_in = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
+        ds_labels = handoff.resolve_dataset(cfg["labels_path"], cfg["labels_key"])
         shape = ds_labels.shape
         block_shape = tuple(cfg["block_shape"])
         blocking = Blocking(shape, block_shape)
@@ -75,6 +79,7 @@ class BlockEdgeFeaturesBase(BaseTask):
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
         channel = cfg.get("channel")
+        self.declare_handoff_producer()
 
         def process(block_id: int):
             block = blocking.get_block(block_id)
@@ -82,7 +87,7 @@ class BlockEdgeFeaturesBase(BaseTask):
             seg = np.asarray(ds_labels[bb])
             val = _read_boundary_map(ds_in, bb, channel)
             uv, _, feats = block_rag(seg, values=val, inner_shape=block.shape)
-            np.savez(
+            self.save_handoff_arrays(
                 block_features_path(self.tmp_folder, block_id), uv=uv, feats=feats
             )
 
@@ -106,7 +111,9 @@ class MergeEdgeFeaturesBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        shape = file_reader(cfg["labels_path"])[cfg["labels_key"]].shape
+        shape = handoff.resolve_dataset(
+            cfg["labels_path"], cfg["labels_key"]
+        ).shape
         block_ids = blocks_in_volume(
             shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
         )
@@ -114,11 +121,13 @@ class MergeEdgeFeaturesBase(BaseTask):
 
         def parts():
             for b in block_ids:
-                with np.load(block_features_path(self.tmp_folder, b)) as f:
-                    yield f["uv"], f["feats"]
+                f = handoff.load_arrays(
+                    block_features_path(self.tmp_folder, b)
+                )
+                yield f["uv"], f["feats"]
 
         feats = merge_feature_lists(uv_global, parts())
-        np.save(features_path(self.tmp_folder), feats)
+        self.save_handoff_array(features_path(self.tmp_folder), feats)
         return {"n_edges": len(feats)}
 
 

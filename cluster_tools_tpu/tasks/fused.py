@@ -72,8 +72,12 @@ class FusedSegmentationBase(BaseTask):
         from ..parallel.pipeline import make_ws_ccl_step
         from ..parallel.split_pipeline import make_ws_ccl_split
 
+        from ..runtime import handoff
+
         cfg = self.get_config()
-        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # fusable input edge: a live in-memory boundary-map handle is
+        # consumed without a storage read
+        inp = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = inp.shape
         roi_begin = tuple(cfg.get("roi_begin") or (0,) * len(shape))
         roi_end = tuple(cfg.get("roi_end") or shape)
@@ -199,4 +203,15 @@ class FusedSegmentationWorkflow(WorkflowBase):
         ]
 
     def run_impl(self):
-        return {}
+        # surface the inner task's output stats in the workflow's own
+        # success manifest — failures_report and operators read the
+        # workflow manifest, and a bare {} hid what the fused path wrote
+        try:
+            doc = self.requires()[0].output().read()
+        except OSError:
+            return {}
+        return {
+            k: doc[k]
+            for k in ("n_foreground", "written", "mesh")
+            if k in doc
+        }

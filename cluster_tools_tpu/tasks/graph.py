@@ -23,8 +23,9 @@ import os
 import numpy as np
 
 from ..ops.rag import block_rag, find_edge_ids, merge_edge_lists
+from ..runtime import handoff
 from ..runtime.task import BaseTask, WorkflowBase
-from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+from ..utils.volume_utils import Blocking, blocks_in_volume
 
 
 def graph_dir(tmp_folder: str) -> str:
@@ -46,9 +47,11 @@ def edge_ids_path(tmp_folder: str, block_id: int) -> str:
 
 
 def load_global_graph(tmp_folder: str):
-    """Load the merged graph: (nodes, uv, edges, sizes)."""
-    with np.load(global_graph_path(tmp_folder)) as f:
-        return f["nodes"], f["uv"], f["edges"], f["sizes"]
+    """Load the merged graph: (nodes, uv, edges, sizes).  Served from the
+    in-memory handoff when the producing task published one (task-graph
+    fusion), else from the npz artifact."""
+    f = handoff.load_arrays(global_graph_path(tmp_folder))
+    return f["nodes"], f["uv"], f["edges"], f["sizes"]
 
 
 def _upper_halo_bb(block, shape):
@@ -69,13 +72,19 @@ class InitialSubGraphsBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        ds = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # fusable edge (watershed -> graph): consume the supervoxel volume
+        # from the producer's in-memory handoff when one is live
+        ds = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = ds.shape
         block_shape = tuple(cfg["block_shape"])
         blocking = Blocking(shape, block_shape)
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
+        # block graphs are published in memory for MergeSubGraphs; stale
+        # markers from a previous process are invalidated here
+        self.declare_handoff_producer()
+
         def process(block_id: int):
             block = blocking.get_block(block_id)
             seg = np.asarray(ds[_upper_halo_bb(block, shape)])
@@ -86,7 +95,7 @@ class InitialSubGraphsBase(BaseTask):
                 seg, inner_shape=block.shape, return_nodes=True
             )
             nodes = nodes.astype(np.uint64)
-            np.savez(
+            self.save_handoff_arrays(
                 block_graph_path(self.tmp_folder, block_id),
                 nodes=nodes,
                 uv=uv,
@@ -114,15 +123,17 @@ class MergeSubGraphsBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        shape = file_reader(cfg["input_path"])[cfg["input_key"]].shape
+        shape = handoff.resolve_dataset(
+            cfg["input_path"], cfg["input_key"]
+        ).shape
         block_ids = blocks_in_volume(
             shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
         )
         edge_lists, node_lists = [], []
         for b in block_ids:
-            with np.load(block_graph_path(self.tmp_folder, b)) as f:
-                edge_lists.append((f["uv"], f["sizes"]))
-                node_lists.append(f["nodes"])
+            f = handoff.load_arrays(block_graph_path(self.tmp_folder, b))
+            edge_lists.append((f["uv"], f["sizes"]))
+            node_lists.append(f["nodes"])
         uv, sizes = merge_edge_lists(edge_lists)
         nodes = (
             np.unique(np.concatenate(node_lists))
@@ -131,7 +142,7 @@ class MergeSubGraphsBase(BaseTask):
         )
         # dense edge representation for solvers: rows index into `nodes`
         edges = np.searchsorted(nodes, uv).astype(np.int64)
-        np.savez(
+        self.save_handoff_arrays(
             global_graph_path(self.tmp_folder),
             nodes=nodes,
             uv=uv,
@@ -158,17 +169,23 @@ class MapEdgeIdsBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        shape = file_reader(cfg["input_path"])[cfg["input_key"]].shape
+        shape = handoff.resolve_dataset(
+            cfg["input_path"], cfg["input_key"]
+        ).shape
         block_ids = blocks_in_volume(
             shape, tuple(cfg["block_shape"]), cfg.get("roi_begin"), cfg.get("roi_end")
         )
         _, uv_global, _, _ = load_global_graph(self.tmp_folder)
+        self.declare_handoff_producer()
 
         def process(block_id: int):
-            with np.load(block_graph_path(self.tmp_folder, block_id)) as f:
-                uv = f["uv"]
+            uv = handoff.load_arrays(
+                block_graph_path(self.tmp_folder, block_id)
+            )["uv"]
             ids = find_edge_ids(uv_global, uv)
-            np.save(edge_ids_path(self.tmp_folder, block_id), ids)
+            self.save_handoff_array(
+                edge_ids_path(self.tmp_folder, block_id), ids
+            )
 
         n = self.host_block_map(block_ids, process)
         return {"n_blocks": n}

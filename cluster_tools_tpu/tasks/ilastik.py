@@ -606,8 +606,10 @@ class IlastikPredictionBase(BaseTask):
         }
 
     def run_impl(self):
+        from ..runtime import handoff
+
         cfg = self.get_config()
-        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        inp = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = inp.shape
         block_shape = tuple(cfg["block_shape"])
         halo = tuple(cfg.get("halo") or [0] * len(shape))
@@ -633,8 +635,10 @@ class IlastikPredictionBase(BaseTask):
             forest["leaf_probs"].shape[-1] if forest is not None else W.shape[1]
         )
 
-        out = file_reader(cfg["output_path"]).require_dataset(
-            cfg["output_key"],
+        # MemoryTarget output: the probability map stays in RAM for a
+        # downstream thresholding/CC consumer, spill under the ladder
+        out = self.handoff_dataset(
+            cfg["output_path"], cfg["output_key"],
             shape=(n_classes,) + shape,
             chunks=(1,) + block_shape,
             dtype="float32",

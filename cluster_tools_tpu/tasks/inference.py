@@ -90,8 +90,10 @@ class InferenceBase(BaseTask):
         }
 
     def run_impl(self):
+        from ..runtime import handoff
+
         cfg = self.get_config()
-        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        inp = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = inp.shape
         block_shape = tuple(cfg["block_shape"])
         halo = tuple(cfg.get("halo") or [0] * len(shape))
@@ -135,8 +137,11 @@ class InferenceBase(BaseTask):
                 jax.random.PRNGKey(0), jnp.zeros(sample, jnp.float32)
             )
 
-        out = file_reader(cfg["output_path"]).require_dataset(
-            cfg["output_key"],
+        # MemoryTarget output (docs/PERFORMANCE.md "Task-graph fusion"):
+        # the probability map stays in RAM for a downstream watershed /
+        # thresholding consumer, spilling to this path under the ladder
+        out = self.handoff_dataset(
+            cfg["output_path"], cfg["output_key"],
             shape=(out_channels,) + shape,
             chunks=(1,) + block_shape,
             dtype="float32",

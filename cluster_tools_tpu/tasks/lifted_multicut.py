@@ -54,8 +54,10 @@ def lmc_assignments_path(tmp_folder: str) -> str:
 
 def _load_problem(tmp_folder: str, scale: int):
     if scale == 0:
+        from ..runtime import handoff
+
         _, _, edges, _ = load_global_graph(tmp_folder)
-        costs = np.load(costs_path(tmp_folder)).astype(np.float64)
+        costs = handoff.load_array(costs_path(tmp_folder)).astype(np.float64)
         with np.load(lifted_problem_path(tmp_folder)) as f:
             lifted_edges = f["edges"].astype(np.int64)
             lifted_costs = f["costs"].astype(np.float64)
@@ -202,6 +204,8 @@ class SolveLiftedGlobalBase(BaseTask):
     task_name = "solve_lifted_global"
 
     def run_impl(self):
+        from ..runtime import handoff
+
         cfg = self.get_config()
         scale = int(cfg.get("scale", 0))
         edges, costs, ledges, lcosts, node_labeling = _load_problem(
@@ -219,7 +223,7 @@ class SolveLiftedGlobalBase(BaseTask):
             le0, lc0 = f["edges"].astype(np.int64), f["costs"].astype(np.float64)
         energy = lifted_multicut_energy(
             edges0.astype(np.int64),
-            np.load(costs_path(self.tmp_folder)).astype(np.float64),
+            handoff.load_array(costs_path(self.tmp_folder)).astype(np.float64),
             le0,
             lc0,
             final,

@@ -34,9 +34,10 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ..ops.multicut import contract_graph, multicut_energy
+from ..runtime import handoff
 from ..runtime.task import BaseTask, WorkflowBase
 from ..utils.segmentation_utils import get_multicut_solver
-from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
+from ..utils.volume_utils import Blocking, blocks_in_volume
 from .costs import costs_path
 from .graph import block_graph_path, load_global_graph
 
@@ -60,19 +61,21 @@ def assignments_path(tmp_folder: str) -> str:
 
 
 def _load_problem(tmp_folder: str, scale: int):
-    """Problem at ``scale``: s0 is built from the graph + costs artifacts."""
+    """Problem at ``scale``: s0 is built from the graph + costs artifacts
+    (fusable edges: served from live in-memory handoffs when the producing
+    tasks published them, else from the npz/npy artifacts)."""
     if scale == 0:
         _, _, edges, _ = load_global_graph(tmp_folder)
-        costs = np.load(costs_path(tmp_folder)).astype(np.float64)
+        costs = handoff.load_array(costs_path(tmp_folder)).astype(np.float64)
         n_nodes = int(edges.max()) + 1 if len(edges) else 0
         node_labeling = np.arange(n_nodes, dtype=np.int64)
         return edges.astype(np.int64), costs, node_labeling
-    with np.load(problem_path(tmp_folder, scale)) as f:
-        return (
-            f["edges"].astype(np.int64),
-            f["costs"].astype(np.float64),
-            f["node_labeling"].astype(np.int64),
-        )
+    f = handoff.load_arrays(problem_path(tmp_folder, scale))
+    return (
+        f["edges"].astype(np.int64),
+        f["costs"].astype(np.float64),
+        f["node_labeling"].astype(np.int64),
+    )
 
 
 def _scale_block_nodes(tmp_folder, cfg, scale, node_labeling):
@@ -81,7 +84,7 @@ def _scale_block_nodes(tmp_folder, cfg, scale, node_labeling):
     Scale-s blocks are ``block_shape * 2**s``; their node sets come from the
     scale-0 per-block graphs, mapped through the original-label -> dense ->
     current chain."""
-    shape = file_reader(cfg["input_path"])[cfg["input_key"]].shape
+    shape = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"]).shape
     block_shape0 = tuple(cfg["block_shape"])
     nodes_table, _, _, _ = load_global_graph(tmp_folder)
     block_shape_s = tuple(b * (2 ** scale) for b in block_shape0)
@@ -107,8 +110,9 @@ def _scale_block_nodes(tmp_folder, cfg, scale, node_labeling):
             b0 = blocking_0.grid_position_to_id(pos0)
             if b0 not in ids_0:
                 continue
-            with np.load(block_graph_path(tmp_folder, b0)) as f:
-                labels = f["nodes"]
+            labels = handoff.load_arrays(
+                block_graph_path(tmp_folder, b0)
+            )["nodes"]
             dense = np.searchsorted(nodes_table, labels)
             node_set.append(node_labeling[dense])
         out[bs] = (
@@ -180,7 +184,7 @@ class SolveSubproblemsBase(BaseTask):
         # an edge merges only if some subproblem saw it and none cut it;
         # edges outside every subproblem (e.g. spanning block boundaries)
         # stay for the next scale / the global solve
-        np.savez(
+        self.save_handoff_arrays(
             cut_edges_path(self.tmp_folder, scale), cut=cut, seen=seen
         )
         return {
@@ -209,8 +213,8 @@ class ReduceProblemBase(BaseTask):
         cfg = self.get_config()
         scale = int(cfg.get("scale", 0))
         edges, costs, node_labeling = _load_problem(self.tmp_folder, scale)
-        with np.load(cut_edges_path(self.tmp_folder, scale)) as f:
-            cut, seen = f["cut"], f["seen"]
+        f = handoff.load_arrays(cut_edges_path(self.tmp_folder, scale))
+        cut, seen = f["cut"], f["seen"]
         n_nodes = int(node_labeling.max()) + 1 if len(node_labeling) else 0
 
         from ..ops.unionfind import union_find_host
@@ -222,7 +226,7 @@ class ReduceProblemBase(BaseTask):
 
         new_edges, new_costs = contract_graph(edges, costs, new_ids)
         new_labeling = new_ids[node_labeling]
-        np.savez(
+        self.save_handoff_arrays(
             problem_path(self.tmp_folder, scale + 1),
             edges=new_edges,
             costs=new_costs,
@@ -293,10 +297,10 @@ class SolveGlobalBase(BaseTask):
         nodes_table, _, edges0, _ = load_global_graph(self.tmp_folder)
         energy = multicut_energy(
             edges0.astype(np.int64),
-            np.load(costs_path(self.tmp_folder)).astype(np.float64),
+            handoff.load_array(costs_path(self.tmp_folder)).astype(np.float64),
             final,
         )
-        np.savez(
+        self.save_handoff_arrays(
             assignments_path(self.tmp_folder),
             keys=nodes_table,
             values=(final + 1).astype(np.uint64),

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..ops.mws import mutex_watershed
 from ..runtime.executor import region_verifier
+from ..runtime import handoff
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -48,7 +49,8 @@ class MwsBlocksBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        ds_in = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # fusable input edge: resolve a live in-memory affinity handle
+        ds_in = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         offsets = [list(map(int, o)) for o in cfg.get("offsets") or DEFAULT_OFFSETS]
         shape = ds_in.shape[1:]
         ndim = len(shape)

@@ -468,8 +468,10 @@ class GraphWatershedAssignmentsBase(BaseTask):
         from .graph import load_global_graph
 
         cfg = self.get_config()
+        from ..runtime import handoff
+
         nodes, _, edges, _ = load_global_graph(self.tmp_folder)
-        feats = np.load(features_path(self.tmp_folder))
+        feats = handoff.load_array(features_path(self.tmp_folder))
         probs = feats[:, 0].astype(np.float64)
         # node sizes from the label-size histograms
         d = _sizes_dir(self.tmp_folder)

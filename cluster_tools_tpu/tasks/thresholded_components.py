@@ -13,6 +13,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..runtime.executor import region_verifier
+from ..runtime import handoff
 from ..runtime.task import BaseTask, WorkflowBase, get_task_cls
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
 
@@ -33,7 +34,9 @@ class ThresholdBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # fusable input edge: a live in-memory producer handle (e.g. an
+        # inference probability map) is consumed without a storage read
+        inp = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = inp.shape
         block_shape = tuple(cfg["block_shape"])
         out = file_reader(cfg["output_path"]).require_dataset(

@@ -115,8 +115,13 @@ class _WsTaskBase(BaseTask):
         }
 
     def _setup(self):
+        from ..runtime import handoff
+
         cfg = self.get_config()
-        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # fusable input edge (inference -> watershed): a live in-memory
+        # handoff from the producing task is consumed directly; otherwise
+        # this is the plain storage dataset
+        inp = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = inp.shape
         block_shape = tuple(cfg["block_shape"])
         halo = tuple(cfg.get("halo") or [0] * len(shape))
@@ -124,8 +129,13 @@ class _WsTaskBase(BaseTask):
         block_ids = blocks_in_volume(
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
-        out = file_reader(cfg["output_path"]).require_dataset(
-            cfg["output_key"], shape=shape, chunks=block_shape, dtype="uint64"
+        # MemoryTarget output (docs/PERFORMANCE.md "Task-graph fusion"):
+        # with memory_handoffs on, the label volume stays in host RAM for
+        # the graph/features/write consumers, spilling to this storage
+        # path under the degrade ladder; off, this IS the storage dataset
+        out = self.handoff_dataset(
+            cfg["output_path"], cfg["output_key"],
+            shape=shape, chunks=block_shape, dtype="uint64",
         )
         mask_ds = None
         if cfg.get("mask_path"):

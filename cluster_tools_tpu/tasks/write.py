@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import numpy as np
 
+from ..runtime import handoff
 from ..runtime.executor import region_verifier
 from ..runtime.task import BaseTask
 from ..utils.volume_utils import Blocking, blocks_in_volume, file_reader
@@ -41,11 +42,15 @@ class WriteBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
-        inp = file_reader(cfg["input_path"])[cfg["input_key"]]
+        # fusable edges (watershed -> write, multicut -> write): labels and
+        # the assignment table come from live in-memory handoffs when the
+        # producers published them; the OUTPUT always goes to storage —
+        # it is the workflow's product, not an intermediate
+        inp = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"])
         shape = inp.shape
         block_shape = tuple(cfg["block_shape"])
-        with np.load(cfg["assignment_path"]) as f:
-            keys, values = f["keys"], f["values"]
+        f = handoff.load_arrays(cfg["assignment_path"])
+        keys, values = f["keys"], f["values"]
 
         out_f = file_reader(cfg["output_path"])
         out = out_f.require_dataset(

@@ -275,6 +275,23 @@ def clean_up_for_retry(tmp_folder: str, task_name: str):
             os.remove(os.path.join(d, fname))
 
 
+def clear_block_markers(tmp_folder: str, task_name: str):
+    """Drop ALL of a task's markers — block grain included.
+
+    Used when the data the markers describe no longer exists: an in-memory
+    handoff output (docs/PERFORMANCE.md "Task-graph fusion") dies with its
+    process, so markers a previous process wrote would make a resumed run
+    skip blocks whose results were never stored anywhere.
+    """
+    d = _marker_dir(tmp_folder, task_name)
+    for fname in os.listdir(d):
+        if fname.startswith(("block_", "job_")):
+            try:
+                os.remove(os.path.join(d, fname))
+            except OSError:
+                pass
+
+
 def _now() -> str:
     return datetime.datetime.now().isoformat()
 

@@ -92,6 +92,27 @@ def format_io_metrics(tasks) -> list:
             lines.append(
                 f"  uncached direct reads: {int(m['direct_reads'])}"
             )
+        published = int(m.get("handoffs_published", 0))
+        served = int(m.get("handoffs_served", 0))
+        spilled = int(m.get("handoffs_spilled", 0))
+        fallbacks = int(m.get("handoff_fallbacks", 0))
+        # a spill inside THIS task's snapshot window reconciles bytes
+        # another task counted, so the per-task delta can be negative —
+        # clamp for display (the spill itself shows in the spilled count;
+        # sums across tasks still net to the true figure)
+        not_stored = max(0.0, float(m.get("bytes_not_stored", 0)))
+        if published or served or spilled or fallbacks \
+                or m.get("bytes_not_stored"):
+            # task-graph fusion (docs/PERFORMANCE.md): in-memory targets
+            # this task published/consumed, how many spilled to storage,
+            # and the intermediate bytes that never touched the store
+            lines.append(
+                f"  handoffs: {published} published, {served} served "
+                f"in-memory, {spilled} spilled "
+                f"({_human_bytes(float(m.get('bytes_spilled', 0)))}), "
+                f"{fallbacks} fallback read(s), "
+                f"{_human_bytes(not_stored)} never stored"
+            )
         batches = int(m.get("batches_dispatched", 0))
         if batches:
             blocks = int(m.get("blocks_dispatched", 0))

@@ -1,0 +1,25 @@
+"""CT007 firing fixture: MemoryTarget declarations without spill wiring."""
+
+
+class BadTask:
+    def run_impl(self):
+        cfg = {}
+        # missing shape/chunks/dtype: the storage spill twin cannot be
+        # created under admission/headroom/fault pressure
+        out = self.handoff_dataset(cfg["output_path"], cfg["output_key"])
+        # full creation spec, but the handle is never wired into a
+        # region_verifier anywhere in this module
+        unverified = self.handoff_dataset(
+            cfg["output_path"], "k2",
+            shape=(8, 8), chunks=(4, 4), dtype="uint64",
+        )
+        # result not bound at all: nothing can verify it
+        self.handoff_dataset(
+            cfg["output_path"], "k3",
+            shape=(8, 8), chunks=(4, 4), dtype="uint64",
+        )
+        # kwarg-only declaration missing shape: still incomplete wiring
+        kwonly = self.handoff_dataset(
+            path=cfg["output_path"], key="k4", chunks=(4, 4), dtype="uint64",
+        )
+        return out, unverified, kwonly

@@ -1,0 +1,31 @@
+"""CT007 quiet fixture: the full MemoryTarget spill contract."""
+
+
+def region_verifier(ds):
+    return lambda block: None
+
+
+class GoodTask:
+    def run_impl(self):
+        cfg = {}
+        out = self.handoff_dataset(
+            cfg["output_path"], cfg["output_key"],
+            shape=(8, 8), chunks=(4, 4), dtype="uint64",
+        )
+        # positional creation spec is equally complete
+        twin = self.handoff_dataset(
+            cfg["output_path"], "k2", (8, 8), (4, 4), "uint64",
+        )
+        verify = region_verifier(out)
+        verify2 = region_verifier(twin)
+        # positional path + keyword key is fully wired too
+        mixed = self.handoff_dataset(
+            cfg["output_path"], key="k4",
+            shape=(8, 8), chunks=(4, 4), dtype="uint64",
+        )
+        verify4 = region_verifier(mixed)
+        # wholesale-forwarded wiring is not statically checkable: quiet
+        kw = dict(shape=(8, 8), chunks=(4, 4), dtype="uint64")
+        fwd = self.handoff_dataset(cfg["output_path"], "k3", **kw)
+        verify3 = region_verifier(fwd)
+        return out, verify, verify2, verify3, verify4

@@ -277,6 +277,104 @@ def test_chaos_silent_failures_supervised(tmp_path):
     assert "declared lost" in slog and "resubmitting" in slog
 
 
+def test_chaos_fused_handoffs_spill_and_kill(tmp_path):
+    """ISSUE 8 acceptance: the watershed -> graph -> multicut workflow
+    with task-graph fusion (``memory_handoffs``, docs/PERFORMANCE.md
+    "Task-graph fusion").
+
+    Happy path: zero intermediate storage writes — no supervoxel dataset,
+    no graph/multicut artifacts on disk — asserted via io_metrics.json's
+    handoff counters, with the final segmentation bit-identical to the
+    all-storage run.
+
+    Chaos path: every handoff publish is forced to spill (``spill`` fault
+    at site ``publish``) and the run is killed mid-DAG (task grain).  The
+    resumed process finds no live handles, consumes the spilled
+    (CRC-checksummed) copies transparently, completes bit-identically, and
+    ``failures.json`` attributes every spill ``degraded:spilled``."""
+    root = str(tmp_path)
+    _, _, bmap = make_case(noise=0.02, seed=SEED)
+
+    # -- reference: all-storage run (handoffs off is the default) ----------
+    ref_spec, ref_path, _ = _workspace(root, "ref", bmap)
+    proc = _run_driver(ref_spec)
+    assert proc.returncode == 0, f"storage run failed:\n{proc.stderr[-4000:]}"
+    ref_seg = file_reader(ref_path, "r")["seg"][...]
+
+    # -- happy path: fused run, zero intermediate storage writes -----------
+    fused_spec, fused_path, fused_tmp = _workspace(
+        root, "fused", bmap, global_cfg={"memory_handoffs": True}
+    )
+    proc = _run_driver(fused_spec)
+    assert proc.returncode == 0, f"fused run failed:\n{proc.stderr[-4000:]}"
+    fused = file_reader(fused_path, "r")
+    np.testing.assert_array_equal(fused["seg"][...], ref_seg)
+    assert "ws" not in fused, "supervoxels hit storage on the happy path"
+    gdir = os.path.join(fused_tmp, "graph")
+    assert not os.path.isdir(gdir) or os.listdir(gdir) == [], (
+        "graph artifacts hit storage on the happy path"
+    )
+    with open(os.path.join(fused_tmp, "io_metrics.json")) as f:
+        tasks = json.load(f)["tasks"]
+    totals = {}
+    for m in tasks.values():
+        for k, v in m.items():
+            if k.startswith("handoff") or k.startswith("bytes_"):
+                totals[k] = totals.get(k, 0) + v
+    assert totals.get("handoffs_served", 0) > 0, totals
+    assert totals.get("bytes_not_stored", 0) > 0, totals
+    assert totals.get("handoffs_spilled", 0) == 0, totals
+
+    # -- chaos: forced spills + a mid-DAG kill, resume from spilled copies -
+    chaos_spec, chaos_path, chaos_tmp = _workspace(
+        root, "chaos_fused", bmap, global_cfg={"memory_handoffs": True}
+    )
+    state_dir = os.path.join(root, "chaos_fused", "fault_state")
+    faults_cfg = {
+        "seed": SEED,
+        "state_dir": state_dir,
+        "faults": [
+            # every in-memory target is written through to its storage
+            # spill path (checksummed) instead of living only in RAM
+            {"site": "publish", "kind": "spill", "fail_attempts": 1000000},
+            # ... and the process dies between tasks: the resumed run must
+            # consume the spilled copies, not recompute from luck
+            {"site": "task_done", "kind": "kill", "after": 3},
+        ],
+    }
+    kills = 0
+    for _ in range(5):
+        proc = _run_driver(chaos_spec, faults_cfg)
+        if proc.returncode == 0:
+            break
+        assert proc.returncode == KILL_EXIT_CODE, (
+            f"chaos run died with rc={proc.returncode}, expected injected "
+            f"kill ({KILL_EXIT_CODE}):\n{proc.stderr[-4000:]}"
+        )
+        kills += 1
+    assert proc.returncode == 0, "fused chaos run never completed"
+    assert kills == 1, f"expected exactly 1 injected kill, got {kills}"
+
+    # bit-identical through the spill + restart
+    chaos = file_reader(chaos_path, "r")
+    np.testing.assert_array_equal(chaos["seg"][...], ref_seg)
+    # the spilled supervoxels are on storage, digest sidecars and all
+    assert "ws" in chaos
+    assert os.path.isdir(os.path.join(chaos_path, "ws", ".ctt_checksums"))
+
+    # every spill attributed; the resumed run consumed spilled copies
+    with open(os.path.join(chaos_tmp, "failures.json")) as f:
+        recs = json.load(f)["records"]
+    spilled = [r for r in recs if r.get("resolution") == "degraded:spilled"]
+    assert spilled, "no degraded:spilled attribution"
+    assert all(r["sites"].get("spill") for r in spilled)
+    assert any(r["task"].startswith("watershed") for r in spilled)
+    with open(os.path.join(chaos_tmp, "io_metrics.json")) as f:
+        tasks = json.load(f)["tasks"]
+    fallbacks = sum(m.get("handoff_fallbacks", 0) for m in tasks.values())
+    assert fallbacks > 0, "resume never read a spilled copy"
+
+
 def test_chaos_resource_exhaustion_and_preemption(tmp_path):
     """ISSUE 4 acceptance: watershed -> graph -> multicut under seeded
     ``oom`` + ``enospc`` faults and a REAL mid-run SIGTERM (``preempt``

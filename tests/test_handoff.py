@@ -1,0 +1,718 @@
+"""Task-graph fusion: typed in-memory targets (docs/PERFORMANCE.md
+"Task-graph fusion", runtime/handoff.py).
+
+Covers the registry (publish/resolve/fallback, read-only serving,
+counters), the ``memory://`` HandoffDataset (storage parity, integrity
+verification, fault hooks, chunk-aligned checksummed spill), the degrade
+ladder (byte-budget admission, headroom spill, forced ``spill`` faults with
+``degraded:spilled`` attribution), the DAG resume contract (a memory-only
+manifest whose handle died re-runs the producer; stale block markers are
+invalidated), end-to-end workflow parity with zero intermediate storage
+writes, and the <10 s smoke twin of ``make bench-fuse``.  Tier-1.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.io.containers import (
+    ChunkCorruptionError,
+    HandoffDataset,
+)
+from cluster_tools_tpu.runtime import faults, handoff
+from cluster_tools_tpu.runtime.task import BaseTask, build
+from cluster_tools_tpu.utils import function_utils as fu
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    handoff.reset()
+    faults.configure(None)
+    yield
+    handoff.reset()
+    faults.configure(None)
+
+
+def _mk_handoff(tmp_path, key="a", shape=(8, 8, 8), chunks=(4, 4, 4),
+                dtype="uint64", producer="prod.0", failures_path=None):
+    path = os.path.join(str(tmp_path), "data.zarr")
+    ds, entry = handoff.acquire_dataset(
+        path, key, shape=shape, chunks=chunks, dtype=dtype,
+        producer=producer, failures_path=failures_path,
+    )
+    return path, ds, entry
+
+
+# -- registry + HandoffDataset basics -----------------------------------------
+
+
+def test_dataset_handoff_resolve_and_storage_parity(tmp_path):
+    path, ds, entry = _mk_handoff(tmp_path)
+    assert isinstance(ds, HandoffDataset)
+    block = np.arange(64, dtype=np.uint64).reshape(4, 4, 4)
+    ds[0:4, 0:4, 0:4] = block
+    # consumer resolve returns the live handle, counted as served
+    snap = handoff.snapshot()
+    got = handoff.resolve_dataset(path, "a")
+    assert got is ds
+    assert handoff.delta(snap)["handoffs_served"] == 1
+    np.testing.assert_array_equal(got[0:4, 0:4, 0:4], block)
+    # nothing landed on storage
+    assert not os.path.exists(os.path.join(path, "a"))
+    # post-store integrity verification covers the in-memory plane
+    ds.verify_region((slice(0, 4),) * 3)
+    # spill: chunk-aligned flush through the checksummed write path
+    entry.complete = True
+    freed = handoff.spill_for_headroom()
+    assert freed == 8 * 8 * 8 * 8
+    stored = file_reader(path)["a"]
+    np.testing.assert_array_equal(
+        np.asarray(stored[0:4, 0:4, 0:4]), block
+    )
+    # digest sidecars exist for the spilled regions, and the old handle
+    # delegates to storage
+    assert os.path.isdir(os.path.join(path, "a", ".ctt_checksums"))
+    np.testing.assert_array_equal(ds[0:4, 0:4, 0:4], block)
+    # consumers now fall back, counted as such
+    snap = handoff.snapshot()
+    got = handoff.resolve_dataset(path, "a")
+    assert not isinstance(got, HandoffDataset)
+    assert handoff.delta(snap)["handoff_fallbacks"] == 1
+
+
+def test_handoff_dataset_detects_injected_corruption(tmp_path):
+    _path, ds, _entry = _mk_handoff(tmp_path)
+    faults.configure({
+        "faults": [{"site": "io_write", "kind": "corrupt", "blocks": [3]}],
+    })
+    with faults.block_context(3):
+        ds[0:4, 0:4, 0:4] = np.ones((4, 4, 4), np.uint64)
+    # the bit-flip landed behind the digest: only verification can tell
+    with pytest.raises(ChunkCorruptionError):
+        ds.verify_region((slice(0, 4),) * 3)
+
+
+def test_handoff_dataset_io_fault_hooks_fire(tmp_path):
+    _path, ds, _entry = _mk_handoff(tmp_path)
+    faults.configure({
+        "faults": [{"site": "io_read", "kind": "error", "blocks": [7]}],
+    })
+    with faults.block_context(7):
+        with pytest.raises(faults.InjectedFault):
+            ds[0:2, 0:2, 0:2]
+    # second attempt passes (fail_attempts defaults to 1): retriable
+    with faults.block_context(7):
+        ds[0:2, 0:2, 0:2]
+
+
+def test_artifact_publish_serves_readonly_views(tmp_path):
+    p = os.path.join(str(tmp_path), "graph", "block_0.npz")
+    src = np.arange(6)
+    handoff.publish_arrays(p, {"uv": src}, producer="prod.0")
+    # no file was written
+    assert not os.path.exists(p)
+    assert handoff.array_exists(p)
+    got = handoff.load_arrays(p)["uv"]
+    np.testing.assert_array_equal(got, src)
+    with pytest.raises(ValueError):
+        got[0] = 99  # consumers cannot mutate the published payload
+    # mutating the producer's original does not reach consumers either
+    src[0] = 42
+    np.testing.assert_array_equal(handoff.load_arrays(p)["uv"][:1], [0])
+
+
+def test_artifact_spill_is_crc_verified_on_fallback(tmp_path):
+    p = os.path.join(str(tmp_path), "costs.npy")
+    faults.configure({
+        "faults": [{"site": "publish", "kind": "spill",
+                    "fail_attempts": 1000000}],
+    })
+    entry = handoff.publish_arrays(p, {"data": np.arange(5.0)},
+                                   producer="prod.0")
+    assert entry.spilled and os.path.exists(p)
+    faults.configure(None)
+    snap = handoff.snapshot()
+    np.testing.assert_array_equal(handoff.load_array(p), np.arange(5.0))
+    assert handoff.delta(snap)["handoff_fallbacks"] == 1
+    # corrupt the spilled bytes on disk: the CRC sidecar must catch it
+    arr = np.load(p)
+    arr[0] = 123.0
+    np.save(p, arr)
+    with pytest.raises(ChunkCorruptionError):
+        handoff.load_array(p)
+
+
+def test_forced_spill_records_degraded_attribution(tmp_path):
+    failures = os.path.join(str(tmp_path), "failures.json")
+    faults.configure({
+        "faults": [{"site": "publish", "kind": "spill",
+                    "fail_attempts": 1000000}],
+    })
+    path, ds, entry = _mk_handoff(
+        tmp_path, producer="watershed.x", failures_path=failures
+    )
+    # spill-at-birth: the "handle" is the real storage dataset
+    assert not isinstance(ds, HandoffDataset)
+    assert entry.spilled and entry.spill_reason == "fault"
+    # finalize emits the manifest records + failures.json attribution
+    class _T:
+        pass
+
+    t = _T()
+    t.entry = entry
+    recs = handoff.finalize_task([t], "watershed.x")
+    assert recs == [{
+        "identity": entry.identity, "path": path, "key": "a",
+        "kind": "dataset", "stored": True, "bytes": entry.nbytes,
+    }]
+    with open(failures) as f:
+        frecs = json.load(f)["records"]
+    assert any(
+        r["resolution"] == "degraded:spilled"
+        and r["sites"] == {"spill": 1}
+        and r["task"] == "watershed.x.handoff"
+        for r in frecs
+    )
+
+
+def test_budget_admission_spills_at_birth(tmp_path, monkeypatch):
+    monkeypatch.setenv("CTT_HANDOFF_BYTES", "128")  # 8^3 uint64 >> 128
+    _path, ds, entry = _mk_handoff(tmp_path)
+    assert not isinstance(ds, HandoffDataset)
+    assert entry.spilled and entry.spill_reason.startswith("admission")
+    # writes land straight on (checksummed) storage
+    ds[0:4, 0:4, 0:4] = np.ones((4, 4, 4), np.uint64)
+    assert handoff.live_bytes() == 0
+
+
+def test_spilled_predecessor_forces_write_through(tmp_path):
+    """A second producer acquiring a spilled identity (two-pass watershed
+    after pass one spilled) must write through to storage — a fresh memory
+    array would shadow the spilled labels with zeros."""
+    path, ds, entry = _mk_handoff(tmp_path)
+    ds[0:4, 0:4, 0:4] = np.full((4, 4, 4), 7, np.uint64)
+    entry.complete = True
+    handoff.spill_for_headroom()
+    ds2, entry2 = handoff.acquire_dataset(
+        path, "a", shape=(8, 8, 8), chunks=(4, 4, 4), dtype="uint64",
+        producer="pass2.0",
+    )
+    assert entry2 is entry and not isinstance(ds2, HandoffDataset)
+    # pass-one data is visible to the pass-two reader
+    np.testing.assert_array_equal(
+        np.asarray(ds2[0:2, 0:2, 0:2]), np.full((2, 2, 2), 7, np.uint64)
+    )
+
+
+# -- task integration: markers, manifests, resume -----------------------------
+
+
+class _HandoffProducer(BaseTask):
+    """Minimal producing task: one handoff dataset, one block marker."""
+
+    task_name = "ho_producer"
+
+    def run_impl(self):
+        cfg = self.get_config()
+        out = self.handoff_dataset(
+            cfg["output_path"], cfg["output_key"],
+            shape=(4, 4), chunks=(4, 4), dtype="uint64",
+        )
+        from cluster_tools_tpu.runtime.executor import region_verifier
+
+        done = set(self.blocks_done())
+        if 0 not in done:
+            out[0:4, 0:4] = np.arange(16, dtype=np.uint64).reshape(4, 4)
+            verify = region_verifier(out)
+            if verify is not None:
+                verify(type("B", (), {"bb": (slice(0, 4), slice(0, 4))})())
+            self.log_block_success(0)
+        return {"n_blocks": 1}
+
+
+def _producer(tmp_path, **params):
+    cdir = os.path.join(str(tmp_path), "config")
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, "global.config"), "w") as f:
+        json.dump({"memory_handoffs": True}, f)
+    return _HandoffProducer(
+        tmp_folder=os.path.join(str(tmp_path), "tmp"),
+        config_dir=cdir,
+        output_path=os.path.join(str(tmp_path), "out.zarr"),
+        output_key="x",
+        **params,
+    )
+
+
+def test_manifest_records_memory_target_and_complete_contract(tmp_path):
+    task = _producer(tmp_path)
+    assert build([task])
+    doc = task.output().read()
+    assert doc["handoffs"] == [{
+        "identity": handoff.dataset_identity(
+            os.path.join(str(tmp_path), "out.zarr"), "x"
+        ),
+        "path": os.path.join(str(tmp_path), "out.zarr"),
+        "key": "x",
+        "kind": "dataset",
+        "stored": False,
+        "bytes": 128,
+    }]
+    # io_metrics carries the handoff counters for this task
+    with open(fu.io_metrics_path(task.tmp_folder)) as f:
+        metrics = json.load(f)["tasks"][task.uid]
+    assert metrics["handoffs_published"] == 1
+    assert metrics["bytes_not_stored"] == 128
+    # live handle -> complete; the DAG would skip the task
+    assert task.complete()
+    # simulate a process restart: registry gone -> manifest invalidated,
+    # block markers cleared, task re-runs
+    handoff.reset()
+    fresh = _producer(tmp_path)
+    assert not fresh.complete()
+    assert fresh.blocks_done() == []
+    assert not fresh.output().exists()
+    assert build([fresh])  # re-runs cleanly and republishes
+    assert handoff.is_live(fresh._memory_targets[0].identity)
+
+
+def test_spilled_manifest_stays_complete_across_restart(tmp_path):
+    faults.configure({
+        "faults": [{"site": "publish", "kind": "spill",
+                    "fail_attempts": 1000000}],
+    })
+    task = _producer(tmp_path)
+    assert build([task])
+    doc = task.output().read()
+    assert doc["handoffs"][0]["stored"] is True
+    # restart: the stored copy is the truth, the task stays done
+    handoff.reset()
+    faults.configure(None)
+    fresh = _producer(tmp_path)
+    assert fresh.complete()
+    # and the consumer-side fallback serves the spilled bytes
+    ds = handoff.resolve_dataset(
+        os.path.join(str(tmp_path), "out.zarr"), "x"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ds[0:4, 0:4]),
+        np.arange(16, dtype=np.uint64).reshape(4, 4),
+    )
+
+
+def _stamp_foreign_process(task):
+    """Rewrite the marker-epoch sentinel as if ANOTHER process's in-memory
+    run wrote the markers (the data died with that process)."""
+    path = handoff._sentinel_path(task.tmp_folder, task.uid)
+    fu.atomic_write_json(path, {"token": "999999.deadbeefdead"})
+
+
+def test_stale_markers_cleared_for_foreign_memory_runs(tmp_path):
+    """Markers stamped by a previous process's in-memory run are
+    invalidated on the next blocks_done — same-process retries keep
+    theirs."""
+    task = _producer(tmp_path)
+    assert build([task])
+    assert task.blocks_done() == [0]
+    # same process, second acquire: markers survive
+    task2 = _producer(tmp_path)
+    task2.handoff_dataset(
+        os.path.join(str(tmp_path), "out.zarr"), "x",
+        shape=(4, 4), chunks=(4, 4), dtype="uint64",
+    )
+    assert task2.blocks_done() == [0]
+    # "previous process": a foreign sentinel token -> markers must go
+    _stamp_foreign_process(task2)
+    task3 = _producer(tmp_path)
+    assert task3.blocks_done() == []
+
+
+def test_stale_markers_cleared_even_when_rerun_spills_at_birth(tmp_path,
+                                                              monkeypatch):
+    """Review regression: a re-run whose acquire spills at birth
+    (admission/fault) — or runs with the knob off entirely — must STILL
+    invalidate markers from a dead process's memory run, or the storage
+    twin keeps fill-value holes where the markers claim blocks are
+    done."""
+    task = _producer(tmp_path)
+    assert build([task])
+    # simulate the process dying: live handles gone, markers + sentinel
+    # left behind by the old process
+    handoff.reset()
+    _stamp_foreign_process(task)
+    # spill-at-birth path: tiny budget rejects the memory target
+    monkeypatch.setenv("CTT_HANDOFF_BYTES", "16")
+    t2 = _producer(tmp_path)
+    ds = t2.handoff_dataset(
+        os.path.join(str(tmp_path), "out.zarr"), "x",
+        shape=(4, 4), chunks=(4, 4), dtype="uint64",
+    )
+    assert not isinstance(ds, HandoffDataset)
+    assert t2.blocks_done() == []
+    # knob-off path: blocks_done alone must invalidate too
+    _stamp_foreign_process(task)
+    fu.log_block_success(task.tmp_folder, task.uid, 0)
+    monkeypatch.setenv("CTT_HANDOFF", "0")
+    t3 = _producer(tmp_path)
+    assert t3.blocks_done() == []
+
+
+def test_failed_spill_retry_reflushes_every_region(tmp_path):
+    """Review regression: a spill that failed midway must stay retriable —
+    the retry re-writes EVERY region instead of short-circuiting to 'done'
+    over a storage copy with fill-value holes."""
+    path, ds, entry = _mk_handoff(tmp_path)
+    block = np.arange(64, dtype=np.uint64).reshape(4, 4, 4)
+    ds[0:4, 0:4, 0:4] = block
+    ds[4:8, 4:8, 4:8] = block + 100
+    entry.complete = True
+    # first flush attempt dies on the FIRST storage write
+    faults.configure({
+        "faults": [{"site": "io_write", "kind": "error",
+                    "fail_attempts": 1}],
+    })
+    assert handoff.spill_for_headroom() == 0
+    assert not entry.spilled and entry.obj is not None  # still live
+    faults.configure(None)
+    # retry: full re-flush, storage parity across ALL regions
+    assert handoff.spill_for_headroom() == 8 * 8 * 8 * 8
+    stored = file_reader(path)["a"]
+    np.testing.assert_array_equal(np.asarray(stored[0:4, 0:4, 0:4]), block)
+    np.testing.assert_array_equal(
+        np.asarray(stored[4:8, 4:8, 4:8]), block + 100
+    )
+
+
+def test_restart_fallback_loads_are_crc_verified(tmp_path):
+    """Review regression: a crash-resumed process (empty registry) must
+    still CRC-verify spilled artifacts — the restart case is what the
+    sidecar exists for — and count the fallback read."""
+    p = os.path.join(str(tmp_path), "table.npy")
+    faults.configure({
+        "faults": [{"site": "publish", "kind": "spill",
+                    "fail_attempts": 1000000}],
+    })
+    handoff.publish_arrays(p, {"data": np.arange(7.0)}, producer="x.0")
+    faults.configure(None)
+    handoff.reset()  # process restart: no registry entry
+    snap = handoff.snapshot()
+    np.testing.assert_array_equal(handoff.load_array(p), np.arange(7.0))
+    assert handoff.delta(snap)["handoff_fallbacks"] == 1
+    arr = np.load(p)
+    arr[2] = -1.0
+    np.save(p, arr)
+    with pytest.raises(ChunkCorruptionError):
+        handoff.load_array(p)
+
+
+def test_post_manifest_spill_keeps_producer_complete(tmp_path):
+    """Review regression: a headroom spill AFTER the manifest was written
+    leaves a valid checksummed storage copy — the producer must stay
+    complete, not be invalidated and recomputed."""
+    task = _producer(tmp_path)
+    assert build([task])
+    assert task.complete()
+    assert handoff.spill_for_headroom() > 0  # flush the completed target
+    fresh = _producer(tmp_path)
+    assert fresh.complete()  # spilled = stored, not dead
+    # and consumers fall back to the spilled bytes
+    ds = handoff.resolve_dataset(
+        os.path.join(str(tmp_path), "out.zarr"), "x"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ds[0:4, 0:4]),
+        np.arange(16, dtype=np.uint64).reshape(4, 4),
+    )
+
+
+def test_admission_spills_only_until_new_target_fits(tmp_path, monkeypatch):
+    """Review regression: one marginal admission spills elders only until
+    the newcomer fits — it must not flush every live handoff and force
+    the whole DAG onto fallback reads."""
+    monkeypatch.setenv("CTT_HANDOFF_BYTES", "3000")
+    pa = os.path.join(str(tmp_path), "a.npy")
+    pb = os.path.join(str(tmp_path), "b.npy")
+    pc = os.path.join(str(tmp_path), "c.npy")
+    ea = handoff.publish_arrays(pa, {"data": np.zeros(150)}, producer="a.0")
+    eb = handoff.publish_arrays(pb, {"data": np.zeros(150)}, producer="b.0")
+    ec = handoff.publish_arrays(pc, {"data": np.zeros(150)}, producer="c.0")
+    # 3 x 1200B > 3000: the OLDEST entry spills, the others stay live
+    assert ea.spilled and not eb.spilled
+    assert not ec.spilled and ec.obj is not None
+
+
+def test_knob_off_rerun_overrides_stale_live_payloads(tmp_path):
+    """Review regression: re-running a workspace with handoffs OFF must
+    not let a previous run's live payload (or spill CRC sidecar) shadow
+    the freshly stored bytes."""
+    # run 1: handoffs on — artifact lives in memory, dataset too
+    p = os.path.join(str(tmp_path), "costs.npy")
+    task = _producer(tmp_path)
+    assert build([task])
+    faults.configure({
+        "faults": [{"site": "publish", "kind": "spill",
+                    "fail_attempts": 1000000}],
+    })
+    task.save_handoff_array(p, np.arange(3.0))  # spilled: file + sidecar
+    faults.configure(None)
+    # run 2: knob off — fresh storage writes are the truth (the config is
+    # rewritten AFTER construction; _producer seeds it with the knob on)
+    t2 = _producer(tmp_path)
+    with open(os.path.join(str(tmp_path), "config",
+                           "global.config"), "w") as f:
+        json.dump({"memory_handoffs": False}, f)
+    ds = t2.handoff_dataset(
+        os.path.join(str(tmp_path), "out.zarr"), "x",
+        shape=(4, 4), chunks=(4, 4), dtype="uint64",
+    )
+    assert not isinstance(ds, HandoffDataset)
+    ds[0:4, 0:4] = np.full((4, 4), 9, np.uint64)
+    # resolve must see the stored bytes, not run 1's RAM copy
+    got = handoff.resolve_dataset(os.path.join(str(tmp_path), "out.zarr"), "x")
+    np.testing.assert_array_equal(
+        np.asarray(got[0:4, 0:4]), np.full((4, 4), 9, np.uint64)
+    )
+    # plain re-save of the artifact drops the stale CRC sidecar
+    t2.save_handoff_array(p, np.arange(5.0))
+    np.testing.assert_array_equal(handoff.load_array(p), np.arange(5.0))
+
+
+def test_spill_reconciles_bytes_not_stored(tmp_path):
+    """Review regression: bytes that later spilled DID reach storage —
+    the net 'never stored' figure must not count them."""
+    snap = handoff.snapshot()
+    _path, ds, entry = _mk_handoff(tmp_path)
+    ds[0:4, 0:4, 0:4] = np.ones((4, 4, 4), np.uint64)
+    assert handoff.delta(snap)["bytes_not_stored"] == 512
+    entry.complete = True
+    assert handoff.spill_for_headroom() > 0
+    d = handoff.delta(snap)
+    assert d["bytes_not_stored"] == 0 and d["bytes_spilled"] > 0
+
+
+def test_reacquire_waits_out_inflight_spill(tmp_path):
+    """Review regression: a producer re-acquiring an identity mid-spill
+    must not get the memory handle whose regions the spill already copied
+    — it waits the flush out and lands on the storage path."""
+    import threading
+
+    path, ds, entry = _mk_handoff(tmp_path)
+    ds[0:4, 0:4, 0:4] = np.full((4, 4, 4), 5, np.uint64)
+    entry.complete = True
+    reg = handoff.get_registry()
+    assert reg.claim_spill(entry)  # spill "in flight"
+
+    def _finish():
+        import time as _t
+
+        _t.sleep(0.1)
+        freed = entry.obj.spill()
+        reg.finish_spill(entry, ok=True, reason="headroom")
+        entry.obj = None
+        assert freed > 0
+
+    th = threading.Thread(target=_finish)
+    th.start()
+    ds2, entry2 = handoff.acquire_dataset(
+        path, "a", shape=(8, 8, 8), chunks=(4, 4, 4), dtype="uint64",
+        producer="p2.0",
+    )
+    th.join()
+    assert entry2 is entry
+    assert not isinstance(ds2, HandoffDataset)  # storage write-through
+    np.testing.assert_array_equal(
+        np.asarray(ds2[0:4, 0:4, 0:4]), np.full((4, 4, 4), 5, np.uint64)
+    )
+
+
+def test_spill_claim_is_exclusive(tmp_path):
+    """Review regression: entry.spilled must never be observable before
+    the storage copy completed — the claim protocol gives exactly one
+    spiller the entry, and losers do not flip the flags."""
+    _path, _ds, entry = _mk_handoff(tmp_path)
+    reg = handoff.get_registry()
+    # an INCOMPLETE entry (a producer still writing, or one that
+    # re-acquired the identity) can never be claimed: spilling it would
+    # copy a torn snapshot
+    assert not reg.claim_spill(entry)
+    entry.complete = True
+    assert reg.claim_spill(entry)
+    # a concurrent spiller cannot claim (or mark spilled) meanwhile
+    assert not reg.claim_spill(entry)
+    assert not entry.spilled
+    assert handoff.spill_for_headroom() == 0  # candidate filtered out
+    reg.finish_spill(entry, ok=False, reason="headroom")
+    assert not entry.spilled and entry.obj is not None  # failed: stays live
+    assert reg.claim_spill(entry)
+    reg.finish_spill(entry, ok=True, reason="headroom")
+    assert entry.spilled and entry.obj is None
+
+
+# -- end-to-end workflow parity ----------------------------------------------
+
+
+def _run_workflow(tmp_path, name, vol, memory_handoffs):
+    from cluster_tools_tpu.workflows import MulticutSegmentationWorkflow
+
+    base = os.path.join(str(tmp_path), name)
+    cdir = os.path.join(base, "config")
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, "global.config"), "w") as f:
+        json.dump(
+            {"block_shape": [8, 8, 8], "memory_handoffs": memory_handoffs},
+            f,
+        )
+    path = os.path.join(base, "data.zarr")
+    src = file_reader(path).create_dataset(
+        "bmap", shape=vol.shape, chunks=(8, 8, 8), dtype="float32"
+    )
+    src[...] = vol
+    wf = MulticutSegmentationWorkflow(
+        tmp_folder=os.path.join(base, "tmp"), config_dir=cdir, max_jobs=4,
+        target="local", input_path=path, input_key="bmap",
+        ws_path=path, ws_key="ws", output_path=path, output_key="seg",
+        threshold=0.5, halo=[2, 2, 2], beta=0.5,
+    )
+    assert build([wf]), f"{name} workflow failed"
+    return base, path
+
+
+def test_workflow_fusion_zero_intermediate_writes_bit_identical(tmp_path):
+    """The ISSUE 8 acceptance shape, in-process: the full multicut
+    workflow with handoffs on writes NO intermediate storage (no ws
+    dataset, no graph/multicut artifacts), stays bit-identical to the
+    all-storage run, and attributes the avoided IO in io_metrics.json."""
+    from scipy import ndimage
+
+    rng = np.random.default_rng(3)
+    vol = ndimage.gaussian_filter(rng.random((16, 16, 16)), 2.0)
+    vol = ((vol - vol.min()) / (vol.max() - vol.min())).astype(np.float32)
+
+    _base_off, p_off = _run_workflow(tmp_path, "off", vol, False)
+    snap = handoff.snapshot()
+    base_on, p_on = _run_workflow(tmp_path, "on", vol, True)
+    d = handoff.delta(snap)
+
+    np.testing.assert_array_equal(
+        np.asarray(file_reader(p_on)["seg"][...]),
+        np.asarray(file_reader(p_off)["seg"][...]),
+    )
+    # zero intermediate storage writes on the happy path
+    assert "ws" not in file_reader(p_on)
+    gdir = os.path.join(base_on, "tmp", "graph")
+    assert not os.path.isdir(gdir) or os.listdir(gdir) == []
+    mdir = os.path.join(base_on, "tmp", "multicut")
+    leftovers = [
+        f for f in (os.listdir(mdir) if os.path.isdir(mdir) else [])
+        if not f.endswith(".ckpt.npz")
+    ]
+    assert leftovers == []
+    assert d["handoffs_spilled"] == 0 and d["handoff_fallbacks"] == 0
+    assert d["handoffs_served"] > 0 and d["bytes_not_stored"] > 0
+    # io_metrics.json carries the per-task counters, and the report
+    # renders them
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "failures_report",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "failures_report.py"),
+    )
+    fr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fr)
+    io_tasks = fr.load_io_metrics(
+        os.path.join(base_on, "tmp", "failures.json")
+    )
+    text = "\n".join(fr.format_io_metrics(io_tasks))
+    assert "handoffs:" in text and "never stored" in text
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_workflow_fusion_bit_identical_with_spills_forced(tmp_path):
+    """With every publish forced to spill, the workflow still completes
+    bit-identically — consumers read the spilled (checksummed) copies —
+    and every spill is attributed degraded:spilled."""
+    from scipy import ndimage
+
+    rng = np.random.default_rng(3)
+    vol = ndimage.gaussian_filter(rng.random((16, 16, 16)), 2.0)
+    vol = ((vol - vol.min()) / (vol.max() - vol.min())).astype(np.float32)
+
+    _base_off, p_off = _run_workflow(tmp_path, "off", vol, False)
+    faults.configure({
+        "faults": [{"site": "publish", "kind": "spill",
+                    "fail_attempts": 1000000}],
+    })
+    snap = handoff.snapshot()
+    base_on, p_on = _run_workflow(tmp_path, "spill", vol, True)
+    d = handoff.delta(snap)
+    np.testing.assert_array_equal(
+        np.asarray(file_reader(p_on)["seg"][...]),
+        np.asarray(file_reader(p_off)["seg"][...]),
+    )
+    assert d["handoffs_spilled"] > 0 and d["bytes_not_stored"] == 0
+    assert "ws" in file_reader(p_on)  # the spill landed on storage
+    with open(os.path.join(base_on, "tmp", "failures.json")) as f:
+        recs = json.load(f)["records"]
+    spilled = [r for r in recs if r.get("resolution") == "degraded:spilled"]
+    assert spilled and all(r["sites"] == {"spill": 1} for r in spilled)
+
+
+# -- executor integration ------------------------------------------------------
+
+
+def test_executor_budget_subtracts_live_handoffs(tmp_path):
+    """The auto inflight budget treats live handoff bytes as co-resident
+    memory (same envelope as the chunk cache)."""
+    _path, ds, _entry = _mk_handoff(tmp_path, shape=(32, 32, 32))
+    assert handoff.live_bytes() == 32 ** 3 * 8
+    # spill_for_headroom only touches COMPLETE entries
+    assert handoff.spill_for_headroom() == 0
+    assert handoff.live_bytes() == 32 ** 3 * 8
+
+
+def test_fused_segmentation_workflow_surfaces_inner_summary(tmp_path):
+    """Satellite: FusedSegmentationWorkflow's manifest carries the inner
+    task's output stats instead of {}."""
+    pytest.importorskip("jax")
+    from cluster_tools_tpu.tasks.fused import FusedSegmentationWorkflow
+
+    rng = np.random.default_rng(0)
+    path = os.path.join(str(tmp_path), "d.zarr")
+    # z extent 64 over the 8-device test mesh: shard extent 8 >= halo 4
+    vol = rng.random((64, 16, 16)).astype(np.float32)
+    src = file_reader(path).create_dataset(
+        "bmap", shape=vol.shape, chunks=(16, 16, 16), dtype="float32"
+    )
+    src[...] = vol
+    cdir = os.path.join(str(tmp_path), "config")
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, "global.config"), "w") as f:
+        json.dump({"block_shape": [16, 16, 16]}, f)
+    wf = FusedSegmentationWorkflow(
+        tmp_folder=os.path.join(str(tmp_path), "tmp"), config_dir=cdir,
+        target="local", input_path=path, input_key="bmap",
+        output_path=path, ws_key="ws", cc_key=None, threshold=0.5,
+        halo=4,
+    )
+    assert build([wf])
+    doc = wf.output().read()
+    assert "n_foreground" in doc and "written" in doc
+    assert "ws" in doc["written"]
+
+
+# -- bench smoke (the <10 s twin of `make bench-fuse`) ------------------------
+
+
+def test_fuse_bench_smoke():
+    import bench
+
+    rec = bench.fuse_bench(smoke=True)
+    assert rec["bit_identical"] is True
+    assert rec["zero_intermediate_writes"] is True
+    assert rec["handoffs_on"]["handoffs_served"] > 0
+    assert rec["handoffs_off"]["intermediate_bytes_written"] > 0

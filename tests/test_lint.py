@@ -101,6 +101,33 @@ def test_ct006_all_violation_classes():
     assert any("REQUEUE_EXIT_CODE" in m for m in msgs)
 
 
+def test_ct007_all_violation_classes():
+    """The MemoryTarget spill contract (docs/PERFORMANCE.md "Task-graph
+    fusion"): missing storage-twin spec, unverified handle, unbound
+    result — each is its own violation class."""
+    findings, _ = lint_fixture("ct007_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT007"]
+    assert any("misses spill wiring" in m for m in msgs)
+    assert any("never passed to region_verifier" in m for m in msgs)
+    assert any("not bound to a name" in m for m in msgs)
+    # kwarg-only call missing only `shape`: the required-kwarg slice must
+    # not wrap negative and drop it
+    assert any("['shape']" in m for m in msgs)
+
+
+def test_ct007_real_declaring_tasks_pass_unsuppressed():
+    """Every production MemoryTarget declaration satisfies the spill
+    contract on merit: the four hardened workflow tasks that declare
+    dataset handoffs lint clean without opt-outs."""
+    pkg = os.path.join(REPO_ROOT, "cluster_tools_tpu", "tasks")
+    for fname in ("watershed.py", "connected_components.py",
+                  "inference.py", "ilastik.py"):
+        path = os.path.join(pkg, fname)
+        findings, _ = run_lint([path])
+        assert [f for f in findings if f.rule == "CT007"] == [], fname
+        assert "ctlint: disable=CT007" not in open(path).read()
+
+
 # -- suppressions -------------------------------------------------------------
 
 
