@@ -36,6 +36,18 @@
 #                     throughput, dispatch counts, and bit-identity into
 #                     BENCH_r07.json; cpu backend, <30 s (a <10 s smoke
 #                     twin runs inside tier1 via tests/test_sharded.py)
+#   bench-solve     = distributed-agglomeration bench (docs/PERFORMANCE.md
+#                     "Distributed agglomeration"): the >=100k-edge
+#                     solver-scale instance solved single-host vs over the
+#                     Morton-octant reduce tree (in-process + a 2-worker
+#                     multihost group), recording the energy gap (<=0.1%),
+#                     determinism, and bit-identity into BENCH_r09.json;
+#                     cpu backend, <30 s (a <10 s smoke twin runs inside
+#                     tier1 via tests/test_reduce_tree.py)
+#   bench-trajectory= aggregate the BENCH_r01..r09 headline numbers into
+#                     one table (stdout + rewritten into docs/PERFORMANCE.md
+#                     "Performance trajectory"), so the perf history is
+#                     readable without opening nine JSON files
 #   supervise-demo  = smoke-check recipe: watershed workflow on the
 #                     stub-slurm cluster target under an injected job loss,
 #                     printing the supervisor's resubmission log
@@ -44,7 +56,8 @@ CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
 .PHONY: test lint tier1 chaos chaos-resource failures-report bench-io \
-	bench-sweep bench-fuse supervise-demo native clean
+	bench-sweep bench-fuse bench-solve bench-trajectory supervise-demo \
+	native clean
 
 test: lint tier1 chaos
 
@@ -75,6 +88,12 @@ bench-sweep:
 
 bench-fuse:
 	JAX_PLATFORMS=cpu $(PY) bench.py --fuse
+
+bench-solve:
+	JAX_PLATFORMS=cpu $(PY) bench.py --solve
+
+bench-trajectory:
+	$(PY) scripts/bench_trajectory.py --write
 
 supervise-demo:
 	JAX_PLATFORMS=cpu $(PY) scripts/supervise_demo.py

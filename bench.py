@@ -652,6 +652,133 @@ def sweep_bench(smoke=False, n_devices=1):
     return rec
 
 
+def solve_bench(smoke=False):
+    """Distributed-agglomeration config (docs/PERFORMANCE.md "Distributed
+    agglomeration"): the >=100k-edge solver-scale instance of BENCH_r06
+    (``grid_rag(g=33)``) solved three ways —
+
+    1. single-host parallel GAEC (the host rung of ops/contraction.py):
+       the reference energy and wall time,
+    2. the Morton-octant reduce tree in one process
+       (``parallel/reduce_tree.py``, frontier-aware contraction rounds,
+       run twice to prove the merged labeling is deterministic),
+    3. the same tree over a 2-process multihost worker group
+       (``solve_over_workers``: jax.distributed worker wiring, boundary
+       packets as the inter-host reduce hops), asserted bit-identical to
+       the in-process tree.
+
+    Records the energy gap vs the single-host solve (acceptance:
+    |gap| <= 0.1%), determinism, and per-path wall times.  ``smoke=True``
+    is the <10 s tier-1 variant (g=12, no file output); the full run
+    writes BENCH_r09.json next to this script.  Emits exactly one JSON
+    line on stdout and returns the record.
+    """
+    import tempfile
+
+    from cluster_tools_tpu.ops.contraction import parallel_contraction
+    from cluster_tools_tpu.ops.multicut import multicut_energy
+    from cluster_tools_tpu.parallel import reduce_tree as rt
+    from cluster_tools_tpu.utils import function_utils as fu
+    from cluster_tools_tpu.utils.synthetic import grid_rag
+
+    g = 12 if smoke else 33
+    shards = 4 if smoke else 8
+    fanout = 2
+    n_workers = 2
+    n, edges, costs = grid_rag(g=g, seed=0)
+    impl = rt._host_impl()  # same concrete rung everywhere -> bit-comparable
+    log(
+        f"solve bench: grid_rag g={g} ({len(edges)} edges, {n} nodes), "
+        f"{shards} shards, fanout {fanout}, impl {impl}"
+    )
+
+    t0 = time.perf_counter()
+    lab_single = parallel_contraction(
+        n, edges, costs.reshape(-1, 1), "max", 0.0, impl=impl
+    )
+    t_single = time.perf_counter() - t0
+    e_single = multicut_energy(edges, costs, lab_single)
+
+    pos = np.stack(np.unravel_index(np.arange(n), (g, g, g)), axis=1)
+    node_shard = rt.morton_node_shards(pos, shards)
+    solver = rt.default_tree_solver("max", 0.0, impl=impl)
+    t0 = time.perf_counter()
+    lab_tree, info = rt.sharded_solve(
+        n, edges, costs, node_shard, fanout=fanout, solver=solver,
+        max_workers=4,
+    )
+    t_tree = time.perf_counter() - t0
+    lab_rerun, _ = rt.sharded_solve(
+        n, edges, costs, node_shard, fanout=fanout, solver=solver,
+        max_workers=1,
+    )
+    deterministic = bool(np.array_equal(lab_tree, lab_rerun))
+    e_tree = multicut_energy(edges, costs, lab_tree)
+    gap_pct = 100.0 * (e_tree - e_single) / max(abs(e_single), 1e-12)
+    log(
+        f"solve bench: single-host {t_single:.3f}s E={e_single:.1f} | "
+        f"reduce tree {t_tree:.3f}s E={e_tree:.1f} "
+        f"(gap {gap_pct:+.4f}%, deterministic={deterministic})"
+    )
+
+    scratch = tempfile.mkdtemp(prefix="ctt_solve_bench_")
+    t0 = time.perf_counter()
+    lab_workers, winfo = rt.solve_over_workers(
+        n, edges, costs, node_shard, fanout=fanout, n_workers=n_workers,
+        scratch_dir=scratch,
+    )
+    t_workers = time.perf_counter() - t0
+    workers_identical = bool(np.array_equal(lab_workers, lab_tree))
+    e_workers = multicut_energy(edges, costs, lab_workers)
+    gap_workers = 100.0 * (e_workers - e_single) / max(abs(e_single), 1e-12)
+    log(
+        f"solve bench: {n_workers}-worker group {t_workers:.3f}s "
+        f"E={e_workers:.1f} (gap {gap_workers:+.4f}%, "
+        f"bit-identical to in-process tree: {workers_identical})"
+    )
+
+    rec = {
+        "metric": "distributed_agglomeration_solve",
+        "backend": "cpu",
+        "smoke": bool(smoke),
+        "impl": impl,
+        "n_nodes": int(n),
+        "n_edges": int(len(edges)),
+        "solver_shards": int(shards),
+        "reduce_fanout": int(fanout),
+        "single_host": {
+            "seconds": round(t_single, 4),
+            "energy": round(e_single, 3),
+        },
+        "reduce_tree": {
+            "seconds": round(t_tree, 4),
+            "energy": round(e_tree, 3),
+            "energy_gap_pct": round(gap_pct, 4),
+            "deterministic_across_reruns": deterministic,
+            "levels": info["levels"],
+            "boundary_edges_root": info["boundary_edges_root"],
+        },
+        "worker_group": {
+            "workers": int(n_workers),
+            "seconds": round(t_workers, 4),
+            "energy": round(e_workers, 3),
+            "energy_gap_pct": round(gap_workers, 4),
+            "bit_identical_to_in_process": workers_identical,
+        },
+        "gap_within_0p1pct": bool(
+            abs(gap_pct) <= 0.1 and abs(gap_workers) <= 0.1
+        ),
+    }
+    print(json.dumps(rec), flush=True)
+    if not smoke:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r09.json"
+        )
+        fu.atomic_write_json(path, rec)
+        log(f"solve bench done -> {path}")
+    return rec
+
+
 def main():
     log(f"start; env JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}")
     probed = os.environ.get("CT_BENCH_ACCEL")
@@ -1622,6 +1749,8 @@ if __name__ == "__main__":
             sweep_bench()
         elif "--fuse" in sys.argv or os.environ.get("CT_BENCH_FUSE"):
             fuse_bench()
+        elif "--solve" in sys.argv or os.environ.get("CT_BENCH_SOLVE"):
+            solve_bench()
         elif os.environ.get("CT_BENCH_IMPL"):
             main()
         else:

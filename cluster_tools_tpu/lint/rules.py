@@ -118,8 +118,22 @@ EXECUTOR_KNOBS = frozenset({"io_threads", "max_retries"})
 #: task owns a chunked output dataset (``require_dataset`` in scope).
 HOST_MAP_KNOBS = frozenset({"store_verify_fn", "blocking"})
 
-#: files that *define* the executor surface (call sites only are checked)
-_CT001_DEFINING = ("executor.py", "task.py")
+#: knobs every sharded-global-solve call site must plumb
+#: (``parallel/reduce_tree.py``, docs/PERFORMANCE.md "Distributed
+#: agglomeration"): the shard/fanout knobs must come from the task config
+#: (not hard-coded topology) and the failure attribution must be wired so a
+#: degraded solve lands in failures.json as ``degraded:unsharded_solve``
+#: instead of vanishing.
+SOLVE_KNOBS = frozenset({
+    "solver_shards",
+    "fanout",
+    "failures_path",
+    "task_name",
+})
+
+#: files that *define* the executor/solve surface (call sites only are
+#: checked; reduce_tree.py's internal driver calls are its own contract)
+_CT001_DEFINING = ("executor.py", "task.py", "reduce_tree.py")
 
 
 def ct001_executor_contract(module: LintModule) -> List[Finding]:
@@ -127,10 +141,12 @@ def ct001_executor_contract(module: LintModule) -> List[Finding]:
 
     Guards the hand-plumbed convention ROADMAP item 5 complains about:
     every ``BlockwiseExecutor``/``map_blocks`` site must wire the retry /
-    deadline / verify / schedule knobs, and every ``host_block_map`` site
-    that owns a chunked store must wire ``store_verify_fn`` + ``blocking``.
-    Opt out with ``# ctlint: disable=CT001`` where a knob is genuinely
-    inapplicable (say why in the comment).
+    deadline / verify / schedule knobs, every ``host_block_map`` site
+    that owns a chunked store must wire ``store_verify_fn`` + ``blocking``,
+    and every ``solve_with_reduce_tree`` site (the sharded global solve)
+    must plumb the shard/fanout knobs from config plus the
+    failures-attribution wiring.  Opt out with ``# ctlint: disable=CT001``
+    where a knob is genuinely inapplicable (say why in the comment).
     """
     if module.name in _CT001_DEFINING and "lint_fixtures" not in module.path:
         return []
@@ -141,6 +157,8 @@ def ct001_executor_contract(module: LintModule) -> List[Finding]:
             required = MAP_BLOCKS_KNOBS
         elif name == "BlockwiseExecutor":
             required = EXECUTOR_KNOBS
+        elif name == "solve_with_reduce_tree":
+            required = SOLVE_KNOBS
         elif name == "host_block_map":
             fn = module.enclosing_function(call)
             scope = fn if fn is not None else module.tree
@@ -226,10 +244,11 @@ def ct002_atomic_writes(module: LintModule) -> List[Finding]:
 # CT003 - lock discipline
 # =============================================================================
 
-#: modules participating in the runtime's lock graph
+#: modules participating in the runtime's lock graph (reduce_tree.py: the
+#: sharded solve's merge queue + metrics locks)
 _CT003_SCOPE = (
     "executor.py", "chunk_cache.py", "supervision.py",
-    "function_utils.py", "containers.py", "handoff.py",
+    "function_utils.py", "containers.py", "handoff.py", "reduce_tree.py",
 )
 
 #: method/function names that block the calling thread (never allowed

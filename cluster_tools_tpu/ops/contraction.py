@@ -56,13 +56,51 @@ remain available as ``impl="heap"`` (and are the quality oracle in tests).
 
 from __future__ import annotations
 
+import threading
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
 _ACCEL_PLATFORMS = ("tpu", "axon")
+
+
+# -- process-wide solver metrics ---------------------------------------------
+# Same snapshot/delta pattern as the executor's dispatch counters and the
+# chunk cache: the task runtime snapshots around run_impl and merges the
+# delta into io_metrics.json, so every solve stops being a black box next
+# to the instrumented I/O and dispatch paths (docs/PERFORMANCE.md
+# "Distributed agglomeration").  ``solver_rounds`` is counted by the numpy
+# reference rung (the native rung is bit-parity with it but does not
+# report its loop count; the jax rung's count lives on device).
+
+_METRICS_LOCK = threading.Lock()
+_SOLVER_COUNTERS = {
+    "solver_calls": 0,      # parallel_contraction invocations
+    "solver_rounds": 0,     # contraction rounds (numpy rung)
+    "solver_edges_in": 0,   # edges entering the solves
+    "solver_edges_out": 0,  # inter-cluster edges remaining after them
+}
+
+
+def solver_snapshot() -> Dict[str, float]:
+    """Current process-wide contraction-solver counters (monotonic; diff
+    two snapshots with :func:`solver_delta` to attribute a task's share)."""
+    with _METRICS_LOCK:
+        return dict(_SOLVER_COUNTERS)
+
+
+def solver_delta(snapshot: Dict[str, float]) -> Dict[str, float]:
+    """Counter movement since ``snapshot`` (same keys)."""
+    cur = solver_snapshot()
+    return {k: cur[k] - snapshot.get(k, 0) for k in cur}
+
+
+def _record_solver_metrics(**deltas) -> None:
+    with _METRICS_LOCK:
+        for k, v in deltas.items():
+            _SOLVER_COUNTERS[k] += int(v)
 
 
 def _resolve_impl(impl: str) -> str:
@@ -83,6 +121,30 @@ def _relabel_consecutive(roots: np.ndarray) -> np.ndarray:
     return labels.astype(np.int64)
 
 
+def sum_by_key(
+    key: np.ndarray, payload: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Group-by-key payload-column sums: ``(unique_keys_sorted, sums)``.
+
+    Stable argsort + bincount instead of ``np.unique(return_inverse)``:
+    same groups, same original-order accumulation — THE documented
+    summation order of the contraction engine (the native kernel
+    reproduces it for bit-parity, and the reduce tree's frontier/merge
+    aggregation reuses it so hierarchical solves stay bit-comparable) —
+    about 2x faster per round."""
+    order = np.argsort(key, kind="stable")
+    ks = key[order]
+    first = np.ones(len(ks), bool)
+    first[1:] = ks[1:] != ks[:-1]
+    uniq = ks[first]
+    inv = np.empty(len(ks), np.int64)
+    inv[order] = np.cumsum(first) - 1
+    out = np.empty((len(uniq), payload.shape[1]), np.float64)
+    for c in range(payload.shape[1]):
+        out[:, c] = np.bincount(inv, weights=payload[:, c], minlength=len(uniq))
+    return uniq, out
+
+
 def _canonical_edges(
     n_nodes: int, edges: np.ndarray, payload: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -100,19 +162,7 @@ def _canonical_edges(
             np.zeros((0, payload.shape[1]), np.float64),
         )
     key = u.astype(np.int64) * np.int64(n_nodes) + v.astype(np.int64)
-    # stable argsort + bincount instead of np.unique(return_inverse): same
-    # groups, same original-edge-order accumulation (the summation order the
-    # native kernel reproduces for bit-parity), about 2x faster per round
-    order = np.argsort(key, kind="stable")
-    ks = key[order]
-    first = np.ones(len(ks), bool)
-    first[1:] = ks[1:] != ks[:-1]
-    uniq = ks[first]
-    inv = np.empty(len(ks), np.int64)
-    inv[order] = np.cumsum(first) - 1
-    out = np.empty((len(uniq), payload.shape[1]), np.float64)
-    for c in range(payload.shape[1]):
-        out[:, c] = np.bincount(inv, weights=payload[:, c], minlength=len(uniq))
+    uniq, out = sum_by_key(key, payload)
     return (uniq // n_nodes).astype(np.int64), (uniq % n_nodes).astype(np.int64), out
 
 
@@ -136,6 +186,7 @@ def _contract_rounds_numpy(
     u, v, payload = _canonical_edges(n_nodes, edges, payload)
     sign = 1.0 if mode == "max" else -1.0
     thr = sign * float(threshold)
+    rounds = 0
 
     while len(u):
         prio = payload[:, 0] if payload.shape[1] == 1 else (
@@ -158,6 +209,7 @@ def _contract_rounds_numpy(
         np.minimum.at(best_e, v[cand_v], eid[cand_v])
         # step 2: mutual picks form a matching -> depth-1 union
         mutual = elig & (best_e[u] == eid) & (best_e[v] == eid)
+        rounds += 1
         root = np.arange(n_nodes, dtype=np.int64)
         root[v[mutual]] = u[mutual]
         labels = root[labels]
@@ -165,6 +217,7 @@ def _contract_rounds_numpy(
         u, v, payload = _canonical_edges(
             n_nodes, np.stack([root[u], root[v]], axis=1), payload
         )
+    _record_solver_metrics(solver_rounds=rounds)
     return _relabel_consecutive(labels)
 
 
@@ -327,23 +380,36 @@ def parallel_contraction(
         return np.arange(n_nodes, dtype=np.int64)
     payload = np.asarray(payload, dtype=np.float64).reshape(len(edges), -1)
 
+    labels = None
     resolved = _resolve_impl(impl)
     if resolved == "jax":
-        return _contract_rounds_jax(n_nodes, edges, payload, mode, threshold)
-    if resolved == "native":
+        labels = _contract_rounds_jax(n_nodes, edges, payload, mode, threshold)
+    elif resolved == "native":
         from .. import native
 
         labels = native.parallel_contract(
             n_nodes, edges, payload, mode == "max", threshold
         )
-        if labels is not None:
-            return labels
-        if impl == "native":
-            raise RuntimeError("native library unavailable for impl='native'")
-        resolved = "numpy"
-    if resolved == "numpy":
-        return _contract_rounds_numpy(n_nodes, edges, payload, mode, threshold)
-    raise ValueError(f"unknown impl {impl!r}")
+        if labels is None:
+            if impl == "native":
+                raise RuntimeError(
+                    "native library unavailable for impl='native'"
+                )
+            resolved = "numpy"
+    if labels is None:
+        if resolved != "numpy":
+            raise ValueError(f"unknown impl {impl!r}")
+        labels = _contract_rounds_numpy(n_nodes, edges, payload, mode, threshold)
+    # observability (docs/PERFORMANCE.md "Distributed agglomeration"):
+    # edges-in vs surviving inter-cluster edges, per solve
+    _record_solver_metrics(
+        solver_calls=1,
+        solver_edges_in=len(edges),
+        solver_edges_out=int(
+            (labels[edges[:, 0]] != labels[edges[:, 1]]).sum()
+        ),
+    )
+    return labels
 
 
 def gaec_parallel(

@@ -135,7 +135,14 @@ KILL_EXIT_CODE = 113
 
 ENV_VAR = "CTT_FAULTS"
 
-_ERROR_SITES = ("load", "store", "io_read", "io_write", "submit", "task")
+#: "solve" is the sharded-global-solve site (parallel/reduce_tree.py): an
+#: error there models a lost reduce hop or a dying solver worker — the
+#: entry point must degrade to the single-host solve (resolution
+#: "degraded:unsharded_solve").  Inside a reduce-tree worker the same hook
+#: (block-targeted by worker id) escalates to a real SIGKILL, so chaos can
+#: kill one worker of the group and prove the driver's fallback.
+_ERROR_SITES = ("load", "store", "io_read", "io_write", "submit", "task",
+                "solve")
 _KILL_SITES = ("block_done", "task_done")
 #: "dispatch" is the batch-grain site of the sharded sweep (one compiled
 #: program per Morton batch, docs/PERFORMANCE.md "Sharded sweeps"): an oom

@@ -182,7 +182,16 @@ class BaseTask:
         :meth:`save_handoff_arrays` stay in host RAM and downstream tasks
         consume them without a storage round-trip, with spill-to-storage
         (byte-budget admission, headroom probes, forced ``spill`` faults)
-        as the universal fallback."""
+        as the universal fallback.  ``solver_shards`` / ``reduce_fanout`` /
+        ``solver_workers`` shard the global agglomeration/multicut solve
+        over an octant reduce tree (docs/PERFORMANCE.md "Distributed
+        agglomeration"; ``parallel/reduce_tree.py``): ``solver_shards=1``
+        keeps today's single-host solve, ``>1`` partitions the graph by
+        Morton block octants, runs frontier-aware contraction per shard,
+        and merges boundary edges up a ``reduce_fanout``-ary tree —
+        in-process, or over a ``solver_workers``-process multihost worker
+        group; any sharded failure degrades back to the single-host solve
+        (``degraded:unsharded_solve`` in failures.json)."""
         return {
             "max_retries": 0,
             "retry_backoff_s": 1.0,
@@ -203,6 +212,9 @@ class BaseTask:
             "degrade_wait_s": 5.0,
             "inflight_byte_budget": None,
             "memory_handoffs": False,
+            "solver_shards": 1,
+            "reduce_fanout": 2,
+            "solver_workers": 1,
         }
 
     @staticmethod
@@ -239,6 +251,9 @@ class BaseTask:
 
         from . import executor as executor_mod
 
+        from ..ops import contraction as contraction_mod
+        from ..parallel import reduce_tree as reduce_tree_mod
+
         t0 = time.time()
         self.logger.info(f"start {self.task_name} (target={self.target})")
         # fault specs with a "tasks" filter target the running task's uid
@@ -246,6 +261,8 @@ class BaseTask:
         io_snap = chunk_cache.snapshot()
         disp_snap = executor_mod.dispatch_snapshot()
         handoff_snap = handoff_mod.snapshot()
+        solver_snap = contraction_mod.solver_snapshot()
+        tree_snap = reduce_tree_mod.solve_snapshot()
         try:
             result = self.run_impl() or {}
             # finalize in-memory targets INSIDE the task context: forced
@@ -273,6 +290,16 @@ class BaseTask:
         handoff_metrics = handoff_mod.delta(handoff_snap)
         if any(handoff_metrics.values()):
             io_metrics.update(handoff_metrics)
+        # solver attribution: contraction-engine calls/rounds/edge counts
+        # plus the reduce tree's per-level solve/merge movement, so the
+        # global solve is as observable as the I/O and dispatch paths
+        # (docs/PERFORMANCE.md "Distributed agglomeration")
+        solver_metrics = contraction_mod.solver_delta(solver_snap)
+        if any(solver_metrics.values()):
+            io_metrics.update(solver_metrics)
+        tree_metrics = reduce_tree_mod.solve_delta(tree_snap)
+        if any(tree_metrics.values()):
+            io_metrics.update(tree_metrics)
         if any(io_metrics.values()):
             result["io_metrics"] = io_metrics
             try:

@@ -31,7 +31,16 @@ class AgglomerativeClusteringBase(BaseTask):
     it, default 0.5); ``impl`` selects the contraction engine
     (:mod:`..ops.contraction` ladder: ``auto`` resolves device-JAX on an
     accelerator, else native C++, else numpy; ``heap`` is the sequential
-    oracle of :mod:`..ops.agglomeration`)."""
+    oracle of :mod:`..ops.agglomeration`).
+
+    ``solver_shards > 1`` shards the agglomeration over the reduce tree
+    (docs/PERFORMANCE.md "Distributed agglomeration") with the
+    size-weighted mean payload carried through every merge level; the
+    supervoxel id range stands in for block octants (blockwise watershed
+    labels consecutive ids per block, so contiguous ranges are spatial
+    neighborhoods).  Single-host average linkage stays the
+    ``solver_shards=1`` case and the ``degraded:unsharded_solve``
+    fallback."""
 
     task_name = "agglomerative_clustering"
 
@@ -46,26 +55,68 @@ class AgglomerativeClusteringBase(BaseTask):
 
     def run_impl(self):
         cfg = self.get_config()
+        from ..ops import contraction as contraction_mod
+        from ..parallel import reduce_tree as reduce_tree_mod
         from ..runtime import handoff
 
         nodes, _, edges, sizes = load_global_graph(self.tmp_folder)
         feats = handoff.load_array(features_path(self.tmp_folder))
-        labels = average_parallel(
-            len(nodes),
-            edges.astype(np.int64),
-            feats[:, 0],
-            sizes,
-            float(cfg.get("threshold", 0.5)),
-            impl=str(cfg.get("impl", "auto")),
-        )
+        threshold = float(cfg.get("threshold", 0.5))
+        impl = str(cfg.get("impl", "auto"))
+        shards = int(cfg.get("solver_shards", 1) or 1)
+        solver_snap = contraction_mod.solver_snapshot()
+        tree_snap = reduce_tree_mod.solve_snapshot()
+
+        def unsharded():
+            return average_parallel(
+                len(nodes), edges.astype(np.int64), feats[:, 0], sizes,
+                threshold, impl=impl,
+            )
+
+        if shards > 1 and len(edges):
+            # average-linkage payload: (prob * size, size) columns, summed
+            # on merge — the same contract as ops/contraction
+            s = np.maximum(np.asarray(sizes, np.float64), 1e-12)
+            payload = np.stack(
+                [np.asarray(feats[:, 0], np.float64) * s, s], axis=1
+            )
+            labels, solve_info = reduce_tree_mod.solve_with_reduce_tree(
+                len(nodes), edges.astype(np.int64), payload,
+                node_shard=reduce_tree_mod.contiguous_node_shards(
+                    len(nodes), shards
+                ),
+                solver_shards=shards,
+                fanout=int(cfg.get("reduce_fanout", 2) or 2),
+                failures_path=self.failures_path,
+                task_name=self.uid,
+                unsharded=unsharded,
+                mode="min",
+                threshold=threshold,
+                workers=int(cfg.get("solver_workers", 1) or 1),
+                scratch_dir=os.path.join(self.tmp_folder, "reduce_tree"),
+                max_workers=max(1, self.max_jobs),
+            )
+        else:
+            labels = unsharded()
+            solve_info = {"sharded": False, "shards": 1}
         np.savez(
             agglomerative_assignments_path(self.tmp_folder),
             keys=nodes,
             values=(labels + 1).astype(np.uint64),
         )
+        from .multicut import _solver_manifest
+
         return {
             "n_nodes": int(len(nodes)),
             "n_clusters": int(labels.max()) + 1 if len(labels) else 0,
+            # no signed multicut objective here; the mean-probability
+            # criterion has no global energy — record edge movement/rounds
+            "solver": _solver_manifest(
+                None, edges, labels,
+                contraction_mod.solver_delta(solver_snap),
+                reduce_tree_mod.solve_delta(tree_snap),
+                solve_info,
+            ),
         }
 
 

@@ -199,12 +199,24 @@ class ReduceLiftedProblemTPU(ReduceLiftedProblemBase):
 
 class SolveLiftedGlobalBase(BaseTask):
     """Final lifted solve + assignment table (reference:
-    ``solve_lifted_global.py``)."""
+    ``solve_lifted_global.py``).
+
+    ``solver_shards > 1`` shards the solve over the Morton-octant reduce
+    tree exactly like :class:`..multicut.SolveGlobalBase`, with the lifted
+    edge set carried through every level: contracted endpoints relabel,
+    internal lifted edges join the node's lifted GAEC solve, parallel
+    lifted edges accumulate.  The lifted node solver is boundary-blind
+    (no frontier formulation for the lifted objective yet); the
+    single-host lifted GAEC remains the ``solver_shards=1`` case and the
+    ``degraded:unsharded_solve`` fallback."""
 
     task_name = "solve_lifted_global"
 
     def run_impl(self):
+        from ..ops import contraction as contraction_mod
+        from ..parallel import reduce_tree as reduce_tree_mod
         from ..runtime import handoff
+        from .multicut import _octant_node_shards, _solver_manifest
 
         cfg = self.get_config()
         scale = int(cfg.get("scale", 0))
@@ -212,11 +224,42 @@ class SolveLiftedGlobalBase(BaseTask):
             self.tmp_folder, scale
         )
         n_nodes = int(node_labeling.max()) + 1 if len(node_labeling) else 0
-        labels = (
-            lifted_greedy_additive(n_nodes, edges, costs, ledges, lcosts)
-            if len(edges)
-            else np.zeros(n_nodes, np.int64)
-        )
+        shards = int(cfg.get("solver_shards", 1) or 1)
+        solver_snap = contraction_mod.solver_snapshot()
+        tree_snap = reduce_tree_mod.solve_snapshot()
+
+        def unsharded():
+            return (
+                lifted_greedy_additive(n_nodes, edges, costs, ledges, lcosts)
+                if len(edges)
+                else np.zeros(n_nodes, np.int64)
+            )
+
+        if shards > 1 and len(edges):
+            # partition as a thunk: see multicut.SolveGlobalBase — failure
+            # to build it degrades instead of failing the task
+            labels, solve_info = reduce_tree_mod.solve_with_reduce_tree(
+                n_nodes, edges, costs,
+                node_shard=lambda: _octant_node_shards(
+                    self.tmp_folder, cfg, scale, node_labeling, n_nodes,
+                    shards,
+                ),
+                solver_shards=shards,
+                fanout=int(cfg.get("reduce_fanout", 2) or 2),
+                failures_path=self.failures_path,
+                task_name=self.uid,
+                unsharded=unsharded,
+                lifted_edges=ledges,
+                lifted_payload=lcosts,
+                workers=int(cfg.get("solver_workers", 1) or 1),
+                scratch_dir=os.path.join(
+                    lmc_dir(self.tmp_folder), "reduce_tree"
+                ),
+                max_workers=max(1, self.max_jobs),
+            )
+        else:
+            labels = unsharded()
+            solve_info = {"sharded": False, "shards": 1}
         final = labels[node_labeling]
         nodes_table, _, edges0, _ = load_global_graph(self.tmp_folder)
         with np.load(lifted_problem_path(self.tmp_folder)) as f:
@@ -236,6 +279,12 @@ class SolveLiftedGlobalBase(BaseTask):
         return {
             "n_segments": int(final.max()) + 1 if len(final) else 0,
             "energy": energy,
+            "solver": _solver_manifest(
+                energy, edges, labels,
+                contraction_mod.solver_delta(solver_snap),
+                reduce_tree_mod.solve_delta(tree_snap),
+                solve_info,
+            ),
         }
 
 
@@ -265,7 +314,10 @@ class LiftedMulticutWorkflow(WorkflowBase):
         n_scales = int(p.get("n_scales", 1))
         keys = {
             k: p[k]
-            for k in ("input_path", "input_key", "block_shape", "roi_begin", "roi_end")
+            for k in (
+                "input_path", "input_key", "block_shape", "roi_begin",
+                "roi_end", "solver_shards", "reduce_fanout", "solver_workers",
+            )
             if k in p
         }
         deps = list(self.dependencies)

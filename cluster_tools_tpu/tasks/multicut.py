@@ -78,6 +78,40 @@ def _load_problem(tmp_folder: str, scale: int):
     )
 
 
+def _octant_node_shards(tmp_folder, cfg, scale, node_labeling, n_nodes, n_shards):
+    """Node -> shard assignment by Morton block octants (docs/PERFORMANCE.md
+    "Distributed agglomeration"): the *scale-0* blocks (the finest
+    geometry the run has — their node sets map through ``node_labeling``
+    to current ids, so coarser solve scales shard just as well) are
+    ordered along the Z-order curve and split into ``n_shards``
+    contiguous runs — each shard an octant-shaped neighborhood of the
+    block grid, so the edges crossing shards are (near-)minimal boundary
+    faces.  A node appearing in several blocks takes the first
+    (lowest-Morton) block's shard — deterministic.  Returns int64
+    [n_nodes], or None when the grid has no blocks to shard by."""
+    from ..parallel.reduce_tree import morton_argsort
+
+    block_nodes = _scale_block_nodes(tmp_folder, cfg, 0, node_labeling)
+    if not block_nodes:
+        return None
+    shape = handoff.resolve_dataset(cfg["input_path"], cfg["input_key"]).shape
+    blocking_s = Blocking(shape, tuple(cfg["block_shape"]))
+    ids = sorted(block_nodes)
+    pos = np.array([blocking_s.block_grid_position(b) for b in ids])
+    order = morton_argsort(pos)
+    node_shard = np.full(int(n_nodes), -1, np.int64)
+    k = max(1, min(int(n_shards), len(ids)))
+    for rank, oi in enumerate(order):
+        shard = rank * k // len(ids)
+        nodes = block_nodes[ids[oi]]
+        if len(nodes) == 0:
+            continue
+        fresh = nodes[node_shard[nodes] < 0]
+        node_shard[fresh] = shard
+    node_shard[node_shard < 0] = 0  # nodes outside every block: shard 0
+    return node_shard
+
+
 def _scale_block_nodes(tmp_folder, cfg, scale, node_labeling):
     """Node sets (current ids) per scale-``scale`` block.
 
@@ -123,6 +157,35 @@ def _scale_block_nodes(tmp_folder, cfg, scale, node_labeling):
     return out
 
 
+def _solver_manifest(energy, edges, labels, solver_delta, tree_delta,
+                     solve_info):
+    """The observability block every solve task puts in its success
+    manifest (ISSUE 9 satellite): objective energy, edges in vs surviving
+    inter-cluster edges, contraction round count (numpy-rung exact; the
+    native rung is bit-parity but does not report its loop count), and
+    the reduce-tree shape when the solve ran sharded.  The same counters
+    flow additively into ``io_metrics.json`` via the deltas
+    ``BaseTask.run`` merges; ``make failures-report`` renders both."""
+    edges = np.asarray(edges)
+    labels = np.asarray(labels)
+    edges_out = (
+        int((labels[edges[:, 0]] != labels[edges[:, 1]]).sum())
+        if len(edges) else 0
+    )
+    out = {
+        "energy": float(energy) if energy is not None else None,
+        "edges_in": int(len(edges)),
+        "edges_out": edges_out,
+        "rounds": int(
+            (solver_delta or {}).get("solver_rounds", 0)
+            + (tree_delta or {}).get("tree_rounds", 0)
+        ),
+        "solver_calls": int((solver_delta or {}).get("solver_calls", 0)),
+    }
+    out.update(solve_info or {})
+    return out
+
+
 class SolveSubproblemsBase(BaseTask):
     """Per-block multicut subproblems at one scale (reference:
     ``solve_subproblems.py``).  Params: ``scale``, ``agglomerator`` (solver
@@ -145,10 +208,13 @@ class SolveSubproblemsBase(BaseTask):
         }
 
     def run_impl(self):
+        from ..ops import contraction as contraction_mod
+
         cfg = self.get_config()
         scale = int(cfg.get("scale", 0))
         solver = get_multicut_solver(cfg.get("agglomerator", "gaec_parallel"))
         edges, costs, node_labeling = _load_problem(self.tmp_folder, scale)
+        solver_snap = contraction_mod.solver_snapshot()
         block_nodes = _scale_block_nodes(self.tmp_folder, cfg, scale, node_labeling)
 
         cut = np.zeros(len(edges), dtype=bool)
@@ -187,11 +253,20 @@ class SolveSubproblemsBase(BaseTask):
         self.save_handoff_arrays(
             cut_edges_path(self.tmp_folder, scale), cut=cut, seen=seen
         )
+        sd = contraction_mod.solver_delta(solver_snap)
         return {
             "scale": scale,
             "n_subproblems": len(block_nodes),
             "n_cut": int(cut.sum()),
             "n_edges": len(edges),
+            # per-scale solver attribution: the subproblem solves' rounds
+            # and edge movement (numpy-rung rounds; see _solver_manifest)
+            "solver": {
+                "solver_calls": int(sd.get("solver_calls", 0)),
+                "rounds": int(sd.get("solver_rounds", 0)),
+                "edges_in": int(sd.get("solver_edges_in", 0)),
+                "edges_out": int(sd.get("solver_edges_out", 0)),
+            },
         }
 
 
@@ -250,7 +325,18 @@ class ReduceProblemTPU(ReduceProblemBase):
 class SolveGlobalBase(BaseTask):
     """Solve the final reduced problem and emit the node-assignment table
     (reference: ``solve_global.py``).  Params: ``scale`` (the final level),
-    ``agglomerator``."""
+    ``agglomerator``.
+
+    With ``solver_shards > 1`` (docs/PERFORMANCE.md "Distributed
+    agglomeration") the solve shards over the Morton-octant reduce tree
+    (:mod:`..parallel.reduce_tree`): frontier-aware contraction rounds per
+    shard, boundary edges merged up a ``reduce_fanout``-ary tree —
+    in-process, or over a ``solver_workers``-process multihost worker
+    group.  The configured ``agglomerator`` stays the single-host solver
+    (the degenerate ``solver_shards=1`` case AND the
+    ``degraded:unsharded_solve`` fallback); the sharded path always runs
+    the round-based contraction engine, whose frontier abstention is what
+    bounds the energy gap (``make bench-solve``)."""
 
     task_name = "solve_global"
 
@@ -263,36 +349,67 @@ class SolveGlobalBase(BaseTask):
         }
 
     def run_impl(self):
+        from ..ops import contraction as contraction_mod
+        from ..parallel import reduce_tree as reduce_tree_mod
+
         cfg = self.get_config()
         scale = int(cfg.get("scale", 0))
         solver = get_multicut_solver(cfg.get("agglomerator", "kernighan-lin"))
         edges, costs, node_labeling = _load_problem(self.tmp_folder, scale)
         n_nodes = int(node_labeling.max()) + 1 if len(node_labeling) else 0
+        shards = int(cfg.get("solver_shards", 1) or 1)
+        solver_snap = contraction_mod.solver_snapshot()
+        tree_snap = reduce_tree_mod.solve_snapshot()
 
-        # preemption safety (SURVEY.md §5.3): checkpoint-capable solvers
-        # persist their partition every outer sweep; a killed run resumes
-        # mid-solve instead of restarting the global solve from scratch
-        ckpt = None
-        solver_kw = {}
-        if getattr(solver, "supports_checkpoint", False) and len(edges):
-            from ..ops.multicut import SolverCheckpoint
+        def unsharded():
+            # preemption safety (SURVEY.md §5.3): checkpoint-capable
+            # solvers persist their partition every outer sweep; a killed
+            # run resumes mid-solve instead of restarting the global solve
+            # from scratch
+            ckpt = None
+            solver_kw = {}
+            if getattr(solver, "supports_checkpoint", False) and len(edges):
+                from ..ops.multicut import SolverCheckpoint
 
-            ckpt = SolverCheckpoint(
-                os.path.join(
-                    mc_dir(self.tmp_folder), f"solve_global_s{scale}.ckpt.npz"
-                ),
-                edges,
-                costs,
+                ckpt = SolverCheckpoint(
+                    os.path.join(
+                        mc_dir(self.tmp_folder),
+                        f"solve_global_s{scale}.ckpt.npz",
+                    ),
+                    edges,
+                    costs,
+                )
+                solver_kw["checkpoint"] = ckpt
+            labels = (
+                solver(n_nodes, edges, costs, **solver_kw)
+                if len(edges)
+                else np.zeros(n_nodes, np.int64)
             )
-            solver_kw["checkpoint"] = ckpt
+            if ckpt is not None:
+                ckpt.clear()
+            return labels
 
-        labels = (
-            solver(n_nodes, edges, costs, **solver_kw)
-            if len(edges)
-            else np.zeros(n_nodes, np.int64)
-        )
-        if ckpt is not None:
-            ckpt.clear()
+        if shards > 1 and len(edges):
+            # partition as a thunk: building it re-opens block geometry,
+            # and any failure there must degrade, not fail the task
+            labels, solve_info = reduce_tree_mod.solve_with_reduce_tree(
+                n_nodes, edges, costs,
+                node_shard=lambda: _octant_node_shards(
+                    self.tmp_folder, cfg, scale, node_labeling, n_nodes,
+                    shards,
+                ),
+                solver_shards=shards,
+                fanout=int(cfg.get("reduce_fanout", 2) or 2),
+                failures_path=self.failures_path,
+                task_name=self.uid,
+                unsharded=unsharded,
+                workers=int(cfg.get("solver_workers", 1) or 1),
+                scratch_dir=os.path.join(mc_dir(self.tmp_folder), "reduce_tree"),
+                max_workers=max(1, self.max_jobs),
+            )
+        else:
+            labels = unsharded()
+            solve_info = {"sharded": False, "shards": 1}
         final = labels[node_labeling]  # original dense node -> segment
         nodes_table, _, edges0, _ = load_global_graph(self.tmp_folder)
         energy = multicut_energy(
@@ -305,9 +422,18 @@ class SolveGlobalBase(BaseTask):
             keys=nodes_table,
             values=(final + 1).astype(np.uint64),
         )
+        # the solve is no longer a black box: energy, contraction rounds,
+        # and edge movement land in the manifest (and, via the counter
+        # deltas BaseTask.run merges, in io_metrics.json)
         return {
             "n_segments": int(final.max()) + 1 if len(final) else 0,
             "energy": energy,
+            "solver": _solver_manifest(
+                energy, edges, labels,
+                contraction_mod.solver_delta(solver_snap),
+                reduce_tree_mod.solve_delta(tree_snap),
+                solve_info,
+            ),
         }
 
 
@@ -348,6 +474,9 @@ class MulticutWorkflow(WorkflowBase):
                 "roi_begin",
                 "roi_end",
                 "agglomerator",
+                "solver_shards",
+                "reduce_fanout",
+                "solver_workers",
             )
             if k in p
         }

@@ -191,6 +191,7 @@ class MergeStitchAssignmentsBase(BaseTask):
             shape, block_shape, cfg.get("roi_begin"), cfg.get("roi_end")
         )
         d = _stitch_dir(self.tmp_folder)
+        solver_stats = None
         all_pairs, all_sums, all_counts = [], [], []
         for b in block_ids:
             p = os.path.join(d, f"block_{b}.npz")
@@ -237,9 +238,18 @@ class MergeStitchAssignmentsBase(BaseTask):
                 # stitch-via-multicut on the face graph: probs -> costs
                 # (cost > 0 iff mean < thr, see compute_costs: attractive
                 # when p < 1 - beta), then the parallel GAEC decides which
-                # pairs actually merge given the whole graph
+                # pairs actually merge given the whole graph.  With
+                # solver_shards > 1 the solve shards over the reduce tree
+                # (docs/PERFORMANCE.md "Distributed agglomeration"); the
+                # segmentation labels' id range stands in for octants
+                # (blockwise labeling orders ids spatially), and any
+                # sharded failure degrades to the single-host GAEC
+                from ..ops import contraction as contraction_mod
                 from ..ops.contraction import gaec_parallel
+                from ..ops.multicut import multicut_energy
+                from ..parallel import reduce_tree as reduce_tree_mod
                 from .costs import compute_costs
+                from .multicut import _solver_manifest
 
                 costs = compute_costs(
                     mean.astype(np.float32),
@@ -248,7 +258,36 @@ class MergeStitchAssignmentsBase(BaseTask):
                     if cfg.get("weight_by_contact_area")
                     else None,
                 ).astype(np.float64)
-                labels = gaec_parallel(len(nodes), dense, costs)
+                shards = int(cfg.get("solver_shards", 1) or 1)
+                solver_snap = contraction_mod.solver_snapshot()
+                tree_snap = reduce_tree_mod.solve_snapshot()
+                if shards > 1:
+                    labels, solve_info = reduce_tree_mod.solve_with_reduce_tree(
+                        len(nodes), dense, costs,
+                        node_shard=reduce_tree_mod.contiguous_node_shards(
+                            len(nodes), shards
+                        ),
+                        solver_shards=shards,
+                        fanout=int(cfg.get("reduce_fanout", 2) or 2),
+                        failures_path=self.failures_path,
+                        task_name=self.uid,
+                        unsharded=lambda: gaec_parallel(
+                            len(nodes), dense, costs
+                        ),
+                        workers=int(cfg.get("solver_workers", 1) or 1),
+                        scratch_dir=os.path.join(d, "reduce_tree"),
+                        max_workers=max(1, self.max_jobs),
+                    )
+                else:
+                    labels = gaec_parallel(len(nodes), dense, costs)
+                    solve_info = {"sharded": False, "shards": 1}
+                solver_stats = _solver_manifest(
+                    multicut_energy(dense.astype(np.int64), costs, labels),
+                    dense, labels,
+                    contraction_mod.solver_delta(solver_snap),
+                    reduce_tree_mod.solve_delta(tree_snap),
+                    solve_info,
+                )
                 merge = labels[dense[:, 0]] == labels[dense[:, 1]]
             else:
                 raise ValueError(f"unknown merge_mode {mode!r}")
@@ -265,11 +304,14 @@ class MergeStitchAssignmentsBase(BaseTask):
             keys=nodes,
             values=(assignment + 1).astype(np.uint64),
         )
-        return {
+        out = {
             "n_labels": int(len(nodes)),
             "n_merged_pairs": int(len(merge_pairs)),
             "n_components": int(assignment.max()) + 1 if len(assignment) else 0,
         }
+        if solver_stats is not None:
+            out["solver"] = solver_stats
+        return out
 
 
 class MergeStitchAssignmentsLocal(MergeStitchAssignmentsBase):
@@ -314,7 +356,14 @@ class StitchingWorkflow(WorkflowBase):
             dependencies=[t1],
             seg_path=p["seg_path"],
             seg_key=p["seg_key"],
-            **{k: p[k] for k in ("stitch_threshold", "merge_mode") if k in p},
+            **{
+                k: p[k]
+                for k in (
+                    "stitch_threshold", "merge_mode",
+                    "solver_shards", "reduce_fanout", "solver_workers",
+                )
+                if k in p
+            },
             **grid,
         )
         t3 = staged_write_tasks(

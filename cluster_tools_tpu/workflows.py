@@ -118,7 +118,10 @@ class MulticutSegmentationWorkflow(WorkflowBase):
             dependencies=[costs],
             input_path=ws_path,
             input_key=ws_key,
-            **_pick(p, "n_scales", "agglomerator"),
+            **_pick(
+                p, "n_scales", "agglomerator",
+                "solver_shards", "reduce_fanout", "solver_workers",
+            ),
             **grid,
         )
         write = get_task_cls(write_mod, "Write", self.target)(
@@ -359,7 +362,10 @@ class LiftedMulticutSegmentationWorkflow(WorkflowBase):
             dependencies=[costs, lifted_costs],
             input_path=ws_path,
             input_key=ws_key,
-            **_pick(p, "n_scales"),
+            **_pick(
+                p, "n_scales",
+                "solver_shards", "reduce_fanout", "solver_workers",
+            ),
             **grid,
         )
         write = get_task_cls(write_mod, "Write", self.target)(

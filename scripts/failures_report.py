@@ -113,6 +113,32 @@ def format_io_metrics(tasks) -> list:
                 f"{fallbacks} fallback read(s), "
                 f"{_human_bytes(not_stored)} never stored"
             )
+        # solver attribution (docs/PERFORMANCE.md "Distributed
+        # agglomeration"): contraction-engine calls/rounds/edge movement,
+        # plus the reduce tree's level counts and degradations when the
+        # solve ran sharded
+        calls = int(m.get("solver_calls", 0))
+        tree_rounds = int(m.get("tree_rounds", 0))
+        if calls or tree_rounds:
+            rounds = int(m.get("solver_rounds", 0)) + tree_rounds
+            lines.append(
+                f"  solver: {calls} solve(s), {rounds} contraction "
+                f"round(s), edges {int(m.get('solver_edges_in', 0))} -> "
+                f"{int(m.get('solver_edges_out', 0))} surviving"
+            )
+        sharded = int(m.get("sharded_solves", 0))
+        if sharded or m.get("unsharded_fallbacks"):
+            lines.append(
+                f"  reduce tree: {sharded} sharded solve(s), "
+                f"{int(m.get('solve_shards', 0))} shard(s) over "
+                f"{int(m.get('solve_levels', 0))} level(s), "
+                f"boundary edges {int(m.get('boundary_edges_in', 0))} -> "
+                f"{int(m.get('boundary_edges_out', 0))} at root, "
+                f"solve {float(m.get('tree_solve_s', 0.0)):.2f}s / merge "
+                f"{float(m.get('tree_merge_s', 0.0)):.2f}s, "
+                f"{int(m.get('unsharded_fallbacks', 0))} unsharded "
+                "fallback(s)"
+            )
         batches = int(m.get("batches_dispatched", 0))
         if batches:
             blocks = int(m.get("blocks_dispatched", 0))

@@ -1,4 +1,4 @@
-"""CT001 fixture: executor call sites that drop the hardening knobs."""
+"""CT001 fixture: executor/solve call sites that drop the hardening knobs."""
 
 from cluster_tools_tpu.runtime.executor import BlockwiseExecutor, region_verifier
 from cluster_tools_tpu.utils.volume_utils import file_reader
@@ -39,3 +39,16 @@ def unhardened_host_map(self, cfg, blocking, block_ids, process):
     )
     del out
     self.host_block_map(block_ids, process)  # missing store_verify_fn/blocking
+
+
+def unhardened_sharded_solve(self, n_nodes, edges, costs, node_shard,
+                             unsharded):
+    from cluster_tools_tpu.parallel.reduce_tree import solve_with_reduce_tree
+
+    # hard-codes the tree topology and drops the failures attribution:
+    # missing solver_shards / fanout / failures_path / task_name
+    return solve_with_reduce_tree(
+        n_nodes, edges, costs,
+        node_shard=node_shard,
+        unsharded=unsharded,
+    )

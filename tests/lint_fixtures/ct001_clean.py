@@ -40,3 +40,18 @@ def artifact_scan_needs_no_verify(self, block_ids, process):
     # no require_dataset in scope: the task writes npy/swc artifacts, so
     # there is no chunked store to verify — CT001 does not apply
     self.host_block_map(block_ids, process)
+
+
+def hardened_sharded_solve(self, cfg, n_nodes, edges, costs, node_shard,
+                           unsharded):
+    from cluster_tools_tpu.parallel.reduce_tree import solve_with_reduce_tree
+
+    return solve_with_reduce_tree(
+        n_nodes, edges, costs,
+        node_shard=node_shard,
+        solver_shards=int(cfg.get("solver_shards", 1) or 1),
+        fanout=int(cfg.get("reduce_fanout", 2) or 2),
+        failures_path=self.failures_path,
+        task_name=self.uid,
+        unsharded=unsharded,
+    )

@@ -471,3 +471,71 @@ def test_chaos_resource_exhaustion_and_preemption(tmp_path):
     # schema v2: every record is attributable to its writing process
     for r in recs:
         assert r["schema_version"] == 2 and r["hostname"] and r["pid"]
+
+
+def test_chaos_sharded_solve_killed_worker(tmp_path):
+    """Distributed agglomeration under a killed solver worker
+    (docs/PERFORMANCE.md "Distributed agglomeration").
+
+    The workflow runs with the global solve sharded over a 2-worker reduce
+    tree, and a `solve` fault targeted at worker 1 makes it SIGKILL itself
+    mid-reduce (no cleanup, no packet — a lost host).  The surviving worker
+    reports the lost hop, the driver degrades to the single-host solve
+    (resolution "degraded:unsharded_solve" in failures.json), and the final
+    segmentation is BIT-IDENTICAL to the fault-free single-host run — the
+    sharded path can never produce a worse outcome than not having it.
+    """
+    root = str(tmp_path)
+    _, _, bmap = make_case(noise=0.02, seed=SEED)
+
+    def _with(spec_path, **extra):
+        # the fallback must re-solve with the SAME solver chain as the
+        # reference run, or "bit-identical" would compare different
+        # algorithms' labelings
+        with open(spec_path) as f:
+            spec = json.load(f)
+        spec.update(agglomerator="gaec_parallel", **extra)
+        with open(spec_path, "w") as f:
+            json.dump(spec, f, indent=2)
+
+    # -- reference: fault-free single-host run ----------------------------
+    ref_spec, ref_path, _ = _workspace(root, "ref", bmap)
+    _with(ref_spec)
+    proc = _run_driver(ref_spec)
+    assert proc.returncode == 0, f"fault-free run failed:\n{proc.stderr[-4000:]}"
+    ref_seg = np.asarray(file_reader(ref_path, "r")["seg"][...])
+
+    # -- chaos: sharded solve, worker 1 dies ------------------------------
+    chaos_spec, chaos_path, tmp_folder = _workspace(root, "chaos", bmap)
+    _with(chaos_spec, solver_shards=2, reduce_fanout=2, solver_workers=2)
+    proc = _run_driver(
+        chaos_spec,
+        faults_cfg={"faults": [{
+            "site": "solve", "kind": "error", "blocks": [1],
+            "fail_attempts": 9,
+        }]},
+        extra_env={
+            "CT_RT_WAIT_S": "10",      # surviving worker gives up fast
+            "CT_RT_TIMEOUT_S": "240",
+        },
+    )
+    assert proc.returncode == 0, (
+        f"chaos run did not absorb the killed worker:\n{proc.stderr[-4000:]}"
+    )
+
+    # -- bit-identical to the fault-free single-host result ---------------
+    chaos_seg = np.asarray(file_reader(chaos_path, "r")["seg"][...])
+    np.testing.assert_array_equal(chaos_seg, ref_seg)
+
+    # -- attribution -------------------------------------------------------
+    with open(os.path.join(tmp_folder, "failures.json")) as f:
+        recs = json.load(f)["records"]
+    solve_recs = [
+        r for r in recs
+        if r["task"].startswith("solve_global")
+        and r.get("resolution") == "degraded:unsharded_solve"
+    ]
+    assert solve_recs, f"no degraded:unsharded_solve record in {recs}"
+    rec = solve_recs[0]
+    assert rec["resolved"] and rec["sites"] == {"solve": 1}
+    assert rec["schema_version"] == 2

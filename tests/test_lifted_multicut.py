@@ -70,11 +70,14 @@ def workspace(tmp_path):
     return tmp_folder, config_dir, str(tmp_path)
 
 
-def test_lifted_multicut_workflow_uses_attribution(workspace):
+@pytest.mark.parametrize("solver_shards", [1, 2])
+def test_lifted_multicut_workflow_uses_attribution(workspace, solver_shards):
     """Supervoxels with an AMBIGUOUS local boundary (p = 0.5 everywhere on
     one interface) get resolved by the nucleus-style attribution volume:
     supervoxels attributed to the same nucleus merge, different nuclei
-    split."""
+    split.  solver_shards=2 routes SolveLiftedGlobal through the octant
+    reduce tree (ISSUE 9) with the lifted edge set carried through every
+    level — the oracle partition must be unchanged."""
     from cluster_tools_tpu.workflows import LiftedMulticutSegmentationWorkflow
 
     tmp_folder, config_dir, root = workspace
@@ -120,6 +123,7 @@ def test_lifted_multicut_workflow_uses_attribution(workspace):
         w_attractive=4.0,
         w_repulsive=4.0,
         n_scales=1,
+        solver_shards=solver_shards,
     )
     assert build([wf]), "workflow failed (see logs)"
     seg = file_reader(path, "r")["seg"][...]

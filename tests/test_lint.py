@@ -75,6 +75,44 @@ def test_ct001_sharded_path_requires_sweep_mode_knob():
     assert any("['sweep_mode']" in m for m in msgs)
 
 
+def test_ct001_sharded_solve_requires_knob_plumbing():
+    """The sharded global solve (parallel/reduce_tree.py) is enforced like
+    the executor paths: a solve_with_reduce_tree call site must plumb the
+    shard/fanout knobs from config and the failures attribution."""
+    findings, _ = lint_fixture("ct001_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT001"]
+    assert any(
+        "solve_with_reduce_tree" in m
+        and "failures_path" in m and "solver_shards" in m
+        for m in msgs
+    )
+    # the clean twin's fully-plumbed solve site stays quiet
+    clean, _ = lint_fixture("ct001_clean.py")
+    assert [f for f in clean if f.rule == "CT001"] == []
+
+
+def test_ct003_scopes_reduce_tree_merge_queue(tmp_path):
+    """reduce_tree.py participates in the lock graph: a blocking call
+    under its merge-queue lock fires, and the real module is clean."""
+    bad = tmp_path / "reduce_tree.py"
+    bad.write_text(
+        "import threading\n"
+        "merge_lock = threading.Lock()\n"
+        "def drain_queue(fut, results, gi):\n"
+        "    with merge_lock:\n"
+        "        results[gi] = fut.result()\n"
+    )
+    findings, _ = run_lint([str(bad)])
+    assert any(
+        f.rule == "CT003" and "fut.result" in f.message for f in findings
+    )
+    real = os.path.join(
+        REPO_ROOT, "cluster_tools_tpu", "parallel", "reduce_tree.py"
+    )
+    findings, _ = run_lint([real])
+    assert [f for f in findings if f.rule == "CT003"] == []
+
+
 def test_ct005_branch_static_and_timing():
     findings, _ = lint_fixture("ct005_bad.py")
     msgs = [f.message for f in findings if f.rule == "CT005"]

@@ -30,3 +30,39 @@ def test_cc_merges_across_process_boundaries(num_processes, devices_per_process)
         assert rc == 0, f"worker {pid} failed:\n{err[-2000:]}"
         assert "CC_POD_OK" in out, f"worker {pid} missing success marker:\n{out[-500:]}"
         assert f"processes={num_processes}" in out
+
+
+def test_reduce_tree_merge_across_worker_group(tmp_path):
+    """Distributed agglomeration's inter-host hops (docs/PERFORMANCE.md
+    "Distributed agglomeration"): a 2-worker CPU-spawn group solves a
+    4-shard grid RAG over the reduce tree — each worker joins the
+    jax.distributed runtime, solves the shards/merge groups it owns, and
+    the boundary-edge packets between levels are the reduce hops.  The
+    merged labeling must be bit-identical to the in-process tree (same
+    level steps, same deterministic tie-breaking)."""
+    import numpy as np
+
+    from cluster_tools_tpu.parallel import reduce_tree as rt
+    from cluster_tools_tpu.utils.synthetic import grid_rag
+
+    g, shards = 10, 4
+    n, edges, costs = grid_rag(g=g, seed=1)
+    pos = np.stack(np.unravel_index(np.arange(n), (g, g, g)), axis=1)
+    node_shard = rt.morton_node_shards(pos, shards)
+    solver = rt.default_tree_solver("max", 0.0, impl=rt._host_impl())
+    lab_in, _ = rt.sharded_solve(
+        n, edges, costs, node_shard, fanout=2, solver=solver
+    )
+    try:
+        lab_w, info = rt.solve_over_workers(
+            n, edges, costs, node_shard, fanout=2, n_workers=2,
+            scratch_dir=str(tmp_path / "hops"), timeout=240,
+        )
+    except rt.ShardedSolveError as e:
+        # same env-skip guard as the collectives test above: old jaxlib
+        # CPU backends cannot form the multi-process runtime
+        if "aren't implemented on the CPU backend" in str(e):
+            pytest.skip("jaxlib CPU backend has no multiprocess collectives")
+        raise
+    assert info["workers"] == 2 and info["shards"] == shards
+    assert np.array_equal(lab_in, lab_w)

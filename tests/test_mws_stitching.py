@@ -230,11 +230,15 @@ def test_native_python_constraint_parity(rng):
     )
 
 
-def test_stitching_workflow_multicut_mode(workspace):
+@pytest.mark.parametrize("solver_shards", [1, 2])
+def test_stitching_workflow_multicut_mode(workspace, solver_shards):
     """merge_mode='multicut': face-pair means become signed costs and the
     parallel GAEC (ops/contraction.py) decides the merges globally —
     same-object fragments split by the block grid must reunify, distinct
-    ground-truth objects must stay cut (ISSUE 1 via-multicut stitching)."""
+    ground-truth objects must stay cut (ISSUE 1 via-multicut stitching).
+    solver_shards=2 routes the same solve through the octant reduce tree
+    (ISSUE 9) — the oracle partition must be unchanged and the manifest
+    must carry the solver observability block."""
     from cluster_tools_tpu.tasks.stitching import StitchingWorkflow
 
     tmp_folder, config_dir, root = workspace
@@ -281,8 +285,21 @@ def test_stitching_workflow_multicut_mode(workspace):
         input_key="bmap",
         stitch_threshold=0.5,
         merge_mode="multicut",
+        solver_shards=solver_shards,
         block_shape=[16, 16, 16],
     )
     assert build([wf]), "workflow failed (see logs)"
     seg = file_reader(path, "r")["seg"][...]
     assert_labels_equivalent(seg, gt)
+    # the stitching solve reports the observability block (ISSUE 9)
+    import json as json_mod
+
+    merge_doc = None
+    for fn in os.listdir(tmp_folder):
+        if fn.startswith("merge_stitch_assignments") and fn.endswith(
+            ".success.json"
+        ):
+            merge_doc = json_mod.load(open(os.path.join(tmp_folder, fn)))
+    assert merge_doc is not None and "solver" in merge_doc
+    assert merge_doc["solver"]["sharded"] is (solver_shards > 1)
+    assert merge_doc["solver"]["energy"] is not None
