@@ -18,7 +18,17 @@
 #                     chaos+slow markers keep it out of `tier1`
 #   failures-report = one-screen post-mortem of a run's failures.json
 #                     (pass TMP=/path/to/tmp_folder or .../failures.json),
-#                     plus the per-task chunk-IO metrics when recorded
+#                     plus the per-task chunk-IO metrics when recorded and
+#                     the trace summary when the run was traced
+#                     (CTT_TRACE=1; docs/OBSERVABILITY.md); use
+#                     `python scripts/failures_report.py --json TMP` for
+#                     the machine-readable combined document
+#   progress        = live run status from the heartbeat files and block
+#                     markers (pass TMP=/path/to/tmp_folder): per-task
+#                     state (done / in-flight / stalled? / failed), blocks
+#                     markered, quarantines, stale-heartbeat warnings
+#                     (docs/OBSERVABILITY.md); rc 1 when anything is
+#                     stalled or failed
 #   bench-io        = IO-amplification bench (docs/PERFORMANCE.md
 #                     "Chunk-aware I/O"): the halo'd watershed sweep with
 #                     the decompressed-chunk cache off vs on, asserting
@@ -55,9 +65,9 @@ PY ?= python
 CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
-.PHONY: test lint tier1 chaos chaos-resource failures-report bench-io \
-	bench-sweep bench-fuse bench-solve bench-trajectory supervise-demo \
-	native clean
+.PHONY: test lint tier1 chaos chaos-resource failures-report progress \
+	bench-io bench-sweep bench-fuse bench-solve bench-trajectory \
+	supervise-demo native clean
 
 test: lint tier1 chaos
 
@@ -79,6 +89,9 @@ chaos-resource:
 
 failures-report:
 	$(PY) scripts/failures_report.py $(TMP)
+
+progress:
+	$(PY) scripts/progress.py $(TMP)
 
 bench-io:
 	JAX_PLATFORMS=cpu $(PY) bench.py --io

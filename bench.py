@@ -498,11 +498,14 @@ def sweep_bench(smoke=False, n_devices=1):
     output); the full run writes BENCH_r07.json next to this script.
     Emits exactly one JSON line on stdout and returns the record.
     """
+    import tempfile
+
     import jax
     import jax.numpy as jnp
 
     from cluster_tools_tpu.parallel.batch_shard import sharded_slab_sweep
     from cluster_tools_tpu.runtime import executor as executor_mod
+    from cluster_tools_tpu.runtime import trace as trace_mod
     from cluster_tools_tpu.runtime.executor import BlockwiseExecutor, get_mesh
     from cluster_tools_tpu.utils import function_utils as fu
     from cluster_tools_tpu.utils.volume_utils import Blocking, pad_block_to
@@ -545,7 +548,7 @@ def sweep_bench(smoke=False, n_devices=1):
         data = vol[b.outer_bb]
         return (pad_block_to(data, outer, constant_values=1.0),)
 
-    runs, outs = {}, {}
+    runs, outs, run_onces = {}, {}, {}
     for mode in ("per_block", "sharded"):
         out = np.zeros(shape, np.float32)
 
@@ -559,22 +562,27 @@ def sweep_bench(smoke=False, n_devices=1):
             max_retries=2,
         )
 
-        def run_once(store_fn):
-            return ex.map_blocks(
-                kernel,
-                blocks,
-                load,
-                store_fn,
-                failures_path=None,
-                task_name=f"sweep_{mode}",
-                block_deadline_s=None,
-                watchdog_period_s=None,
-                store_verify_fn=None,
-                schedule="morton",
-                sweep_mode=mode,
-                sharded_batch=sharded_batch,
-            )
+        def run_once(store_fn, mode=mode, ex=ex):
+            # the task trace context (docs/ANALYSIS.md CT008): outside a
+            # task class, the executor's spans need an explicit task.run
+            # bracket to be attributable on the timeline
+            with trace_mod.task_context(f"sweep_{mode}"):
+                return ex.map_blocks(
+                    kernel,
+                    blocks,
+                    load,
+                    store_fn,
+                    failures_path=None,
+                    task_name=f"sweep_{mode}",
+                    block_deadline_s=None,
+                    watchdog_period_s=None,
+                    store_verify_fn=None,
+                    schedule="morton",
+                    sweep_mode=mode,
+                    sharded_batch=sharded_batch,
+                )
 
+        run_onces[mode] = run_once
         run_once(store)  # warm: compile + first-touch outside the clock
         seconds, delta = None, None
         for _ in range(reps):  # best warm rep: the 2-core CI box is noisy
@@ -620,6 +628,170 @@ def sweep_bench(smoke=False, n_devices=1):
     ])
     slab_identical = bool(np.array_equal(slab_dev, slab_ref))
 
+    # -- tracer overhead (docs/OBSERVABILITY.md): the same sharded sweep
+    # with CTT_TRACE on, best-of-reps vs the traced-off figure above.  The
+    # acceptance bar is <5% wall: per-block span cost must stay invisible
+    # next to real dispatch + IO work.  The traced outputs must also stay
+    # bit-identical — observability cannot perturb results.
+    trace_dir = tempfile.mkdtemp(prefix="ctt_bench_trace_")
+    shard_dir = os.path.join(trace_dir, trace_mod.TRACE_DIRNAME)
+    traced_out = np.zeros(shape, np.float32)
+
+    def store_traced(b, raw, out=traced_out):
+        out[b.bb] = np.asarray(raw)[b.inner_in_outer_bb]
+
+    # the measured workload is the WHOLE bench-sweep config (one per-block
+    # + one sharded sweep per sample): that is what "overhead on make
+    # bench-sweep" means, and at ~40 ms per sample the box's scheduler
+    # noise stops drowning the sub-ms tracer cost.  Interleaved off/on
+    # pairs cancel drift; min-of-N takes the noise-free floor of each arm.
+    # N must be large enough that BOTH arms sample the box's fast phase —
+    # this host flips between ~40 ms and ~65 ms regimes that outlast a
+    # single pair, so small N occasionally strands one arm in the slow
+    # phase and fakes a large overhead either direction.
+    def one_bench_sweep():
+        run_onces["per_block"](store_traced)
+        run_onces["sharded"](store_traced)
+
+    u_times, t_times = [], []
+    # GC parity: the traced arm allocates (one tuple + args dict per
+    # event), so collection cycles would land disproportionately inside
+    # its samples and bill a ~10 ms gen-2 pass to the tracer
+    import gc
+
+    gc.collect()
+    gc.disable()
+    try:
+        trace_mod.configure(enabled=True, trace_dir=shard_dir)
+        one_bench_sweep()  # warm the traced code paths outside the clock
+
+        # wall A/B cross-check: interleaved, order-alternated pairs, floor
+        # vs floor.  On this host the CPU flips between speed phases ~60%
+        # apart and throttles under sustained load, so the A/B resolves a
+        # few-percent effect only as a sanity band (its sign flips run to
+        # run); the headline overhead_frac below is the phase-invariant
+        # per-event accounting instead.
+        n_ab = 3 if smoke else 8
+        for i in range(n_ab):
+            order = ("u", "t") if i % 2 == 0 else ("t", "u")
+            for which in order:
+                if which == "u":
+                    trace_mod.configure(enabled=False)
+                else:
+                    trace_mod.configure(enabled=True, trace_dir=shard_dir)
+                t0 = time.perf_counter()
+                one_bench_sweep()
+                (u_times if which == "u" else t_times).append(
+                    time.perf_counter() - t0
+                )
+
+        # contended per-event cost, measured adjacent in time: 4 threads
+        # (the executor's io_threads) emitting spans concurrently price
+        # the GIL handoffs a single-thread microbench would hide.  Both
+        # this and the sweep wall scale with the host's current speed
+        # phase, so their RATIO is phase-invariant — the property every
+        # wall-difference estimator above lacks.
+        from concurrent.futures import ThreadPoolExecutor as _TPE
+
+        trace_mod.configure(enabled=True, trace_dir=shard_dir)
+        n_threads, per_thread = 4, 10_000
+
+        def _emit(k):
+            for j in range(per_thread):
+                with trace_mod.span("executor.load", block=j, task="ovh"):
+                    pass
+
+        with _TPE(max_workers=n_threads) as tpe:
+            list(tpe.map(_emit, range(n_threads)))  # warm
+            t0 = time.perf_counter()
+            list(tpe.map(_emit, range(n_threads)))
+            per_event_s = (
+                (time.perf_counter() - t0) / (n_threads * per_thread)
+            )
+    finally:
+        gc.enable()
+    # events per bench-sweep: count what ONE traced per_block + sharded
+    # pass actually records (the A/B loop above left the buffer holding
+    # its last traced sample — clear and re-run one clean pass)
+    trace_mod.configure(enabled=True, trace_dir=shard_dir)
+    one_bench_sweep()
+    trace_mod.flush()
+    trace_summary = trace_mod.write_timeline(trace_dir) or {}
+    trace_events = int(trace_summary.get("n_events", 0))
+
+    # controlled wall A/B: the wall cost of exactly the event volume one
+    # bench sweep records, measured on a fixed host-side workload (no XLA
+    # dispatch, no IO, no thread pool) where a sub-ms on/off delta
+    # actually RESOLVES.  This is the real wall measurement backing the
+    # <5% bar — the sweep-level A/B above upper-bounds scheduler noise on
+    # shared hosts, not the tracer.  gc stays enabled (the traced arm's
+    # per-event allocations are billed to it); min-of-N floors discard
+    # samples that caught a collection pass or a speed-phase flip.
+    ctl_work = np.full((32, 32), 0.5, np.float32)
+    n_ctl_events = max(trace_events, 1)
+
+    def _controlled_pass():
+        acc = ctl_work
+        for j in range(n_ctl_events):
+            with trace_mod.span("executor.load", block=j, task="ctl"):
+                acc = ctl_work @ ctl_work
+        return acc
+
+    ctl_u, ctl_t = [], []
+    _controlled_pass()  # warm
+    for i in range(4 if smoke else 16):
+        for which in (("u", "t") if i % 2 == 0 else ("t", "u")):
+            if which == "u":
+                trace_mod.configure(enabled=False)
+            else:
+                trace_mod.configure(enabled=True, trace_dir=shard_dir)
+            t0 = time.perf_counter()
+            _controlled_pass()
+            (ctl_u if which == "u" else ctl_t).append(
+                time.perf_counter() - t0
+            )
+    ctl_delta_s = min(ctl_t) - min(ctl_u)
+    trace_mod.configure(enabled=False)  # back to the traced-off default
+    untraced_s, traced_s = min(u_times), min(t_times)
+    # the headline: phase-invariant per-event accounting — what the
+    # recorded events actually cost on the untraced wall.  The wall A/B
+    # floors ride along as the sanity band (noise-limited on this host).
+    trace_overhead = (trace_events * per_event_s) / max(untraced_s, 1e-9)
+    ab_frac = (traced_s - untraced_s) / max(untraced_s, 1e-9)
+    trace_rec = {
+        "overhead_frac": round(trace_overhead, 4),
+        "per_event_us": round(per_event_s * 1e6, 3),
+        "events_per_sweep": trace_events,
+        "untraced_seconds": round(untraced_s, 4),
+        "ab_traced_seconds": round(traced_s, 4),
+        # raw (unclamped — a negative value shows the A/B is noise-limited
+        # on this host, which is the honest reading)
+        "ab_overhead_frac": round(ab_frac, 4),
+        # the wall-measured tracer cost of one sweep's event volume, on a
+        # workload where the delta resolves; overhead_frac scales it to
+        # the untraced sweep wall (same event count)
+        "controlled": {
+            "n_events": n_ctl_events,
+            "untraced_ms": round(min(ctl_u) * 1e3, 3),
+            "traced_ms": round(min(ctl_t) * 1e3, 3),
+            "wall_delta_ms": round(ctl_delta_s * 1e3, 3),
+            "per_event_us": round(ctl_delta_s / n_ctl_events * 1e6, 3),
+            "overhead_frac": round(
+                ctl_delta_s / max(untraced_s, 1e-9), 4
+            ),
+        },
+        "bit_identical": bool(np.array_equal(traced_out, outs["sharded"])),
+    }
+    log(
+        f"sweep bench traced: {trace_events} events/sweep x "
+        f"{per_event_s * 1e6:.2f} us = "
+        f"{100.0 * trace_overhead:.1f}% overhead on "
+        f"{untraced_s * 1000:.1f} ms (controlled wall: "
+        f"{ctl_delta_s * 1e3:.2f} ms = "
+        f"{100.0 * ctl_delta_s / max(untraced_s, 1e-9):.1f}%; "
+        f"sweep A/B floors: {100.0 * ab_frac:.1f}%, noise-limited)"
+    )
+
     pb, sh = runs["per_block"], runs["sharded"]
     rec = {
         "metric": "sharded_sweep_dispatch",
@@ -641,6 +813,7 @@ def sweep_bench(smoke=False, n_devices=1):
         ),
         "device_halo_slab_identical": slab_identical,
         "schedule": "morton",
+        "trace": trace_rec,
     }
     print(json.dumps(rec), flush=True)
     if not smoke:

@@ -262,6 +262,13 @@ class ChunkCache:
         with self._lock:
             self.stats["stall_fallbacks"] += 1
             self.stats["bytes_from_storage"] += int(nbytes)
+        # a stall fallback is exactly the silent latency event the unified
+        # timeline exists to surface (docs/OBSERVABILITY.md): mark it as an
+        # instant so the wedged storage read is visible next to the block
+        # whose patience it burned
+        from ..runtime import trace as trace_mod
+
+        trace_mod.instant("chunk_cache.stall_fallback", nbytes=int(nbytes))
 
     @property
     def cached_bytes(self) -> int:

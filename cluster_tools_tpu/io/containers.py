@@ -496,37 +496,69 @@ class Dataset(_ChecksumOps):
         hang defense's speculative re-execution stays independent of the
         read it is routing around.  The first chunk failure is raised
         after the loop, keeping shared tokens consistent."""
+        from ..runtime import trace as trace_mod
+
         cache = _chunk_cache.get_chunk_cache()
         region = plan.region
         patience = _chunk_cache.stall_wait_s()
         out = np.empty(_region_shape(region), self.dtype)
         first_exc: Optional[BaseException] = None
-        for key, box, kind, handle in plan.steps:
-            if first_exc is not None:
-                # fail fast: owner tokens settle via their storage-future
-                # callbacks regardless, so there is nothing to wait out —
-                # waiting (or stall-fallback-reading) chunks whose bytes
-                # will be discarded only delays the error
-                continue
-            try:
-                if kind == cache.HIT:
-                    chunk = handle
-                else:
-                    try:
-                        chunk = cache.wait(handle, timeout=patience)
-                    except _chunk_cache.ChunkWaitTimeout:
-                        cbb = tuple(slice(a, b) for a, b in box)
-                        chunk = np.asarray(self._store[cbb].read().result())
-                        cache.record_stall_fallback(chunk.nbytes)
-            except Exception as e:
-                first_exc = e
-                continue
-            src, dst = [], []
-            for (ra, rb), (ca, cb) in zip(region, box):
-                lo, hi = max(ra, ca), min(rb, cb)
-                src.append(slice(lo - ca, hi - ca))
-                dst.append(slice(lo - ra, hi - ra))
-            out[tuple(dst)] = chunk[tuple(src)]
+        # one assembly span per region read (not per chunk — a halo'd read
+        # covers dozens): hit/miss/coalesced-wait composition in the args,
+        # duration = the storage latency the cache failed to hide
+        # (docs/OBSERVABILITY.md).  The composition scans are gated on the
+        # tracer so the default-off hot read path stays a true no-op
+        if trace_mod.enabled():
+            n_hits = sum(
+                1 for _k, _b, kind, _h in plan.steps if kind == cache.HIT
+            )
+            n_waits = sum(
+                1 for _k, _b, kind, _h in plan.steps if kind == cache.WAIT
+            )
+            assemble_span = trace_mod.span(
+                "chunk_cache.assemble", n_chunks=len(plan.steps),
+                hits=n_hits, misses=len(plan.steps) - n_hits - n_waits,
+                waits=n_waits,
+            )
+        else:
+            assemble_span = trace_mod.span("chunk_cache.assemble")
+        with assemble_span:
+            for key, box, kind, handle in plan.steps:
+                if first_exc is not None:
+                    # fail fast: owner tokens settle via their storage-future
+                    # callbacks regardless, so there is nothing to wait out —
+                    # waiting (or stall-fallback-reading) chunks whose bytes
+                    # will be discarded only delays the error
+                    continue
+                try:
+                    if kind == cache.HIT:
+                        chunk = handle
+                    else:
+                        try:
+                            if kind == cache.WAIT:
+                                # the single-flight wait: time spent behind
+                                # ANOTHER reader's in-flight storage read
+                                with trace_mod.span("chunk_cache.wait"):
+                                    chunk = cache.wait(
+                                        handle, timeout=patience
+                                    )
+                            else:
+                                chunk = cache.wait(handle, timeout=patience)
+                        except _chunk_cache.ChunkWaitTimeout:
+                            cbb = tuple(slice(a, b) for a, b in box)
+                            chunk = np.asarray(
+                                self._store[cbb].read().result()
+                            )
+                            cache.record_stall_fallback(chunk.nbytes)
+                except Exception as e:
+                    first_exc = e
+                    continue
+                src, dst = [], []
+                for (ra, rb), (ca, cb) in zip(region, box):
+                    lo, hi = max(ra, ca), min(rb, cb)
+                    src.append(slice(lo - ca, hi - ca))
+                    dst.append(slice(lo - ra, hi - ra))
+                out[tuple(dst)] = chunk[tuple(src)]
         if first_exc is not None:
             raise first_exc
         cache.record_served(out.nbytes)

@@ -1,4 +1,4 @@
-"""The ctlint rule classes CT001-CT007 (docs/ANALYSIS.md).
+"""The ctlint rule classes CT001-CT008 (docs/ANALYSIS.md).
 
 Every rule is derived from a *real* invariant of this codebase — the
 docstring of each checker names the file/contract it guards.  Rules are
@@ -1026,6 +1026,132 @@ def ct007_memory_target_contract(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT008 - trace hygiene
+# =============================================================================
+
+#: direct wall-clock calls banned in ``runtime/`` outside the tracer
+#: (docs/OBSERVABILITY.md "Timing discipline"): every duration must come
+#: from a trace span (so the timeline, the counters, and the manifests
+#: agree on one clock) and every wall timestamp from ``trace.walltime()``.
+_CT008_BANNED_CLOCKS = frozenset({"time.time", "time.perf_counter"})
+
+#: orchestration entry points that must run under a task trace context —
+#: the spans they emit (executor.load/store/dispatch, host.block,
+#: solve.*) are only attributable when a ``task.run``-shaped span
+#: brackets them.  Call sites inside a class get the context from
+#: ``BaseTask.run``; free functions (bench drivers, scripts) must open
+#: one explicitly with ``trace.task_context(...)``.
+_CT008_TRACED_CALLS = frozenset({
+    "map_blocks",
+    "host_block_map",
+    "solve_with_reduce_tree",
+})
+
+
+def _in_runtime_package(path: str) -> bool:
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    try:
+        return parts[parts.index("cluster_tools_tpu") + 1] == "runtime"
+    except (ValueError, IndexError):
+        return False
+
+
+def ct008_trace_hygiene(module: LintModule) -> List[Finding]:
+    """The unified tracing plane's two contracts (docs/OBSERVABILITY.md).
+
+    (a) **One clock**: no direct ``time.time()`` / ``time.perf_counter()``
+    timing in ``runtime/`` outside ``trace.py`` — durations come from
+    trace spans (``trace.span``/``trace.begin``, whose ``end()`` returns
+    the elapsed seconds even with the tracer off) and wall timestamps
+    from ``trace.walltime()``, so the timeline, the io_metrics counters,
+    and the heartbeat/manifest stamps can never disagree about where the
+    wall-clock went.  ``time.monotonic()`` deadlines and ``time.sleep``
+    backoffs are not timing *measurements* and stay allowed.
+
+    (b) **Attributable spans**: every ``map_blocks`` /
+    ``host_block_map`` / ``solve_with_reduce_tree`` call site runs under
+    a task trace context — inside a task class (``BaseTask.run`` opens
+    the ``task.run`` span) or under an explicit
+    ``trace.task_context(...)`` in the enclosing function/module (bench
+    drivers, scripts).  Without it, the hot-boundary spans those calls
+    emit land on the timeline with no task to belong to.
+    """
+    out: List[Finding] = []
+    is_fixture = "ct008" in module.name
+
+    # -- (a) wall-clock discipline in runtime/ ----------------------------
+    if (is_fixture or _in_runtime_package(module.path)) \
+            and module.name != "trace.py":
+        time_aliases = {"time"}   # names that refer to the time module
+        from_time = {}            # local name -> original name in time
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        time_aliases.add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    from_time[a.asname or a.name] = a.name
+        for call in calls_in(module.tree):
+            name = dotted(call.func)
+            if name is None:
+                continue
+            mod, _, attr = name.rpartition(".")
+            banned = (
+                name in _CT008_BANNED_CLOCKS
+                # aliased module form: import time as t; t.perf_counter()
+                or (mod in time_aliases
+                    and attr in ("time", "perf_counter"))
+                # from-import form incl. aliases: from time import
+                # perf_counter as pc; pc()
+                or from_time.get(name) in ("time", "perf_counter")
+            )
+            if banned:
+                out.append(Finding(
+                    "CT008", module.path, call.lineno, call.col_offset,
+                    f"direct {name}() timing in runtime/ bypasses the "
+                    "tracing plane; measure durations with trace.span/"
+                    "trace.begin (end() returns elapsed seconds even with "
+                    "the tracer off) and stamp wall clocks with "
+                    "trace.walltime()",
+                ))
+
+    # -- (b) orchestration calls under a task trace context ---------------
+    for call in calls_in(module.tree):
+        seg = last_seg(dotted(call.func))
+        if seg not in _CT008_TRACED_CALLS:
+            continue
+        if module.enclosing_class(call) is not None:
+            # a method of a task class: BaseTask.run brackets run_impl
+            # (and everything it calls) in the task.run span
+            continue
+        covered = False
+        scope: Optional[ast.AST] = module.enclosing_function(call)
+        while scope is not None and not covered:
+            covered = any(
+                last_seg(dotted(c.func)) == "task_context"
+                for c in calls_in(scope)
+            )
+            scope = module.enclosing_function(scope)
+        if not covered:
+            # module level: a top-level task_context call still counts
+            covered = any(
+                last_seg(dotted(c.func)) == "task_context"
+                and module.enclosing_function(c) is None
+                for c in calls_in(module.tree)
+            )
+        if not covered:
+            out.append(Finding(
+                "CT008", module.path, call.lineno, call.col_offset,
+                f"{seg} call site outside any task class and without a "
+                "trace.task_context(...) in scope: its hot-boundary spans "
+                "would land on the timeline unattributed — open a task "
+                "context (or move the call into a task)",
+            ))
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -1037,4 +1163,5 @@ RULES = {
     "CT005": ct005_jit_hygiene,
     "CT006": ct006_drain_safety,
     "CT007": ct007_memory_target_contract,
+    "CT008": ct008_trace_hygiene,
 }

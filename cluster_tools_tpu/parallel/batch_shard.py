@@ -266,6 +266,8 @@ def sharded_slab_sweep(
         )
     )
 
+    from ..runtime import trace as trace_mod
+
     fill_edge = np.full(edge_shape, fill, vol.dtype)
     outs = []
     for start in range(0, n_slabs, batch):
@@ -288,6 +290,11 @@ def sharded_slab_sweep(
             pad = np.zeros(slab_shape, vol.dtype)
             pad[:halo] = hi
             stack = np.concatenate([stack, np.stack([pad] * n_pad)], axis=0)
-        out = np.asarray(prog(stack, lo, hi))
+        # one span per sharded slab program — the device-halo twin of the
+        # executor's dispatch spans (docs/OBSERVABILITY.md)
+        with trace_mod.span(
+            "shard.slab_batch", start=start, n_slabs=len(idxs)
+        ):
+            out = np.asarray(prog(stack, lo, hi))
         outs.append(out[: len(idxs)])
     return np.concatenate(outs, axis=0)

@@ -261,7 +261,22 @@ def worker_main() -> None:
     )
     mod_name, fn_name = target.split(":")
     fn = getattr(importlib.import_module(mod_name), fn_name)
-    fn()
+    # worker-lifetime span on the unified trace timeline (docs/
+    # OBSERVABILITY.md): active only when the launcher exported
+    # CTT_TRACE=<dir>; the flush is best-effort (targets that flush
+    # themselves — the reduce-tree worker — just rewrite the same shard)
+    from ..runtime import trace as trace_mod
+
+    try:
+        with trace_mod.span("worker.main", worker=pid, target=target):
+            fn()
+    finally:
+        # flush on the failure path too — the shard of the worker that
+        # DIED is the one the post-mortem timeline needs most
+        try:
+            trace_mod.flush()
+        except Exception:
+            pass
 
 
 def cc_pod_demo() -> None:

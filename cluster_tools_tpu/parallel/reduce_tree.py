@@ -77,6 +77,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..runtime import trace as trace_mod
 from ..utils import function_utils as fu
 
 #: env knobs of the worker-group driver (inherited by the workers)
@@ -568,10 +569,19 @@ def sharded_solve(
         edges_in = len(state.edges)
         results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         internal_total = 0
-        t0 = time.perf_counter()
+        # the level spans double as the solve_s/merge_s clocks
+        # (docs/OBSERVABILITY.md): one timing source, and a traced run
+        # shows every reduce-tree level as its own timeline extent
+        solve_span = trace_mod.begin(
+            "solve.level_solve", level=li, groups=len(groups),
+            edges_in=int(edges_in),
+        )
 
-        def run_group(gi, _groups=groups):
-            members, labels, n_int = _solve_group(state, _groups[gi], solver)
+        def run_group(gi, _groups=groups, _li=li):
+            with trace_mod.span("solve.group", level=_li, group=gi):
+                members, labels, n_int = _solve_group(
+                    state, _groups[gi], solver
+                )
             with merge_lock:
                 results[gi] = (members, labels)
             return n_int
@@ -581,11 +591,11 @@ def sharded_solve(
                 internal_total = sum(pool.map(run_group, range(len(groups))))
         else:
             internal_total = sum(run_group(gi) for gi in range(len(groups)))
-        t_solve = time.perf_counter() - t0
+        t_solve = solve_span.end()
 
-        t0 = time.perf_counter()
+        merge_span = trace_mod.begin("solve.level_merge", level=li)
         _apply_level(state, groups, results)
-        t_merge = time.perf_counter() - t0
+        t_merge = merge_span.end()
         info["levels"].append({
             "level": li,
             "groups": len(groups),
@@ -675,6 +685,13 @@ def reduce_worker_main() -> None:
         traceback.print_exc()
         sys.stderr.flush()
         sys.stdout.flush()
+        try:
+            # the shard of a FAILING worker is the one the post-mortem
+            # needs most (it shows the hop wait that never returned) —
+            # flush before the self-SIGKILL
+            trace_mod.flush()
+        except Exception:
+            pass
         os.kill(os.getpid(), signal_mod.SIGKILL)
 
 
@@ -686,6 +703,12 @@ def _reduce_worker_body() -> None:
     pid = int(os.environ[multihost._ENV_PID])
     n_workers = int(os.environ[multihost._ENV_NPROC])
     hop_wait_s = float(os.environ.get(_ENV_WAIT, DEFAULT_HOP_WAIT_S))
+    # solver-worker lifetime span (docs/OBSERVABILITY.md): tracing is on
+    # only when the driver exported CTT_TRACE=<dir>, pointing this process
+    # at the submitter's shard directory
+    worker_span = trace_mod.begin(
+        "solve.worker", worker=pid, workers=n_workers
+    )
 
     with open(os.path.join(scratch, "meta.json")) as f:
         meta = json.load(f)
@@ -723,7 +746,12 @@ def _reduce_worker_body() -> None:
         for gi in range(len(groups)):
             if _group_owner(li, gi, n_workers) != pid:
                 continue
-            members, labels, n_int = _solve_group(state, groups[gi], solver)
+            with trace_mod.span(
+                "solve.group", level=li, group=gi, worker=pid
+            ):
+                members, labels, n_int = _solve_group(
+                    state, groups[gi], solver
+                )
             _publish_npz(
                 _packet_path(scratch, li, gi),
                 members=members, labels=labels,
@@ -732,12 +760,25 @@ def _reduce_worker_body() -> None:
         # collect every group's packet (the reduce hop) and fold the level
         results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         for gi in range(len(groups)):
-            pkt = _wait_npz(_packet_path(scratch, li, gi), hop_wait_s)
+            # the hop wait is the inter-host latency PAPERS.md's wafer-
+            # scale-reduce analysis says must be measured per hop — one
+            # span per awaited packet, worker- and level-attributed
+            with trace_mod.span(
+                "solve.hop_wait", level=li, group=gi, worker=pid
+            ):
+                pkt = _wait_npz(_packet_path(scratch, li, gi), hop_wait_s)
             results[gi] = (
                 pkt["members"].astype(np.int64),
                 pkt["labels"].astype(np.int64),
             )
         _apply_level(state, groups, results)
+        # crash-safe: each level's flush rewrites the full shard, so a
+        # worker killed at level N leaves its spans through level N-1 —
+        # but a tracing write failure must never fail a healthy worker
+        try:
+            trace_mod.flush()
+        except Exception:
+            pass
 
     if pid == 0:
         _publish_npz(
@@ -747,6 +788,11 @@ def _reduce_worker_body() -> None:
             # own snapshot cannot see this process's state)
             boundary_edges_root=np.int64(len(state.edges)),
         )
+    worker_span.end()
+    try:
+        trace_mod.flush()
+    except Exception:
+        pass
     print(f"REDUCE_TREE_OK pid={pid} workers={n_workers}", flush=True)
 
 
@@ -812,24 +858,33 @@ def solve_over_workers(
         # workers' own per-hop wait so a lost packet surfaces as a worker
         # rc, not a group kill
         timeout = float(os.environ.get("CT_RT_TIMEOUT_S", "600"))
-    t0 = time.perf_counter()
+    group_span = trace_mod.begin(
+        "solve.worker_group", workers=int(n_workers), shards=n_shards
+    )
+    extra_env = {
+        _ENV_DIR: scratch_dir,
+        # explicit arg > operator env > default — launch_workers
+        # applies extra_env over os.environ, so the env knob must
+        # be threaded through here to reach the workers at all
+        _ENV_WAIT: str(
+            hop_wait_s if hop_wait_s is not None
+            else os.environ.get(_ENV_WAIT, DEFAULT_HOP_WAIT_S)
+        ),
+    }
+    if trace_mod.enabled() and trace_mod.trace_dir():
+        # a traced driver hands the workers its shard directory — the env
+        # value both enables tracing and pins the directory, so a run
+        # enabled programmatically (configure()) still traces its workers
+        extra_env[trace_mod.ENV_VAR] = trace_mod.trace_dir()
     try:
         results = launch_workers(
             int(n_workers),
             "cluster_tools_tpu.parallel.reduce_tree:reduce_worker_main",
             timeout=timeout,
-            extra_env={
-                _ENV_DIR: scratch_dir,
-                # explicit arg > operator env > default — launch_workers
-                # applies extra_env over os.environ, so the env knob must
-                # be threaded through here to reach the workers at all
-                _ENV_WAIT: str(
-                    hop_wait_s if hop_wait_s is not None
-                    else os.environ.get(_ENV_WAIT, DEFAULT_HOP_WAIT_S)
-                ),
-            },
+            extra_env=extra_env,
         )
     except TimeoutError as e:
+        group_span.end(error=True)
         raise ShardedSolveError(f"worker group timed out: {e}") from e
     failed = [
         (pid, rc, (err or "")[-500:])
@@ -837,6 +892,7 @@ def solve_over_workers(
         if rc != 0
     ]
     if failed:
+        group_span.end(error=True)
         raise ShardedSolveError(
             "worker(s) died during the sharded solve: "
             + "; ".join(f"pid {p} rc={rc}" for p, rc, _ in failed)
@@ -844,12 +900,13 @@ def solve_over_workers(
         )
     result_path = os.path.join(scratch_dir, "result.npz")
     if not os.path.exists(result_path):
+        group_span.end(error=True)
         raise ShardedSolveError("worker group finished without a result packet")
     with np.load(result_path, allow_pickle=False) as f:
         labels = f["labels"].astype(np.int64)
         root_edges = int(f["boundary_edges_root"]) \
             if "boundary_edges_root" in f.files else 0
-    wall = time.perf_counter() - t0
+    wall = group_span.end()
     levels = reduce_tree_levels(n_shards, fanout)
     info = {
         "sharded": True,
@@ -959,8 +1016,14 @@ def solve_with_reduce_tree(
         if no_partition:
             return unsharded(), {"sharded": False, "shards": 1}
         # the fallback ladder: anything short of a drain degrades to the
-        # single-host solve, attributed like every other degradation
+        # single-host solve, attributed like every other degradation —
+        # and lands on the trace timeline next to the solve latency it
+        # causes (docs/OBSERVABILITY.md)
         _record_solve_metrics(unsharded_fallbacks=1)
+        trace_mod.instant(
+            "degraded:unsharded_solve", task=task_name,
+            error=f"{type(e).__name__}: {e}"[:200],
+        )
         tb = fu.cap_traceback(
             f"{type(e).__name__}: {e}"
         )

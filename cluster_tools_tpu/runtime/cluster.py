@@ -73,6 +73,7 @@ from typing import Any, Dict, Optional
 
 from ..utils import function_utils as fu
 from . import faults as faults_mod
+from . import trace as trace_mod
 from .supervision import heartbeat_path, pid_alive, read_heartbeat
 
 
@@ -296,7 +297,7 @@ def supervise_job(
             os.unlink(rq_path)
         except OSError:
             pass
-        submit_t = time.time()
+        submit_t = trace_mod.walltime()
         hb_seen["raw"] = read_heartbeat(tmp_folder, uid)
         hb_seen["at"] = submit_t
         if injector.lose_job():
@@ -356,10 +357,10 @@ def supervise_job(
     job_id, submit_t = _submit()
     if logger is not None:
         logger.info(f"{flavor} job {job_id} submitted ({script_path})")
-    t0 = time.time()
+    t0 = trace_mod.walltime()
     unknown_since = None
     while not os.path.exists(result_path):
-        now = time.time()
+        now = trace_mod.walltime()
         if timeout and now - t0 > float(timeout):
             _cancel(job_id)
             raise RuntimeError(
@@ -404,8 +405,8 @@ def supervise_job(
         if running is False or probe_exhausted:
             # job left the queue (or scheduler unreachable too long): give
             # the result file an NFS-lag grace window before declaring loss
-            t_gone = time.time()
-            while (time.time() - t_gone < grace
+            t_gone = trace_mod.walltime()
+            while (trace_mod.walltime() - t_gone < grace
                    and not os.path.exists(result_path)):
                 time.sleep(min(poll, 2.0))
             if os.path.exists(result_path):
@@ -577,6 +578,17 @@ def make_cluster_task(local_cls, flavor: str):
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
         hb_path = heartbeat_path(self.tmp_folder, self.uid)
+        # unified tracing plane (docs/OBSERVABILITY.md): a traced submitter
+        # hands the worker the run's shard directory through the
+        # environment, so the remote process's spans land on the SAME
+        # merged timeline (the env value both enables tracing and pins the
+        # directory)
+        trace_export = ""
+        if trace_mod.enabled():
+            trace_dir = trace_mod.trace_dir() or os.path.join(
+                self.tmp_folder, trace_mod.TRACE_DIRNAME
+            )
+            trace_export = f"export CTT_TRACE={trace_dir}\n"
         with open(script_path, "w") as f:
             f.write(
                 "#!/bin/bash\n"
@@ -586,6 +598,7 @@ def make_cluster_task(local_cls, flavor: str):
                 # runs, so its intermediate outputs must hit storage
                 # (docs/PERFORMANCE.md "Task-graph fusion")
                 "export CTT_HANDOFF=0\n"
+                f"{trace_export}"
                 # boot heartbeat from the shell, BEFORE the interpreter
                 # starts: the supervisor's staleness clock must not count
                 # queue exit -> first Python beat (slow jax imports) as

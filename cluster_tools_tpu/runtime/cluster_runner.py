@@ -31,7 +31,6 @@ import json
 import os
 import socket
 import sys
-import time
 import traceback
 
 
@@ -79,6 +78,25 @@ def main(spec_path: str) -> int:
             float(spec.get("heartbeat_interval_s", 5.0)),
         ).start()
 
+    # unified tracing plane (docs/OBSERVABILITY.md): when the submitter's
+    # batch script exported CTT_TRACE=<dir>, this process traces into the
+    # same shard directory — the worker's spans interleave with the
+    # submitter's on one clock-corrected timeline.  The lifetime span is
+    # the "cluster-worker lifetime" track; the flush in the finally is
+    # best-effort by contract (observability must never fail the job).
+    from . import trace as trace_mod
+
+    worker_span = trace_mod.begin(
+        "cluster.worker", task=spec.get("uid"), spec=os.path.basename(spec_path)
+    )
+
+    def _flush_trace(error: bool = False) -> None:
+        try:
+            worker_span.end(error=True) if error else worker_span.end()
+            trace_mod.flush()
+        except Exception:
+            pass
+
     try:
         from . import faults as faults_mod
 
@@ -116,6 +134,7 @@ def main(spec_path: str) -> int:
                     )
                 except OSError:
                     pass
+        _flush_trace()
         emit({"ok": True, "result": result})
         return 0
     except DrainInterrupt as e:
@@ -131,11 +150,12 @@ def main(spec_path: str) -> int:
                     "preempted": True,
                     "reason": e.reason,
                     "remaining_blocks": len(e.remaining_ids),
-                    "time": time.time(),
+                    "time": trace_mod.walltime(),
                     "host": socket.gethostname(),
                     "pid": os.getpid(),
                 }, f)
             os.replace(tmp, requeue_path)
+        _flush_trace()
         if spec.get("uid"):
             # one last beat so the supervisor's staleness clock sees the
             # drain, not dead air, while the marker propagates over NFS
@@ -145,6 +165,7 @@ def main(spec_path: str) -> int:
                 pass
         return REQUEUE_EXIT_CODE
     except Exception as e:  # noqa: BLE001 - report ANY failure to the poller
+        _flush_trace(error=True)
         emit({
             "ok": False,
             "error": f"{type(e).__name__}: {e}",

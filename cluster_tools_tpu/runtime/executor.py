@@ -101,6 +101,7 @@ from . import handoff as handoff_mod
 from ..utils import function_utils as fu
 from ..utils.volume_utils import Block, Blocking
 from . import faults as faults_mod
+from . import trace as trace_mod
 from .supervision import (
     DrainInterrupt,
     FirstWins,
@@ -609,7 +610,14 @@ class BlockwiseExecutor:
             batched_kernel = self._cached_program(
                 kernel, ("vmap", dev_key), _vmap_program
             )
-        t_sweep = time.perf_counter()
+        # the sweep span doubles as the sweep_s clock (docs/OBSERVABILITY.md):
+        # trace spans are the one timing source in runtime/ (CT008), and a
+        # begin/end pair still measures with the tracer off so the
+        # io_metrics counters keep working
+        sweep_span = trace_mod.begin(
+            "executor.sweep", task=task_name, n_blocks=len(blocks),
+            sharded=bool(use_sharded),
+        )
         dispatch_stats = {"batches": 0, "blocks": 0, "wait_s": 0.0}
         stats_lock = threading.Lock()
 
@@ -629,6 +637,14 @@ class BlockwiseExecutor:
 
         def note_failure(block, site, attempts, error, quarantine,
                          resource=None):
+            if quarantine or error is not None:
+                # attribution-plane crossing: the failure lands on the
+                # timeline next to the latency it caused
+                trace_mod.instant(
+                    f"fault:{site}", block=int(block.block_id),
+                    task=task_name, quarantined=bool(quarantine),
+                    resource=resource,
+                )
             with fail_lock:
                 rec = failures.setdefault(
                     int(block.block_id),
@@ -654,6 +670,10 @@ class BlockwiseExecutor:
                     quarantined_ids.add(int(block.block_id))
 
         def mark_resolved(block, resolution=None):
+            if resolution is not None:
+                trace_mod.instant(
+                    resolution, block=int(block.block_id), task=task_name
+                )
             with fail_lock:
                 rec = failures.get(int(block.block_id))
                 if rec is not None:
@@ -721,8 +741,13 @@ class BlockwiseExecutor:
             kern, width = _per_block_kernel()
             stacked = tuple(np.stack([x] * width) for x in val)
             stacked = tuple(jax.device_put(a, sharding) for a in stacked)
+            # span starts AFTER the lock is held — same grain semantics as
+            # the sharded path, so executor.dispatch never bills another
+            # dispatch's lock wait regardless of which path emitted it
             with dispatch_lock:
-                out = kern(*stacked)
+                with trace_mod.span("executor.dispatch", n_blocks=1,
+                                    task=task_name, grain="per_block"):
+                    out = kern(*stacked)
             _note_dispatch(1)
             return jax.tree_util.tree_map(lambda a: np.asarray(a)[0], out)
 
@@ -763,6 +788,14 @@ class BlockwiseExecutor:
                 stack.enter_context(
                     faults_mod.block_context(int(block.block_id))
                 )
+                # per-block load span covers the whole retry ladder: the
+                # latency an operator chases is time-to-loaded, not
+                # per-attempt time.  task passed explicitly: hot-path spans
+                # must not pay the thread-local context lookup per block
+                stack.enter_context(trace_mod.span(
+                    "executor.load", block=int(block.block_id),
+                    origin=origin, task=task_name,
+                ))
                 for k in range(self.max_retries + 1):
                     attempts = k + 1
                     try:
@@ -924,6 +957,10 @@ class BlockwiseExecutor:
                     with contextlib.ExitStack() as stack:
                         stack.enter_context(_watched(blk, "store", origin))
                         stack.enter_context(faults_mod.block_context(bid))
+                        stack.enter_context(trace_mod.span(
+                            "executor.store", block=bid, origin=origin,
+                            task=task_name,
+                        ))
                         _, attempts, tb, store_resource = self._io_with_retries(
                             "store", blk, _store_and_verify, on_error=_classify
                         )
@@ -1012,11 +1049,15 @@ class BlockwiseExecutor:
             the per-block fallback twin in sharded mode), and a first-wins
             commit against the (possibly still stuck) original."""
             try:
-                val = load_block(blk, origin="speculative")
-                if val is None:
-                    return
-                out0 = _exec_single(val)
-                handle_block_output(blk, out0, origin="speculative")
+                with trace_mod.span(
+                    "executor.speculate", block=int(blk.block_id),
+                    task=task_name,
+                ):
+                    val = load_block(blk, origin="speculative")
+                    if val is None:
+                        return
+                    out0 = _exec_single(val)
+                    handle_block_output(blk, out0, origin="speculative")
             except Exception:
                 note_failure(
                     blk, "speculate", 1,
@@ -1145,14 +1186,21 @@ class BlockwiseExecutor:
                         # sweep exits through DrainInterrupt for a requeue
                         drained = True
                         break
-                    t_wait = time.perf_counter()
+                    # the wait span doubles as the wait_s clock: the IO the
+                    # double-buffering failed to hide, and (traced) the gap
+                    # Perfetto shows between consecutive dispatch spans.
+                    # Sub-100us waits are measured (the counter needs them)
+                    # but not recorded — a fully-overlapped sweep must not
+                    # pay one timeline event per batch for a non-stall
+                    wait_span = trace_mod.begin(
+                        "executor.batch_wait", task=task_name, batch=i
+                    )
                     batch, arrays = pending_loads.pop(0).result()
+                    waited = wait_span.end(discard=True)
+                    if waited > 1e-4:
+                        wait_span.end()
                     with stats_lock:
-                        # dispatch loop stalled on un-overlapped loads: the
-                        # IO the double-buffering failed to hide
-                        dispatch_stats["wait_s"] += (
-                            time.perf_counter() - t_wait
-                        )
+                        dispatch_stats["wait_s"] += waited
                     if i + prefetch < n_batches:
                         pending_loads.append(pool.submit(load_batch, i + prefetch))
                     # prompt drain: surface finished stores (and any programming
@@ -1193,6 +1241,11 @@ class BlockwiseExecutor:
                         # compiling) speculative dispatch is not this batch's
                         # wall time, and must not cascade into false hangs
                         with dispatch_lock, contextlib.ExitStack() as stack:
+                            stack.enter_context(trace_mod.span(
+                                "executor.dispatch", task=task_name,
+                                n_blocks=len(batch),
+                                grain="sharded" if use_sharded else "batch",
+                            ))
                             for blk in batch:
                                 stack.enter_context(_watched(blk, "compute"))
                             out = batched_kernel(*arrays)
@@ -1227,6 +1280,13 @@ class BlockwiseExecutor:
                         # it is the stage the compute watchdog must cover.
                         try:
                             with contextlib.ExitStack() as stack:
+                                # this copy is where a wedged kernel blocks
+                                # (dispatch is async): the span is the
+                                # timeline's true per-batch compute extent
+                                stack.enter_context(trace_mod.span(
+                                    "executor.d2h", task=task_name,
+                                    n_blocks=len(batch),
+                                ))
                                 for blk in batch:
                                     stack.enter_context(_watched(blk, "compute"))
                                 out_np = jax.tree_util.tree_map(np.asarray, out)
@@ -1476,7 +1536,10 @@ class BlockwiseExecutor:
                 dispatch_stats["batches"],
                 dispatch_stats["blocks"],
                 dispatch_stats["wait_s"],
-                time.perf_counter() - t_sweep,
+                sweep_span.end(
+                    n_batches=dispatch_stats["batches"],
+                    n_quarantined=len(quarantined_ids),
+                ),
             )
 
         unresolved = sorted(

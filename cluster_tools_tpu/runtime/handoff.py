@@ -209,6 +209,15 @@ class HandoffRegistry:
         with self._lock:
             self._entries[entry.identity] = entry
             self._entries.move_to_end(entry.identity)
+        # timeline crossing (docs/OBSERVABILITY.md): every published target
+        # lands as an instant, so fused handoffs are visible between the
+        # producer's and consumer's task.run spans
+        from . import trace as trace_mod
+
+        trace_mod.instant(
+            "handoff.publish", identity=entry.identity,
+            nbytes=int(entry.nbytes), spilled=bool(entry.spilled),
+        )
 
     def entries_of(self, producer: str) -> List[_Entry]:
         with self._lock:
@@ -472,14 +481,23 @@ def resolve_dataset(path: str, key: str):
     handoff exists for ``(path, key)`` (counted ``handoffs_served``), the
     stored copy when it spilled (counted ``handoff_fallbacks``), else the
     plain storage dataset."""
+    from . import trace as trace_mod
+
     reg = get_registry()
-    entry = reg.get(dataset_identity(path, key))
+    identity = dataset_identity(path, key)
+    entry = reg.get(identity)
     if entry is not None and entry.kind == "dataset":
         obj = entry.obj
         if not entry.spilled and obj is not None:
             reg.bump("handoffs_served")
+            trace_mod.instant(
+                "handoff.resolve", identity=identity, served="memory"
+            )
             return obj
         reg.bump("handoff_fallbacks")
+        trace_mod.instant(
+            "handoff.resolve", identity=identity, served="fallback"
+        )
     return _file_reader(path)[key]
 
 
@@ -596,14 +614,22 @@ def load_arrays(path: str) -> Dict[str, np.ndarray]:
     when one exists (``handoffs_served``), else the file — verified against
     its CRC sidecar when the artifact was spilled (``handoff_fallbacks``).
     Plain files published before the handoff layer load unchanged."""
+    from . import trace as trace_mod
+
     reg = get_registry()
     entry = reg.get(artifact_identity(path))
     if entry is not None and entry.kind == "arrays":
         obj = entry.obj
         if not entry.spilled and obj is not None:
             reg.bump("handoffs_served")
+            trace_mod.instant(
+                "handoff.resolve", identity=entry.identity, served="memory"
+            )
             return _views(obj)
         reg.bump("handoff_fallbacks")
+        trace_mod.instant(
+            "handoff.resolve", identity=entry.identity, served="fallback"
+        )
     if _is_npy(path):
         arr = np.load(path)
         out = {"data": arr}
@@ -661,6 +687,8 @@ def _spill_entry(entry: _Entry, reason: str) -> int:
     fallback would read a half-written dataset.  Exactly one thread wins
     the claim; the flags flip (under the registry lock) only after the
     copy landed."""
+    from . import trace as trace_mod
+
     reg = get_registry()
     if not reg.claim_spill(entry):
         return 0
@@ -668,11 +696,17 @@ def _spill_entry(entry: _Entry, reason: str) -> int:
     freed = 0
     ok = False
     try:
-        if entry.kind == "dataset":
-            freed = obj.spill()
-        else:
-            _write_artifact(entry.path, obj)
-            freed = entry.nbytes
+        # the spill is real storage IO mid-pipeline: a span, not an
+        # instant, so the timeline shows the stall it caused
+        with trace_mod.span(
+            "handoff.spill", identity=entry.identity, reason=reason,
+            nbytes=int(entry.nbytes),
+        ):
+            if entry.kind == "dataset":
+                freed = obj.spill()
+            else:
+                _write_artifact(entry.path, obj)
+                freed = entry.nbytes
         ok = True
     except Exception:
         ok = False

@@ -197,10 +197,14 @@ def heartbeat_path(tmp_folder: str, uid: str) -> str:
 
 def write_heartbeat(tmp_folder: str, uid: str) -> None:
     """Atomically record ``{time, pid, host}`` — the shared-filesystem pulse
-    the supervisor checks for staleness and pid-liveness."""
+    the supervisor checks for staleness and pid-liveness.  Stamped through
+    the tracer's wall-clock source (docs/ANALYSIS.md CT008), so heartbeat
+    timestamps and the merged trace timeline share one anchor."""
+    from . import trace as trace_mod
+
     fu.atomic_write_json(
         heartbeat_path(tmp_folder, uid),
-        {"time": time.time(), "pid": os.getpid(),
+        {"time": trace_mod.walltime(), "pid": os.getpid(),
          "host": socket.gethostname()},
     )
 

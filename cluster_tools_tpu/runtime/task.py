@@ -35,6 +35,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from ..utils import function_utils as fu
 from ..utils import task_utils as tu
+from . import trace as trace_mod
 
 
 class SuccessTarget:
@@ -54,7 +55,7 @@ class SuccessTarget:
 
     def write(self, payload: Optional[Dict[str, Any]] = None):
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        doc = {"time": time.time()}
+        doc = {"time": trace_mod.walltime()}
         if payload:
             doc.update(payload)
         fu.atomic_write_json(self.path, doc, default=tu._default)
@@ -254,8 +255,21 @@ class BaseTask:
         from ..ops import contraction as contraction_mod
         from ..parallel import reduce_tree as reduce_tree_mod
 
-        t0 = time.time()
         self.logger.info(f"start {self.task_name} (target={self.target})")
+        # unified tracing plane (docs/OBSERVABILITY.md): every task of a run
+        # shards its spans into <tmp_folder>/trace/; first writer pins the
+        # directory, an operator CTT_TRACE=<dir> pin always wins
+        if trace_mod.enabled():
+            trace_mod.set_trace_dir(
+                os.path.join(self.tmp_folder, trace_mod.TRACE_DIRNAME)
+            )
+        # the task.run span doubles as the runtime_s clock (CT008: trace
+        # spans are the one timing source in runtime/) and carries the
+        # dependency uids the trace aggregator's critical path walks
+        run_span = trace_mod.begin(
+            "task.run", task=self.uid, task_name=self.task_name,
+            deps=[d.uid for d in self.dependencies],
+        )
         # fault specs with a "tasks" filter target the running task's uid
         faults_mod.set_current_task(self.uid)
         io_snap = chunk_cache.snapshot()
@@ -263,14 +277,22 @@ class BaseTask:
         handoff_snap = handoff_mod.snapshot()
         solver_snap = contraction_mod.solver_snapshot()
         tree_snap = reduce_tree_mod.solve_snapshot()
+        ok = False
         try:
             result = self.run_impl() or {}
             # finalize in-memory targets INSIDE the task context: forced
             # `spill` faults filter on the producing task's uid
             handoff_records = self._finalize_handoffs()
+            ok = True
         finally:
             faults_mod.set_current_task(None)
-        result["runtime_s"] = time.time() - t0
+            if not ok:
+                # a failing task still leaves its spans behind: the error'd
+                # task.run span and everything below it flush now, so the
+                # timeline of a crashed run shows exactly where it died
+                run_span.end(error=True)
+                self._flush_trace()
+        result["runtime_s"] = run_span.end()
         result["target"] = self.target
         if handoff_records:
             # the DAG engine's resume contract (complete()): a memory-only
@@ -311,9 +333,32 @@ class BaseTask:
                     f"io_metrics recording failed:\n{traceback.format_exc()}"
                 )
         self.output().write(result)
+        # flush this process's trace shard and (re)stitch the run timeline
+        # so trace.json + trace_summary.json track the run as it executes;
+        # the restitch re-reads every shard, so it is throttled to once per
+        # MERGE_MIN_INTERVAL_S per process — build() always merges at the
+        # end, so the finished timeline is current regardless
+        self._flush_trace(merge=True)
         self.logger.info(
             f"done {self.task_name} in {result['runtime_s']:.2f}s"
         )
+
+    def _flush_trace(self, merge: bool = False) -> None:
+        """Best-effort trace shard flush (+ optional timeline re-merge):
+        observability must never fail a run."""
+        if not trace_mod.enabled():
+            return
+        try:
+            trace_mod.flush()
+            if merge:
+                trace_mod.write_timeline(
+                    self.tmp_folder,
+                    min_interval_s=trace_mod.MERGE_MIN_INTERVAL_S,
+                )
+        except Exception:
+            self.logger.warning(
+                f"trace flush failed:\n{traceback.format_exc()}"
+            )
 
     # -- block-level resume helpers ---------------------------------------
     def blocks_done(self) -> List[int]:
@@ -605,32 +650,41 @@ class BaseTask:
                 skipped_for_drain.append(block_id)
                 return
             last_tb, attempts = None, 0
-            for k in range(io_retries + 1):
-                attempts = k + 1
-                if watchdog is not None:
-                    watchdog.register(
-                        (block_id, k), block_id=int(block_id), stage="host"
-                    )
-                try:
-                    process(block_id)
-                    if store_verify_fn is not None and blocking is not None:
-                        # post-store integrity check: a corruption raises,
-                        # and the retry re-runs process -> re-writes the
-                        # block -> repairs the corrupt chunk
-                        store_verify_fn(blocking.get_block(block_id))
-                except Exception as e:
-                    last_tb = fu.cap_traceback(traceback.format_exc())
-                    if classify_resource_error(e) is not None:
-                        break  # same-size retries re-run the failed alloc
-                    if k < io_retries:
-                        time.sleep(fu.backoff_delay(k, io_backoff, 5.0))
-                else:
-                    completed.add(block_id)
-                    self.log_block_success(block_id)
-                    return
-                finally:
+            # the span covers the whole retry ladder — the latency an
+            # operator chases is time-to-markered, not per-attempt time
+            with trace_mod.span(
+                "host.block", block=int(block_id), task=self.uid
+            ):
+                for k in range(io_retries + 1):
+                    attempts = k + 1
                     if watchdog is not None:
-                        watchdog.clear((block_id, k))
+                        watchdog.register(
+                            (block_id, k), block_id=int(block_id), stage="host"
+                        )
+                    try:
+                        process(block_id)
+                        if store_verify_fn is not None and blocking is not None:
+                            # post-store integrity check: a corruption
+                            # raises, and the retry re-runs process ->
+                            # re-writes the block -> repairs the corrupt
+                            # chunk
+                            store_verify_fn(blocking.get_block(block_id))
+                    except Exception as e:
+                        last_tb = fu.cap_traceback(traceback.format_exc())
+                        if classify_resource_error(e) is not None:
+                            break  # same-size retries re-run the failed alloc
+                        if k < io_retries:
+                            time.sleep(fu.backoff_delay(k, io_backoff, 5.0))
+                    else:
+                        completed.add(block_id)
+                        self.log_block_success(block_id)
+                        return
+                    finally:
+                        if watchdog is not None:
+                            watchdog.clear((block_id, k))
+            trace_mod.instant(
+                "fault:host", block=int(block_id), task=self.uid
+            )
             errors.append((block_id, last_tb, attempts))
 
         from concurrent.futures import ThreadPoolExecutor
@@ -834,6 +888,10 @@ def build(tasks: Sequence[BaseTask], rerun: bool = False) -> bool:
     for t in tasks:
         visit(t, ())
 
+    # the DAG-engine span: brackets every task.run of this build, so the
+    # timeline shows scheduling gaps (skip checks, retry backoffs) between
+    # tasks, not just the tasks themselves (docs/OBSERVABILITY.md)
+    build_span = trace_mod.begin("task.build", n_tasks=len(order))
     failed: set = set()
     for task in order:
         key = _key(task)
@@ -862,4 +920,13 @@ def build(tasks: Sequence[BaseTask], rerun: bool = False) -> bool:
             faults_mod.get_injector().kill_point("task_done")
         else:
             failed.add(key)
+    build_span.end(n_failed=len(failed))
+    if trace_mod.enabled() and order:
+        # the build span itself must reach the timeline: flush through the
+        # last task's tmp_folder (where the run's shard directory lives)
+        try:
+            trace_mod.flush()
+            trace_mod.write_timeline(order[-1].tmp_folder)
+        except Exception:
+            pass
     return not failed

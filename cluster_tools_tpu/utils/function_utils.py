@@ -197,18 +197,30 @@ def io_metrics_path(tmp_folder: str) -> str:
 def record_io_metrics(path: str, task_name: str, metrics) -> None:
     """Merge one task's chunk-IO counter deltas into ``io_metrics.json``.
 
-    Schema: ``{"version": 1, "tasks": {uid: {counter: total, ...}}}``.
-    Counters merge *additively* per task uid — a resumed run's second pass,
-    or concurrent cluster job processes writing over the shared filesystem,
-    accumulate into one total (same file-lock discipline as
-    :func:`record_failures`).  Derived figures (hit rate, bytes saved) are
-    computed at render time by ``scripts/failures_report.py``, never stored.
+    Schema: ``{"version": 2, "tasks": {uid: {counter: total, ...}},
+    "provenance": {uid: {"host:pid": {"host", "pid", "last_updated",
+    "merges", "counters"}}}}``.  Counters merge *additively* per task uid —
+    a resumed run's second pass, or concurrent cluster job processes
+    writing over the shared filesystem, accumulate into one total (same
+    file-lock discipline as :func:`record_failures`).  The additive merge
+    alone makes a cluster worker's delta indistinguishable from the
+    submitter's, so every merge also stamps a **provenance** entry for the
+    writing process: which host:pid contributed, when it last wrote, how
+    many times it merged, and which counter keys it moved — multi-process
+    runs stay attributable per contributor.  Derived figures (hit rate,
+    bytes saved) are computed at render time by
+    ``scripts/failures_report.py``, never stored.
     """
+    import socket
+
     with file_lock(path):
         doc = read_json_if_valid(path) or {}
-        doc.setdefault("version", 1)
+        # version 2 = the provenance map; the tasks schema is unchanged,
+        # so version-1 readers keep working
+        doc["version"] = max(2, int(doc.get("version") or 1))
         tasks = doc.setdefault("tasks", {})
         cur = dict(tasks.get(task_name) or {})
+        moved = []
         for k, v in dict(metrics).items():
             if isinstance(v, (int, float)) and isinstance(
                 cur.get(k), (int, float)
@@ -216,7 +228,20 @@ def record_io_metrics(path: str, task_name: str, metrics) -> None:
                 cur[k] = cur[k] + v
             else:
                 cur[k] = v
+            if not isinstance(v, (int, float)) or v:
+                moved.append(str(k))
         tasks[task_name] = cur
+        host, pid = socket.gethostname(), os.getpid()
+        prov = doc.setdefault("provenance", {}).setdefault(task_name, {})
+        entry = dict(prov.get(f"{host}:{pid}") or {})
+        entry.update({
+            "host": host,
+            "pid": pid,
+            "last_updated": _now(),
+            "merges": int(entry.get("merges", 0)) + 1,
+            "counters": sorted(set(entry.get("counters") or []) | set(moved)),
+        })
+        prov[f"{host}:{pid}"] = entry
         atomic_write_json(path, doc)
 
 

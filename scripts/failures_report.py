@@ -3,6 +3,8 @@
 Usage::
 
     python scripts/failures_report.py <tmp_folder | failures.json>
+    python scripts/failures_report.py --trace <tmp_folder | trace_summary.json>
+    python scripts/failures_report.py --json <tmp_folder> [--no-lint]
     python scripts/failures_report.py --lint <lint.json | ->
     make failures-report TMP=/path/to/tmp_folder
 
@@ -14,7 +16,23 @@ when records came from more than one process (schema v2).
 When the run recorded chunk-IO metrics (``io_metrics.json``, written next
 to ``failures.json`` by the task runtime — docs/PERFORMANCE.md "Chunk-aware
 I/O"), a second section renders each task's cache hit rate, bytes read from
-storage vs bytes served, and the bytes the cache saved.
+storage vs bytes served, and the bytes the cache saved — with per-process
+provenance (which host:pid contributed which counters, and when) for
+multi-process runs (io_metrics.json schema v2).
+
+``--trace`` renders the unified-timeline aggregates
+(``trace_summary.json``, written by a ``CTT_TRACE=1`` run next to
+``io_metrics.json`` — docs/OBSERVABILITY.md): per-site latency percentiles
+(p50/p95/p99), instant counts, the task-DAG critical path, and per-process
+utilization.  The default report appends the same section when a summary
+exists.
+
+``--json`` emits ONE machine-readable document for the whole run —
+failure summaries + io_metrics (with provenance) + the trace summary +
+a fresh ctlint pass over the repo (skippable with ``--no-lint``) — so CI
+and the service mode consume the post-mortem without scraping text.
+Exit code 1 when the run has unresolved failures or the lint pass found
+findings.
 
 ``--lint`` renders a ctlint findings document (docs/ANALYSIS.md) instead:
 ``python -m cluster_tools_tpu.lint --json > lint.json`` then point this at
@@ -38,9 +56,10 @@ def load_records(path: str):
     return path, doc.get("version"), doc.get("records", [])
 
 
-def load_io_metrics(failures_json_path: str):
+def load_io_metrics(failures_json_path: str, with_provenance: bool = False):
     """Per-task chunk-IO counters from the sibling ``io_metrics.json``
-    ({} when the run recorded none — the report stays failures-only)."""
+    ({} when the run recorded none — the report stays failures-only).
+    ``with_provenance`` returns ``(tasks, provenance)`` instead."""
     path = os.path.join(
         os.path.dirname(os.path.abspath(failures_json_path)),
         "io_metrics.json",
@@ -49,8 +68,11 @@ def load_io_metrics(failures_json_path: str):
         with open(path) as f:
             doc = json.load(f)
     except (OSError, ValueError):
-        return {}
-    return doc.get("tasks", {}) or {}
+        doc = {}
+    tasks = doc.get("tasks", {}) or {}
+    if with_provenance:
+        return tasks, doc.get("provenance", {}) or {}
+    return tasks
 
 
 def _human_bytes(n: float) -> str:
@@ -61,7 +83,7 @@ def _human_bytes(n: float) -> str:
     return f"{n:.1f}GiB"  # pragma: no cover - loop always returns
 
 
-def format_io_metrics(tasks) -> list:
+def format_io_metrics(tasks, provenance=None) -> list:
     """Render per-task cache effectiveness lines (hit rate, bytes saved)
     and, when the task ran compiled sweeps, the dispatch-amortization
     figures of the sharded executor (docs/PERFORMANCE.md "Sharded
@@ -154,6 +176,90 @@ def format_io_metrics(tasks) -> list:
                 f"{per:.1f} blocks/dispatch, "
                 f"dispatch wait {wait:.2f}s, overlap efficiency {overlap}"
             )
+        # multi-process attribution (io_metrics.json schema v2): when more
+        # than one process merged into this task's counters, say which
+        # host:pid contributed what — the additive totals alone cannot
+        contributors = (provenance or {}).get(task) or {}
+        if len(contributors) > 1:
+            for key in sorted(contributors):
+                c = contributors[key]
+                counters = c.get("counters") or []
+                shown = ", ".join(counters[:6]) + (
+                    ", ..." if len(counters) > 6 else ""
+                )
+                lines.append(
+                    f"  contributed by {key} (x{int(c.get('merges', 1))}, "
+                    f"last {c.get('last_updated', '?')}): {shown}"
+                )
+    return lines
+
+
+def load_trace_summary(failures_json_path: str):
+    """The run's ``trace_summary.json`` (written next to io_metrics.json by
+    a ``CTT_TRACE=1`` run — docs/OBSERVABILITY.md), or {}."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(failures_json_path)),
+        "trace_summary.json",
+    )
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def format_trace_summary(summ) -> list:
+    """Render the unified-timeline aggregates: per-site latency
+    percentiles, instants, the critical path, per-process utilization, and
+    the executor overlap cross-check (docs/OBSERVABILITY.md)."""
+    lines = [
+        f"trace summary (trace_summary.json): {int(summ.get('n_events', 0))} "
+        f"event(s) from {int(summ.get('n_processes', 0))} process(es)"
+        + (f", {int(summ['dropped'])} dropped" if summ.get("dropped") else "")
+    ]
+    sites = summ.get("sites") or {}
+    if sites:
+        lines.append("  site                     count    p50_ms    p99_ms    total_s")
+        for name in sorted(sites):
+            s = sites[name]
+            lines.append(
+                f"  {name:<24} {int(s.get('count', 0)):>5}"
+                f" {float(s.get('p50_ms', 0)):>9.3f}"
+                f" {float(s.get('p99_ms', 0)):>9.3f}"
+                f" {float(s.get('total_s', 0)):>10.3f}"
+            )
+    instants = summ.get("instants") or {}
+    if instants:
+        lines.append(
+            "  instants: " + ", ".join(
+                f"{name}={n}" for name, n in sorted(instants.items())
+            )
+        )
+    cp = summ.get("critical_path")
+    if cp:
+        lines.append(
+            f"  critical path ({float(cp.get('total_s', 0)):.3f}s): "
+            + " -> ".join(
+                f"{uid} ({cp.get('task_s', {}).get(uid, 0):.3f}s)"
+                for uid in cp.get("tasks", [])
+            )
+        )
+    for p in summ.get("processes") or []:
+        busy = p.get("busy_s_by_cat") or {}
+        busy_str = ", ".join(
+            f"{c}={v:.2f}s" for c, v in sorted(busy.items())
+        )
+        lines.append(
+            f"  [{p.get('process')}] {int(p.get('events', 0))} event(s) "
+            f"over {float(p.get('wall_s', 0)):.3f}s wall: {busy_str}"
+        )
+    overlap = summ.get("overlap")
+    if overlap:
+        lines.append(
+            f"  executor overlap: sweep {overlap.get('sweep_s', 0):.3f}s, "
+            f"batch wait {overlap.get('batch_wait_s', 0):.3f}s, "
+            f"efficiency {100.0 * overlap.get('overlap_efficiency', 0):.1f}%"
+        )
     return lines
 
 
@@ -198,12 +304,15 @@ def summarize(records):
     return out
 
 
-def format_report(path, version, summaries, io_tasks=None) -> str:
+def format_report(path, version, summaries, io_tasks=None, provenance=None,
+                  trace_summary=None) -> str:
     lines = [f"failures report: {path} (schema v{version})", ""]
     if not summaries:
         lines.append("no failure records — clean run")
         if io_tasks:
-            lines.extend(["", *format_io_metrics(io_tasks)])
+            lines.extend(["", *format_io_metrics(io_tasks, provenance)])
+        if trace_summary:
+            lines.extend(["", *format_trace_summary(trace_summary)])
         return "\n".join(lines)
     n_unresolved = sum(len(s["unresolved"]) for s in summaries)
     all_hosts = sorted({h for s in summaries for h in s["hosts"]})
@@ -232,7 +341,9 @@ def format_report(path, version, summaries, io_tasks=None) -> str:
     )
     lines.append(verdict)
     if io_tasks:
-        lines.extend(["", *format_io_metrics(io_tasks)])
+        lines.extend(["", *format_io_metrics(io_tasks, provenance)])
+    if trace_summary:
+        lines.extend(["", *format_trace_summary(trace_summary)])
     return "\n".join(lines)
 
 
@@ -271,6 +382,57 @@ def format_lint_report(doc) -> str:
     return "\n".join(lines)
 
 
+def run_repo_lint():
+    """A fresh ctlint pass over the repo's package (docs/ANALYSIS.md), as
+    the linter's own ``--json`` document — or None when the package cannot
+    be found/parsed (report consumers treat null as "lint not run")."""
+    try:
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        sys.path.insert(0, repo_root)
+        from cluster_tools_tpu.lint.core import findings_to_json, run_lint
+
+        pkg = os.path.join(repo_root, "cluster_tools_tpu")
+        findings, stats = run_lint([pkg])
+        return findings_to_json(findings, stats)
+    except Exception:
+        return None
+
+
+def build_json_report(tmp_folder: str, with_lint: bool = True):
+    """The machine-readable run report: every observability plane this run
+    produced, in one document (docs/OBSERVABILITY.md)."""
+    fpath = os.path.join(tmp_folder, "failures.json")
+    error = None
+    try:
+        _, version, records = load_records(fpath)
+    except (OSError, ValueError) as e:
+        version, records = None, []
+        # only a MISSING manifest is clean (same contract as the text
+        # report): a present-but-unparseable one is crash evidence and
+        # must surface as an error, not as n_records=0
+        if os.path.exists(fpath):
+            error = f"torn failures manifest: {e}"
+    io_tasks, provenance = load_io_metrics(fpath, with_provenance=True)
+    summaries = summarize(records)
+    doc = {
+        "version": 1,
+        "tmp_folder": os.path.abspath(tmp_folder),
+        "failures": {
+            "schema_version": version,
+            "error": error,
+            "n_records": len(records),
+            "n_unresolved": sum(len(s["unresolved"]) for s in summaries),
+            "tasks": summaries,
+        },
+        "io_metrics": {"tasks": io_tasks, "provenance": provenance},
+        "trace": load_trace_summary(fpath) or None,
+        "lint": run_repo_lint() if with_lint else None,
+    }
+    return doc
+
+
 def main(argv) -> int:
     if len(argv) > 1 and argv[1] == "--lint":
         if len(argv) != 3:
@@ -287,6 +449,36 @@ def main(argv) -> int:
             return 2
         print(format_lint_report(doc))
         return 1 if doc.get("findings") else 0
+    if len(argv) > 1 and argv[1] == "--trace":
+        if len(argv) != 3:
+            print(__doc__.strip(), file=sys.stderr)
+            return 2
+        spath = (
+            os.path.join(argv[2], "trace_summary.json")
+            if os.path.isdir(argv[2])
+            else argv[2]
+        )
+        try:
+            with open(spath) as f:
+                summ = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read trace summary: {e}", file=sys.stderr)
+            return 1
+        print("\n".join(format_trace_summary(summ)))
+        return 0
+    if len(argv) > 1 and argv[1] == "--json":
+        args = [a for a in argv[2:] if a != "--no-lint"]
+        if len(args) != 1:
+            print(__doc__.strip(), file=sys.stderr)
+            return 2
+        doc = build_json_report(args[0], with_lint="--no-lint" not in argv)
+        print(json.dumps(doc, indent=2))
+        bad = (
+            doc["failures"]["error"]
+            or doc["failures"]["n_unresolved"]
+            or (doc["lint"] or {}).get("findings")
+        )
+        return 1 if bad else 0
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2
@@ -303,16 +495,22 @@ def main(argv) -> int:
         # clean — a present-but-unparseable (torn) one is exactly the kind
         # of crash evidence this report exists to surface, and must keep
         # its error + nonzero exit
-        io_tasks = load_io_metrics(fpath)
+        io_tasks, provenance = load_io_metrics(fpath, with_provenance=True)
         if io_tasks and not os.path.exists(fpath):
             print("no failures manifest — clean run")
-            print("\n".join(format_io_metrics(io_tasks)))
+            print("\n".join(format_io_metrics(io_tasks, provenance)))
+            trace_summary = load_trace_summary(fpath)
+            if trace_summary:
+                print()
+                print("\n".join(format_trace_summary(trace_summary)))
             return 0
         print(f"cannot read failures manifest: {e}", file=sys.stderr)
         return 1
+    io_tasks, provenance = load_io_metrics(path, with_provenance=True)
     print(
         format_report(
-            path, version, summarize(records), load_io_metrics(path)
+            path, version, summarize(records), io_tasks, provenance,
+            load_trace_summary(path),
         )
     )
     return 0

@@ -1,0 +1,256 @@
+"""Live run status from a run's scratch directory (docs/OBSERVABILITY.md).
+
+Usage::
+
+    python scripts/progress.py <tmp_folder> [--json] [--stale-after S]
+    make progress TMP=/path/to/tmp_folder
+
+The supervision layer already writes everything an operator needs to see a
+run's pulse — per-block success markers (``markers/<uid>/block_*.json``),
+per-task success manifests (``<uid>.success.json``), heartbeat files
+(``heartbeats/<uid>.json``) and the shared ``failures.json`` — but the
+supervisor log only hints at it.  This script is the operator view: one
+line per task with its state, block progress, quarantines, and heartbeat
+freshness, plus warnings for anything that looks wedged.
+
+States:
+
+- ``done``        — a valid success manifest exists
+- ``in-flight``   — markers or a fresh heartbeat, no manifest yet
+- ``stalled?``    — no manifest and the newest sign of life (heartbeat or
+  marker) is older than ``--stale-after`` seconds (default 60; judged by
+  file mtimes on THIS host's clock, so worker clock skew cannot fake it)
+- ``failed``      — unresolved failure records and no manifest
+
+``--json`` emits the same as a machine-readable document (for dashboards
+and the service mode's admission view).  Stdlib-only on purpose: the
+operator view must work on a bare login node without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import time
+from collections import defaultdict
+
+STALE_AFTER_S = 60.0
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _mtime(path):
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return None
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except Exception:
+        return True
+    return True
+
+
+def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
+                     now: float = None):
+    """One record per task uid seen in markers / manifests / heartbeats /
+    failures.json — the union, so a task that died before its first marker
+    still shows up through its heartbeat or failure records."""
+    now = time.time() if now is None else now
+    uids = set()
+
+    marker_root = os.path.join(tmp_folder, "markers")
+    markers = {}
+    if os.path.isdir(marker_root):
+        for uid in sorted(os.listdir(marker_root)):
+            d = os.path.join(marker_root, uid)
+            if not os.path.isdir(d):
+                continue
+            uids.add(uid)
+            blocks, newest = 0, None
+            for fname in os.listdir(d):
+                if fname.startswith("block_") and fname.endswith(".json"):
+                    blocks += 1
+                    mt = _mtime(os.path.join(d, fname))
+                    if mt and (newest is None or mt > newest):
+                        newest = mt
+            markers[uid] = {"blocks_done": blocks, "newest": newest}
+
+    manifests = {}
+    try:
+        listing = sorted(os.listdir(tmp_folder))
+    except OSError:
+        listing = []
+    for fname in listing:
+        if fname.endswith(".success.json"):
+            uid = fname[: -len(".success.json")]
+            doc = _read_json(os.path.join(tmp_folder, fname))
+            if doc is not None:  # torn manifest = not done (resume contract)
+                uids.add(uid)
+                manifests[uid] = doc
+
+    heartbeats = {}
+    hb_dir = os.path.join(tmp_folder, "heartbeats")
+    if os.path.isdir(hb_dir):
+        for fname in sorted(os.listdir(hb_dir)):
+            if not fname.endswith(".json"):
+                continue
+            uid = fname[: -len(".json")]
+            uids.add(uid)
+            path = os.path.join(hb_dir, fname)
+            mt = _mtime(path)
+            heartbeats[uid] = {
+                "doc": _read_json(path) or {},
+                "age_s": (now - mt) if mt else None,
+            }
+
+    fail_doc = _read_json(os.path.join(tmp_folder, "failures.json")) or {}
+    by_task = defaultdict(lambda: {"quarantined": 0, "unresolved": 0,
+                                   "records": 0})
+    for rec in fail_doc.get("records", []):
+        uid = str(rec.get("task"))
+        uids.add(uid)
+        t = by_task[uid]
+        t["records"] += 1
+        if rec.get("quarantined"):
+            t["quarantined"] += 1
+        if not rec.get("resolved"):
+            t["unresolved"] += 1
+
+    tasks = []
+    for uid in sorted(uids):
+        mk = markers.get(uid, {})
+        hb = heartbeats.get(uid)
+        fails = by_task.get(uid, {"quarantined": 0, "unresolved": 0,
+                                  "records": 0})
+        done = uid in manifests
+        hb_age = hb["age_s"] if hb else None
+        hb_doc = (hb or {}).get("doc") or {}
+        hb_pid_dead = bool(
+            hb_doc.get("pid") is not None
+            and hb_doc.get("host") == socket.gethostname()
+            and not _pid_alive(hb_doc["pid"])
+        )
+        newest_life = max(
+            [t for t in (mk.get("newest"), (now - hb_age) if hb_age is not None
+             else None) if t is not None],
+            default=None,
+        )
+        if done:
+            state = "done"
+        elif fails["unresolved"]:
+            state = "failed"
+        elif newest_life is None:
+            state = "pending"
+        elif (now - newest_life) > stale_after_s or hb_pid_dead:
+            state = "stalled?"
+        else:
+            state = "in-flight"
+        tasks.append({
+            "task": uid,
+            "state": state,
+            "blocks_done": int(mk.get("blocks_done", 0)),
+            "quarantined": fails["quarantined"],
+            "unresolved": fails["unresolved"],
+            "runtime_s": manifests.get(uid, {}).get("runtime_s"),
+            "heartbeat_age_s": (
+                round(hb_age, 1) if hb_age is not None else None
+            ),
+            "heartbeat_pid_dead": hb_pid_dead,
+        })
+    return {
+        "version": 1,
+        "tmp_folder": os.path.abspath(tmp_folder),
+        "time": now,
+        "stale_after_s": float(stale_after_s),
+        "tasks": tasks,
+        "traced": os.path.isdir(os.path.join(tmp_folder, "trace")),
+    }
+
+
+def format_progress(doc) -> str:
+    tasks = doc["tasks"]
+    lines = [
+        f"run progress: {doc['tmp_folder']}  "
+        f"({sum(1 for t in tasks if t['state'] == 'done')}/{len(tasks)} "
+        "task(s) done"
+        + (", traced" if doc.get("traced") else "") + ")"
+    ]
+    if not tasks:
+        lines.append("  no tasks seen yet (no markers, manifests, "
+                     "heartbeats, or failure records)")
+        return "\n".join(lines)
+    width = max(len(t["task"]) for t in tasks)
+    for t in tasks:
+        bits = [f"{t['blocks_done']} block(s) markered"]
+        if t["quarantined"]:
+            bits.append(f"{t['quarantined']} quarantined")
+        if t["unresolved"]:
+            bits.append(f"{t['unresolved']} UNRESOLVED")
+        if t["runtime_s"] is not None:
+            bits.append(f"ran {float(t['runtime_s']):.2f}s")
+        if t["heartbeat_age_s"] is not None:
+            bits.append(f"heartbeat {t['heartbeat_age_s']:.1f}s ago")
+        lines.append(
+            f"  {t['task']:<{width}}  {t['state']:<9}  " + ", ".join(bits)
+        )
+    warnings = []
+    for t in tasks:
+        if t["state"] == "stalled?":
+            why = (
+                "heartbeat pid is dead" if t["heartbeat_pid_dead"]
+                else f"no sign of life for > {doc['stale_after_s']:g}s"
+            )
+            warnings.append(f"  WARNING: {t['task']} looks stalled ({why})")
+        if t["state"] == "failed":
+            warnings.append(
+                f"  WARNING: {t['task']} has {t['unresolved']} unresolved "
+                "failure(s) — see failures.json / make failures-report"
+            )
+    if warnings:
+        lines.append("")
+        lines.extend(warnings)
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    as_json = "--json" in argv
+    stale = STALE_AFTER_S
+    for i, a in enumerate(argv):
+        if a == "--stale-after":
+            try:
+                stale = float(argv[i + 1])
+            except (IndexError, ValueError):
+                print(__doc__.strip(), file=sys.stderr)
+                return 2
+            if argv[i + 1] in args:
+                args.remove(argv[i + 1])
+    if len(args) != 1 or not os.path.isdir(args[0]):
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    doc = collect_progress(args[0], stale_after_s=stale)
+    if as_json:
+        print(json.dumps(doc, indent=2))
+    else:
+        print(format_progress(doc))
+    # rc mirrors the operator's concern: something stalled or failed -> 1
+    bad = any(t["state"] in ("stalled?", "failed") for t in doc["tasks"])
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

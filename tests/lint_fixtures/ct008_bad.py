@@ -1,0 +1,37 @@
+"""CT008 fixture: direct wall-clock timing in runtime/ and orchestration
+calls outside any task trace context."""
+
+import time
+import time as _t
+from time import perf_counter
+from time import perf_counter as pc
+
+
+def timed_sweep(executor, blocks, load, store):
+    t0 = time.time()  # banned: bypasses the tracing plane
+    executor.map_blocks(  # banned: no class, no task_context in scope
+        lambda x: x, blocks, load, store,
+        failures_path="f.json", task_name="t",
+        block_deadline_s=None, watchdog_period_s=None,
+        store_verify_fn=None, schedule="morton", sweep_mode="auto",
+    )
+    return time.perf_counter() - t0  # banned
+
+
+def solve_things(n, edges, costs, shard):
+    dt = perf_counter()  # banned: from-import form
+    solve_with_reduce_tree(  # banned: unattributed spans
+        n, edges, costs, node_shard=shard, solver_shards=2, fanout=2,
+        failures_path="f.json", task_name="t", unsharded=lambda: None,
+    )
+    return dt
+
+
+def host_scan(task, ids):
+    task.host_block_map(ids, print)  # banned: free function, no context
+
+
+def aliased_clocks():
+    t0 = _t.time()  # banned: aliased module form
+    t1 = _t.perf_counter()  # banned: aliased module form
+    return pc() - t0 - t1  # banned: aliased from-import form

@@ -1,0 +1,49 @@
+"""CT008 clean twin: spans are the timing source; orchestration calls run
+inside a task class or under an explicit trace.task_context."""
+
+import time
+
+from cluster_tools_tpu.runtime import trace
+
+
+def timed_sweep(executor, blocks, load, store):
+    sweep = trace.begin("bench.sweep")  # the sanctioned duration source
+    with trace.task_context("bench_sweep"):
+        executor.map_blocks(
+            lambda x: x, blocks, load, store,
+            failures_path="f.json", task_name="t",
+            block_deadline_s=None, watchdog_period_s=None,
+            store_verify_fn=None, schedule="morton", sweep_mode="auto",
+        )
+    return sweep.end()
+
+
+def wait_with_deadline(event):
+    # monotonic deadlines and sleep backoffs are not timing measurements
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if event.is_set():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class SolveTask:
+    """Task-class call sites inherit the task.run span from BaseTask.run."""
+
+    uid = "solve.deadbeef"
+
+    def run_impl(self, n, edges, costs, shard):
+        solve_with_reduce_tree(
+            n, edges, costs, node_shard=shard, solver_shards=2, fanout=2,
+            failures_path="f.json", task_name=self.uid,
+            unsharded=lambda: None,
+        )
+        self.host_block_map([1, 2, 3], print)
+
+    def host_block_map(self, ids, fn):
+        return [fn(i) for i in ids]
+
+
+def stamp():
+    return trace.walltime()  # the sanctioned wall-clock source

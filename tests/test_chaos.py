@@ -484,6 +484,14 @@ def test_chaos_sharded_solve_killed_worker(tmp_path):
     (resolution "degraded:unsharded_solve" in failures.json), and the final
     segmentation is BIT-IDENTICAL to the fault-free single-host run — the
     sharded path can never produce a worse outcome than not having it.
+
+    The chaos run is TRACED (CTT_TRACE=1, docs/OBSERVABILITY.md — the
+    ISSUE-10 acceptance scenario): the merged Perfetto timeline must hold
+    spans from >= 2 processes (the submitter AND the surviving solver
+    worker, whose failure-path flush ran before its self-SIGKILL),
+    the `degraded:unsharded_solve` instant must land on the SAME timeline
+    as the blocks whose latency it caused, and `trace_summary.json` must
+    report per-site p50/p99 plus a critical path through the task DAG.
     """
     root = str(tmp_path)
     _, _, bmap = make_case(noise=0.02, seed=SEED)
@@ -517,6 +525,7 @@ def test_chaos_sharded_solve_killed_worker(tmp_path):
         extra_env={
             "CT_RT_WAIT_S": "10",      # surviving worker gives up fast
             "CT_RT_TIMEOUT_S": "240",
+            "CTT_TRACE": "1",          # the unified timeline, all processes
         },
     )
     assert proc.returncode == 0, (
@@ -539,3 +548,47 @@ def test_chaos_sharded_solve_killed_worker(tmp_path):
     rec = solve_recs[0]
     assert rec["resolved"] and rec["sites"] == {"solve": 1}
     assert rec["schema_version"] == 2
+
+    # -- unified timeline (docs/OBSERVABILITY.md): one merged Perfetto
+    # trace with spans from BOTH processes + the degrade instant ----------
+    with open(os.path.join(tmp_folder, "trace.json")) as f:
+        trace_doc = json.load(f)
+    events = trace_doc["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    span_pids = {e["pid"] for e in spans}
+    assert len(span_pids) >= 2, (
+        f"expected spans from >= 2 processes, got pids {span_pids}"
+    )
+    # the surviving solver worker's shard (flushed on its failure path
+    # before the self-SIGKILL) carries the lost reduce hop
+    hop_pids = {e["pid"] for e in spans if e["name"] == "solve.hop_wait"}
+    task_pids = {e["pid"] for e in spans if e["name"] == "task.run"}
+    assert hop_pids and task_pids and not (hop_pids & task_pids), (
+        "solver-worker spans must come from a different process than the "
+        f"submitter's task.run spans (hops {hop_pids}, tasks {task_pids})"
+    )
+    # the degrade instant sits on the SAME timeline as the blocks whose
+    # latency it caused: same pid as the executor/task spans, and block-
+    # grain executor spans exist alongside it
+    degrade = [
+        e for e in events
+        if e.get("ph") == "i" and e["name"] == "degraded:unsharded_solve"
+    ]
+    assert degrade, "degraded:unsharded_solve instant missing from timeline"
+    assert degrade[0]["pid"] in task_pids
+    assert any(
+        e["name"] in ("executor.load", "executor.store", "host.block")
+        and "block" in e.get("args", {})
+        for e in spans
+    ), "no per-block spans on the merged timeline"
+
+    # -- trace_summary.json: per-site latency aggregates + critical path --
+    with open(os.path.join(tmp_folder, "trace_summary.json")) as f:
+        summary = json.load(f)
+    assert summary["n_processes"] >= 2
+    for site, st in summary["sites"].items():
+        assert "p50_ms" in st and "p99_ms" in st, site
+    assert "task.run" in summary["sites"]
+    cp = summary["critical_path"]
+    assert cp and cp["tasks"] and cp["total_s"] > 0
+    assert summary["instants"].get("degraded:unsharded_solve", 0) >= 1
