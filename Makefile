@@ -1,11 +1,16 @@
 # Developer entry points.
 #   test            = lint, then tier-1 (fast; chaos excluded via the slow
-#                     marker), then the full chaos suite
+#                     marker), then tier-2, then the full chaos suite
 #   lint            = ctlint static analysis (docs/ANALYSIS.md): the
 #                     executor-contract / atomic-write / lock-discipline /
 #                     fault-coverage / jit-hygiene / drain-safety rules;
 #                     exit 1 on findings (CI gate)
 #   tier1           = the fast suite alone
+#   tier2           = the slow-marked non-chaos tests: a handful of
+#                     compile-heavy e2e variants (~2 min of XLA compiles)
+#                     whose coverage overlaps faster tier-1 siblings; kept
+#                     out of tier1 so the fast gate stays under its time
+#                     budget, still part of `make test`
 #   chaos           = the whole fault-injection suite, fixed seed — kills/
 #                     resume, the silent-failure scenarios (hang, chunk
 #                     corruption, job loss), and the resource-exhaustion /
@@ -54,10 +59,22 @@
 #                     determinism, and bit-identity into BENCH_r09.json;
 #                     cpu backend, <30 s (a <10 s smoke twin runs inside
 #                     tier1 via tests/test_reduce_tree.py)
-#   bench-trajectory= aggregate the BENCH_r01..r09 headline numbers into
+#   bench-serve     = traffic-shaped service bench (docs/SERVING.md): an
+#                     open-loop load generator (Poisson arrivals, mixed
+#                     request classes, 2 tenants + an aggressor phase)
+#                     against the resident server, recording p50/p99
+#                     latency, throughput, the cold-vs-warm split, and
+#                     per-tenant fairness into BENCH_r10.json; cpu
+#                     backend (a <10 s smoke twin runs inside tier1 via
+#                     tests/test_serve.py)
+#   bench-trajectory= aggregate the BENCH_r01..r10 headline numbers into
 #                     one table (stdout + rewritten into docs/PERFORMANCE.md
 #                     "Performance trajectory"), so the perf history is
-#                     readable without opening nine JSON files
+#                     readable without opening ten JSON files
+#   serve-smoke     = service-mode smoke (docs/SERVING.md): start the
+#                     resident server, submit concurrent tiny workflows
+#                     from two tenants, assert both complete with
+#                     warm-cache reuse visible in io_metrics; <10 s, cpu
 #   supervise-demo  = smoke-check recipe: watershed workflow on the
 #                     stub-slurm cluster target under an injected job loss,
 #                     printing the supervisor's resubmission log
@@ -65,17 +82,21 @@ PY ?= python
 CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
-.PHONY: test lint tier1 chaos chaos-resource failures-report progress \
-	bench-io bench-sweep bench-fuse bench-solve bench-trajectory \
-	supervise-demo native clean
+.PHONY: test lint tier1 tier2 chaos chaos-resource failures-report progress \
+	bench-io bench-sweep bench-fuse bench-solve bench-serve \
+	bench-trajectory serve-smoke supervise-demo native clean
 
-test: lint tier1 chaos
+test: lint tier1 tier2 chaos
 
 lint:
 	$(PY) -m cluster_tools_tpu.lint
 
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+tier2:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'slow and not chaos' \
 		--continue-on-collection-errors -p no:cacheprovider
 
 chaos:
@@ -104,6 +125,13 @@ bench-fuse:
 
 bench-solve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --solve
+
+bench-serve:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve
+
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve.py -q \
+		-k serve_smoke -p no:cacheprovider
 
 bench-trajectory:
 	$(PY) scripts/bench_trajectory.py --write

@@ -458,11 +458,11 @@ def ct003_lock_discipline(module: LintModule) -> List[Finding]:
 #: the real module when it is reachable on disk)
 _DEFAULT_SITES = frozenset({
     "load", "store", "io_read", "io_write", "submit", "task",
-    "block_done", "task_done", "compute", "kernel",
+    "block_done", "task_done", "compute", "kernel", "admit",
 })
 _DEFAULT_KINDS = frozenset({
     "error", "oom", "enospc", "hang", "corrupt", "nan",
-    "job_loss", "kill", "preempt", "spill",
+    "job_loss", "kill", "preempt", "spill", "reject",
 })
 
 #: hook callables whose first positional arg is a site name
@@ -603,7 +603,7 @@ def ct004_fault_site_coverage(module: LintModule) -> List[Finding]:
                 "preemption chaos cannot target block completion",
             ))
 
-    # (d) the 10-class registry itself
+    # (d) the 11-class registry itself
     if module.name == "faults.py" and "lint_fixtures" not in module.path:
         missing = _DEFAULT_KINDS - kinds
         if missing:
@@ -1152,6 +1152,161 @@ def ct008_trace_hygiene(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT009 - service-mode server hygiene
+# =============================================================================
+
+#: the service-mode surface (docs/SERVING.md): the resident server, its
+#: admission controller, and the serve CLI entry
+_CT009_SCOPE = ("server.py", "admission.py", "serve.py")
+
+#: storage-IO call segments additionally banned under the server's
+#: bookkeeping locks: every request handler, HTTP thread, and worker
+#: contends for the admission/request locks, so one filesystem call under
+#: them head-of-line-blocks the whole service
+_CT009_IO_CALLS = frozenset({
+    "open", "dump", "dumps", "load", "loads", "listdir", "replace",
+    "unlink", "remove", "makedirs", "save", "fsync", "read", "write",
+    "atomic_write_json", "record_failures", "dump_config", "_write_state",
+    "flush_namespace", "_json_report",
+})
+
+
+def _walk_inline(stmt: ast.AST) -> Iterable[ast.AST]:
+    """``ast.walk`` minus nested function/lambda bodies: a def or lambda
+    under a lock only DEFINES deferred code — what it calls runs after the
+    lock is released, so flagging it would be a false positive."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def ct009_server_hygiene(module: LintModule) -> List[Finding]:
+    """Service-mode hygiene for the resident server (docs/SERVING.md).
+
+    (a) **Admission-lock discipline**: the admission/request locks guard
+    pure bookkeeping only — no blocking calls (``.result``/``sleep``/
+    ``wait``/``join``) and no storage IO (``open``/``json.dump``/
+    ``atomic_write_json``/``record_failures``/...) while holding them.
+    Every submit, worker dispatch, and status probe contends for these
+    locks; one slow callee under them freezes the whole service.
+
+    (b) **Attributable request handlers**: every handler that runs a
+    workflow (``build(...)``) must do so under BOTH an ambient request
+    context (``admission.request_context``/``request_scope`` — handoff
+    identities lose their request namespace without it, letting
+    concurrent requests over the same paths resolve each other's
+    intermediates) and a trace task context (``trace.task_context`` —
+    otherwise the request's spans land on the resident timeline with no
+    request to belong to).
+
+    (c) **Drain protocol at the entry point**: any caller of
+    ``serve_until_drained()`` (which raises ``DrainInterrupt`` after the
+    drain finishes) must map it to ``REQUEUE_EXIT_CODE`` — a drained
+    server that exits nonzero-as-crash breaks the rolling-restart
+    protocol (docs/SERVING.md "Lifecycle").
+    """
+    is_fixture = "ct009" in module.name
+    if module.name not in _CT009_SCOPE and not is_fixture:
+        return []
+    out: List[Finding] = []
+
+    # -- (a) nothing slow under the server's bookkeeping locks -------------
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.With):
+            continue
+        keys = [
+            k for k in (
+                _lock_key(module, item.context_expr) for item in node.items
+            ) if k is not None
+        ]
+        if not keys:
+            continue
+        held = keys[-1]
+        for stmt in node.body:
+            for inner in _walk_inline(stmt):
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = dotted(inner.func)
+                seg = last_seg(name)
+                if seg is None:
+                    continue
+                if seg in _BLOCKING_CALLS or (name or "").startswith(
+                    "subprocess."
+                ):
+                    if seg == "join" and isinstance(
+                        inner.func, ast.Attribute
+                    ) and isinstance(inner.func.value, ast.Constant):
+                        continue  # "sep".join(...) is not a thread join
+                    out.append(Finding(
+                        "CT009", module.path, inner.lineno,
+                        inner.col_offset,
+                        f"blocking call '{name}' while holding server "
+                        f"lock '{held}': every submit/dispatch/status "
+                        "thread contends for it — wait outside the "
+                        "critical section (admission waits on the "
+                        "dispatch event, not under the lock)",
+                    ))
+                elif seg in _CT009_IO_CALLS:
+                    out.append(Finding(
+                        "CT009", module.path, inner.lineno,
+                        inner.col_offset,
+                        f"storage IO '{name}' under server lock "
+                        f"'{held}': state/failure writes must happen "
+                        "after release — snapshot under the lock, write "
+                        "outside it",
+                    ))
+
+    # -- (b) request handlers run under request + trace contexts -----------
+    for call in calls_in(module.tree):
+        if last_seg(dotted(call.func)) != "build":
+            continue
+        covered_req = covered_task = False
+        scope: Optional[ast.AST] = module.enclosing_function(call)
+        while scope is not None:
+            for c in calls_in(scope):
+                seg = last_seg(dotted(c.func))
+                if seg in ("request_context", "request_scope"):
+                    covered_req = True
+                elif seg == "task_context":
+                    covered_task = True
+            scope = module.enclosing_function(scope)
+        missing = []
+        if not covered_req:
+            missing.append("admission.request_context (handoff "
+                           "identities lose their request namespace)")
+        if not covered_task:
+            missing.append("trace.task_context (spans land on the "
+                           "resident timeline unattributed)")
+        if missing:
+            out.append(Finding(
+                "CT009", module.path, call.lineno, call.col_offset,
+                "request handler runs build() without "
+                + " or ".join(missing),
+            ))
+
+    # -- (c) serve entry points speak the drain protocol -------------------
+    for call in calls_in(module.tree):
+        if last_seg(dotted(call.func)) != "serve_until_drained":
+            continue
+        if not ("DrainInterrupt" in module.source
+                and "REQUEUE_EXIT_CODE" in module.source):
+            out.append(Finding(
+                "CT009", module.path, call.lineno, call.col_offset,
+                "serve_until_drained() raises DrainInterrupt after the "
+                "drain, but this entry point never maps it to "
+                "REQUEUE_EXIT_CODE: a SIGTERM'd server exits as a crash "
+                "instead of a rolling-restart requeue",
+            ))
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -1164,4 +1319,5 @@ RULES = {
     "CT006": ct006_drain_safety,
     "CT007": ct007_memory_target_contract,
     "CT008": ct008_trace_hygiene,
+    "CT009": ct009_server_hygiene,
 }

@@ -97,6 +97,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io import chunk_cache as chunk_cache_mod
 from ..io.containers import ChunkCorruptionError
+from . import admission as admission_mod
 from . import handoff as handoff_mod
 from ..utils import function_utils as fu
 from ..utils.volume_utils import Block, Blocking
@@ -837,6 +838,20 @@ class BlockwiseExecutor:
             note_failure(block, "load", attempts, last_tb, quarantine=True)
             return None
 
+        # service mode (docs/SERVING.md): store_fn may publish block-grain
+        # artifact handoffs, and those identities are namespaced by the
+        # thread-local request context — capture it on the sweep's thread
+        # and re-enter it on every pool-submitted worker (loads, stores,
+        # speculative re-runs), or a resident server's concurrent requests
+        # over the same paths could resolve each other's intermediates
+        _req_ctx = admission_mod.current_request()
+
+        def _scoped(fn):
+            def run(*a, **kw):
+                with admission_mod.request_scope(_req_ctx):
+                    return fn(*a, **kw)
+            return run
+
         def load_batch(batch_idx: int):
             batch = blocks[batch_idx * bs : (batch_idx + 1) * bs]
             # load_fn may return futures (e.g. io.prefetch.async_loader's
@@ -1090,7 +1105,7 @@ class BlockwiseExecutor:
                     if bid in speculated:
                         return
                     speculated.add(bid)
-                spec_futures.append(spec_pool.submit(speculative_rerun, blk))
+                spec_futures.append(spec_pool.submit(_scoped(speculative_rerun), blk))
 
             watchdog = Watchdog(
                 deadline,
@@ -1106,6 +1121,16 @@ class BlockwiseExecutor:
         if inflight_byte_budget is None:
             avail = host_mem_available_bytes()
             budget = int(avail * 0.25) if avail else 0
+            # tenant-tagged budgets (docs/SERVING.md): under a service-mode
+            # request context, the auto budget is additionally capped at
+            # the running request's share of its tenant's byte quota — one
+            # tenant's sweep cannot claim the whole host envelope away
+            # from its neighbors.  An explicit inflight_byte_budget (the
+            # operator's word) is never overridden.
+            tenant_cap = admission_mod.ambient_byte_cap()
+            if tenant_cap:
+                budget = min(budget, int(tenant_cap)) if budget \
+                    else int(tenant_cap)
             if budget and chunk_cache_mod.cache_enabled():
                 # the decompressed-chunk cache is co-resident host memory:
                 # subtract its byte budget from the same headroom probe so
@@ -1176,7 +1201,8 @@ class BlockwiseExecutor:
         try:
             with ThreadPoolExecutor(max_workers=self.io_threads) as pool:
                 pending_loads: List[Future] = [
-                    pool.submit(load_batch, i) for i in range(min(prefetch, n_batches))
+                    pool.submit(_scoped(load_batch), i)
+                    for i in range(min(prefetch, n_batches))
                 ]
                 write_futures: List[Future] = []
                 for i in range(n_batches):
@@ -1202,7 +1228,9 @@ class BlockwiseExecutor:
                     with stats_lock:
                         dispatch_stats["wait_s"] += waited
                     if i + prefetch < n_batches:
-                        pending_loads.append(pool.submit(load_batch, i + prefetch))
+                        pending_loads.append(
+                            pool.submit(_scoped(load_batch), i + prefetch)
+                        )
                     # prompt drain: surface finished stores (and any programming
                     # error in the store path, with its batch's block ids) now,
                     # not at the end of the run
@@ -1298,7 +1326,7 @@ class BlockwiseExecutor:
                         finally:
                             _release_inflight(nbytes)
 
-                    write_futures.append(pool.submit(store_batch))
+                    write_futures.append(pool.submit(_scoped(store_batch)))
                     # backpressure: each pending store closure pins its batch's
                     # DEVICE output buffers until its d2h copy runs, so the bound
                     # must be a small constant (not thread-count) or HBM fills

@@ -36,7 +36,13 @@ task runtime, and container IO layer call at their failure-relevant sites:
   instead of living only in host RAM.  The handoff layer
   (``runtime/handoff.py``) queries this at every dataset acquire / array
   publish, so chaos can force the consumer-side fallback-to-storage path
-  (and crash-resume from the spilled, checksummed copy) on demand.
+  (and crash-resume from the spilled, checksummed copy) on demand,
+- :meth:`FaultInjector.maybe_reject` — force a typed admission rejection
+  (``kind='reject'``, site ``admit``; docs/SERVING.md) for a tenant's
+  request at the service-mode admission gate (``runtime/server.py``), so
+  chaos can prove rejected requests are attributed in ``failures.json``
+  and leave no partial markers, manifests, or handoff entries behind.
+  Targeted by tenant name (``"tenants": [...]``) instead of block.
 
 Resource-exhaustion and preemption classes (docs/ROBUSTNESS.md "Graceful
 degradation") ride the same hooks:
@@ -96,7 +102,11 @@ Config schema::
         # tasks is written through to its storage spill path (set
         # fail_attempts high — the hook counts one attempt per publish)
         {"site": "publish", "kind": "spill", "fail_attempts": 1000000,
-         "tasks": ["watershed"]}
+         "tasks": ["watershed"]},
+        # service mode: tenant-b's first 2 submissions to the resident
+        # server are rejected with a typed backpressure error
+        {"site": "admit", "kind": "reject", "tenants": ["tenant-b"],
+         "fail_attempts": 2}
       ]
     }
 
@@ -158,6 +168,14 @@ _ENOSPC_SITES = ("store", "io_write")
 #: can prove consumers fall back to the stored (checksummed) copy and that
 #: crash-resume consumes it bit-identically.
 _SPILL_SITES = ("publish",)
+#: "admit" is the service-mode admission site (runtime/server.py): the
+#: moment a tenant's request asks to be queued.  A ``reject`` fault there
+#: forces a typed admission rejection (``rejected:fault``), so chaos can
+#: prove a rejected request is attributed in failures.json and leaves no
+#: partial markers, manifests, or handoff entries behind.  Targeting is by
+#: *tenant* (the ``tenants`` spec key), not block — admission has no
+#: blocks.
+_REJECT_SITES = ("admit",)
 #: maybe_fail kinds: all raise at the same hook, with their own exception
 #: types so the executor's *typed* classification is what gets exercised
 _FAIL_KINDS = ("error", "oom", "enospc")
@@ -314,6 +332,12 @@ class FaultInjector:
                         f"spill fault site must be one of {_SPILL_SITES}, "
                         f"got {site!r}"
                     )
+            elif kind == "reject":
+                if site not in _REJECT_SITES:
+                    raise ValueError(
+                        f"reject fault site must be one of {_REJECT_SITES}, "
+                        f"got {site!r}"
+                    )
             elif kind == "hang":
                 if site not in _HANG_SITES:
                     raise ValueError(
@@ -451,6 +475,36 @@ class FaultInjector:
         for idx, spec in enumerate(self.specs):
             if self._active(idx, spec, "publish", None, "spill") is not None:
                 return True
+        return False
+
+    def maybe_reject(self, tenant: Optional[str] = None) -> bool:
+        """True if this admission (site ``admit``, kind ``reject``) must
+        be rejected with a typed backpressure error — the service mode's
+        seeded per-tenant admission failure (docs/SERVING.md).  The
+        ``tenants`` spec key gates on the submitting tenant's name (no
+        key: every tenant); attempts count per ``(site, tenant)``, so
+        ``fail_attempts`` bounds how many of one tenant's submissions are
+        rejected and ``rate`` draws a seeded per-attempt coin."""
+        if not self.enabled:
+            return False
+        for idx, spec in enumerate(self.specs):
+            if spec.get("kind") != "reject" or spec.get("site") != "admit":
+                continue
+            tenants = spec.get("tenants")
+            if tenants is not None:
+                if tenant is None or str(tenant) not in {
+                    str(t) for t in tenants
+                }:
+                    continue
+            attempt = self._next_attempt("admit", tenant, idx)
+            if attempt > int(spec.get("fail_attempts", 1)):
+                continue
+            rate = spec.get("rate")
+            if rate is not None and self._unit(
+                "admit", tenant, attempt
+            ) >= float(rate):
+                continue
+            return True
         return False
 
     def lose_job(self) -> bool:

@@ -106,15 +106,49 @@ def handoff_budget() -> int:
     return 512 << 20
 
 
+def _request_namespace() -> Optional[str]:
+    """The running service request's id (docs/SERVING.md), or None in
+    batch mode.  Handoff identities are namespaced by it so two concurrent
+    requests over the SAME dataset paths can never resolve each other's
+    in-flight intermediates."""
+    from . import admission
+
+    ctx = admission.current_request()
+    return None if ctx is None else ctx.request_id
+
+
+def _namespaced(base: str) -> str:
+    ns = _request_namespace()
+    return f"req:{ns}::{base}" if ns else base
+
+
+def identity_namespace(identity: str) -> Optional[str]:
+    """The request id an identity was namespaced under, or None."""
+    identity = str(identity)
+    if identity.startswith("req:") and "::" in identity:
+        return identity[len("req:"):identity.index("::")]
+    return None
+
+
+def in_current_namespace(identity) -> bool:
+    """Whether ``identity`` belongs to THIS thread's request namespace
+    (both None in batch mode).  The resume contract depends on it: a
+    manifest recording a memory-only output from a *different* request's
+    namespace is unreachable for the current consumer and must re-run."""
+    return identity_namespace(str(identity)) == _request_namespace()
+
+
 def dataset_identity(path: str, key: str) -> str:
     """Stable identity of a chunked dataset handoff: the same (container
-    path, key) a storage consumer would open."""
-    return f"{os.path.abspath(path)}:{key}"
+    path, key) a storage consumer would open — prefixed with the service
+    request's namespace when one is active."""
+    return _namespaced(f"{os.path.abspath(path)}:{key}")
 
 
 def artifact_identity(path: str) -> str:
-    """Stable identity of an array-artifact handoff (an npz/npy path)."""
-    return os.path.abspath(path)
+    """Stable identity of an array-artifact handoff (an npz/npy path),
+    request-namespaced like :func:`dataset_identity`."""
+    return _namespaced(os.path.abspath(path))
 
 
 class _Entry:
@@ -268,6 +302,16 @@ def delta(snap: Dict[str, float]) -> Dict[str, float]:
 
 def live_bytes() -> int:
     return get_registry().live_bytes()
+
+
+def live_entries() -> int:
+    """Number of registry entries (any state).  The resident server
+    publishes this in ``server_state.json`` so the chaos suite can assert
+    from OUTSIDE the process that terminal requests released their
+    namespaces — no orphaned handoff entries accrete."""
+    reg = get_registry()
+    with reg._lock:
+        return len(reg._entries)
 
 
 # -- marker-epoch sentinel ----------------------------------------------------
@@ -769,6 +813,53 @@ def spill_for_headroom(need_bytes: Optional[int] = None) -> int:
             break
         freed += _spill_entry(entry, "headroom")
     return freed
+
+
+def _namespace_entries(request_id: str) -> List[_Entry]:
+    prefix = f"req:{request_id}::"
+    reg = get_registry()
+    with reg._lock:
+        return [
+            e for e in reg._entries.values()
+            if e.identity.startswith(prefix)
+        ]
+
+
+def flush_namespace(request_id: str, datasets_only: bool = True) -> int:
+    """Write a completed service request's live *dataset* handoffs back to
+    their storage paths (docs/SERVING.md): once the server reports a
+    request done, every client-visible chunked dataset must exist on
+    storage — later requests (or a restarted server) read it through the
+    ordinary fallback path.  Artifact intermediates (npz/npy inside the
+    request's tmp_folder) are private to the request and die with its
+    namespace, which is what preserves the fusion layer's
+    zero-intermediate-storage headline under service mode.  Returns bytes
+    flushed.  The write-back is a planned completion step, not a degrade,
+    so it is NOT attributed as ``degraded:spilled`` in failures.json."""
+    flushed = 0
+    for entry in _namespace_entries(request_id):
+        if datasets_only and entry.kind != "dataset":
+            continue
+        if entry.spilled or entry.obj is None or not entry.complete:
+            continue
+        entry.recorded = True  # suppress the degrade attribution
+        flushed += _spill_entry(entry, "service:finalize")
+    return flushed
+
+
+def release_request(request_id: str) -> int:
+    """Drop every registry entry of a request's namespace (terminal
+    states: done, failed, drained).  A resident server process must not
+    accrete dead request state, and a rejected/failed request must leave
+    no orphaned handoff entries behind — the chaos suite asserts this.
+    Returns the number of entries released."""
+    prefix = f"req:{request_id}::"
+    reg = get_registry()
+    with reg._lock:
+        doomed = [k for k in reg._entries if k.startswith(prefix)]
+        for k in doomed:
+            reg._entries.pop(k, None)
+    return len(doomed)
 
 
 def finalize_task(targets, uid: str) -> List[Dict[str, Any]]:

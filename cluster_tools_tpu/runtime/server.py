@@ -1,0 +1,758 @@
+"""Service mode: a resident, multi-tenant pipeline server (docs/SERVING.md).
+
+The batch CLI pays the cold tax on every invocation — compiled programs,
+the decompressed-chunk cache, and in-memory handoffs all die with the
+process (BENCH_r06 prices the difference at ~10x for the RAG+solve shape).
+:class:`PipelineServer` is the resident owner those assets were waiting
+for: one long-lived process that accepts concurrent workflow requests (the
+existing task DAGs — watershed, connected_components, multicut, inference)
+over a local HTTP endpoint, executes them on a small worker pool, and
+keeps every process-wide cache warm across requests.
+
+Admission is per-tenant (``runtime/admission.py``): quotas on queue depth,
+concurrent workflows, and bytes in flight, deficit-round-robin dispatch
+between tenants, queue deadlines, and typed backpressure errors — every
+rejection is recorded in the server's ``failures.json`` (resolution
+``rejected:*``), so admission failures are attributed like any other
+fault.  Each request runs under an ambient request context: handoff
+identities are namespaced by request id (two concurrent requests over the
+same dataset paths can never resolve each other's intermediates), the
+executor caps its inflight byte budget at the tenant's share, and a
+``task.run``-shaped trace span brackets the whole request so a
+``CTT_TRACE=<dir>``-pinned server lands every request's spans on one
+resident-process timeline.
+
+The operational surface is the planes the runtime already ships:
+
+- **Drain**: SIGTERM flips the PR-4 drain latch — admission stops,
+  in-flight requests drain at their safe block/task boundaries (markers +
+  manifests flushed), queued ones stay recorded for resubmission, and the
+  entry point exits ``REQUEUE_EXIT_CODE`` (114) so rolling restarts ride
+  the same protocol as every other preempted job.
+- **Status**: ``GET /status`` returns the machine-readable run report
+  (the ``failures_report.py --json`` document over the server's base
+  directory) plus live per-tenant admission stats; its ``rc`` field
+  preserves the report's exit-code semantics (1 on unresolved failures /
+  torn manifests).
+- **Progress**: the server heartbeats (``heartbeats/server.json``) and
+  maintains ``server_state.json`` next to its ``failures.json``;
+  ``scripts/progress.py`` renders per-tenant queue depth / in-flight /
+  completed alongside the block-marker view (``make progress`` against a
+  live server).
+
+Resident-owner handoff policy (docs/PERFORMANCE.md "Task-graph fusion"):
+``memory_handoffs`` defaults ON for the in-process workflows the server
+owns (cluster targets stay off — their memory dies with the remote job).
+When a request completes, its live *dataset* handoffs are written back to
+storage (the client-visible durability contract) and every entry of its
+namespace is released, so a resident process never accretes dead request
+state and rejected/failed requests leave no orphaned handoff entries.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import shutil
+import socket
+import threading
+import time
+import traceback
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from ..utils import function_utils as fu
+from ..utils import task_utils as tu
+from . import admission as admission_mod
+from . import faults as faults_mod
+from . import handoff as handoff_mod
+from . import trace as trace_mod
+from .supervision import (
+    DrainInterrupt,
+    HeartbeatWriter,
+    drain_reason,
+    drain_requested,
+)
+
+SERVER_UID = "server"
+STATE_FILENAME = "server_state.json"
+ENDPOINT_FILENAME = "server.json"
+
+#: completed/terminal request records kept in memory (oldest pruned)
+_MAX_RECORDS = 512
+
+_CLUSTER_TARGETS = ("slurm", "lsf")
+
+
+def _resolve_workflow(name: str):
+    """A workflow class from the CLI registry (``cluster_tools_tpu.cli.
+    WORKFLOWS``) or an explicit ``module:Class`` spec."""
+    from ..cli import WORKFLOWS
+
+    spec = WORKFLOWS.get(name, name)
+    if ":" not in spec:
+        raise ValueError(
+            f"unknown workflow {name!r}; known: {sorted(WORKFLOWS)} "
+            "(or pass an explicit 'module:Class' spec)"
+        )
+    mod_name, cls_name = spec.split(":", 1)
+    return getattr(importlib.import_module(mod_name), cls_name)
+
+
+class PipelineServer:
+    """The resident server: admission controller + worker pool + HTTP
+    endpoint + state/heartbeat files.  See the module docstring for the
+    architecture and docs/SERVING.md for the operator guide."""
+
+    def __init__(
+        self,
+        base_dir: str,
+        tenants: Optional[Dict[str, Dict[str, Any]]] = None,
+        default_quota: Optional[Dict[str, Any]] = None,
+        max_workers: int = 2,
+        default_est_bytes: int = 0,
+        default_max_jobs: int = 2,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.base_dir = os.path.abspath(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.failures_path = fu.failures_path(self.base_dir)
+        self.default_est_bytes = int(default_est_bytes)
+        self.default_max_jobs = int(default_max_jobs)
+        self.max_workers = max(1, int(max_workers))
+        quotas = {
+            name: admission_mod.TenantQuota.from_config(doc)
+            for name, doc in (tenants or {}).items()
+        }
+        self.controller = admission_mod.AdmissionController(
+            quotas=quotas,
+            default_quota=admission_mod.TenantQuota.from_config(default_quota),
+            on_reject=self._on_reject,
+        )
+        self._requests: "Dict[str, Dict[str, Any]]" = {}
+        self._requests_lock = threading.Lock()
+        self._reject_seq = 0
+        self._order: List[str] = []  # insertion order, for pruning
+        self._workers: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._heartbeat: Optional[HeartbeatWriter] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self.host = host
+        self.port = int(port)
+        self.started_at = trace_mod.walltime()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "PipelineServer":
+        """Bind the endpoint, start workers + heartbeat, and write the
+        endpoint file clients discover the port from."""
+        if trace_mod.enabled():
+            # one resident-process timeline: every request's spans land in
+            # the server's trace dir (an operator CTT_TRACE=<dir> pin
+            # targeting the same place is equivalent and also sticks)
+            trace_mod.set_trace_dir(
+                os.path.join(self.base_dir, trace_mod.TRACE_DIRNAME)
+            )
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), _RequestHandler
+        )
+        self._httpd.pipeline = self  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._http_thread.start()
+        self._heartbeat = HeartbeatWriter(
+            self.base_dir, SERVER_UID, interval_s=2.0
+        ).start()
+        for i in range(self.max_workers):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"serve-worker-{i}", daemon=True
+            )
+            t.start()
+            self._workers.append(t)
+        fu.atomic_write_json(
+            os.path.join(self.base_dir, ENDPOINT_FILENAME),
+            {
+                "host": self.host,
+                "port": self.port,
+                "pid": os.getpid(),
+                "hostname": socket.gethostname(),
+                "time": trace_mod.walltime(),
+            },
+        )
+        self._write_state()
+        return self
+
+    def serve_until_drained(self, poll_s: float = 0.2) -> None:
+        """Block until the drain latch flips (SIGTERM/SIGUSR1), then drain:
+        stop admission AND dispatch, let in-flight requests finish at
+        their safe boundaries, flush state, and raise
+        :class:`DrainInterrupt` for the entry point to map to
+        ``REQUEUE_EXIT_CODE`` (docs/ANALYSIS.md CT006/CT009).  The caller
+        must have installed the drain handler
+        (:func:`~cluster_tools_tpu.runtime.supervision.
+        install_drain_handler`)."""
+        while not drain_requested():
+            time.sleep(poll_s)
+        self.controller.begin_drain()
+        for t in self._workers:
+            t.join()
+        self._write_state()
+        self._teardown()
+        raise DrainInterrupt(
+            drain_reason() or "drain requested",
+        )
+
+    def stop(self) -> None:
+        """Cooperative shutdown for embedders/tests: stop dispatching,
+        wait for workers, tear the endpoint down.  No drain semantics —
+        use :meth:`serve_until_drained` for the SIGTERM protocol."""
+        self.controller.begin_drain()
+        self._stop.set()
+        for t in self._workers:
+            t.join(timeout=60.0)
+        self._write_state()
+        self._teardown()
+
+    def _teardown(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Admit one workflow request; returns ``{"request_id", "state"}``
+        or raises :class:`~cluster_tools_tpu.runtime.admission.
+        AdmissionError` with a typed backpressure code."""
+        tenant = str(payload.get("tenant") or "default")
+        request_id = str(payload.get("request_id") or f"{tenant}-{uuid.uuid4().hex[:12]}")
+        workflow = payload.get("workflow")
+        if not workflow:
+            raise ValueError("request payload needs a 'workflow' name")
+        _resolve_workflow(str(workflow))  # fail fast on unknown workflows
+        # seeded per-tenant admission faults (kind='reject' at site
+        # 'admit', runtime/faults.py): chaos proves a rejected request
+        # leaves no partial state behind — checked BEFORE any directory or
+        # record for the request exists
+        if faults_mod.get_injector().maybe_reject(tenant):
+            code = admission_mod.REJECT_FAULT
+            self.controller._reject(
+                admission_mod.Request(tenant=tenant, request_id=request_id),
+                tenant, code, "injected admit fault",
+            )
+            raise admission_mod.AdmissionError(
+                code, tenant, "injected admit fault"
+            )
+        request = admission_mod.Request(
+            tenant=tenant,
+            request_id=request_id,
+            est_bytes=int(payload.get("est_bytes")
+                          or self.default_est_bytes),
+            deadline_s=payload.get("deadline_s"),
+            payload=payload,
+        )
+        rec = {
+            "request_id": request_id,
+            "tenant": tenant,
+            "workflow": str(workflow),
+            "state": "queued",
+            "submitted": trace_mod.walltime(),
+            "queue_span": trace_mod.begin(
+                "server.queue", request=request_id, tenant=tenant
+            ),
+            "tmp_folder": self._tmp_folder(payload, request_id),
+        }
+        with self._requests_lock:
+            # duplicate check + insert under ONE acquisition: two racing
+            # submits with the same id must not both pass the check.  Only
+            # a LIVE record (or a completed one, whose outputs exist) makes
+            # the id a duplicate — a rejected/failed/drained record is
+            # replaceable, because the typed-backpressure protocol is
+            # "back off and resubmit the same request" and a poisoned id
+            # would turn every retry into rejected:duplicate.
+            existing = self._requests.get(request_id)
+            duplicate = existing is not None and existing.get("state") in (
+                "queued", "running", "done",
+            )
+            if not duplicate:
+                if existing is not None:
+                    self._order = [r for r in self._order if r != request_id]
+                self._requests[request_id] = rec
+                self._order.append(request_id)
+                self._prune_locked()
+        if duplicate:
+            # attributed like every other rejection; request=None because
+            # the live record under this id belongs to the ORIGINAL
+            # submission and must not be flipped to rejected
+            detail = f"request_id {request_id!r} already submitted"
+            self.controller._reject(
+                None, tenant, admission_mod.REJECT_DUPLICATE, detail
+            )
+            raise admission_mod.AdmissionError(
+                admission_mod.REJECT_DUPLICATE, tenant, detail
+            )
+        try:
+            self.controller.submit(request)
+        except admission_mod.AdmissionError as e:
+            with self._requests_lock:
+                rec["state"] = "rejected"
+                rec["code"] = e.code
+                rec["error"] = e.detail
+            self._write_state()
+            raise
+        self._write_state()
+        return {"request_id": request_id, "state": "queued"}
+
+    def _tmp_folder(self, payload: Dict[str, Any], request_id: str) -> str:
+        cfg = payload.get("config") or {}
+        return os.path.abspath(
+            cfg.get("tmp_folder")
+            or os.path.join(self.base_dir, "requests", request_id)
+        )
+
+    def _prune_locked(self) -> None:
+        while len(self._order) > _MAX_RECORDS:
+            victim = None
+            for rid in self._order:
+                if self._requests.get(rid, {}).get("state") not in (
+                    "queued", "running",
+                ):
+                    victim = rid
+                    break
+            if victim is None:
+                break
+            self._order.remove(victim)
+            self._requests.pop(victim, None)
+
+    # -- rejection attribution --------------------------------------------
+    def _on_reject(self, request, tenant, code, detail) -> None:
+        """Called by the admission controller for every rejection (never
+        under its lock): attribute it in the server's failures.json and
+        update the request record when one exists (deadline expiries)."""
+        request_id = getattr(request, "request_id", None)
+        if request_id is not None:
+            with self._requests_lock:
+                rec = self._requests.get(request_id)
+                if rec is not None and rec["state"] in ("queued", "running"):
+                    rec["state"] = "rejected"
+                    rec["code"] = code
+                    rec["error"] = detail
+        # every rejection is its own attributable unit: record_failures
+        # keys records by (task, block_id), so a shared key would make a
+        # tenant's later rejections silently replace earlier ones (incl.
+        # across a restart — the rejection history is the point)
+        with self._requests_lock:
+            self._reject_seq += 1
+            seq = self._reject_seq
+        try:
+            fu.record_failures(
+                self.failures_path,
+                f"server.{tenant}",
+                [{
+                    "block_id": (
+                        f"admit:{request_id or tenant}:{os.getpid()}:{seq}"
+                    ),
+                    "sites": {"admit": 1},
+                    "error": detail or None,
+                    "quarantined": False,
+                    "resolved": True,
+                    "resolution": code,
+                    "tenant": tenant,
+                    "request": request_id,
+                }],
+            )
+        except Exception:
+            pass  # attribution is best-effort; the rejection already stands
+        trace_mod.instant(
+            "server.reject", tenant=tenant, code=code,
+            request=request_id or "",
+        )
+        self._write_state()
+
+    # -- execution ---------------------------------------------------------
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            if drain_requested():
+                # SIGTERM: make sure admission/dispatch latch and stop
+                # claiming queued requests; the in-flight ones (other
+                # workers) drain at their own safe boundaries
+                self.controller.begin_drain()
+            request = self.controller.next_request(timeout=0.2)
+            if request is None:
+                if self.controller.draining():
+                    return
+                continue
+            state = None
+            try:
+                state = self._execute(request)
+            finally:
+                # failed/drained requests release their claims but must not
+                # count as completed in the tenant stats
+                self.controller.release(request, completed=state == "done")
+                self._write_state()
+
+    def _execute(self, request: admission_mod.Request) -> None:
+        from .task import build
+
+        payload = request.payload or {}
+        rid = request.request_id
+        with self._requests_lock:
+            rec = self._requests.get(rid) or {"request_id": rid}
+            rec["state"] = "running"
+            qspan = rec.pop("queue_span", None)
+            rec["queued_s"] = round(qspan.end(), 6) if qspan is not None else None
+        self._write_state()
+        run_span = trace_mod.begin(
+            "server.request", request=rid, tenant=request.tenant,
+            workflow=str(payload.get("workflow")),
+        )
+        state, error = "failed", None
+        try:
+            # the ambient request context: handoff namespacing + the
+            # executor's tenant byte cap; the task_context puts the whole
+            # request on the resident timeline (docs/ANALYSIS.md CT009)
+            with admission_mod.request_context(
+                request.tenant, rid, byte_cap=request.byte_cap
+            ):
+                with trace_mod.task_context(
+                    f"request.{rid}", tenant=request.tenant
+                ):
+                    wf = self._instantiate(payload, rid)
+                    ok = build([wf], rerun=bool(payload.get("rerun")))
+                    if ok:
+                        # client-visible durability: live dataset handoffs
+                        # are written back before the request reports done
+                        handoff_mod.flush_namespace(rid)
+                    state = "done" if ok else "failed"
+        except DrainInterrupt as e:
+            # graceful preemption mid-request: markers/manifests are
+            # flushed — the resubmitted request resumes at block grain
+            state, error = "drained", str(e)
+        except Exception:
+            error = fu.cap_traceback(traceback.format_exc())
+        finally:
+            # drop the request's namespace: a resident process must not
+            # accrete dead request state (memory-only intermediates died
+            # with the request; datasets were flushed above on success)
+            handoff_mod.release_request(rid)
+        run_s = run_span.end(error=state != "done")
+        with self._requests_lock:
+            rec["state"] = state
+            rec["run_s"] = round(run_s, 6)
+            rec["total_s"] = round((rec.get("queued_s") or 0.0) + run_s, 6)
+            rec["finished"] = trace_mod.walltime()
+            if error:
+                rec["error"] = error
+        return state
+
+    def _instantiate(self, payload: Dict[str, Any], request_id: str):
+        cls = _resolve_workflow(str(payload.get("workflow")))
+        cfg = payload.get("config") or {}
+        tmp_folder = self._tmp_folder(payload, request_id)
+        target = str(cfg.get("target", "local"))
+        config_dir = self._materialize_config(cfg, tmp_folder, target)
+        return cls(
+            tmp_folder=tmp_folder,
+            config_dir=config_dir,
+            max_jobs=int(cfg.get("max_jobs", self.default_max_jobs)),
+            target=target,
+            **(cfg.get("params") or {}),
+        )
+
+    def _materialize_config(self, cfg: Dict[str, Any], tmp_folder: str,
+                            target: str) -> str:
+        """The request's effective config_dir: the caller's base configs
+        (if any) overlaid with the request's ``global_config`` and the
+        resident-owner defaults — ``memory_handoffs`` ON for in-process
+        targets (the PR-8 default-off note was waiting for exactly this
+        owner; cluster targets stay off because their memory dies with the
+        remote job).  An explicit caller value always wins."""
+        out_dir = os.path.join(tmp_folder, "server_config")
+        os.makedirs(out_dir, exist_ok=True)
+        base = cfg.get("config_dir")
+        doc: Dict[str, Any] = {}
+        if base and os.path.isdir(base):
+            for fname in sorted(os.listdir(base)):
+                if not fname.endswith(".config"):
+                    continue
+                if fname == "global.config":
+                    doc.update(tu.load_config(os.path.join(base, fname)))
+                else:
+                    shutil.copy(
+                        os.path.join(base, fname),
+                        os.path.join(out_dir, fname),
+                    )
+        doc.update(cfg.get("global_config") or {})
+        if target not in _CLUSTER_TARGETS:
+            doc.setdefault("memory_handoffs", True)
+        tu.dump_config(os.path.join(out_dir, "global.config"), doc)
+        return out_dir
+
+    # -- introspection -----------------------------------------------------
+    def request_record(self, request_id: str) -> Optional[Dict[str, Any]]:
+        with self._requests_lock:
+            rec = self._requests.get(request_id)
+            if rec is None:
+                return None
+            return {k: v for k, v in rec.items() if k != "queue_span"}
+
+    def _state_doc(self) -> Dict[str, Any]:
+        with self._requests_lock:
+            requests = {
+                rid: {
+                    k: rec.get(k)
+                    for k in ("tenant", "workflow", "state", "queued_s",
+                              "run_s", "total_s", "code")
+                    if rec.get(k) is not None
+                }
+                for rid, rec in self._requests.items()
+            }
+        return {
+            "version": 1,
+            "uid": SERVER_UID,
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "host": self.host,
+            "port": self.port,
+            "time": trace_mod.walltime(),
+            "started": self.started_at,
+            "draining": self.controller.draining() or drain_requested(),
+            "tenants": self.controller.snapshot(),
+            "requests": requests,
+            # the resident caches' pulse: chaos asserts live_entries goes
+            # back to 0 once every request is terminal (no orphaned
+            # handoff state), and operators see what "warm" is worth
+            "handoffs": {
+                "live_entries": handoff_mod.live_entries(),
+                "live_bytes": int(handoff_mod.live_bytes()),
+            },
+        }
+
+    def _write_state(self) -> None:
+        """Atomically refresh ``server_state.json`` — the file
+        ``scripts/progress.py`` renders the per-tenant view from.  Never
+        called under the admission lock (docs/ANALYSIS.md CT009);
+        best-effort, the server must outlive a full disk."""
+        try:
+            fu.atomic_write_json(
+                os.path.join(self.base_dir, STATE_FILENAME), self._state_doc()
+            )
+        except OSError:
+            pass
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/status`` document: the machine-readable run report
+        (``failures_report.py --json`` over the server's base dir, lint
+        pass skipped — it is a static repo property, not run state) plus
+        the live server/tenant stats.  ``rc`` preserves the report's
+        exit-code semantics: 1 on unresolved failures or a torn
+        manifest."""
+        report = self._json_report()
+        failures = (report or {}).get("failures") or {}
+        rc = 1 if (failures.get("error") or failures.get("n_unresolved")) else 0
+        return {"server": self._state_doc(), "report": report, "rc": rc}
+
+    def _json_report(self) -> Optional[Dict[str, Any]]:
+        try:
+            report_mod = _load_failures_report()
+            if report_mod is not None:
+                return report_mod.build_json_report(
+                    self.base_dir, with_lint=False
+                )
+        except Exception:
+            pass
+        # minimal fallback (scripts/ not shipped next to the package):
+        # same rc-relevant fields, straight from failures.json
+        doc = fu.read_json_if_valid(self.failures_path)
+        records = (doc or {}).get("records", [])
+        error = None
+        if doc is None and os.path.exists(self.failures_path):
+            error = "torn failures manifest"
+        return {
+            "version": 1,
+            "tmp_folder": self.base_dir,
+            "failures": {
+                "error": error,
+                "n_records": len(records),
+                "n_unresolved": sum(
+                    1 for r in records if not r.get("resolved")
+                ),
+            },
+        }
+
+
+def _load_failures_report():
+    """``scripts/failures_report.py`` as a module, located relative to the
+    package checkout (None when not present — installed without scripts)."""
+    import importlib.util
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(os.path.dirname(pkg_dir), "scripts",
+                        "failures_report.py")
+    if not os.path.isfile(path):
+        return None
+    spec = importlib.util.spec_from_file_location("_ctt_failures_report", path)
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- HTTP plumbing ------------------------------------------------------------
+
+
+class _RequestHandler(BaseHTTPRequestHandler):
+    """Minimal JSON-over-HTTP surface: POST /submit, GET /status,
+    GET /request/<id>, GET /healthz.  Local-endpoint only by default
+    (the server binds 127.0.0.1)."""
+
+    server_version = "ctt-serve/1"
+
+    @property
+    def pipeline(self) -> PipelineServer:
+        return self.server.pipeline  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # quiet: the state file is the log
+        pass
+
+    def _reply(self, code: int, doc: Dict[str, Any]) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path.rstrip("/") != "/submit":
+            self._reply(404, {"error": "not_found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, OSError) as e:
+            self._reply(400, {"error": "bad_request", "detail": str(e)})
+            return
+        try:
+            self._reply(200, self.pipeline.submit(payload))
+        except admission_mod.AdmissionError as e:
+            http = 503 if e.code == admission_mod.REJECT_DRAINING else 429
+            self._reply(http, {
+                "error": e.code, "tenant": e.tenant, "detail": e.detail,
+            })
+        except (ValueError, KeyError) as e:
+            self._reply(400, {"error": "bad_request", "detail": str(e)})
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.rstrip("/")
+        if path == "/healthz":
+            self._reply(200, {
+                "ok": True,
+                "draining": self.pipeline.controller.draining()
+                or drain_requested(),
+            })
+        elif path == "/status":
+            self._reply(200, self.pipeline.status())
+        elif path.startswith("/request/"):
+            rec = self.pipeline.request_record(path[len("/request/"):])
+            if rec is None:
+                self._reply(404, {"error": "unknown_request"})
+            else:
+                self._reply(200, rec)
+        else:
+            self._reply(404, {"error": "not_found"})
+
+
+# -- client -------------------------------------------------------------------
+
+
+class ServeRejected(RuntimeError):
+    """Client-side view of a typed admission rejection."""
+
+    def __init__(self, code: str, detail: str = "", http_status: int = 429):
+        self.code = code
+        self.detail = detail
+        self.http_status = http_status
+        super().__init__(f"{code} (http {http_status}): {detail}")
+
+
+class ServeClient:
+    """Stdlib HTTP client for the serve endpoint (tests, the load
+    generator, operator scripts)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+
+    @classmethod
+    def from_endpoint_file(cls, base_dir: str,
+                           timeout_s: float = 30.0) -> "ServeClient":
+        doc = fu.read_json_if_valid(
+            os.path.join(base_dir, ENDPOINT_FILENAME)
+        )
+        if not doc:
+            raise FileNotFoundError(
+                f"no server endpoint file under {base_dir!r}"
+            )
+        return cls(doc["host"], doc["port"], timeout_s=timeout_s)
+
+    def _call(self, method: str, path: str,
+              body: Optional[Dict[str, Any]] = None) -> tuple:
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        try:
+            data = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if data else {}
+            conn.request(method, path, body=data, headers=headers)
+            resp = conn.getresponse()
+            doc = json.loads(resp.read() or b"{}")
+            return resp.status, doc
+        finally:
+            conn.close()
+
+    def submit(self, **payload) -> Dict[str, Any]:
+        status, doc = self._call("POST", "/submit", payload)
+        if status != 200:
+            raise ServeRejected(
+                str(doc.get("error")), str(doc.get("detail") or ""),
+                http_status=status,
+            )
+        return doc
+
+    def status(self) -> Dict[str, Any]:
+        return self._call("GET", "/status")[1]
+
+    def request(self, request_id: str) -> Optional[Dict[str, Any]]:
+        status, doc = self._call("GET", f"/request/{request_id}")
+        return None if status == 404 else doc
+
+    def wait(self, request_id: str, timeout_s: float = 120.0,
+             poll_s: float = 0.05) -> Dict[str, Any]:
+        """Poll until the request reaches a terminal state; returns its
+        record.  Raises TimeoutError when it stays live past
+        ``timeout_s``."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            rec = self.request(request_id)
+            if rec is not None and rec.get("state") not in (
+                "queued", "running",
+            ):
+                return rec
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"request {request_id} still "
+                    f"{(rec or {}).get('state')!r} after {timeout_s:g}s"
+                )
+            time.sleep(poll_s)

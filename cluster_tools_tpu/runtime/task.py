@@ -545,10 +545,18 @@ class BaseTask:
 
             # resolvable = live in memory OR spilled since the manifest
             # was written (a post-completion headroom spill leaves a valid
-            # checksummed storage copy — not a reason to recompute)
+            # checksummed storage copy — not a reason to recompute).  Under
+            # service mode the identity must also belong to THIS request's
+            # namespace: a resubmitted request must never trust a manifest
+            # whose memory-only outputs live under a previous request's id
+            # (its consumers resolve through the new namespace and would
+            # find a hole) — docs/SERVING.md.
             stale = [
                 h for h in stale
-                if not handoff.is_resolvable(h.get("identity"))
+                if not (
+                    handoff.in_current_namespace(h.get("identity"))
+                    and handoff.is_resolvable(h.get("identity"))
+                )
             ]
         if not stale:
             return True
@@ -597,6 +605,7 @@ class BaseTask:
         (OOM/ENOSPC) skip the same-size retries — re-running the exact
         allocation that just failed only burns the budget.
         """
+        from . import admission as admission_mod
         from .supervision import (
             DrainInterrupt,
             Watchdog,
@@ -643,6 +652,14 @@ class BaseTask:
                 _on_hung,
             ).start()
 
+        # service mode (docs/SERVING.md): the ambient request context is
+        # thread-local, but process() may publish block-grain artifact
+        # handoffs from THIS pool's worker threads — capture the context
+        # here and re-enter it per block, or those identities would lose
+        # their request namespace and concurrent requests over the same
+        # dataset paths could resolve each other's intermediates
+        req_ctx = admission_mod.current_request()
+
         def wrapped(block_id):
             if drain_requested():
                 # drain latch flipped (SIGTERM): stop claiming blocks; the
@@ -652,7 +669,7 @@ class BaseTask:
             last_tb, attempts = None, 0
             # the span covers the whole retry ladder — the latency an
             # operator chases is time-to-markered, not per-attempt time
-            with trace_mod.span(
+            with admission_mod.request_scope(req_ctx), trace_mod.span(
                 "host.block", block=int(block_id), task=self.uid
             ):
                 for k in range(io_retries + 1):

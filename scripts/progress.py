@@ -25,6 +25,14 @@ States:
 ``--json`` emits the same as a machine-readable document (for dashboards
 and the service mode's admission view).  Stdlib-only on purpose: the
 operator view must work on a bare login node without jax.
+
+Service mode (docs/SERVING.md): pointed at a resident server's base dir
+(``make progress TMP=/srv/ctt``), the same invocation additionally renders
+the per-tenant admission view from ``server_state.json`` + the server
+heartbeat — queue depth, in-flight, completed/rejected counts, bytes in
+flight, and the request table — alongside the block-marker view of
+whatever requests keep their tmp folders underneath.  A stale server
+heartbeat (or a dead pid on this host) warns exactly like a stalled task.
 """
 
 from __future__ import annotations
@@ -117,6 +125,44 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
                 "age_s": (now - mt) if mt else None,
             }
 
+    # -- service mode: the resident server's admission view ---------------
+    server = None
+    server_state = _read_json(os.path.join(tmp_folder, "server_state.json"))
+    if server_state is not None:
+        hb = heartbeats.get("server")
+        hb_age = hb["age_s"] if hb else None
+        hb_doc = (hb or {}).get("doc") or {}
+        pid = server_state.get("pid") or hb_doc.get("pid")
+        pid_dead = bool(
+            pid is not None
+            and (server_state.get("hostname") or hb_doc.get("host"))
+            == socket.gethostname()
+            and not _pid_alive(pid)
+        )
+        stale = pid_dead or (
+            hb_age is not None and hb_age > stale_after_s
+        )
+        states = defaultdict(int)
+        for rec in (server_state.get("requests") or {}).values():
+            states[str(rec.get("state"))] += 1
+        server = {
+            "pid": pid,
+            "hostname": server_state.get("hostname"),
+            "port": server_state.get("port"),
+            "draining": bool(server_state.get("draining")),
+            "heartbeat_age_s": (
+                round(hb_age, 1) if hb_age is not None else None
+            ),
+            "stale": stale,
+            "tenants": server_state.get("tenants") or {},
+            "request_states": dict(states),
+            "handoffs": server_state.get("handoffs") or {},
+        }
+        # the server's own heartbeat is rendered in the server section,
+        # not as a phantom task row
+        heartbeats.pop("server", None)
+        uids.discard("server")
+
     fail_doc = _read_json(os.path.join(tmp_folder, "failures.json")) or {}
     by_task = defaultdict(lambda: {"quarantined": 0, "unresolved": 0,
                                    "records": 0})
@@ -129,6 +175,11 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
             t["quarantined"] += 1
         if not rec.get("resolved"):
             t["unresolved"] += 1
+
+    if server is not None:
+        # admission attributions (task name ``server.<tenant>``) belong to
+        # the server section / failures-report, not the block-marker table
+        uids = {u for u in uids if not u.startswith("server.")}
 
     tasks = []
     for uid in sorted(uids):
@@ -177,8 +228,52 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
         "time": now,
         "stale_after_s": float(stale_after_s),
         "tasks": tasks,
+        "server": server,
         "traced": os.path.isdir(os.path.join(tmp_folder, "trace")),
     }
+
+
+def _format_server(server) -> list:
+    """The per-tenant admission view of a resident server
+    (docs/SERVING.md): one line per tenant, then the request-state tally."""
+    state = "DRAINING" if server["draining"] else "serving"
+    if server["stale"]:
+        state += " (STALE?)"
+    where = f"{server.get('hostname') or '?'}:{server.get('port') or '?'}"
+    hb = (
+        f", heartbeat {server['heartbeat_age_s']:.1f}s ago"
+        if server.get("heartbeat_age_s") is not None else ""
+    )
+    lines = [f"  server {where}  pid {server.get('pid')}  {state}{hb}"]
+    tenants = server.get("tenants") or {}
+    if tenants:
+        width = max(len(t) for t in tenants)
+        for name, s in sorted(tenants.items()):
+            bits = [
+                f"{s.get('queued', 0)} queued",
+                f"{s.get('inflight', 0)} in-flight",
+                f"{s.get('completed', 0)} completed",
+            ]
+            if s.get("rejected"):
+                bits.append(f"{s['rejected']} rejected")
+            if s.get("bytes_in_flight"):
+                bits.append(f"{s['bytes_in_flight'] / 1e6:.1f}MB in flight")
+            lines.append(f"    tenant {name:<{width}}  " + ", ".join(bits))
+    else:
+        lines.append("    no tenants seen yet")
+    states = server.get("request_states") or {}
+    if states:
+        tally = ", ".join(
+            f"{n} {st}" for st, n in sorted(states.items())
+        )
+        lines.append(f"    requests: {tally}")
+    hand = server.get("handoffs") or {}
+    if hand.get("live_entries"):
+        lines.append(
+            f"    handoffs resident: {hand['live_entries']} entries, "
+            f"{hand.get('live_bytes', 0) / 1e6:.1f}MB"
+        )
+    return lines
 
 
 def format_progress(doc) -> str:
@@ -189,6 +284,13 @@ def format_progress(doc) -> str:
         "task(s) done"
         + (", traced" if doc.get("traced") else "") + ")"
     ]
+    if doc.get("server") is not None:
+        lines.extend(_format_server(doc["server"]))
+        if doc["server"]["stale"]:
+            lines.append(
+                "  WARNING: server looks dead (stale heartbeat or dead "
+                "pid) — requests will queue forever; restart it"
+            )
     if not tasks:
         lines.append("  no tasks seen yet (no markers, manifests, "
                      "heartbeats, or failure records)")
@@ -248,7 +350,10 @@ def main(argv) -> int:
     else:
         print(format_progress(doc))
     # rc mirrors the operator's concern: something stalled or failed -> 1
+    # (a dead resident server counts — its queues rot silently otherwise)
     bad = any(t["state"] in ("stalled?", "failed") for t in doc["tasks"])
+    if doc.get("server") is not None and doc["server"]["stale"]:
+        bad = True
     return 1 if bad else 0
 
 

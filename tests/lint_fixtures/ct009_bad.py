@@ -1,0 +1,35 @@
+"""CT009 fixture: blocking + storage IO under the admission lock, a
+request handler without request/trace contexts, a deaf serve entry."""
+
+import json
+import threading
+import time
+
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils import function_utils as fu
+
+
+class Controller:
+    def __init__(self):
+        self._admission_lock = threading.Lock()
+        self._queue = []
+
+    def submit(self, request, fut, path, doc):
+        with self._admission_lock:
+            time.sleep(0.1)  # blocking under the admission lock
+            fut.result()  # a stuck request freezes every submitter
+            with open(path, "w") as f:  # storage IO under the lock
+                json.dump(doc, f)
+            fu.atomic_write_json(path, doc)  # helper IO is still IO
+            self._queue.append(request)
+
+
+def handle_request(workflow):
+    # no request_context: handoff identities lose their namespace;
+    # no task_context: the request's spans land unattributed
+    return build([workflow])
+
+
+def main(server):
+    server.serve_until_drained()  # DrainInterrupt never mapped to 114
+    return 0

@@ -1,0 +1,47 @@
+"""CT009 fixture: pure-bookkeeping lock bodies, contextful request
+handler, drain-correct serve entry (clean)."""
+
+import sys
+import threading
+
+from cluster_tools_tpu.runtime import admission
+from cluster_tools_tpu.runtime import trace
+from cluster_tools_tpu.runtime.supervision import (
+    REQUEUE_EXIT_CODE,
+    DrainInterrupt,
+)
+from cluster_tools_tpu.runtime.task import build
+from cluster_tools_tpu.utils import function_utils as fu
+
+
+class Controller:
+    def __init__(self):
+        self._admission_lock = threading.Lock()
+        self._queue = []
+        self._rejected = 0
+
+    def submit(self, request, path, doc):
+        with self._admission_lock:
+            # bookkeeping only under the lock; IO happens after release
+            self._queue.append(request)
+            self._rejected += 1
+            snapshot = dict(doc)
+        fu.atomic_write_json(path, snapshot)
+
+
+def handle_request(tenant, rid, workflow):
+    with admission.request_context(tenant, rid):
+        with trace.task_context(f"request.{rid}", tenant=tenant):
+            return build([workflow])
+
+
+def main(server):
+    try:
+        server.serve_until_drained()
+    except DrainInterrupt:
+        return REQUEUE_EXIT_CODE
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(None))

@@ -592,3 +592,225 @@ def test_chaos_sharded_solve_killed_worker(tmp_path):
     cp = summary["critical_path"]
     assert cp and cp["tasks"] and cp["total_s"] > 0
     assert summary["instants"].get("degraded:unsharded_solve", 0) >= 1
+
+
+# -- service mode (docs/SERVING.md) -------------------------------------------
+
+
+def _start_serve(srv_dir, env, max_workers=1, config=None):
+    """Launch the real serve CLI as a subprocess and wait for its
+    endpoint.  Returns ``(proc, client)``."""
+    import time
+
+    from cluster_tools_tpu.runtime.server import ServeClient
+
+    args = [
+        sys.executable, "-m", "cluster_tools_tpu.serve",
+        "--base-dir", srv_dir, "--max-workers", str(max_workers),
+    ]
+    if config is not None:
+        cfg_path = os.path.join(srv_dir, "serve_config.json")
+        os.makedirs(srv_dir, exist_ok=True)
+        with open(cfg_path, "w") as f:
+            json.dump(config, f)
+        args += ["--config", cfg_path]
+    proc = subprocess.Popen(
+        args, env=env, cwd=REPO_ROOT, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    endpoint = os.path.join(srv_dir, "server.json")
+    deadline = time.monotonic() + 60
+    while True:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"server died on startup rc={proc.returncode}:\n"
+                f"{proc.stdout.read()[-4000:]}"
+            )
+        try:
+            with open(endpoint) as f:
+                doc = json.load(f)
+            if doc.get("pid") == proc.pid:  # THIS incarnation's endpoint
+                break
+        except (OSError, ValueError):
+            pass
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError("server endpoint never appeared")
+        time.sleep(0.05)
+    return proc, ServeClient(doc["host"], doc["port"])
+
+
+def _submit_riding_backpressure(client, payload, rejected_log):
+    """Submit like a real client: a typed 429 (the injected admit fault)
+    is recorded and retried — backpressure is a protocol, not a crash."""
+    import time
+
+    from cluster_tools_tpu.runtime.server import ServeRejected
+
+    for _ in range(10):
+        try:
+            return client.submit(**payload)
+        except ServeRejected as e:
+            rejected_log.append((payload["tenant"], e.code))
+            time.sleep(0.05)
+    raise AssertionError(f"request never admitted: {payload['request_id']}")
+
+
+def test_chaos_serve_sigterm_drain_restart_and_admit_rejects(tmp_path):
+    """ISSUE 12 acceptance: the resident server under mixed two-tenant
+    traffic with seeded per-tenant admission faults survives a mid-traffic
+    SIGTERM by the book.
+
+    - tenant bob's first submission per server process is rejected by the
+      injected ``reject`` fault at site ``admit`` (``rejected:fault`` in
+      the server's failures.json, typed 429 on the wire) and leaves NO
+      partial state: no tmp folder, no markers, no handoff entries;
+    - SIGTERM mid-traffic drains: the in-flight request finishes at a safe
+      boundary, queued requests stay queued, every request namespace is
+      released (zero live handoff entries in the final state file), and
+      the process exits REQUEUE_EXIT_CODE (114);
+    - a restarted server resumes: re-submitted requests complete, and
+      every output is BIT-IDENTICAL to a single-tenant cold batch run.
+    """
+    import signal
+    import time
+
+    root = str(tmp_path)
+    rng = np.random.default_rng(SEED)
+    vol = (rng.random((16, 16, 16)) > 0.5).astype("float32")
+    data = os.path.join(root, "data.zarr")
+    ds = file_reader(data).create_dataset(
+        "mask", shape=vol.shape, chunks=(8, 8, 8), dtype="float32")
+    ds[...] = vol
+
+    # -- reference: single-tenant cold batch run (memory_handoffs on,
+    # matching the server's resident-owner default) -----------------------
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.connected_components import (
+        ConnectedComponentsWorkflow,
+    )
+
+    ref_dir = os.path.join(root, "ref")
+    os.makedirs(os.path.join(ref_dir, "config"), exist_ok=True)
+    with open(os.path.join(ref_dir, "config", "global.config"), "w") as f:
+        json.dump({"block_shape": [8, 8, 8], "memory_handoffs": True}, f)
+    assert build([ConnectedComponentsWorkflow(
+        tmp_folder=os.path.join(ref_dir, "tmp"),
+        config_dir=os.path.join(ref_dir, "config"),
+        max_jobs=2, target="local",
+        input_path=data, input_key="mask",
+        output_path=data, output_key="ref_seg", threshold=0.5,
+    )])
+    ref_seg = np.asarray(file_reader(data, "r")["ref_seg"][...])
+
+    # -- the server, with the admission fault armed ------------------------
+    srv = os.path.join(root, "srv")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["CTT_FAULTS"] = json.dumps({
+        "seed": SEED,
+        "faults": [{"site": "admit", "kind": "reject",
+                    "tenants": ["bob"], "fail_attempts": 1}],
+    })
+
+    def payload(tenant, rid, out_key):
+        return dict(
+            tenant=tenant, request_id=rid,
+            workflow="connected_components",
+            config=dict(
+                tmp_folder=os.path.join(root, "req_" + rid),
+                global_config={"block_shape": [8, 8, 8]},
+                params=dict(input_path=data, input_key="mask",
+                            output_path=data, output_key=out_key,
+                            threshold=0.5),
+            ),
+        )
+
+    requests = [("alice", f"a{i}", f"seg_a{i}") for i in range(3)] \
+        + [("bob", f"b{i}", f"seg_b{i}") for i in range(3)]
+
+    proc, client = _start_serve(srv, env, max_workers=1)
+    rejected = []
+    for tenant, rid, key in requests:
+        _submit_riding_backpressure(client, payload(tenant, rid, key),
+                                    rejected)
+    # the injected fault fired exactly once (bob's first submission),
+    # was typed, and left no partial state behind
+    assert rejected == [("bob", "rejected:fault")]
+    assert not os.path.exists(os.path.join(root, "req_b0", "markers"))
+
+    # -- SIGTERM mid-traffic ----------------------------------------------
+    deadline = time.monotonic() + 120
+    while True:
+        states = [
+            (client.request(rid) or {}).get("state")
+            for _, rid, _ in requests
+        ]
+        if states.count("done") >= 1 and states.count("done") < len(states):
+            break
+        assert time.monotonic() < deadline, f"no drain window: {states}"
+        time.sleep(0.1)
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=120)
+    assert rc == REQUEUE_EXIT_CODE, (
+        f"drain exited rc={rc}, wanted {REQUEUE_EXIT_CODE}:\n"
+        f"{proc.stdout.read()[-4000:]}"
+    )
+
+    # the final state file: drained flag set, every request terminal-or-
+    # queued, and NO handoff entry outlived its request
+    with open(os.path.join(srv, "server_state.json")) as f:
+        state = json.load(f)
+    assert state["draining"] is True
+    assert state["handoffs"]["live_entries"] == 0, state["handoffs"]
+    assert all(
+        rec["state"] in ("done", "drained", "queued")
+        for rec in state["requests"].values()
+    ), state["requests"]
+    done_before = {
+        rid for rid, rec in state["requests"].items()
+        if rec["state"] == "done"
+    }
+    assert done_before and len(done_before) < len(requests), (
+        "SIGTERM landed outside the traffic window", state["requests"])
+
+    # -- restart: re-submitted requests complete bit-identically -----------
+    proc2, client2 = _start_serve(srv, env, max_workers=2)
+    rejected2 = []
+    for tenant, rid, key in requests:
+        if rid in done_before:
+            continue
+        _submit_riding_backpressure(client2, payload(tenant, rid, key),
+                                    rejected2)
+    for tenant, rid, key in requests:
+        if rid in done_before:
+            continue
+        rec = client2.wait(rid, timeout_s=240)
+        assert rec["state"] == "done", rec
+    # bob's first post-restart submission hit the (re-seeded) fault again
+    assert [(t, c) for t, c in rejected2] \
+        == [("bob", "rejected:fault")] * len(rejected2)
+
+    status = client2.status()
+    assert status["server"]["handoffs"]["live_entries"] == 0
+    assert status["rc"] == 0
+
+    out = file_reader(data, "r")
+    for _, _, key in requests:
+        np.testing.assert_array_equal(np.asarray(out[key][...]), ref_seg)
+
+    # -- attribution: every injected rejection in failures.json ------------
+    with open(os.path.join(srv, "failures.json")) as f:
+        recs = json.load(f)["records"]
+    admit_recs = [r for r in recs if r["task"] == "server.bob"]
+    assert len(admit_recs) == len(rejected) + len(rejected2)
+    for r in admit_recs:
+        assert r["resolution"] == "rejected:fault"
+        assert r["resolved"] is True
+        assert r["sites"] == {"admit": 1}
+        assert r["schema_version"] == 2 and r["hostname"] and r["pid"]
+
+    # -- clean second drain: rolling restarts ride the same protocol -------
+    proc2.send_signal(signal.SIGTERM)
+    assert proc2.wait(timeout=60) == REQUEUE_EXIT_CODE

@@ -166,6 +166,36 @@ def test_ct007_real_declaring_tasks_pass_unsuppressed():
         assert "ctlint: disable=CT007" not in open(path).read()
 
 
+def test_ct009_all_violation_classes():
+    """Service-mode server hygiene (docs/SERVING.md): blocking and
+    storage IO under the admission lock, a contextless request handler,
+    and a serve entry deaf to the drain protocol — each its own class."""
+    findings, _ = lint_fixture("ct009_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT009"]
+    assert any("time.sleep" in m for m in msgs)
+    assert any("fut.result" in m for m in msgs)
+    assert any("storage IO 'json.dump'" in m for m in msgs)
+    assert any("atomic_write_json" in m for m in msgs)
+    assert any("request_context" in m and "task_context" in m for m in msgs)
+    assert any("REQUEUE_EXIT_CODE" in m for m in msgs)
+
+
+def test_ct009_service_modules_pass_unsuppressed():
+    """The real service-mode surface satisfies its own hygiene rule on
+    merit: pure-bookkeeping lock bodies, contextful request execution,
+    drain-mapped entry point — no opt-outs."""
+    paths = [
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime", "server.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "admission.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "serve.py"),
+    ]
+    for path in paths:
+        findings, _ = run_lint([path])
+        assert [f for f in findings if f.rule == "CT009"] == [], path
+        assert "ctlint: disable=CT009" not in open(path).read()
+
+
 # -- suppressions -------------------------------------------------------------
 
 

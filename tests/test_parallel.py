@@ -162,6 +162,8 @@ def test_distributed_ccl_full_connectivity(rng, connectivity):
     assert_labels_equivalent(labels, expected)
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~40 s of XLA compiles; the
+# full-connectivity and pair-dedup tests keep distributed CCL in tier-1
 def test_distributed_ccl_two_axis_diagonal_shards(rng):
     """Connectivity 3 on a 2-axis decomposition: voxels meeting only at the
     corner shared by four diagonal shards must merge."""

@@ -107,6 +107,8 @@ def test_split_overflow_flag_propagates(rng):
     assert bool(overflow)
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~24 s of XLA compiles; the
+# split-vs-fused parity tests keep the split pipeline in tier-1
 def test_split_stage_programs_and_overhead(rng):
     """Per-stage sync points work and the split chain's wall-clock stays
     within a generous factor of the fused program on the CPU mesh — the

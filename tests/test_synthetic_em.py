@@ -125,6 +125,8 @@ def _evaluate_seg(tmp_folder, config_dir, path):
         return json.load(fh)
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~29 s of XLA compiles; the fused
+# variant below keeps the synthetic-EM multicut path in tier-1
 def test_multicut_on_synthetic_em_3d(workspace):
     measures, seg, gt, mask = _run_e2e(workspace, two_d=False)
     # quality against exact GT: VI well under 1 bit total, adapted-RAND

@@ -174,6 +174,8 @@ def test_ws_task_config_respects_explicit_dt_cap(workspace, rng):
     assert kp["dt_max_distance"] == 12.5
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~32 s of XLA compiles; threshold
+# agglomeration also runs under the multicut/synthetic-EM tier-1 tests
 def test_agglomerate_threshold_merges_fragments(rng, workspace):
     """reference watershed/agglomerate.py: in-block average-linkage merge of
     fragments under the mean-boundary threshold."""
@@ -301,6 +303,8 @@ def test_host_impl_refused_for_two_pass(workspace, rng):
     assert not build([wf])
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~22 s of XLA compiles; knob
+# plumbing is also covered by the tile_ws knob tests in tier-1
 def test_capacity_knobs_reach_the_tiled_kernel(rng, workspace):
     # a starved fill_rounds must surface as the task's loud overflow
     # warning (in the per-task LOG FILE — the task logger doesn't
