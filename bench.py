@@ -1142,173 +1142,185 @@ def serve_bench(smoke=False):
         env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
-    endpoint = os.path.join(srv, "server.json")
-    deadline = time.monotonic() + 120
-    while True:
-        if proc.poll() is not None:
-            raise RuntimeError(
-                f"serve bench server died rc={proc.returncode}:\n"
-                f"{proc.stdout.read()[-4000:]}"
-            )
-        try:
-            with open(endpoint) as fh:
-                doc = json.load(fh)
-            if doc.get("pid") == proc.pid:
-                break
-        except (OSError, ValueError):
-            pass
-        if time.monotonic() > deadline:
-            proc.kill()
-            raise RuntimeError("serve bench server never bound")
-        time.sleep(0.05)
-    client = ServeClient(doc["host"], doc["port"], timeout_s=60.0)
-
-    seq = [0]
-    outputs = []  # (cls, out_key) for the bit-identity sweep
-
-    def _payload(tenant, cls):
-        seq[0] += 1
-        rid = f"{tenant}-{seq[0]:03d}"
-        out_key = f"out_{rid}"
-        outputs.append((cls, out_key))
-        return dict(
-            tenant=tenant, request_id=rid, workflow=cls,
-            config=dict(
-                tmp_folder=os.path.join(root, "req", rid),
-                global_config={"block_shape": [block] * 3},
-                params=_cls_params(cls, out_key),
-            ),
-        )
-
-    def _run_open_loop(schedule, rejected=None):
-        """Submit (gap_s, payload) pairs open-loop; returns
-        ``{request_id: (client_latency_s, class, service_s)}`` and the
-        phase wall.  Client latency includes queue wait (the number a
-        caller experiences); ``service_s`` is the server-side ``run_s``
-        (what residency actually saves, queue-independent)."""
-        lat, threads, errors = {}, [], []
-        t_phase = time.perf_counter()
-        for gap, payload in schedule:
-            time.sleep(gap)
-            rid = payload["request_id"]
-            cls = payload["workflow"]
-            t0 = time.perf_counter()
+    try:
+        endpoint = os.path.join(srv, "server.json")
+        deadline = time.monotonic() + 120
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"serve bench server died rc={proc.returncode}:\n"
+                    f"{proc.stdout.read()[-4000:]}"
+                )
             try:
-                client.submit(**payload)
-            except ServeRejected as e:
-                if rejected is None:
-                    raise
-                rejected.append((rid, e.code))
-                outputs.remove((cls, payload["config"]["params"]
-                                ["output_key"]))
-                continue
+                with open(endpoint) as fh:
+                    doc = json.load(fh)
+                if doc.get("pid") == proc.pid:
+                    break
+            except (OSError, ValueError):
+                pass
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise RuntimeError("serve bench server never bound")
+            time.sleep(0.05)
+        client = ServeClient(doc["host"], doc["port"], timeout_s=60.0)
 
-            def _wait(rid=rid, cls=cls, t0=t0):
-                # raising in a Thread only prints to stderr — collect and
-                # re-raise after join, or a failed request would silently
-                # drop out of the latency stats
+        seq = [0]
+        outputs = []  # (cls, out_key) for the bit-identity sweep
+
+        def _payload(tenant, cls):
+            seq[0] += 1
+            rid = f"{tenant}-{seq[0]:03d}"
+            out_key = f"out_{rid}"
+            outputs.append((cls, out_key))
+            return dict(
+                tenant=tenant, request_id=rid, workflow=cls,
+                config=dict(
+                    tmp_folder=os.path.join(root, "req", rid),
+                    global_config={"block_shape": [block] * 3},
+                    params=_cls_params(cls, out_key),
+                ),
+            )
+
+        def _run_open_loop(schedule, rejected=None):
+            """Submit (gap_s, payload) pairs open-loop; returns
+            ``{request_id: (client_latency_s, class, service_s)}`` and the
+            phase wall.  Client latency includes queue wait (the number a
+            caller experiences); ``service_s`` is the server-side ``run_s``
+            (what residency actually saves, queue-independent)."""
+            lat, threads, errors = {}, [], []
+            t_phase = time.perf_counter()
+            for gap, payload in schedule:
+                time.sleep(gap)
+                rid = payload["request_id"]
+                cls = payload["workflow"]
+                t0 = time.perf_counter()
                 try:
-                    rec = client.wait(rid, timeout_s=600, poll_s=0.02)
-                    if rec.get("state") != "done":
-                        raise RuntimeError(f"request {rid} ended {rec}")
-                    lat[rid] = (
-                        time.perf_counter() - t0, cls,
-                        float(rec.get("run_s") or 0.0),
-                    )
-                except Exception as e:
-                    errors.append(e)
+                    client.submit(**payload)
+                except ServeRejected as e:
+                    if rejected is None:
+                        raise
+                    rejected.append((rid, e.code))
+                    outputs.remove((cls, payload["config"]["params"]
+                                    ["output_key"]))
+                    continue
 
-            th = threading.Thread(target=_wait)
-            th.start()
-            threads.append(th)
-        for th in threads:
-            th.join()
-        if errors:
-            raise errors[0]
-        return lat, time.perf_counter() - t_phase
+                def _wait(rid=rid, cls=cls, t0=t0):
+                    # raising in a Thread only prints to stderr — collect and
+                    # re-raise after join, or a failed request would silently
+                    # drop out of the latency stats
+                    try:
+                        rec = client.wait(rid, timeout_s=600, poll_s=0.02)
+                        if rec.get("state") != "done":
+                            raise RuntimeError(f"request {rid} ended {rec}")
+                        lat[rid] = (
+                            time.perf_counter() - t0, cls,
+                            float(rec.get("run_s") or 0.0),
+                        )
+                    except Exception as e:
+                        errors.append(e)
 
-    # -- phase 1: cold (one request per class, sequential) -----------------
-    cold_s, cold_service_s = {}, {}
-    for cls in classes:
-        lat, _ = _run_open_loop([(0.0, _payload("steady", cls))])
-        client_s, _, service_s = next(iter(lat.values()))
-        cold_s[cls] = round(client_s, 3)
-        cold_service_s[cls] = round(service_s, 3)
-    log(f"cold (service): {cold_service_s}")
+                th = threading.Thread(target=_wait)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            if errors:
+                raise errors[0]
+            return lat, time.perf_counter() - t_phase
 
-    # -- phase 2: warm solo (Poisson, mixed classes, one tenant) -----------
-    arr_rng = np.random.default_rng(42)
-    schedule = [
-        (gap, _payload("steady", classes[i % len(classes)]))
-        for i, gap in enumerate(
-            _poisson_gaps(arr_rng, n_warm, mean_gap)
-        )
-    ]
-    warm_lat, warm_wall = _run_open_loop(schedule)
-    warm_by_cls = {
-        cls: _latency_stats(
-            [s for s, c, _ in warm_lat.values() if c == cls]
-        )
-        for cls in classes
-    }
-    warm_service_by_cls = {
-        cls: _latency_stats(
-            [sv for _, c, sv in warm_lat.values() if c == cls]
-        )
-        for cls in classes
-    }
-    warm_all = _latency_stats([s for s, _, _ in warm_lat.values()])
-    throughput = round(len(warm_lat) / warm_wall, 3)
-    log(f"warm solo: p50 {warm_all['p50_s']}s p99 {warm_all['p99_s']}s, "
-        f"{throughput} req/s")
+        # -- phase 1: cold (one request per class, sequential) -----------------
+        cold_s, cold_service_s = {}, {}
+        for cls in classes:
+            lat, _ = _run_open_loop([(0.0, _payload("steady", cls))])
+            client_s, _, service_s = next(iter(lat.values()))
+            cold_s[cls] = round(client_s, 3)
+            cold_service_s[cls] = round(service_s, 3)
+        log(f"cold (service): {cold_service_s}")
 
-    # -- phase 2b: the cold/warm split, apples to apples -------------------
-    # one request per class, SEQUENTIAL like the cold phase was: the
-    # split compares residency (compiled programs + chunk cache warm),
-    # not concurrency (concurrent sweeps contend for the CPU and the
-    # process-wide XLA dispatch lock, inflating service times for cold
-    # and warm alike)
-    warm_seq_service_s = {}
-    for cls in classes:
-        lat, _ = _run_open_loop([(0.0, _payload("steady", cls))])
-        warm_seq_service_s[cls] = round(next(iter(lat.values()))[2], 3)
-    log(f"warm sequential (service): {warm_seq_service_s}")
+        # -- phase 2: warm solo (Poisson, mixed classes, one tenant) -----------
+        arr_rng = np.random.default_rng(42)
+        schedule = [
+            (gap, _payload("steady", classes[i % len(classes)]))
+            for i, gap in enumerate(
+                _poisson_gaps(arr_rng, n_warm, mean_gap)
+            )
+        ]
+        warm_lat, warm_wall = _run_open_loop(schedule)
+        warm_by_cls = {
+            cls: _latency_stats(
+                [s for s, c, _ in warm_lat.values() if c == cls]
+            )
+            for cls in classes
+        }
+        warm_service_by_cls = {
+            cls: _latency_stats(
+                [sv for _, c, sv in warm_lat.values() if c == cls]
+            )
+            for cls in classes
+        }
+        warm_all = _latency_stats([s for s, _, _ in warm_lat.values()])
+        throughput = round(len(warm_lat) / warm_wall, 3)
+        log(f"warm solo: p50 {warm_all['p50_s']}s p99 {warm_all['p99_s']}s, "
+            f"{throughput} req/s")
 
-    # -- phase 3: contended (same steady pattern + aggressor flood) --------
-    rejected = []
-    agg_sched = [
-        (0.05, _payload("aggressor", "watershed"))
-        for _ in range(n_aggressor)
-    ]
-    steady_sched = [
-        (gap, _payload("steady", classes[i % len(classes)]))
-        for i, gap in enumerate(
-            _poisson_gaps(arr_rng, n_contended, mean_gap)
-        )
-    ]
-    agg_result = {}
+        # -- phase 2b: the cold/warm split, apples to apples -------------------
+        # one request per class, SEQUENTIAL like the cold phase was: the
+        # split compares residency (compiled programs + chunk cache warm),
+        # not concurrency (concurrent sweeps contend for the CPU and the
+        # process-wide XLA dispatch lock, inflating service times for cold
+        # and warm alike)
+        warm_seq_service_s = {}
+        for cls in classes:
+            lat, _ = _run_open_loop([(0.0, _payload("steady", cls))])
+            warm_seq_service_s[cls] = round(next(iter(lat.values()))[2], 3)
+        log(f"warm sequential (service): {warm_seq_service_s}")
 
-    def _flood():
-        lat, _ = _run_open_loop(agg_sched, rejected=rejected)
-        agg_result.update(lat)
+        # -- phase 3: contended (same steady pattern + aggressor flood) --------
+        rejected = []
+        agg_sched = [
+            (0.05, _payload("aggressor", "watershed"))
+            for _ in range(n_aggressor)
+        ]
+        steady_sched = [
+            (gap, _payload("steady", classes[i % len(classes)]))
+            for i, gap in enumerate(
+                _poisson_gaps(arr_rng, n_contended, mean_gap)
+            )
+        ]
+        agg_result = {}
 
-    flood_th = threading.Thread(target=_flood)
-    flood_th.start()
-    cont_lat, _ = _run_open_loop(steady_sched)
-    flood_th.join()
-    cont_all = _latency_stats([s for s, _, _ in cont_lat.values()])
-    agg_all = _latency_stats([s for s, _, _ in agg_result.values()])
-    p99_ratio = round(cont_all["p99_s"] / max(warm_all["p99_s"], 1e-9), 3)
-    log(f"contended: steady p99 {cont_all['p99_s']}s "
-        f"(x{p99_ratio} of solo), aggressor p99 "
-        f"{agg_all['p99_s'] if agg_all else None}s, "
-        f"{len(rejected)} typed rejections")
+        def _flood():
+            lat, _ = _run_open_loop(agg_sched, rejected=rejected)
+            agg_result.update(lat)
 
-    # -- /status + drain ---------------------------------------------------
-    status = client.status()
-    tenants_snap = status["server"]["tenants"]
-    proc.send_signal(signal.SIGTERM)
-    drain_rc = proc.wait(timeout=120)
+        flood_th = threading.Thread(target=_flood)
+        flood_th.start()
+        cont_lat, _ = _run_open_loop(steady_sched)
+        flood_th.join()
+        cont_all = _latency_stats([s for s, _, _ in cont_lat.values()])
+        agg_all = _latency_stats([s for s, _, _ in agg_result.values()])
+        p99_ratio = round(cont_all["p99_s"] / max(warm_all["p99_s"], 1e-9), 3)
+        log(f"contended: steady p99 {cont_all['p99_s']}s "
+            f"(x{p99_ratio} of solo), aggressor p99 "
+            f"{agg_all['p99_s'] if agg_all else None}s, "
+            f"{len(rejected)} typed rejections")
+
+        # -- /status + drain ---------------------------------------------------
+        status = client.status()
+        tenants_snap = status["server"]["tenants"]
+        proc.send_signal(signal.SIGTERM)
+        drain_rc = proc.wait(timeout=120)
+    finally:
+        # leaked-server reap: whatever happened above — assertion,
+        # timeout, exception — the resident server must not outlive
+        # the bench (stray servers burn CPU and are the prime
+        # suspect when tier-1 drifts toward its wall-clock ceiling)
+        if proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                pass
 
     # -- bit-identity sweep: every served output == its solo reference -----
     out = file_reader(data, "r")

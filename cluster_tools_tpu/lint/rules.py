@@ -1,4 +1,4 @@
-"""The ctlint rule classes CT001-CT008 (docs/ANALYSIS.md).
+"""The ctlint rule classes CT001-CT010 (docs/ANALYSIS.md).
 
 Every rule is derived from a *real* invariant of this codebase — the
 docstring of each checker names the file/contract it guards.  Rules are
@@ -459,10 +459,11 @@ def ct003_lock_discipline(module: LintModule) -> List[Finding]:
 _DEFAULT_SITES = frozenset({
     "load", "store", "io_read", "io_write", "submit", "task",
     "block_done", "task_done", "compute", "kernel", "admit",
+    "journal", "journal_append", "journal_replay",
 })
 _DEFAULT_KINDS = frozenset({
     "error", "oom", "enospc", "hang", "corrupt", "nan",
-    "job_loss", "kill", "preempt", "spill", "reject",
+    "job_loss", "kill", "preempt", "spill", "reject", "torn",
 })
 
 #: hook callables whose first positional arg is a site name
@@ -603,7 +604,7 @@ def ct004_fault_site_coverage(module: LintModule) -> List[Finding]:
                 "preemption chaos cannot target block completion",
             ))
 
-    # (d) the 11-class registry itself
+    # (d) the 12-class registry itself
     if module.name == "faults.py" and "lint_fixtures" not in module.path:
         missing = _DEFAULT_KINDS - kinds
         if missing:
@@ -1307,6 +1308,154 @@ def ct009_server_hygiene(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT010 - durable-journal discipline
+# =============================================================================
+
+#: the journal-aware surface (docs/SERVING.md "Durability"): the journal
+#: itself plus everything that may hold the server's bookkeeping locks
+_CT010_SCOPE = ("journal.py", "server.py", "admission.py", "serve.py")
+
+#: IO methods that, invoked on a journal-named object/path outside
+#: journal.py, bypass the one framed+fsync'd append path
+_CT010_RAW_IO = frozenset({"write", "writelines", "truncate"})
+
+#: journal-object call segments that do disk IO (an append is an fsync —
+#: a disk round trip) and must never run under the server's locks
+_CT010_JOURNAL_IO = frozenset({
+    "append", "append_transition", "recover", "close", "_journal_append",
+})
+
+
+def _names_journal(name: Optional[str]) -> bool:
+    return name is not None and "journal" in name.lower()
+
+
+def ct010_journal_discipline(module: LintModule) -> List[Finding]:
+    """The durable submission journal's three invariants
+    (docs/SERVING.md "Durability").
+
+    (a) **One append path**: outside ``runtime/journal.py``, nothing may
+    write the journal file directly — no ``open()`` of a journal-named
+    path in write/append mode, no ``.write``/``.truncate`` on a
+    journal-named handle.  ``Journal.append`` is where the CRC framing
+    and the fsync live; a raw write bypasses both and can forge a record
+    a replay would trust.
+
+    (b) **Fsync evidence**: the ``append`` method of a ``Journal`` class
+    must call ``os.fsync`` — an acknowledgement whose record only made it
+    to the page cache is a durability lie under SIGKILL.
+
+    (c) **No journal IO under the server's locks**: a journal append is a
+    disk round trip; under the admission/request locks it head-of-line
+    blocks every submit, dispatch, and status thread (same reasoning as
+    CT009's IO ban, extended to the journal object).
+    """
+    is_fixture = "ct010" in module.name
+    if module.name not in _CT010_SCOPE and not is_fixture:
+        return []
+    out: List[Finding] = []
+    is_journal_module = module.name == "journal.py" and not is_fixture
+
+    # -- (a) raw journal-file IO outside the journal module ----------------
+    if not is_journal_module:
+        for call in calls_in(module.tree):
+            name = dotted(call.func)
+            seg = last_seg(name)
+            if seg == "open" or name == "os.open":
+                touches = any(
+                    _names_journal(dotted(a)) or _names_journal(str_const(a))
+                    for a in call.args
+                )
+                # read-mode opens are fine (report tooling scans the
+                # journal); only write/append modes forge records.  A
+                # mode-less builtin open() defaults to 'r' — read-only;
+                # os.open takes flag ints we cannot prove read-only, so
+                # it always counts as writable.
+                mode = None
+                if len(call.args) >= 2:
+                    mode = str_const(call.args[1])
+                for kw in call.keywords:
+                    if kw.arg == "mode":
+                        mode = str_const(kw.value)
+                if name == "os.open":
+                    writable = True
+                else:
+                    writable = mode is not None and any(
+                        c in mode for c in ("w", "a", "+", "x")
+                    )
+                if touches and writable:
+                    out.append(Finding(
+                        "CT010", module.path, call.lineno, call.col_offset,
+                        "raw open of the journal file outside "
+                        "runtime/journal.py: appends must go through "
+                        "Journal.append (CRC framing + fsync) — a direct "
+                        "write can forge a record replay would trust",
+                    ))
+            elif seg in _CT010_RAW_IO and isinstance(
+                call.func, ast.Attribute
+            ):
+                base = dotted(call.func.value)
+                if _names_journal(base):
+                    out.append(Finding(
+                        "CT010", module.path, call.lineno, call.col_offset,
+                        f"raw '{seg}' on journal handle '{base}' outside "
+                        "runtime/journal.py: the one append path is "
+                        "Journal.append (CRC framing + fsync)",
+                    ))
+
+    # -- (b) fsync evidence in the append path -----------------------------
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef) or "Journal" not in node.name:
+            continue
+        for item in node.body:
+            if not isinstance(item, ast.FunctionDef) or item.name != "append":
+                continue
+            has_fsync = any(
+                last_seg(dotted(c.func)) == "fsync" for c in calls_in(item)
+            )
+            if not has_fsync:
+                out.append(Finding(
+                    "CT010", module.path, item.lineno, item.col_offset,
+                    f"{node.name}.append has no os.fsync evidence: an "
+                    "acknowledgement whose record only reached the page "
+                    "cache is a durability lie under SIGKILL — fsync "
+                    "before returning",
+                ))
+
+    # -- (c) no journal IO under the server's bookkeeping locks ------------
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.With):
+            continue
+        keys = [
+            k for k in (
+                _lock_key(module, item.context_expr) for item in node.items
+            ) if k is not None
+        ]
+        if not keys:
+            continue
+        held = keys[-1]
+        if is_journal_module and held == "Journal._lock":
+            continue  # the journal's own lock IS the append serializer
+        for stmt in node.body:
+            for inner in _walk_inline(stmt):
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = dotted(inner.func)
+                seg = last_seg(name)
+                if seg in _CT010_JOURNAL_IO and _names_journal(name):
+                    out.append(Finding(
+                        "CT010", module.path, inner.lineno,
+                        inner.col_offset,
+                        f"journal IO '{name}' while holding server lock "
+                        f"'{held}': an append is an fsync — a disk round "
+                        "trip that head-of-line blocks every "
+                        "submit/dispatch/status thread; journal outside "
+                        "the critical section",
+                    ))
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -1320,4 +1469,5 @@ RULES = {
     "CT007": ct007_memory_target_contract,
     "CT008": ct008_trace_hygiene,
     "CT009": ct009_server_hygiene,
+    "CT010": ct010_journal_discipline,
 }

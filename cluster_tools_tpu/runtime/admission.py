@@ -188,15 +188,24 @@ class AdmissionController:
         return state
 
     # -- submission --------------------------------------------------------
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request, admitted: bool = False) -> None:
         """Admit ``request`` into its tenant's queue or raise a typed
         :class:`AdmissionError`.  The injected ``reject`` fault (site
         ``admit``) is the caller's to check — it needs the tenant name
-        before a Request even exists."""
+        before a Request even exists.
+
+        ``admitted=True`` re-enters a request a previous server
+        incarnation already acknowledged (journal replay,
+        docs/SERVING.md "Durability"): the quota was charged when the
+        durable 200 was issued, so the quota gates are not re-litigated —
+        the request goes straight to its tenant's queue."""
         code = detail = None
         with self._admission_lock:
             state = self._tenant(request.tenant)
-            if self._draining:
+            if admitted:
+                state.submitted += 1
+                state.queue.append(request)
+            elif self._draining:
                 code, detail = REJECT_DRAINING, "server is draining"
             elif request.est_bytes > state.quota.max_bytes_in_flight:
                 code = REJECT_BYTES
@@ -320,6 +329,22 @@ class AdmissionController:
             if completed:
                 state.completed += 1
         self._event.set()
+
+    def restore_counts(self, tenant: str, submitted: int = 0,
+                       dispatched: int = 0, completed: int = 0,
+                       rejected: int = 0) -> None:
+        """Seed a tenant's lifetime counters from a journal replay
+        (docs/SERVING.md "Durability").  Quota *state* (queue depth,
+        inflight, bytes) rebuilds naturally as replayed requests re-enter
+        through :meth:`submit`; the monotonic counters would otherwise
+        reset to zero across a restart and lie to the operator view and
+        the fairness accounting."""
+        with self._admission_lock:
+            state = self._tenant(tenant)
+            state.submitted += int(submitted)
+            state.dispatched += int(dispatched)
+            state.completed += int(completed)
+            state.rejected += int(rejected)
 
     # -- drain + introspection --------------------------------------------
     def begin_drain(self) -> None:

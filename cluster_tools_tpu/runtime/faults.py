@@ -42,7 +42,17 @@ task runtime, and container IO layer call at their failure-relevant sites:
   request at the service-mode admission gate (``runtime/server.py``), so
   chaos can prove rejected requests are attributed in ``failures.json``
   and leave no partial markers, manifests, or handoff entries behind.
-  Targeted by tenant name (``"tenants": [...]``) instead of block.
+  Targeted by tenant name (``"tenants": [...]``) instead of block,
+- :meth:`FaultInjector.torn_append` — tear a submission-journal append
+  (``kind='torn'``, site ``journal``; docs/SERVING.md "Durability"): a
+  strict prefix of the frame reaches the disk and the process hard-exits
+  mid-write (a torn tail only ever exists because its writer died), so
+  chaos can prove the restarted reader truncates-and-warns instead of
+  refusing to boot.  One-shot via the ``state_dir`` latch like kills;
+  ``keep_fraction`` (default 0.5) sets how much of the frame survives.
+  The journal's durability boundaries are also kill sites:
+  ``journal_append`` (record durable, in-memory state not yet published)
+  and ``journal_replay`` (mid-recovery) take ``kind='kill'`` faults.
 
 Resource-exhaustion and preemption classes (docs/ROBUSTNESS.md "Graceful
 degradation") ride the same hooks:
@@ -106,7 +116,11 @@ Config schema::
         # service mode: tenant-b's first 2 submissions to the resident
         # server are rejected with a typed backpressure error
         {"site": "admit", "kind": "reject", "tenants": ["tenant-b"],
-         "fail_attempts": 2}
+         "fail_attempts": 2},
+        # durable journal: the 3rd journal append is torn — half the frame
+        # lands, the process dies; replay must truncate-and-warn
+        {"site": "journal", "kind": "torn", "after": 3,
+         "keep_fraction": 0.5}
       ]
     }
 
@@ -153,7 +167,18 @@ ENV_VAR = "CTT_FAULTS"
 #: kill one worker of the group and prove the driver's fallback.
 _ERROR_SITES = ("load", "store", "io_read", "io_write", "submit", "task",
                 "solve")
-_KILL_SITES = ("block_done", "task_done")
+#: "journal_append" / "journal_replay" are the durable-journal boundaries
+#: (runtime/journal.py, docs/SERVING.md "Durability"): a kill at the
+#: former models dying after the fsync'd ack record but before the
+#: in-memory state is published; at the latter, dying mid-recovery —
+#: either way the restarted replay must reconstruct every acknowledged
+#: request.
+_KILL_SITES = ("block_done", "task_done", "journal_append",
+               "journal_replay")
+#: "journal" is the torn-append site (kind='torn'): the submission
+#: journal's write is cut mid-frame and the process dies, leaving the
+#: torn tail the reader must truncate-and-warn past.
+_TORN_SITES = ("journal",)
 #: "dispatch" is the batch-grain site of the sharded sweep (one compiled
 #: program per Morton batch, docs/PERFORMANCE.md "Sharded sweeps"): an oom
 #: there models the whole sharded program exceeding device memory, a hang a
@@ -338,6 +363,18 @@ class FaultInjector:
                         f"reject fault site must be one of {_REJECT_SITES}, "
                         f"got {site!r}"
                     )
+            elif kind == "torn":
+                if site not in _TORN_SITES:
+                    raise ValueError(
+                        f"torn fault site must be one of {_TORN_SITES}, "
+                        f"got {site!r}"
+                    )
+                if not self.state_dir:
+                    raise ValueError(
+                        "torn faults require 'state_dir' (the torn write "
+                        "kills the process; the latch keeps the restarted "
+                        "journal from re-tearing)"
+                    )
             elif kind == "hang":
                 if site not in _HANG_SITES:
                     raise ValueError(
@@ -507,6 +544,35 @@ class FaultInjector:
             return True
         return False
 
+    def torn_append(self) -> Optional[float]:
+        """Fraction of the current journal frame to keep if a ``torn``
+        fault (site ``journal``) fires on this append, else None.  The
+        journal writes that prefix, fsyncs it, and calls
+        :func:`hard_exit` — a torn tail only ever exists because its
+        writer died mid-append, so the fault models exactly that.
+        One-shot across restarts via the ``state_dir`` latch (the
+        resumed server's journal must not re-tear); ``after`` picks the
+        N-th append like kill faults."""
+        if not self.enabled:
+            return None
+        for idx, spec in enumerate(self.specs):
+            if spec.get("kind") != "torn" or spec.get("site") != "journal":
+                continue
+            count = self._next_attempt("journal", None, idx)
+            if count != int(spec.get("after", 1)):
+                continue
+            latch = os.path.join(self.state_dir, f"torn_{idx}.done")
+            if os.path.exists(latch):
+                continue
+            tmp = latch + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write("journal")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, latch)
+            return float(spec.get("keep_fraction", 0.5))
+        return None
+
     def lose_job(self) -> bool:
         """True if this scheduler submission should be swallowed: the caller
         fabricates a job id the scheduler will keep reporting as running,
@@ -548,6 +614,14 @@ class FaultInjector:
                 os.kill(os.getpid(), signal.SIGTERM)
             else:
                 os._exit(KILL_EXIT_CODE)
+
+
+def hard_exit() -> None:
+    """``os._exit(KILL_EXIT_CODE)`` — the injector's crash primitive,
+    shared by kill faults and the journal's torn-append path.  Lives here
+    because CT006 allows ``os._exit`` only in this module: everywhere
+    else it would skip the drain protocol's flushes."""
+    os._exit(KILL_EXIT_CODE)
 
 
 # -- module-level singleton ---------------------------------------------------

@@ -1,0 +1,306 @@
+"""Durable submission journal for service mode (docs/SERVING.md
+"Durability").
+
+PR 12's durability story stopped at the graceful drain: a SIGTERM finished
+in-flight work and exited 114, but queued and accepted requests died with
+the process — on a preemptible fleet, where the common failure is an
+abrupt ``kill -9`` and not a polite drain, that pushed exactly-once
+bookkeeping onto every client.  This module makes ``/submit``'s 200 a
+durable promise: every request lifecycle transition (``accepted`` →
+``dispatched`` → ``completed`` / ``failed`` / ``rejected`` /
+``quarantined``) is an fsync'd, CRC-framed, append-only record written
+*before* the state is acknowledged over HTTP, and a restarted
+:class:`~cluster_tools_tpu.runtime.server.PipelineServer` replays the
+journal to reconstruct exactly what it promised:
+
+- **completed** requests are served idempotently — a duplicate resubmit of
+  a done id answers from the recorded result instead of re-running (or
+  bouncing ``rejected:duplicate``);
+- **acknowledged-but-incomplete** requests (accepted / dispatched /
+  drained) are re-enqueued with their original tenant + payload and re-run
+  through the ordinary resume machinery — block markers plus the
+  namespace-stale handoff invalidation make the rerun bit-identical;
+- a replayed request that crashes the server ``max_replay_attempts`` times
+  (the attempt count is itself journaled as ``dispatched`` records) is
+  **quarantined** with a typed ``quarantined:crash_loop`` record instead
+  of wedging the server in a crash loop.
+
+Frame format (append-only, binary)::
+
+    MAGIC(4 = b"CTJ1") | payload_len(u32 LE) | crc32(payload)(u32 LE) | payload
+
+``payload`` is compact JSON.  The reader (:func:`scan`) walks frames from
+the start and stops at the FIRST inconsistency — short header, short
+payload, bad magic, CRC mismatch, unparseable JSON — treating everything
+after it as a torn tail: :meth:`Journal.recover` truncates the file back
+to the last intact frame and warns, it never refuses to boot (the same
+truncate-and-warn posture the atomic-write discipline CT002 gives JSON
+manifests).  A torn tail can only be a *suffix* because appends are
+serialized under the journal lock, every append is fsync'd before the
+state it records becomes observable, and a deliberately torn write (the
+injected ``torn`` fault at site ``journal``) hard-exits the process — a
+torn record mid-file therefore cannot be followed by intact ones.
+
+Lock discipline (ctlint CT010): all appends go through
+:meth:`Journal.append` (raw writes to the journal file anywhere else are
+a lint finding), the append path must show fsync evidence, and journal IO
+— an fsync is a disk round trip — must never run under the server's
+admission/request locks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import function_utils as fu
+from . import faults as faults_mod
+from . import trace as trace_mod
+
+#: the journal file, next to ``server_state.json`` / ``failures.json``
+JOURNAL_FILENAME = "journal.log"
+
+MAGIC = b"CTJ1"
+_HEADER = struct.Struct("<4sII")  # magic, payload length, crc32(payload)
+
+#: a frame claiming a payload larger than this is framing damage, not a
+#: record (the journal holds request metadata, never array data)
+MAX_RECORD_BYTES = 16 << 20
+
+#: lifecycle record types (the ``type`` field of every journal record)
+ACCEPTED = "accepted"
+DISPATCHED = "dispatched"
+COMPLETED = "completed"
+FAILED = "failed"
+REJECTED = "rejected"
+QUARANTINED = "quarantined"
+DRAINED = "drained"
+
+#: types that end a request's lifecycle; anything else at replay time is an
+#: acknowledged-but-incomplete request the restarted server must finish
+TERMINAL_TYPES = (COMPLETED, FAILED, REJECTED, QUARANTINED)
+
+
+def journal_path(base_dir: str) -> str:
+    return os.path.join(base_dir, JOURNAL_FILENAME)
+
+
+def scan(path: str) -> Tuple[List[Dict[str, Any]], int, int]:
+    """Read every intact record of ``path`` in append order.
+
+    Returns ``(records, intact_bytes, torn_bytes)``: ``intact_bytes`` is
+    the offset of the last frame that framed, CRC'd, and parsed;
+    ``torn_bytes`` is whatever trails it (0 for a clean journal).  Missing
+    file = ``([], 0, 0)``.  Pure function, stdlib only — the report
+    tooling mirrors this framing without importing the runtime.
+    """
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return [], 0, 0
+    records: List[Dict[str, Any]] = []
+    off = 0
+    while True:
+        header = data[off:off + _HEADER.size]
+        if len(header) < _HEADER.size:
+            break
+        magic, length, crc = _HEADER.unpack(header)
+        if magic != MAGIC or length > MAX_RECORD_BYTES:
+            break
+        payload = data[off + _HEADER.size:off + _HEADER.size + length]
+        if len(payload) < length or zlib.crc32(payload) != crc:
+            break
+        try:
+            rec = json.loads(payload)
+        except ValueError:
+            break
+        if not isinstance(rec, dict):
+            break
+        records.append(rec)
+        off += _HEADER.size + length
+    return records, off, len(data) - off
+
+
+def fold(records) -> "OrderedDict[str, Dict[str, Any]]":
+    """Collapse the record stream into per-request final state, in first-
+    acknowledgement order.
+
+    Each entry: ``{"request_id", "tenant", "payload", "fingerprint",
+    "state", "attempts", "record", "code"}`` where ``state`` is the last
+    lifecycle type seen, ``attempts`` counts ``dispatched`` records (the
+    crash-loop budget), and ``record`` is the terminal request record for
+    completed/failed/quarantined entries (the idempotent-answer source).
+    A fresh ``accepted`` after a terminal state starts a new incarnation
+    of the id — the typed-backpressure protocol is back-off-and-resubmit
+    the same id, so a rejected/failed id must be re-acceptable.
+    """
+    reqs: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    for rec in records:
+        rid = rec.get("request_id")
+        typ = rec.get("type")
+        if not rid or not typ:
+            continue
+        ent = reqs.get(rid)
+        if typ == ACCEPTED:
+            if ent is None or ent["state"] in TERMINAL_TYPES:
+                reqs[rid] = {
+                    "request_id": rid,
+                    "tenant": rec.get("tenant") or "default",
+                    "payload": rec.get("payload"),
+                    "fingerprint": rec.get("fingerprint"),
+                    "state": ACCEPTED,
+                    "attempts": 0,
+                    "record": None,
+                    "code": None,
+                }
+            # a duplicate accepted for a LIVE id is the racing-submit /
+            # client-retry case: the first acknowledgement stands
+            continue
+        if ent is None:
+            if typ == REJECTED:
+                # rejected at admission (quota / injected fault): the only
+                # transition journaled without a prior accepted
+                reqs[rid] = {
+                    "request_id": rid,
+                    "tenant": rec.get("tenant") or "default",
+                    "payload": None,
+                    "fingerprint": None,
+                    "state": REJECTED,
+                    "attempts": 0,
+                    "record": None,
+                    "code": rec.get("code"),
+                }
+            continue
+        if typ == DISPATCHED:
+            ent["state"] = DISPATCHED
+            ent["attempts"] = max(
+                ent["attempts"] + 1, int(rec.get("attempt") or 0)
+            )
+        elif typ == DRAINED:
+            ent["state"] = DRAINED
+            # a graceful drain PROVES the dispatch did not crash the
+            # server — rolling SIGTERM restarts of a long-running request
+            # must never accrue toward the crash-loop budget, or routine
+            # redeploys would quarantine innocent work
+            ent["attempts"] = 0
+        elif typ in (COMPLETED, FAILED, QUARANTINED):
+            ent["state"] = typ
+            ent["record"] = rec.get("record")
+        elif typ == REJECTED:
+            ent["state"] = REJECTED
+            ent["code"] = rec.get("code")
+    return reqs
+
+
+class Journal:
+    """The append side: one fsync'd CRC-framed record per lifecycle
+    transition, serialized under the journal's own lock (never the
+    server's admission/request locks — CT010)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = None
+        # stats for /healthz + server_state.json (docs/SERVING.md)
+        self.appended = 0
+        self.bytes = 0
+        self.torn_bytes_truncated = 0
+        self._last_fsync_mono: Optional[float] = None
+
+    # -- recovery ----------------------------------------------------------
+    def recover(self) -> List[Dict[str, Any]]:
+        """Read every intact record, truncate a torn tail back to the last
+        intact frame (warn, never refuse to boot), and open the file for
+        appending.  Must be called before the first :meth:`append`."""
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        records, good, torn = scan(self.path)
+        if torn:
+            fu.log(
+                f"journal {self.path}: torn tail ({torn} byte(s) after "
+                f"{len(records)} intact record(s)) — truncating to the "
+                "last intact frame"
+            )
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+                f.flush()
+                os.fsync(f.fileno())
+            self.torn_bytes_truncated = torn
+        with self._lock:
+            self._fh = open(self.path, "ab")
+            self.bytes = good
+        return records
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- the one append path (CT010) ---------------------------------------
+    def append(self, record: Dict[str, Any]) -> None:
+        """Frame, append, and fsync one lifecycle record.  Returns only
+        once the record is durable — callers acknowledge state over HTTP
+        strictly after this returns, so an acknowledgement always has a
+        journal record behind it (SIGKILL included)."""
+        payload = json.dumps(
+            record, separators=(",", ":"), sort_keys=True, default=str
+        ).encode()
+        frame = _HEADER.pack(MAGIC, len(payload), zlib.crc32(payload)) \
+            + payload
+        inj = faults_mod.get_injector()
+        with self._lock:
+            if self._fh is None:  # pragma: no cover - misuse guard
+                raise RuntimeError("journal.append before recover()")
+            keep = inj.torn_append()
+            if keep is not None:
+                # the injected torn write (kind='torn', site='journal'):
+                # a strict prefix of the frame reaches the disk and the
+                # process dies mid-append — the only way a torn tail
+                # arises.  The restarted reader must truncate-and-warn.
+                self._fh.write(frame[:max(1, int(len(frame) * keep))])
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                faults_mod.hard_exit()
+            self._fh.write(frame)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.appended += 1
+            self.bytes += len(frame)
+            self._last_fsync_mono = time.monotonic()
+        # crash-after-ackable-write: the record is durable, the in-memory
+        # state that mirrors it is not yet published — replay must
+        # reconstruct it (chaos kills here to prove that)
+        inj.kill_point("journal_append")
+
+    def append_transition(self, typ: str, request_id: str,
+                          **fields: Any) -> None:
+        """``append`` with the envelope every lifecycle record shares."""
+        rec = {"type": typ, "request_id": request_id,
+               "time": trace_mod.walltime()}
+        rec.update(fields)
+        self.append(rec)
+
+    # -- introspection -----------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """The journal block of ``/healthz`` / ``server_state.json``:
+        byte size, appended-record count, last-fsync age, and the torn
+        bytes recovery truncated at boot."""
+        with self._lock:
+            last = self._last_fsync_mono
+            return {
+                "path": self.path,
+                "bytes": int(self.bytes),
+                "appended": int(self.appended),
+                "last_fsync_age_s": (
+                    round(time.monotonic() - last, 3)
+                    if last is not None else None
+                ),
+                "torn_bytes_truncated": int(self.torn_bytes_truncated),
+            }

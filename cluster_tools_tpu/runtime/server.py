@@ -47,10 +47,22 @@ When a request completes, its live *dataset* handoffs are written back to
 storage (the client-visible durability contract) and every entry of its
 namespace is released, so a resident process never accretes dead request
 state and rejected/failed requests leave no orphaned handoff entries.
+
+Durability (docs/SERVING.md "Durability"): every request lifecycle
+transition is an fsync'd, CRC-framed record in the submission journal
+(``runtime/journal.py``) written *before* the state is acknowledged over
+HTTP, and :meth:`PipelineServer.start` replays the journal before binding
+the endpoint — completed requests answer duplicate resubmits idempotently
+from their recorded results, acknowledged-but-incomplete requests are
+re-enqueued with their original tenant/payload and resume at block grain,
+tenant admission counters are reconstructed, and a request whose replay
+keeps crashing the server is quarantined (``quarantined:crash_loop``)
+after ``max_replay_attempts`` instead of wedging the restart loop.
 """
 
 from __future__ import annotations
 
+import hashlib
 import importlib
 import json
 import os
@@ -68,6 +80,7 @@ from ..utils import task_utils as tu
 from . import admission as admission_mod
 from . import faults as faults_mod
 from . import handoff as handoff_mod
+from . import journal as journal_mod
 from . import trace as trace_mod
 from .supervision import (
     DrainInterrupt,
@@ -80,10 +93,29 @@ SERVER_UID = "server"
 STATE_FILENAME = "server_state.json"
 ENDPOINT_FILENAME = "server.json"
 
+#: the crash-loop quarantine resolution recorded in failures.json when a
+#: replayed request has crashed the server ``max_replay_attempts`` times
+QUARANTINE_CRASH_LOOP = "quarantined:crash_loop"
+
 #: completed/terminal request records kept in memory (oldest pruned)
 _MAX_RECORDS = 512
 
 _CLUSTER_TARGETS = ("slurm", "lsf")
+
+#: request-record states the journal's terminal record types map to
+_JOURNAL_TERMINAL = {"done": journal_mod.COMPLETED,
+                     "failed": journal_mod.FAILED,
+                     "drained": journal_mod.DRAINED}
+
+
+def _payload_fingerprint(payload: Dict[str, Any]) -> str:
+    """Canonical digest of a submission payload: a resubmit with the SAME
+    fingerprint under a live/terminal id is the client's retry of an
+    acknowledged request (answered idempotently), a different one is a
+    real id collision (``rejected:duplicate``)."""
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
 
 
 def _resolve_workflow(name: str):
@@ -116,6 +148,8 @@ class PipelineServer:
         default_max_jobs: int = 2,
         host: str = "127.0.0.1",
         port: int = 0,
+        journal: bool = True,
+        max_replay_attempts: int = 3,
     ):
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
@@ -123,6 +157,18 @@ class PipelineServer:
         self.default_est_bytes = int(default_est_bytes)
         self.default_max_jobs = int(default_max_jobs)
         self.max_workers = max(1, int(max_workers))
+        self.max_replay_attempts = max(1, int(max_replay_attempts))
+        # the durable submission journal (docs/SERVING.md "Durability");
+        # off only for embedders that explicitly opt out of the ack
+        # contract (tests of the pre-journal paths)
+        self._journal: Optional[journal_mod.Journal] = (
+            journal_mod.Journal(journal_mod.journal_path(self.base_dir))
+            if journal else None
+        )
+        #: replay outcome of the LAST start(): rendered by /healthz,
+        #: server_state.json, and scripts/progress.py
+        self._replay_stats = {"replayed": 0, "reenqueued": 0,
+                              "quarantined": 0}
         quotas = {
             name: admission_mod.TenantQuota.from_config(doc)
             for name, doc in (tenants or {}).items()
@@ -147,8 +193,12 @@ class PipelineServer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "PipelineServer":
-        """Bind the endpoint, start workers + heartbeat, and write the
-        endpoint file clients discover the port from."""
+        """Recover + replay the submission journal, then bind the
+        endpoint and start workers + heartbeat, and write the endpoint
+        file clients discover the port from.  Replay runs BEFORE the
+        bind on purpose: a client reconnecting across the restart can
+        never observe a window where an acknowledged request is
+        missing."""
         if trace_mod.enabled():
             # one resident-process timeline: every request's spans land in
             # the server's trace dir (an operator CTT_TRACE=<dir> pin
@@ -156,6 +206,7 @@ class PipelineServer:
             trace_mod.set_trace_dir(
                 os.path.join(self.base_dir, trace_mod.TRACE_DIRNAME)
             )
+        self._recover_journal()
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), _RequestHandler
         )
@@ -225,22 +276,280 @@ class PipelineServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self._journal is not None:
+            self._journal.close()
+
+    # -- journal + replay (docs/SERVING.md "Durability") -------------------
+    def _journal_append(self, typ: str, request_id: str,
+                        **fields: Any) -> None:
+        """One lifecycle transition into the journal (fsync'd; a no-op
+        with the journal off).  Never called under the admission/request
+        locks — an fsync is a disk round trip (ctlint CT010)."""
+        if self._journal is not None:
+            self._journal.append_transition(typ, request_id, **fields)
+
+    def journal_health(self) -> Optional[Dict[str, Any]]:
+        """The journal block of ``/healthz`` / ``server_state.json``:
+        append/fsync stats, the replay outcome of this incarnation, and
+        the live replay backlog (re-enqueued requests not yet
+        terminal)."""
+        if self._journal is None:
+            return None
+        doc = self._journal.health()
+        doc.update(self._replay_stats)
+        with self._requests_lock:
+            doc["replay_backlog"] = sum(
+                1 for rec in self._requests.values()
+                if rec.get("replayed")
+                and rec.get("state") in ("queued", "running")
+            )
+        return doc
+
+    def _recover_journal(self) -> None:
+        """Replay the journal into the restarted server: terminal
+        requests become idempotently-answerable records, acknowledged-
+        but-incomplete ones re-enter the queue with their original
+        payload (resuming at block grain through the ordinary marker /
+        handoff-invalidation machinery), tenant counters are
+        reconstructed, and crash-looping requests are quarantined."""
+        if self._journal is None:
+            return
+        records = self._journal.recover()
+        folded = journal_mod.fold(records)
+        inj = faults_mod.get_injector()
+        counts: Dict[str, Dict[str, int]] = {}
+        for rid, ent in folded.items():
+            tenant = ent["tenant"]
+            c = counts.setdefault(tenant, {
+                "submitted": 0, "dispatched": 0, "completed": 0,
+                "rejected": 0,
+            })
+            state = ent["state"]
+            if state == journal_mod.REJECTED:
+                # typed rejections are terminal AND replaceable — no
+                # record is rebuilt, the id stays free for a resubmit.
+                # A rejected entry WITH a payload was accepted first
+                # (deadline expiry after admission), so its submitted
+                # count is restored too.
+                c["rejected"] += 1
+                if ent.get("payload") is not None:
+                    c["submitted"] += 1
+                continue
+            if state in (journal_mod.COMPLETED, journal_mod.FAILED,
+                         journal_mod.QUARANTINED):
+                c["submitted"] += 1
+                c["dispatched"] += ent["attempts"]
+                if state == journal_mod.COMPLETED:
+                    c["completed"] += 1
+                rec = dict(ent.get("record") or {})
+                rec.setdefault("request_id", rid)
+                rec.setdefault("tenant", tenant)
+                rec.setdefault("state", {
+                    journal_mod.COMPLETED: "done",
+                    journal_mod.FAILED: "failed",
+                    journal_mod.QUARANTINED: "quarantined",
+                }[state])
+                rec.setdefault("fingerprint", ent.get("fingerprint"))
+                rec["replayed"] = True
+                with self._requests_lock:
+                    self._requests[rid] = rec
+                    self._order.append(rid)
+                    self._prune_locked()
+                self._replay_stats["replayed"] += 1
+                continue
+            # acknowledged but incomplete (accepted/dispatched/drained):
+            # the 200 was a durable promise — finish it, unless finishing
+            # it is what keeps killing the server
+            if ent["attempts"] >= self.max_replay_attempts:
+                c["submitted"] += 1
+                c["dispatched"] += ent["attempts"]
+                self._quarantine_crash_loop(ent)
+                continue
+            # prior crashed attempts stay on the tenant's dispatched
+            # count; submit() below restores the submitted count
+            c["dispatched"] += ent["attempts"]
+            self._reenqueue_replayed(ent)
+            # chaos coverage: dying mid-replay must be recoverable — the
+            # journal is unchanged by re-enqueueing, so the next boot
+            # folds to the same decision
+            inj.kill_point("journal_replay")
+        for tenant, c in counts.items():
+            if any(c.values()):
+                self.controller.restore_counts(tenant, **c)
+        self._write_state()
+
+    def _reenqueue_replayed(self, ent: Dict[str, Any]) -> None:
+        rid = ent["request_id"]
+        payload = dict(ent.get("payload") or {})
+        request = admission_mod.Request(
+            tenant=ent["tenant"],
+            request_id=rid,
+            est_bytes=int(payload.get("est_bytes")
+                          or self.default_est_bytes),
+            # the original deadline_s bounded queue time in the dead
+            # incarnation; the replayed promise is completion, so it is
+            # not re-armed (docs/SERVING.md "Durability")
+            deadline_s=None,
+            payload=payload,
+        )
+        rec = {
+            "request_id": rid,
+            "tenant": ent["tenant"],
+            "workflow": str(payload.get("workflow")),
+            "state": "queued",
+            "replayed": True,
+            "attempts": int(ent["attempts"]),
+            "fingerprint": ent.get("fingerprint"),
+            "submitted": trace_mod.walltime(),
+            "queue_span": trace_mod.begin(
+                "server.queue", request=rid, tenant=ent["tenant"],
+                replayed=True,
+            ),
+            "tmp_folder": self._tmp_folder(payload, rid),
+        }
+        with self._requests_lock:
+            self._requests[rid] = rec
+            self._order = [r for r in self._order if r != rid]
+            self._order.append(rid)
+        # admitted=True: the dead incarnation already charged this
+        # request against the tenant's quota when it acknowledged it;
+        # replay never re-litigates (or rejects) its own promise — the
+        # admitted path enqueues unconditionally
+        self.controller.submit(request, admitted=True)
+        self._replay_stats["reenqueued"] += 1
+        trace_mod.instant(
+            "server.replay", request=rid, tenant=ent["tenant"],
+            attempts=int(ent["attempts"]),
+        )
+
+    def _quarantine_crash_loop(self, ent: Dict[str, Any]) -> None:
+        """Crash-loop defense: a replayed request whose dispatch has
+        crashed the server ``max_replay_attempts`` times is quarantined —
+        journaled, attributed in ``failures.json`` as
+        ``quarantined:crash_loop``, and answered idempotently as
+        ``quarantined`` from then on — instead of wedging the server in a
+        replay loop."""
+        rid = ent["request_id"]
+        tenant = ent["tenant"]
+        payload = ent.get("payload") or {}
+        error = (
+            f"request crashed the server {ent['attempts']} time(s); "
+            f"quarantined after max_replay_attempts="
+            f"{self.max_replay_attempts}"
+        )
+        rec = {
+            "request_id": rid,
+            "tenant": tenant,
+            "workflow": str(payload.get("workflow")),
+            "state": "quarantined",
+            "code": QUARANTINE_CRASH_LOOP,
+            "attempts": int(ent["attempts"]),
+            "fingerprint": ent.get("fingerprint"),
+            "replayed": True,
+            "error": error,
+            "finished": trace_mod.walltime(),
+        }
+        self._journal_append(
+            journal_mod.QUARANTINED, rid, tenant=tenant, record=rec,
+        )
+        with self._requests_lock:
+            self._requests[rid] = rec
+            self._order = [r for r in self._order if r != rid]
+            self._order.append(rid)
+        try:
+            fu.record_failures(
+                self.failures_path,
+                f"server.{tenant}",
+                [{
+                    "block_id": f"request:{rid}",
+                    "sites": {"journal_replay": int(ent["attempts"])},
+                    "error": error,
+                    "quarantined": True,
+                    # resolved on the rejection precedent: the quarantine
+                    # IS the resolution — the server defended itself; the
+                    # record is the operator's pointer to the poison
+                    "resolved": True,
+                    "resolution": QUARANTINE_CRASH_LOOP,
+                    "tenant": tenant,
+                    "request": rid,
+                }],
+            )
+        except Exception:
+            pass  # attribution is best-effort; the quarantine stands
+        trace_mod.instant(
+            "server.quarantine", request=rid, tenant=tenant,
+            code=QUARANTINE_CRASH_LOOP,
+        )
+        self._replay_stats["quarantined"] += 1
 
     # -- submission --------------------------------------------------------
+    def _idempotent_doc(self, request_id: str,
+                        rec: Dict[str, Any]) -> Dict[str, Any]:
+        """The answer to a resubmit of an acknowledged id with the same
+        payload: the recorded state (for completed requests, straight
+        from the journal-recovered result) — the 200 was a durable
+        promise, a retry never re-runs or bounces."""
+        doc = {
+            "request_id": request_id,
+            "state": rec.get("state"),
+            "idempotent": True,
+        }
+        for k in ("run_s", "total_s", "code"):
+            if rec.get(k) is not None:
+                doc[k] = rec.get(k)
+        return doc
+
+    def _reject_duplicate(self, tenant: str, request_id: str):
+        detail = (
+            f"request_id {request_id!r} already submitted with a "
+            "different payload"
+        )
+        # attributed like every other rejection; request=None because the
+        # live record under this id belongs to the ORIGINAL submission
+        # and must not be flipped to rejected
+        self.controller._reject(
+            None, tenant, admission_mod.REJECT_DUPLICATE, detail
+        )
+        raise admission_mod.AdmissionError(
+            admission_mod.REJECT_DUPLICATE, tenant, detail
+        )
+
     def submit(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """Admit one workflow request; returns ``{"request_id", "state"}``
         or raises :class:`~cluster_tools_tpu.runtime.admission.
-        AdmissionError` with a typed backpressure code."""
+        AdmissionError` with a typed backpressure code.
+
+        Submission is idempotent per ``(request_id, payload)``: a
+        resubmit of a live, completed, or quarantined id with the same
+        payload fingerprint is the client's retry of an acknowledged
+        request and answers from the record; the same id with a
+        DIFFERENT payload is a collision (``rejected:duplicate``).  A
+        rejected/failed/drained record stays replaceable — the typed
+        backpressure protocol is back-off-and-resubmit the same id.
+        """
         tenant = str(payload.get("tenant") or "default")
         request_id = str(payload.get("request_id") or f"{tenant}-{uuid.uuid4().hex[:12]}")
         workflow = payload.get("workflow")
         if not workflow:
             raise ValueError("request payload needs a 'workflow' name")
         _resolve_workflow(str(workflow))  # fail fast on unknown workflows
+        fingerprint = _payload_fingerprint(payload)
+        with self._requests_lock:
+            existing = self._requests.get(request_id)
+            held = existing is not None and existing.get("state") in (
+                "queued", "running", "done", "quarantined",
+            )
+            same = held and existing.get("fingerprint") == fingerprint
+            snapshot = dict(existing) if held else None
+        if held:
+            if same:
+                return self._idempotent_doc(request_id, snapshot)
+            self._reject_duplicate(tenant, request_id)
         # seeded per-tenant admission faults (kind='reject' at site
         # 'admit', runtime/faults.py): chaos proves a rejected request
-        # leaves no partial state behind — checked BEFORE any directory or
-        # record for the request exists
+        # leaves no partial state behind — checked BEFORE any directory,
+        # record, or journal entry for the request exists (the rejection
+        # itself is journaled through _on_reject)
         if faults_mod.get_injector().maybe_reject(tenant):
             code = admission_mod.REJECT_FAULT
             self.controller._reject(
@@ -263,6 +572,7 @@ class PipelineServer:
             "tenant": tenant,
             "workflow": str(workflow),
             "state": "queued",
+            "fingerprint": fingerprint,
             "submitted": trace_mod.walltime(),
             "queue_span": trace_mod.begin(
                 "server.queue", request=request_id, tenant=tenant
@@ -270,16 +580,13 @@ class PipelineServer:
             "tmp_folder": self._tmp_folder(payload, request_id),
         }
         with self._requests_lock:
-            # duplicate check + insert under ONE acquisition: two racing
-            # submits with the same id must not both pass the check.  Only
-            # a LIVE record (or a completed one, whose outputs exist) makes
-            # the id a duplicate — a rejected/failed/drained record is
-            # replaceable, because the typed-backpressure protocol is
-            # "back off and resubmit the same request" and a poisoned id
-            # would turn every retry into rejected:duplicate.
+            # duplicate re-check + insert under ONE acquisition: two
+            # racing submits with the same id must not both insert; the
+            # loser of the race answers from the winner's record (same
+            # fingerprint) or bounces (different payload)
             existing = self._requests.get(request_id)
             duplicate = existing is not None and existing.get("state") in (
-                "queued", "running", "done",
+                "queued", "running", "done", "quarantined",
             )
             if not duplicate:
                 if existing is not None:
@@ -287,17 +594,23 @@ class PipelineServer:
                 self._requests[request_id] = rec
                 self._order.append(request_id)
                 self._prune_locked()
+            else:
+                snapshot = dict(existing)
         if duplicate:
-            # attributed like every other rejection; request=None because
-            # the live record under this id belongs to the ORIGINAL
-            # submission and must not be flipped to rejected
-            detail = f"request_id {request_id!r} already submitted"
-            self.controller._reject(
-                None, tenant, admission_mod.REJECT_DUPLICATE, detail
-            )
-            raise admission_mod.AdmissionError(
-                admission_mod.REJECT_DUPLICATE, tenant, detail
-            )
+            if snapshot.get("fingerprint") == fingerprint:
+                return self._idempotent_doc(request_id, snapshot)
+            self._reject_duplicate(tenant, request_id)
+        # durable acknowledgement: the accepted record is fsync'd AFTER
+        # winning the id under the lock (a racing same-id submit with a
+        # different payload must not smuggle its payload into the journal
+        # for replay to resurrect) and strictly BEFORE the HTTP 200 — an
+        # acknowledgement always has a record behind it.  A crash in the
+        # insert-to-append window loses a request no client was ever
+        # acked for.
+        self._journal_append(
+            journal_mod.ACCEPTED, request_id, tenant=tenant,
+            payload=payload, fingerprint=fingerprint,
+        )
         try:
             self.controller.submit(request)
         except admission_mod.AdmissionError as e:
@@ -334,9 +647,19 @@ class PipelineServer:
     # -- rejection attribution --------------------------------------------
     def _on_reject(self, request, tenant, code, detail) -> None:
         """Called by the admission controller for every rejection (never
-        under its lock): attribute it in the server's failures.json and
-        update the request record when one exists (deadline expiries)."""
+        under its lock): journal the lifecycle transition, attribute it in
+        the server's failures.json, and update the request record when one
+        exists (deadline expiries)."""
         request_id = getattr(request, "request_id", None)
+        if request_id is not None:
+            # the rejection is a lifecycle end: journaled before the state
+            # flip is observable, so a restart answers this id's fate from
+            # the journal instead of replaying a request nobody admitted.
+            # request=None rejections (duplicates) carry no id and do not
+            # touch the original submission's journal lifecycle.
+            self._journal_append(
+                journal_mod.REJECTED, request_id, tenant=tenant, code=code,
+            )
         if request_id is not None:
             with self._requests_lock:
                 rec = self._requests.get(request_id)
@@ -405,6 +728,17 @@ class PipelineServer:
         rid = request.request_id
         with self._requests_lock:
             rec = self._requests.get(rid) or {"request_id": rid}
+            attempt = int(rec.get("attempts") or 0) + 1
+            rec["attempts"] = attempt
+        # the dispatch transition is journaled BEFORE the workflow runs: a
+        # crash mid-run leaves a dispatched record behind, and the count
+        # of those records is the crash-loop budget replay enforces
+        # (max_replay_attempts -> quarantined:crash_loop)
+        self._journal_append(
+            journal_mod.DISPATCHED, rid, tenant=request.tenant,
+            attempt=attempt,
+        )
+        with self._requests_lock:
             rec["state"] = "running"
             qspan = rec.pop("queue_span", None)
             rec["queued_s"] = round(qspan.end(), 6) if qspan is not None else None
@@ -443,13 +777,32 @@ class PipelineServer:
             # with the request; datasets were flushed above on success)
             handoff_mod.release_request(rid)
         run_s = run_span.end(error=state != "done")
+        terminal = {
+            "request_id": rid,
+            "tenant": request.tenant,
+            "workflow": str(payload.get("workflow")),
+            "state": state,
+            "queued_s": rec.get("queued_s"),
+            "run_s": round(run_s, 6),
+            "total_s": round((rec.get("queued_s") or 0.0) + run_s, 6),
+            "finished": trace_mod.walltime(),
+            "fingerprint": rec.get("fingerprint"),
+            "tmp_folder": rec.get("tmp_folder"),
+        }
+        if error:
+            terminal["error"] = error
+        # terminal transition journaled BEFORE the state flip becomes
+        # observable: done -> the idempotent-answer record a restart
+        # serves; drained -> re-enqueued on replay (the drain protocol's
+        # queued-work-survives contract now holds server-side)
+        self._journal_append(
+            _JOURNAL_TERMINAL.get(state, journal_mod.FAILED), rid,
+            tenant=request.tenant, record=terminal,
+        )
         with self._requests_lock:
-            rec["state"] = state
-            rec["run_s"] = round(run_s, 6)
-            rec["total_s"] = round((rec.get("queued_s") or 0.0) + run_s, 6)
-            rec["finished"] = trace_mod.walltime()
-            if error:
-                rec["error"] = error
+            rec.update(
+                {k: v for k, v in terminal.items() if k != "request_id"}
+            )
         return state
 
     def _instantiate(self, payload: Dict[str, Any], request_id: str):
@@ -504,12 +857,14 @@ class PipelineServer:
             return {k: v for k, v in rec.items() if k != "queue_span"}
 
     def _state_doc(self) -> Dict[str, Any]:
+        journal = self.journal_health()
         with self._requests_lock:
             requests = {
                 rid: {
                     k: rec.get(k)
                     for k in ("tenant", "workflow", "state", "queued_s",
-                              "run_s", "total_s", "code")
+                              "run_s", "total_s", "code", "replayed",
+                              "attempts")
                     if rec.get(k) is not None
                 }
                 for rid, rec in self._requests.items()
@@ -533,6 +888,10 @@ class PipelineServer:
                 "live_entries": handoff_mod.live_entries(),
                 "live_bytes": int(handoff_mod.live_bytes()),
             },
+            # the durable-journal pulse (docs/SERVING.md "Durability"):
+            # fsync freshness, journal growth, and what this incarnation's
+            # replay recovered / re-enqueued / quarantined
+            "journal": journal,
         }
 
     def _write_state(self) -> None:
@@ -658,6 +1017,10 @@ class _RequestHandler(BaseHTTPRequestHandler):
                 "ok": True,
                 "draining": self.pipeline.controller.draining()
                 or drain_requested(),
+                # journal health (docs/SERVING.md "Durability"): last
+                # fsync age, journal bytes, and the replay backlog — a
+                # liveness probe that can also see the ack contract rot
+                "journal": self.pipeline.journal_health(),
             })
         elif path == "/status":
             self._reply(200, self.pipeline.status())
@@ -684,14 +1047,36 @@ class ServeRejected(RuntimeError):
         super().__init__(f"{code} (http {http_status}): {detail}")
 
 
+#: rejection codes a durable client may retry with backoff: the restart
+#: window (503) and transient quota pressure.  byte_quota / duplicate /
+#: fault are NOT retryable-by-default — resubmitting them verbatim can
+#: never succeed (oversize, collision) or is the chaos seed's to count.
+RETRYABLE_REJECTS = (
+    admission_mod.REJECT_DRAINING,
+    admission_mod.REJECT_QUEUE,
+)
+
+
 class ServeClient:
     """Stdlib HTTP client for the serve endpoint (tests, the load
-    generator, operator scripts)."""
+    generator, operator scripts).
 
-    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+    Constructed via :meth:`from_endpoint_file`, the client can survive a
+    server restart: connection-level failures (the server is dead or
+    binding) are retried with capped backoff while the endpoint file is
+    re-read — a restarted server binds a fresh ephemeral port, and the
+    durable submission journal (docs/SERVING.md "Durability") guarantees
+    the requests it acknowledged are still there to poll.  Typed
+    ``rejected:*`` codes are honored: only :data:`RETRYABLE_REJECTS`
+    (draining / queue pressure) are retried by :meth:`submit` when given
+    a retry budget; everything else raises immediately."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0,
+                 base_dir: Optional[str] = None):
         self.host = host
         self.port = int(port)
         self.timeout_s = float(timeout_s)
+        self.base_dir = base_dir
 
     @classmethod
     def from_endpoint_file(cls, base_dir: str,
@@ -703,10 +1088,23 @@ class ServeClient:
             raise FileNotFoundError(
                 f"no server endpoint file under {base_dir!r}"
             )
-        return cls(doc["host"], doc["port"], timeout_s=timeout_s)
+        return cls(doc["host"], doc["port"], timeout_s=timeout_s,
+                   base_dir=base_dir)
 
-    def _call(self, method: str, path: str,
-              body: Optional[Dict[str, Any]] = None) -> tuple:
+    def _refresh_endpoint(self) -> None:
+        """Re-read the endpoint file (when known): a restarted server
+        writes a fresh host/port there before serving."""
+        if not self.base_dir:
+            return
+        doc = fu.read_json_if_valid(
+            os.path.join(self.base_dir, ENDPOINT_FILENAME)
+        )
+        if doc and doc.get("host") and doc.get("port"):
+            self.host = doc["host"]
+            self.port = int(doc["port"])
+
+    def _call_once(self, method: str, path: str,
+                   body: Optional[Dict[str, Any]] = None) -> tuple:
         import http.client
 
         conn = http.client.HTTPConnection(
@@ -722,30 +1120,94 @@ class ServeClient:
         finally:
             conn.close()
 
-    def submit(self, **payload) -> Dict[str, Any]:
-        status, doc = self._call("POST", "/submit", payload)
-        if status != 200:
-            raise ServeRejected(
-                str(doc.get("error")), str(doc.get("detail") or ""),
-                http_status=status,
+    def _call(self, method: str, path: str,
+              body: Optional[Dict[str, Any]] = None,
+              retry_s: Optional[float] = None) -> tuple:
+        """One HTTP call; with a ``retry_s`` budget, connection-level
+        failures (refused / reset / timed out — the restart window) are
+        retried with capped backoff, re-reading the endpoint file between
+        attempts.  HTTP-level answers are never retried here — the typed
+        rejection codes are the caller's protocol."""
+        deadline = (
+            None if not retry_s else time.monotonic() + float(retry_s)
+        )
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(method, path, body)
+            except (OSError, ConnectionError) as e:
+                if deadline is None or time.monotonic() >= deadline:
+                    raise
+                time.sleep(fu.backoff_delay(attempt, 0.05, 1.0))
+                attempt += 1
+                self._refresh_endpoint()
+
+    def submit(self, retry_s: Optional[float] = None,
+               **payload) -> Dict[str, Any]:
+        """POST /submit.  With a ``retry_s`` budget the submit also rides
+        typed backpressure: :data:`RETRYABLE_REJECTS` (draining — the
+        rolling-restart window — and queue pressure) back off and
+        resubmit the SAME payload; submission is idempotent per
+        ``(request_id, payload)`` server-side, so an ambiguous
+        connection drop is safely resubmitted too."""
+        deadline = (
+            None if not retry_s else time.monotonic() + float(retry_s)
+        )
+        attempt = 0
+        while True:
+            # the connection-retry budget is what REMAINS of the caller's
+            # budget, not a fresh retry_s per loop — otherwise a late
+            # rejection re-arms the full window and blocks ~2x as long
+            remaining = (
+                None if deadline is None
+                else max(0.1, deadline - time.monotonic())
             )
-        return doc
+            status, doc = self._call("POST", "/submit", payload,
+                                     retry_s=remaining)
+            if status == 200:
+                return doc
+            code = str(doc.get("error"))
+            if (
+                deadline is None
+                or code not in RETRYABLE_REJECTS
+                or time.monotonic() >= deadline
+            ):
+                raise ServeRejected(
+                    code, str(doc.get("detail") or ""), http_status=status,
+                )
+            time.sleep(fu.backoff_delay(attempt, 0.05, 2.0))
+            attempt += 1
 
     def status(self) -> Dict[str, Any]:
         return self._call("GET", "/status")[1]
 
-    def request(self, request_id: str) -> Optional[Dict[str, Any]]:
-        status, doc = self._call("GET", f"/request/{request_id}")
+    def healthz(self) -> Dict[str, Any]:
+        return self._call("GET", "/healthz")[1]
+
+    def request(self, request_id: str,
+                retry_s: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        status, doc = self._call(
+            "GET", f"/request/{request_id}", retry_s=retry_s
+        )
         return None if status == 404 else doc
 
     def wait(self, request_id: str, timeout_s: float = 120.0,
-             poll_s: float = 0.05) -> Dict[str, Any]:
+             poll_s: float = 0.05,
+             across_restarts: bool = False) -> Dict[str, Any]:
         """Poll until the request reaches a terminal state; returns its
         record.  Raises TimeoutError when it stays live past
-        ``timeout_s``."""
+        ``timeout_s``.  With ``across_restarts`` (needs a ``base_dir``
+        endpoint file), polls ride out server restarts: connection
+        failures retry against the re-read endpoint until the deadline —
+        the journal's replay contract means an acknowledged request's
+        record WILL come back."""
         deadline = time.monotonic() + timeout_s
         while True:
-            rec = self.request(request_id)
+            remaining = deadline - time.monotonic()
+            rec = self.request(
+                request_id,
+                retry_s=max(0.1, remaining) if across_restarts else None,
+            )
             if rec is not None and rec.get("state") not in (
                 "queued", "running",
             ):

@@ -9,13 +9,19 @@ Usage (docs/SERVING.md)::
 The server binds 127.0.0.1 on ``--port`` (0 = ephemeral; the bound port is
 written to ``<base_dir>/server.json`` for clients), admits workflow
 requests per-tenant (``--config`` names a JSON document with ``tenants`` /
-``default_quota`` / ``max_workers`` / ``default_est_bytes`` keys), and
-serves until a SIGTERM drains it — in-flight requests finish at their safe
-boundaries, queued ones stay recorded for resubmission, and the process
-exits ``REQUEUE_EXIT_CODE`` (114) so rolling restarts ride the standard
-requeue protocol.  ``--status`` prints a running server's ``/status``
-document and exits with its ``rc`` field (the ``failures_report.py
---json`` contract).
+``default_quota`` / ``max_workers`` / ``default_est_bytes`` /
+``max_replay_attempts`` keys), and serves until a SIGTERM drains it —
+in-flight requests finish at their safe boundaries, queued ones stay
+journaled for the restart's replay, and the process exits
+``REQUEUE_EXIT_CODE`` (114) so rolling restarts ride the standard
+requeue protocol.  Every acknowledged request is recorded in the durable
+submission journal (``<base_dir>/journal.log``, docs/SERVING.md
+"Durability"): after ANY exit — drain or ``kill -9`` — the restarted
+server replays acknowledged-but-incomplete requests to completion and
+quarantines one that keeps crashing it (``max_replay_attempts``, default
+3).  ``--status`` prints a running server's ``/status`` document and
+exits with its ``rc`` field (the ``failures_report.py --json``
+contract).
 """
 
 from __future__ import annotations
@@ -100,13 +106,18 @@ def main(argv=None) -> int:
         default_est_bytes=int(cfg.get("default_est_bytes", 0)),
         default_max_jobs=int(cfg.get("default_max_jobs", 2)),
         port=args.port,
+        max_replay_attempts=int(cfg.get("max_replay_attempts", 3)),
     )
     install_drain_handler()
     server.start()
+    replay = server.journal_health() or {}
     print(
         f"serving on {server.host}:{server.port} "
         f"(base_dir={os.path.abspath(args.base_dir)}, "
-        f"workers={server.max_workers})",
+        f"workers={server.max_workers}; journal replay: "
+        f"{replay.get('replayed', 0)} replayed, "
+        f"{replay.get('reenqueued', 0)} re-enqueued, "
+        f"{replay.get('quarantined', 0)} quarantined)",
         flush=True,
     )
     try:

@@ -75,6 +75,94 @@ def load_io_metrics(failures_json_path: str, with_provenance: bool = False):
     return tasks
 
 
+def load_journal_stats(failures_json_path: str):
+    """Aggregate stats of the service mode's durable submission journal
+    (``journal.log`` next to ``failures.json`` — docs/SERVING.md
+    "Durability"), or None when the run has no journal.
+
+    The frame scanner mirrors ``runtime/journal.py`` (MAGIC + u32 length
+    + u32 crc32 + compact-JSON payload) on purpose: this report must work
+    stdlib-only on a bare login node, like the progress view.  A torn
+    tail is counted, never fatal — the same truncate-and-warn posture the
+    journal's own reader takes.
+    """
+    import struct
+    import zlib
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(failures_json_path)), "journal.log"
+    )
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    header = struct.Struct("<4sII")
+    records, off = [], 0
+    while True:
+        head = data[off:off + header.size]
+        if len(head) < header.size:
+            break
+        magic, length, crc = header.unpack(head)
+        if magic != b"CTJ1" or length > (16 << 20):
+            break
+        payload = data[off + header.size:off + header.size + length]
+        if len(payload) < length or zlib.crc32(payload) != crc:
+            break
+        try:
+            rec = json.loads(payload)
+        except ValueError:
+            break
+        if not isinstance(rec, dict):
+            break
+        records.append(rec)
+        off += header.size + length
+    by_type = Counter(str(r.get("type")) for r in records)
+    return {
+        "path": path,
+        "bytes": len(data),
+        "n_records": len(records),
+        "by_type": dict(by_type),
+        # a dispatched record with attempt > 1 is a replayed re-run of an
+        # acknowledged request (the crash-loop budget's evidence)
+        "n_replays": sum(
+            1 for r in records
+            if r.get("type") == "dispatched" and int(r.get("attempt") or 1) > 1
+        ),
+        "n_quarantined": int(by_type.get("quarantined", 0)),
+        "torn_tail_bytes": len(data) - off,
+    }
+
+
+def format_journal_stats(j) -> list:
+    """Render the submission-journal block: record counts per lifecycle
+    type, replays, quarantines, and torn-tail evidence."""
+    types = ", ".join(
+        f"{t}={n}" for t, n in sorted((j.get("by_type") or {}).items())
+    )
+    lines = [
+        f"submission journal (journal.log): {j.get('n_records', 0)} "
+        f"record(s), {_human_bytes(float(j.get('bytes', 0)))}"
+        + (f" ({types})" if types else "")
+    ]
+    if j.get("n_replays"):
+        lines.append(
+            f"  {j['n_replays']} replayed dispatch(es) — acknowledged "
+            "work re-run after a restart"
+        )
+    if j.get("n_quarantined"):
+        lines.append(
+            f"  {j['n_quarantined']} quarantined request(s) "
+            "(quarantined:crash_loop — see the failure records above)"
+        )
+    if j.get("torn_tail_bytes"):
+        lines.append(
+            f"  torn tail: {j['torn_tail_bytes']} byte(s) after the last "
+            "intact record (a crash mid-append; replay truncates it)"
+        )
+    return lines
+
+
 def _human_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -305,7 +393,7 @@ def summarize(records):
 
 
 def format_report(path, version, summaries, io_tasks=None, provenance=None,
-                  trace_summary=None) -> str:
+                  trace_summary=None, journal_stats=None) -> str:
     lines = [f"failures report: {path} (schema v{version})", ""]
     if not summaries:
         lines.append("no failure records — clean run")
@@ -313,6 +401,8 @@ def format_report(path, version, summaries, io_tasks=None, provenance=None,
             lines.extend(["", *format_io_metrics(io_tasks, provenance)])
         if trace_summary:
             lines.extend(["", *format_trace_summary(trace_summary)])
+        if journal_stats:
+            lines.extend(["", *format_journal_stats(journal_stats)])
         return "\n".join(lines)
     n_unresolved = sum(len(s["unresolved"]) for s in summaries)
     all_hosts = sorted({h for s in summaries for h in s["hosts"]})
@@ -344,6 +434,8 @@ def format_report(path, version, summaries, io_tasks=None, provenance=None,
         lines.extend(["", *format_io_metrics(io_tasks, provenance)])
     if trace_summary:
         lines.extend(["", *format_trace_summary(trace_summary)])
+    if journal_stats:
+        lines.extend(["", *format_journal_stats(journal_stats)])
     return "\n".join(lines)
 
 
@@ -428,6 +520,10 @@ def build_json_report(tmp_folder: str, with_lint: bool = True):
         },
         "io_metrics": {"tasks": io_tasks, "provenance": provenance},
         "trace": load_trace_summary(fpath) or None,
+        # the service mode's durable submission journal (docs/SERVING.md
+        # "Durability"): records, replays, quarantines, torn-tail
+        # truncations — null for runs without a journal
+        "journal": load_journal_stats(fpath),
         "lint": run_repo_lint() if with_lint else None,
     }
     return doc
@@ -510,7 +606,7 @@ def main(argv) -> int:
     print(
         format_report(
             path, version, summarize(records), io_tasks, provenance,
-            load_trace_summary(path),
+            load_trace_summary(path), load_journal_stats(path),
         )
     )
     return 0

@@ -145,6 +145,7 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
         states = defaultdict(int)
         for rec in (server_state.get("requests") or {}).values():
             states[str(rec.get("state"))] += 1
+        journal = server_state.get("journal")
         server = {
             "pid": pid,
             "hostname": server_state.get("hostname"),
@@ -157,6 +158,19 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
             "tenants": server_state.get("tenants") or {},
             "request_states": dict(states),
             "handoffs": server_state.get("handoffs") or {},
+            # the durable-journal pulse (docs/SERVING.md "Durability"):
+            # replay outcome + live backlog; a backlog that is not
+            # draining means acknowledged requests are going unserved
+            "journal": journal,
+            "journal_backlog_stalled": bool(
+                journal
+                and journal.get("replay_backlog")
+                and (
+                    stale
+                    or (journal.get("last_fsync_age_s") is not None
+                        and journal["last_fsync_age_s"] > stale_after_s)
+                )
+            ),
         }
         # the server's own heartbeat is rendered in the server section,
         # not as a phantom task row
@@ -273,6 +287,27 @@ def _format_server(server) -> list:
             f"    handoffs resident: {hand['live_entries']} entries, "
             f"{hand.get('live_bytes', 0) / 1e6:.1f}MB"
         )
+    j = server.get("journal")
+    if j:
+        fsync = (
+            f"last fsync {j['last_fsync_age_s']:.1f}s ago"
+            if j.get("last_fsync_age_s") is not None
+            else "no append yet"
+        )
+        line = (
+            f"    journal: {j.get('appended', 0)} record(s) appended "
+            f"({j.get('bytes', 0) / 1e3:.1f}kB), {fsync}; replay: "
+            f"{j.get('replayed', 0)} replayed, "
+            f"{j.get('reenqueued', 0)} re-enqueued, "
+            f"{j.get('quarantined', 0)} quarantined"
+        )
+        if j.get("replay_backlog"):
+            line += f"; backlog {j['replay_backlog']}"
+        if j.get("torn_bytes_truncated"):
+            line += (
+                f"; torn tail truncated ({j['torn_bytes_truncated']}B)"
+            )
+        lines.append(line)
     return lines
 
 
@@ -290,6 +325,12 @@ def format_progress(doc) -> str:
             lines.append(
                 "  WARNING: server looks dead (stale heartbeat or dead "
                 "pid) — requests will queue forever; restart it"
+            )
+        if doc["server"].get("journal_backlog_stalled"):
+            lines.append(
+                "  WARNING: journal replay backlog is not draining — "
+                "acknowledged requests are re-enqueued but nothing is "
+                "completing them; check the server's workers"
             )
     if not tasks:
         lines.append("  no tasks seen yet (no markers, manifests, "
@@ -352,7 +393,10 @@ def main(argv) -> int:
     # rc mirrors the operator's concern: something stalled or failed -> 1
     # (a dead resident server counts — its queues rot silently otherwise)
     bad = any(t["state"] in ("stalled?", "failed") for t in doc["tasks"])
-    if doc.get("server") is not None and doc["server"]["stale"]:
+    if doc.get("server") is not None and (
+        doc["server"]["stale"]
+        or doc["server"].get("journal_backlog_stalled")
+    ):
         bad = True
     return 1 if bad else 0
 

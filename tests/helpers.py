@@ -34,6 +34,38 @@ def random_blobs(rng, shape, p=0.5, smooth=1):
     return x > np.quantile(x, 1 - p)
 
 
+def stray_serve_pids():
+    """Pids of live ``cluster_tools_tpu.serve`` processes on this host —
+    the leaked-server guard: a stray resident server keeps burning CPU
+    after its test/bench ends and is the prime suspect when tier-1 drifts
+    toward its wall-clock ceiling."""
+    import os
+
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace")
+        except OSError:
+            continue
+        if "cluster_tools_tpu.serve" in cmd.replace("\x00", " "):
+            out.append(int(pid))
+    return out
+
+
+def reap_process(proc, timeout=30):
+    """SIGKILL + wait a subprocess if it is still alive (the ``finally``
+    guard every serve-spawning test/bench must run)."""
+    if proc.poll() is None:
+        proc.kill()
+        try:
+            proc.wait(timeout=timeout)
+        except Exception:
+            pass
+
+
 def write_stub(path, body):
     """Write an executable shell stub (`#!/bin/bash` + body)."""
     import os

@@ -1,0 +1,36 @@
+"""CT010 fixture: raw journal-file writes outside the journal module, an
+append path with no fsync evidence, and journal IO under server locks."""
+
+import os
+import threading
+
+
+class Journal:
+    def __init__(self, path):
+        self.path = path
+        self._fh = open(path, "ab")
+
+    def append(self, frame):
+        # no fsync: the record only reaches the page cache — a SIGKILL
+        # right after the HTTP 200 loses the acknowledged request
+        self._fh.write(frame)
+        self._fh.flush()
+
+
+class Server:
+    def __init__(self, journal, journal_path):
+        self._journal = journal
+        self.journal_path = journal_path
+        self._requests_lock = threading.Lock()
+
+    def submit(self, record, frame):
+        # raw write to the journal file: bypasses the CRC framing and the
+        # fsync that make the ack durable
+        with open(self.journal_path, "ab") as f:
+            f.write(frame)
+        os.open(self.journal_path, os.O_WRONLY)
+        self._journal._fh.write(frame)  # raw handle write, same bypass
+        with self._requests_lock:
+            # journal IO under the request lock: an fsync'd disk round
+            # trip that head-of-line blocks every submitter
+            self._journal.append(record)

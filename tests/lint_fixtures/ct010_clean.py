@@ -1,0 +1,50 @@
+"""CT010 fixture: framed+fsync'd append path, journal IO outside the
+server's locks, read-only journal access elsewhere (clean)."""
+
+import json
+import os
+import threading
+
+
+class Journal:
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "ab")
+
+    def append(self, frame):
+        with self._lock:
+            self._fh.write(frame)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+
+class Server:
+    def __init__(self, journal):
+        self._journal = journal
+        self._requests_lock = threading.Lock()
+
+    def submit(self, record, frame):
+        # bookkeeping under the lock, the fsync'd append after release
+        with self._requests_lock:
+            snapshot = dict(record)
+        self._journal.append(frame)
+        return snapshot
+
+
+def report(journal_path):
+    # read-mode access to the journal is the report tooling's business
+    with open(journal_path, "rb") as f:
+        return f.read()
+
+
+def peek(journal_path):
+    # mode-less open defaults to 'r' — read-only, not a raw write
+    with open(journal_path) as f:
+        return f.readline()
+
+
+def stats(journal_path):
+    doc = json.loads("{}")
+    doc["bytes"] = os.path.getsize(journal_path)
+    return doc

@@ -1,0 +1,18 @@
+"""The chaos suite's poison request (docs/SERVING.md "Durability"): a
+workflow that hard-kills its own process the moment it runs, so a server
+that dispatches it dies mid-request every single time.  The journal's
+crash-loop defense — not this workflow ever completing — is what ends the
+loop (``quarantined:crash_loop`` after ``max_replay_attempts``).
+
+Referenced from chaos tests by its ``module:Class`` spec
+(``tests.poison:PoisonWorkflow``)."""
+
+from cluster_tools_tpu.runtime import faults
+from cluster_tools_tpu.runtime.task import WorkflowBase
+
+
+class PoisonWorkflow(WorkflowBase):
+    task_name = "poison"
+
+    def run_impl(self):
+        faults.hard_exit()

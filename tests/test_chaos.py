@@ -41,7 +41,7 @@ from cluster_tools_tpu.runtime.faults import KILL_EXIT_CODE
 from cluster_tools_tpu.runtime.supervision import REQUEUE_EXIT_CODE
 from cluster_tools_tpu.utils.volume_utils import file_reader
 
-from .helpers import stub_slurm_bins
+from .helpers import reap_process, stray_serve_pids, stub_slurm_bins
 from .test_multicut_workflow import make_case, _write_ds
 
 pytestmark = [pytest.mark.chaos, pytest.mark.slow]
@@ -731,32 +731,38 @@ def test_chaos_serve_sigterm_drain_restart_and_admit_rejects(tmp_path):
         + [("bob", f"b{i}", f"seg_b{i}") for i in range(3)]
 
     proc, client = _start_serve(srv, env, max_workers=1)
-    rejected = []
-    for tenant, rid, key in requests:
-        _submit_riding_backpressure(client, payload(tenant, rid, key),
-                                    rejected)
-    # the injected fault fired exactly once (bob's first submission),
-    # was typed, and left no partial state behind
-    assert rejected == [("bob", "rejected:fault")]
-    assert not os.path.exists(os.path.join(root, "req_b0", "markers"))
+    try:
+        rejected = []
+        for tenant, rid, key in requests:
+            _submit_riding_backpressure(client, payload(tenant, rid, key),
+                                        rejected)
+        # the injected fault fired exactly once (bob's first submission),
+        # was typed, and left no partial state behind
+        assert rejected == [("bob", "rejected:fault")]
+        assert not os.path.exists(os.path.join(root, "req_b0", "markers"))
 
-    # -- SIGTERM mid-traffic ----------------------------------------------
-    deadline = time.monotonic() + 120
-    while True:
-        states = [
-            (client.request(rid) or {}).get("state")
-            for _, rid, _ in requests
-        ]
-        if states.count("done") >= 1 and states.count("done") < len(states):
-            break
-        assert time.monotonic() < deadline, f"no drain window: {states}"
-        time.sleep(0.1)
-    proc.send_signal(signal.SIGTERM)
-    rc = proc.wait(timeout=120)
-    assert rc == REQUEUE_EXIT_CODE, (
-        f"drain exited rc={rc}, wanted {REQUEUE_EXIT_CODE}:\n"
-        f"{proc.stdout.read()[-4000:]}"
-    )
+        # -- SIGTERM mid-traffic ------------------------------------------
+        deadline = time.monotonic() + 120
+        while True:
+            states = [
+                (client.request(rid) or {}).get("state")
+                for _, rid, _ in requests
+            ]
+            if states.count("done") >= 1 \
+                    and states.count("done") < len(states):
+                break
+            assert time.monotonic() < deadline, f"no drain window: {states}"
+            time.sleep(0.1)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == REQUEUE_EXIT_CODE, (
+            f"drain exited rc={rc}, wanted {REQUEUE_EXIT_CODE}:\n"
+            f"{proc.stdout.read()[-4000:]}"
+        )
+    finally:
+        # leaked-server reap: an assertion mid-traffic must not leave a
+        # resident server burning CPU for the rest of the suite
+        reap_process(proc)
 
     # the final state file: drained flag set, every request terminal-or-
     # queued, and NO handoff entry outlived its request
@@ -776,41 +782,238 @@ def test_chaos_serve_sigterm_drain_restart_and_admit_rejects(tmp_path):
         "SIGTERM landed outside the traffic window", state["requests"])
 
     # -- restart: re-submitted requests complete bit-identically -----------
+    # (the journal re-enqueues them server-side too; the resubmissions
+    # now answer idempotently — the backpressure protocol is unchanged)
     proc2, client2 = _start_serve(srv, env, max_workers=2)
-    rejected2 = []
-    for tenant, rid, key in requests:
-        if rid in done_before:
-            continue
-        _submit_riding_backpressure(client2, payload(tenant, rid, key),
-                                    rejected2)
-    for tenant, rid, key in requests:
-        if rid in done_before:
-            continue
-        rec = client2.wait(rid, timeout_s=240)
-        assert rec["state"] == "done", rec
-    # bob's first post-restart submission hit the (re-seeded) fault again
-    assert [(t, c) for t, c in rejected2] \
-        == [("bob", "rejected:fault")] * len(rejected2)
+    try:
+        rejected2 = []
+        for tenant, rid, key in requests:
+            if rid in done_before:
+                continue
+            _submit_riding_backpressure(client2, payload(tenant, rid, key),
+                                        rejected2)
+        for tenant, rid, key in requests:
+            if rid in done_before:
+                continue
+            rec = client2.wait(rid, timeout_s=240)
+            assert rec["state"] == "done", rec
+        # any post-restart rejection is the (re-seeded) fault, typed
+        assert [(t, c) for t, c in rejected2] \
+            == [("bob", "rejected:fault")] * len(rejected2)
 
-    status = client2.status()
-    assert status["server"]["handoffs"]["live_entries"] == 0
-    assert status["rc"] == 0
+        status = client2.status()
+        assert status["server"]["handoffs"]["live_entries"] == 0
+        assert status["rc"] == 0
 
-    out = file_reader(data, "r")
-    for _, _, key in requests:
-        np.testing.assert_array_equal(np.asarray(out[key][...]), ref_seg)
+        out = file_reader(data, "r")
+        for _, _, key in requests:
+            np.testing.assert_array_equal(np.asarray(out[key][...]),
+                                          ref_seg)
 
-    # -- attribution: every injected rejection in failures.json ------------
-    with open(os.path.join(srv, "failures.json")) as f:
-        recs = json.load(f)["records"]
-    admit_recs = [r for r in recs if r["task"] == "server.bob"]
-    assert len(admit_recs) == len(rejected) + len(rejected2)
-    for r in admit_recs:
-        assert r["resolution"] == "rejected:fault"
-        assert r["resolved"] is True
-        assert r["sites"] == {"admit": 1}
-        assert r["schema_version"] == 2 and r["hostname"] and r["pid"]
+        # -- attribution: every injected rejection in failures.json --------
+        with open(os.path.join(srv, "failures.json")) as f:
+            recs = json.load(f)["records"]
+        admit_recs = [r for r in recs if r["task"] == "server.bob"]
+        assert len(admit_recs) == len(rejected) + len(rejected2)
+        for r in admit_recs:
+            assert r["resolution"] == "rejected:fault"
+            assert r["resolved"] is True
+            assert r["sites"] == {"admit": 1}
+            assert r["schema_version"] == 2 and r["hostname"] and r["pid"]
 
-    # -- clean second drain: rolling restarts ride the same protocol -------
-    proc2.send_signal(signal.SIGTERM)
-    assert proc2.wait(timeout=60) == REQUEUE_EXIT_CODE
+        # -- clean second drain: rolling restarts ride the same protocol ---
+        proc2.send_signal(signal.SIGTERM)
+        assert proc2.wait(timeout=60) == REQUEUE_EXIT_CODE
+    finally:
+        reap_process(proc2)
+    assert stray_serve_pids() == []
+
+
+def test_chaos_serve_sigkill_journal_replay_and_quarantine(tmp_path):
+    """ISSUE 13 acceptance: the durable submission journal under an
+    abrupt ``kill -9`` — the preemptible-fleet failure mode the drain
+    protocol cannot see coming.
+
+    - two-tenant traffic against the resident server; SIGKILL -9
+      mid-traffic (no drain, no flush) → restart → every previously-
+      acknowledged request completes BIT-IDENTICALLY to a solo batch run
+      with ZERO client resubmission (the journal replays completed
+      requests as idempotent records and re-enqueues acknowledged-but-
+      incomplete ones with their original tenant/payload);
+    - a duplicate resubmit of a completed id is answered idempotently
+      from the journal-recovered result;
+    - a seeded poison request (``tests.poison:PoisonWorkflow`` hard-kills
+      the process whenever dispatched) crash-loops the server exactly
+      ``max_replay_attempts`` times and is then quarantined at boot with
+      ``quarantined:crash_loop`` attributed in ``failures.json`` — the
+      server stays up and keeps serving;
+    - final server state shows ``live_entries == 0``, and no stray serve
+      process outlives the test (the leaked-server reap satellite).
+    """
+    import signal
+    import time
+
+    root = str(tmp_path)
+    rng = np.random.default_rng(SEED)
+    vol = (rng.random((16, 16, 16)) > 0.5).astype("float32")
+    data = os.path.join(root, "data.zarr")
+    ds = file_reader(data).create_dataset(
+        "mask", shape=vol.shape, chunks=(8, 8, 8), dtype="float32")
+    ds[...] = vol
+
+    # -- reference: single-tenant cold batch run (memory_handoffs on,
+    # matching the server's resident-owner default) -----------------------
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.connected_components import (
+        ConnectedComponentsWorkflow,
+    )
+
+    ref_dir = os.path.join(root, "ref")
+    os.makedirs(os.path.join(ref_dir, "config"), exist_ok=True)
+    with open(os.path.join(ref_dir, "config", "global.config"), "w") as f:
+        json.dump({"block_shape": [8, 8, 8], "memory_handoffs": True}, f)
+    assert build([ConnectedComponentsWorkflow(
+        tmp_folder=os.path.join(ref_dir, "tmp"),
+        config_dir=os.path.join(ref_dir, "config"),
+        max_jobs=2, target="local",
+        input_path=data, input_key="mask",
+        output_path=data, output_key="ref_seg", threshold=0.5,
+    )])
+    ref_seg = np.asarray(file_reader(data, "r")["ref_seg"][...])
+
+    srv = os.path.join(root, "srv")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("CTT_FAULTS", None)
+    config = {"max_replay_attempts": 2,
+              "tenants": {"alice": {}, "bob": {}}}
+
+    def payload(tenant, rid, out_key):
+        return dict(
+            tenant=tenant, request_id=rid,
+            workflow="connected_components",
+            config=dict(
+                tmp_folder=os.path.join(root, "req_" + rid),
+                global_config={"block_shape": [8, 8, 8]},
+                params=dict(input_path=data, input_key="mask",
+                            output_path=data, output_key=out_key,
+                            threshold=0.5),
+            ),
+        )
+
+    requests = [("alice", f"a{i}", f"seg_a{i}") for i in range(3)] \
+        + [("bob", f"b{i}", f"seg_b{i}") for i in range(3)]
+
+    # -- phase 1: acknowledge all six, SIGKILL -9 mid-traffic --------------
+    proc, client = _start_serve(srv, env, max_workers=1, config=config)
+    try:
+        for tenant, rid, key in requests:
+            client.submit(**payload(tenant, rid, key))
+        # wait for a mid-traffic window: some done, some not
+        deadline = time.monotonic() + 120
+        while True:
+            states = [
+                (client.request(rid) or {}).get("state")
+                for _, rid, _ in requests
+            ]
+            if states.count("done") >= 1 \
+                    and states.count("done") < len(states):
+                break
+            assert time.monotonic() < deadline, f"no kill window: {states}"
+            time.sleep(0.05)
+        proc.kill()  # SIGKILL: no drain, no flush, no goodbye
+        rc = proc.wait(timeout=60)
+        assert rc == -signal.SIGKILL, rc
+        done_before = {
+            rid for (_, rid, _), st in zip(requests, states)
+            if st == "done"
+        }
+    finally:
+        reap_process(proc)
+
+    # -- phase 2: restart; ZERO client resubmission ------------------------
+    proc2, client2 = _start_serve(srv, env, max_workers=2, config=config)
+    try:
+        health = client2.healthz()["journal"]
+        assert health["replayed"] >= len(done_before)
+        assert health["reenqueued"] >= 1, health
+        # only GETs from here: the journal's replay must finish every
+        # acknowledged request without the client lifting a finger
+        for tenant, rid, key in requests:
+            rec = client2.wait(rid, timeout_s=240)
+            assert rec["state"] == "done", rec
+        # a completed-before-the-kill id answers idempotently from the
+        # journal-recovered record (not by re-running)
+        probe = sorted(done_before)[0]
+        t, rid, key = next(r for r in requests if r[1] == probe)
+        doc = client2.submit(**payload(t, rid, key))
+        assert doc["idempotent"] is True and doc["state"] == "done"
+        rec = client2.request(probe)
+        assert rec["replayed"] is True
+
+        out = file_reader(data, "r")
+        for _, _, key in requests:
+            np.testing.assert_array_equal(np.asarray(out[key][...]),
+                                          ref_seg)
+        status = client2.status()
+        assert status["server"]["handoffs"]["live_entries"] == 0
+        assert status["rc"] == 0
+
+        # -- phase 3: the poison request ------------------------------------
+        # acknowledged (durable 200), then it kills the server on every
+        # dispatch — rc 113 via the injector's hard_exit
+        client2.submit(tenant="bob", request_id="poison-1",
+                       workflow="tests.poison:PoisonWorkflow",
+                       config=dict(
+                           tmp_folder=os.path.join(root, "req_poison")))
+        rc = proc2.wait(timeout=120)
+        assert rc == KILL_EXIT_CODE, rc
+    finally:
+        reap_process(proc2)
+
+    # crash loop: boot -> replay re-enqueues (1 attempt on record) ->
+    # dispatch -> dies again.  max_replay_attempts=2 bounds it.
+    proc3 = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.serve",
+         "--base-dir", srv, "--max-workers", "1",
+         "--config", os.path.join(srv, "serve_config.json")],
+        env=env, cwd=REPO_ROOT, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        rc = proc3.wait(timeout=120)
+        assert rc == KILL_EXIT_CODE, (
+            f"2nd dispatch of the poison should have crashed the server "
+            f"(rc {KILL_EXIT_CODE}), got rc={rc}:\n"
+            f"{proc3.stdout.read()[-4000:]}"
+        )
+    finally:
+        reap_process(proc3)
+
+    # -- phase 4: quarantine at boot; the server stays up ------------------
+    proc4, client4 = _start_serve(srv, env, max_workers=1, config=config)
+    try:
+        rec = client4.request("poison-1")
+        assert rec["state"] == "quarantined", rec
+        assert rec["code"] == "quarantined:crash_loop"
+        health = client4.healthz()["journal"]
+        assert health["quarantined"] == 1
+        assert health["replay_backlog"] == 0
+        with open(os.path.join(srv, "failures.json")) as f:
+            recs = json.load(f)["records"]
+        qrec = [r for r in recs
+                if r.get("block_id") == "request:poison-1"]
+        assert qrec and qrec[0]["resolution"] == "quarantined:crash_loop"
+        assert qrec[0]["quarantined"] is True and qrec[0]["resolved"] is True
+        assert qrec[0]["sites"] == {"journal_replay": 2}
+        # the quarantine defended the service: new work still completes
+        client4.submit(**payload("alice", "post-q", "seg_postq"))
+        assert client4.wait("post-q", timeout_s=240)["state"] == "done"
+        np.testing.assert_array_equal(
+            np.asarray(file_reader(data, "r")["seg_postq"][...]), ref_seg)
+        status = client4.status()
+        assert status["server"]["handoffs"]["live_entries"] == 0
+    finally:
+        reap_process(proc4)
+    assert stray_serve_pids() == []

@@ -232,9 +232,12 @@ def test_numpy_parallel_beats_python_heap_5x():
     lab_heap = _python_heap_gaec(n, edges, costs)
     t_heap = time.perf_counter() - t0
 
+    # min over 5 samples: a scheduler hiccup in ONE parallel sample must
+    # not fake a regression (the bar itself is unchanged; min-of-N is the
+    # standard noise-rejecting estimate of the true runtime)
     t_par = min(
         _timed(lambda: gaec_parallel(n, edges, costs, impl="numpy"))
-        for _ in range(3)
+        for _ in range(5)
     )
     lab_par = gaec_parallel(n, edges, costs, impl="numpy")
     assert t_heap / t_par >= 5.0, (

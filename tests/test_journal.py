@@ -1,0 +1,310 @@
+"""The durable submission journal (docs/SERVING.md "Durability"): CRC
+framing, torn-tail recovery at EVERY truncation offset, lifecycle folding,
+the fsync'd append path, and the injected ``torn`` fault.  Tier-1 fast —
+pure file IO, no jax."""
+
+import json
+import os
+import subprocess
+import sys
+import zlib
+
+import pytest
+
+from cluster_tools_tpu.runtime import faults
+from cluster_tools_tpu.runtime import journal as journal_mod
+from cluster_tools_tpu.runtime.faults import KILL_EXIT_CODE
+from cluster_tools_tpu.runtime.journal import Journal
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+def _records(n):
+    return [
+        {"type": "accepted", "request_id": f"r{i}", "tenant": "t",
+         "payload": {"workflow": "connected_components", "i": i}}
+        for i in range(n)
+    ]
+
+
+def _write_journal(path, records):
+    j = Journal(path)
+    assert j.recover() == []
+    for rec in records:
+        j.append(rec)
+    j.close()
+    return j
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def test_append_scan_round_trip(tmp_path):
+    path = str(tmp_path / "journal.log")
+    recs = _records(5)
+    j = _write_journal(path, recs)
+    assert j.appended == 5 and j.bytes == os.path.getsize(path)
+    got, good, torn = journal_mod.scan(path)
+    assert got == recs
+    assert good == os.path.getsize(path) and torn == 0
+
+
+def test_scan_missing_file_is_empty(tmp_path):
+    got, good, torn = journal_mod.scan(str(tmp_path / "nope.log"))
+    assert (got, good, torn) == ([], 0, 0)
+
+
+def test_recover_appends_after_previous_records(tmp_path):
+    path = str(tmp_path / "journal.log")
+    _write_journal(path, _records(2))
+    j = Journal(path)
+    assert j.recover() == _records(2)
+    j.append({"type": "dispatched", "request_id": "r0", "attempt": 1})
+    j.close()
+    got, _, torn = journal_mod.scan(path)
+    assert len(got) == 3 and torn == 0
+
+
+# -- torn-tail recovery at every byte offset ----------------------------------
+
+
+def test_torn_tail_truncation_at_every_offset(tmp_path):
+    """The acceptance property: truncating the journal at ANY byte offset
+    yields exactly the prefix of intact records — never an exception,
+    never a phantom or partial record."""
+    path = str(tmp_path / "journal.log")
+    recs = _records(4)
+    _write_journal(path, recs)
+    with open(path, "rb") as f:
+        data = f.read()
+    # per-record frame sizes, to compute the expected intact prefix
+    sizes = []
+    for rec in recs:
+        payload = json.dumps(
+            rec, separators=(",", ":"), sort_keys=True, default=str
+        ).encode()
+        sizes.append(12 + len(payload))
+    assert sum(sizes) == len(data)
+    boundaries = [sum(sizes[:k]) for k in range(len(sizes) + 1)]
+    trunc = str(tmp_path / "trunc.log")
+    for off in range(len(data) + 1):
+        expect = max(k for k in range(len(sizes) + 1)
+                     if boundaries[k] <= off)
+        with open(trunc, "wb") as f:
+            f.write(data[:off])
+        got, good, torn = journal_mod.scan(trunc)
+        assert got == recs[:expect], f"offset {off}"
+        assert good == boundaries[expect], f"offset {off}"
+        assert torn == off - boundaries[expect], f"offset {off}"
+
+
+def test_recover_truncates_torn_tail_and_reuses_file(tmp_path):
+    path = str(tmp_path / "journal.log")
+    recs = _records(3)
+    _write_journal(path, recs)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 5)  # cut the final record mid-payload
+    j = Journal(path)
+    assert j.recover() == recs[:2]
+    assert j.torn_bytes_truncated > 0
+    # the torn bytes are GONE from disk; a new append lands cleanly
+    j.append(recs[2])
+    j.close()
+    got, _, torn = journal_mod.scan(path)
+    assert got == recs[:2] + [recs[2]] and torn == 0
+
+
+def test_scan_stops_at_corrupt_crc_and_bad_magic(tmp_path):
+    path = str(tmp_path / "journal.log")
+    recs = _records(3)
+    _write_journal(path, recs)
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    # flip one payload byte of the second record
+    payload0 = json.dumps(
+        recs[0], separators=(",", ":"), sort_keys=True, default=str
+    ).encode()
+    off = 12 + len(payload0) + 12 + 2
+    data[off] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    got, good, torn = journal_mod.scan(path)
+    assert got == recs[:1] and torn > 0
+    assert good == 12 + len(payload0)
+
+
+# -- lifecycle folding --------------------------------------------------------
+
+
+def test_fold_lifecycle_states():
+    recs = [
+        {"type": "accepted", "request_id": "a", "tenant": "t1",
+         "payload": {"workflow": "w"}, "fingerprint": "fp-a"},
+        {"type": "dispatched", "request_id": "a", "attempt": 1},
+        {"type": "completed", "request_id": "a",
+         "record": {"state": "done", "run_s": 1.5}},
+        {"type": "accepted", "request_id": "b", "tenant": "t2",
+         "payload": {"workflow": "w"}},
+        {"type": "dispatched", "request_id": "b", "attempt": 1},
+        {"type": "accepted", "request_id": "c", "tenant": "t2",
+         "payload": {"workflow": "w"}},
+        {"type": "rejected", "request_id": "d", "tenant": "t1",
+         "code": "rejected:queue_depth"},
+    ]
+    folded = journal_mod.fold(recs)
+    assert list(folded) == ["a", "b", "c", "d"]
+    assert folded["a"]["state"] == "completed"
+    assert folded["a"]["attempts"] == 1
+    assert folded["a"]["record"] == {"state": "done", "run_s": 1.5}
+    assert folded["a"]["fingerprint"] == "fp-a"
+    assert folded["b"]["state"] == "dispatched"  # acknowledged, incomplete
+    assert folded["c"]["state"] == "accepted"
+    assert folded["d"]["state"] == "rejected"
+    assert folded["d"]["code"] == "rejected:queue_depth"
+
+
+def test_fold_counts_attempts_and_new_incarnation():
+    recs = [
+        {"type": "accepted", "request_id": "x", "tenant": "t",
+         "payload": {"v": 1}},
+        {"type": "dispatched", "request_id": "x", "attempt": 1},
+        {"type": "dispatched", "request_id": "x", "attempt": 2},
+        {"type": "dispatched", "request_id": "x", "attempt": 3},
+    ]
+    assert journal_mod.fold(recs)["x"]["attempts"] == 3
+    # a terminal state frees the id: a later accepted starts a fresh
+    # incarnation (the back-off-and-resubmit protocol)
+    recs += [
+        {"type": "failed", "request_id": "x",
+         "record": {"state": "failed"}},
+        {"type": "accepted", "request_id": "x", "tenant": "t",
+         "payload": {"v": 2}},
+    ]
+    ent = journal_mod.fold(recs)["x"]
+    assert ent["state"] == "accepted" and ent["attempts"] == 0
+    assert ent["payload"] == {"v": 2}
+    # a duplicate accepted for a LIVE id keeps the original payload
+    recs += [{"type": "accepted", "request_id": "x", "tenant": "t",
+              "payload": {"v": 3}}]
+    assert journal_mod.fold(recs)["x"]["payload"] == {"v": 2}
+
+
+def test_fold_drained_is_not_terminal_and_resets_attempts():
+    recs = [
+        {"type": "accepted", "request_id": "q", "tenant": "t",
+         "payload": {}},
+        {"type": "dispatched", "request_id": "q", "attempt": 1},
+        {"type": "drained", "request_id": "q"},
+    ]
+    ent = journal_mod.fold(recs)["q"]
+    assert ent["state"] == "drained"
+    assert ent["state"] not in journal_mod.TERMINAL_TYPES
+    # a graceful drain proves the dispatch did NOT crash the server:
+    # rolling SIGTERM restarts must never accrue toward the crash-loop
+    # budget (or routine redeploys would quarantine long-running work)
+    assert ent["attempts"] == 0
+    recs = (recs * 3) + [
+        {"type": "dispatched", "request_id": "q", "attempt": 1},
+    ]
+    ent = journal_mod.fold(recs)["q"]
+    assert ent["state"] == "dispatched" and ent["attempts"] == 1
+
+
+# -- the injected torn append (kind='torn', site='journal') -------------------
+
+
+def test_torn_fault_requires_journal_site_and_state_dir(tmp_path):
+    with pytest.raises(ValueError):
+        faults.configure({"faults": [{"site": "load", "kind": "torn"}],
+                          "state_dir": str(tmp_path)})
+    with pytest.raises(ValueError):
+        faults.configure({"faults": [{"site": "journal", "kind": "torn"}]})
+
+
+def test_torn_append_hook_is_one_shot_via_latch(tmp_path):
+    inj = faults.configure({
+        "state_dir": str(tmp_path),
+        "faults": [{"site": "journal", "kind": "torn", "after": 2,
+                    "keep_fraction": 0.25}],
+    })
+    assert inj.torn_append() is None          # 1st append untouched
+    assert inj.torn_append() == 0.25          # 2nd append tears
+    assert inj.torn_append() is None          # counter moved past 'after'
+    # a fresh injector (the restarted process) honors the latch
+    inj2 = faults.configure({
+        "state_dir": str(tmp_path),
+        "faults": [{"site": "journal", "kind": "torn", "after": 2,
+                    "keep_fraction": 0.25}],
+    })
+    assert all(inj2.torn_append() is None for _ in range(4))
+
+
+def test_torn_fault_tears_real_append_and_recovery_truncates(tmp_path):
+    """End-to-end through a subprocess (the torn write hard-exits): the
+    2nd append lands only a prefix and the process dies with the injected
+    kill code; recovery truncates back to the intact first record and the
+    rerun (latched fault) completes the journal."""
+    path = str(tmp_path / "journal.log")
+    state = str(tmp_path / "state")
+    script = (
+        "from cluster_tools_tpu.runtime.journal import Journal\n"
+        f"j = Journal({path!r})\n"
+        "j.recover()\n"
+        "j.append({'type': 'accepted', 'request_id': 'r0'})\n"
+        "j.append({'type': 'accepted', 'request_id': 'r1'})\n"
+        "j.append({'type': 'accepted', 'request_id': 'r2'})\n"
+        "j.close()\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["CTT_FAULTS"] = json.dumps({
+        "state_dir": state,
+        "faults": [{"site": "journal", "kind": "torn", "after": 2}],
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == KILL_EXIT_CODE, proc.stderr[-2000:]
+    got, good, torn = journal_mod.scan(path)
+    assert [r["request_id"] for r in got] == ["r0"]
+    assert torn > 0  # the torn half-frame is on disk
+    # the restarted process: latched fault stays quiet, recovery truncates
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    got, _, torn = journal_mod.scan(path)
+    assert [r["request_id"] for r in got] == ["r0", "r0", "r1", "r2"]
+    assert torn == 0
+
+
+def test_crc_framing_detects_single_bit_flips(tmp_path):
+    """Every single-bit flip inside a frame is caught by the CRC/framing —
+    a flipped record can never replay as valid."""
+    path = str(tmp_path / "journal.log")
+    rec = {"type": "accepted", "request_id": "r0", "tenant": "t"}
+    _write_journal(path, [rec])
+    with open(path, "rb") as f:
+        clean = f.read()
+    payload = json.dumps(
+        rec, separators=(",", ":"), sort_keys=True, default=str
+    ).encode()
+    assert zlib.crc32(payload) == int.from_bytes(clean[8:12], "little")
+    for byte in range(len(clean)):
+        for bit in range(8):
+            data = bytearray(clean)
+            data[byte] ^= 1 << bit
+            with open(path, "wb") as f:
+                f.write(bytes(data))
+            got, _, _ = journal_mod.scan(path)
+            assert got == [], f"bit flip at byte {byte} bit {bit} survived"

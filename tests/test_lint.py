@@ -196,6 +196,38 @@ def test_ct009_service_modules_pass_unsuppressed():
         assert "ctlint: disable=CT009" not in open(path).read()
 
 
+def test_ct010_all_violation_classes():
+    """Durable-journal discipline (docs/SERVING.md "Durability"): a raw
+    journal-file write outside the journal module, an append path with no
+    fsync evidence, and journal IO under a server lock — each its own
+    violation class."""
+    findings, _ = lint_fixture("ct010_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT010"]
+    assert any("raw open of the journal file" in m for m in msgs)
+    assert any("raw 'write' on journal handle" in m for m in msgs)
+    assert any("no os.fsync evidence" in m for m in msgs)
+    assert any("while holding server lock" in m for m in msgs)
+
+
+def test_ct010_journal_surface_passes_unsuppressed():
+    """The real journal-aware surface satisfies the discipline on merit:
+    one framed+fsync'd append path, journal IO outside the server's
+    locks — no opt-outs."""
+    paths = [
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "journal.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "server.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "admission.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "serve.py"),
+    ]
+    for path in paths:
+        findings, _ = run_lint([path])
+        assert [f for f in findings if f.rule == "CT010"] == [], path
+        assert "ctlint: disable=CT010" not in open(path).read()
+
+
 # -- suppressions -------------------------------------------------------------
 
 

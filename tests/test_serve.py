@@ -27,6 +27,8 @@ from cluster_tools_tpu.runtime.admission import (
 from cluster_tools_tpu.utils import function_utils as fu
 from cluster_tools_tpu.utils.volume_utils import file_reader
 
+from .helpers import stray_serve_pids as _stray_serve_pids
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -346,6 +348,11 @@ def test_serve_smoke_two_tenants_warm_cache(tmp_path):
         np.testing.assert_array_equal(seg_a, seg_b)
     finally:
         server.stop()
+    # the leaked-server guard: no stray resident serve process outlives
+    # the smoke test on this host — leaked servers burn CPU for the rest
+    # of the suite and are the prime suspect when tier-1 drifts toward
+    # its wall-clock ceiling
+    assert _stray_serve_pids() == []
 
 
 def test_injected_admit_fault_leaves_no_partial_state(tmp_path):
@@ -392,6 +399,11 @@ def test_injected_admit_fault_leaves_no_partial_state(tmp_path):
 
 
 def test_duplicate_and_unknown_requests_rejected(tmp_path):
+    """Submission is idempotent per (request_id, payload): the SAME
+    payload under a live/done id answers from the record (the durable-ack
+    contract — a client retry must never re-run or bounce), while a
+    DIFFERENT payload under the same id is a real collision and stays
+    rejected:duplicate."""
     base = str(tmp_path)
     data = _mk_input(base, shape=(8, 8, 8))
     server, client = _start_server(base, max_workers=1)
@@ -399,16 +411,28 @@ def test_duplicate_and_unknown_requests_rejected(tmp_path):
         from cluster_tools_tpu.runtime.server import ServeRejected
 
         client.submit(**_serve_payload(base, data, "t", "r1", "seg1"))
+        # retry of the acknowledged submission: idempotent 200, no re-run
+        doc = client.submit(**_serve_payload(base, data, "t", "r1", "seg1"))
+        assert doc["idempotent"] is True
+        assert doc["state"] in ("queued", "running", "done")
+        # same id, different payload: a collision, typed and attributed
         with pytest.raises(ServeRejected) as ei:
-            client.submit(**_serve_payload(base, data, "t", "r1", "seg1"))
+            client.submit(**_serve_payload(base, data, "t", "r1", "OTHER"))
         assert ei.value.code == admission.REJECT_DUPLICATE
-        # duplicates are attributed like every other rejection
         assert client.status()["server"]["tenants"]["t"]["rejected"] == 1
         with pytest.raises(ServeRejected) as ei:
             client.submit(tenant="t", request_id="r2",
                           workflow="definitely_not_a_workflow")
         assert ei.value.http_status == 400
-        assert client.wait("r1", timeout_s=120)["state"] == "done"
+        rec = client.wait("r1", timeout_s=120)
+        assert rec["state"] == "done"
+        # a duplicate resubmit of the COMPLETED id answers idempotently
+        # from the recorded result
+        doc = client.submit(**_serve_payload(base, data, "t", "r1", "seg1"))
+        assert doc == {
+            "request_id": "r1", "state": "done", "idempotent": True,
+            "run_s": rec["run_s"], "total_s": rec["total_s"],
+        }
     finally:
         server.stop()
 
@@ -444,6 +468,188 @@ def test_server_queue_quota_backpressure_http(tmp_path):
         server.stop()
 
 
+# -- the durable submission journal (docs/SERVING.md "Durability") ------------
+
+
+def _journal_of(base):
+    from cluster_tools_tpu.runtime import journal as journal_mod
+
+    return journal_mod.journal_path(os.path.join(base, "srv"))
+
+
+def test_restart_replays_completed_requests_idempotently(tmp_path):
+    """A restarted server rebuilds completed requests from the journal:
+    duplicate resubmits of a done id answer idempotently from the
+    recorded result, and tenant counters survive the restart."""
+    base = str(tmp_path)
+    data = _mk_input(base, shape=(8, 8, 8))
+    payload = _serve_payload(base, data, "alice", "a1", "seg")
+    server, client = _start_server(base, tenants={"alice": {}})
+    try:
+        client.submit(**payload)
+        rec = client.wait("a1", timeout_s=120)
+        assert rec["state"] == "done"
+    finally:
+        server.stop()
+
+    server2, client2 = _start_server(base, tenants={"alice": {}})
+    try:
+        # the record came back from the journal, not from client memory
+        rec2 = client2.request("a1")
+        assert rec2["state"] == "done" and rec2["replayed"] is True
+        assert rec2["run_s"] == rec["run_s"]
+        doc = client2.submit(**payload)
+        assert doc["idempotent"] is True and doc["state"] == "done"
+        # counters reconstructed from replay: quotas + operator view stay
+        # correct across the restart
+        snap = client2.status()["server"]["tenants"]["alice"]
+        assert snap["submitted"] == 1 and snap["completed"] == 1
+        # ... and a DIFFERENT payload under the done id is still a
+        # collision
+        from cluster_tools_tpu.runtime.server import ServeRejected
+
+        with pytest.raises(ServeRejected) as ei:
+            client2.submit(**_serve_payload(base, data, "alice", "a1",
+                                            "other_key"))
+        assert ei.value.code == admission.REJECT_DUPLICATE
+    finally:
+        server2.stop()
+
+
+def test_replay_reenqueues_acknowledged_incomplete_request(tmp_path):
+    """An accepted-but-never-run request (the SIGKILL window) is re-run
+    by the restarted server with its original tenant/payload — the 200
+    was a durable promise, no client resubmission needed."""
+    from cluster_tools_tpu.runtime import journal as journal_mod
+    from cluster_tools_tpu.runtime.server import _payload_fingerprint
+
+    base = str(tmp_path)
+    data = _mk_input(base, shape=(8, 8, 8))
+    payload = _serve_payload(base, data, "bob", "b1", "seg_b")
+    os.makedirs(os.path.join(base, "srv"), exist_ok=True)
+    j = journal_mod.Journal(_journal_of(base))
+    j.recover()
+    j.append_transition(
+        journal_mod.ACCEPTED, "b1", tenant="bob", payload=payload,
+        fingerprint=_payload_fingerprint(payload),
+    )
+    j.close()
+
+    server, client = _start_server(base, tenants={"bob": {}})
+    try:
+        health = client.healthz()["journal"]
+        assert health["reenqueued"] == 1 and health["quarantined"] == 0
+        rec = client.wait("b1", timeout_s=120)
+        assert rec["state"] == "done" and rec["replayed"] is True
+        out = np.asarray(file_reader(data)["seg_b"][...])
+        assert out.shape == (8, 8, 8)
+        assert client.healthz()["journal"]["replay_backlog"] == 0
+        assert handoff.live_entries() == 0
+    finally:
+        server.stop()
+
+
+def test_replay_quarantines_crash_looping_request(tmp_path):
+    """Crash-loop defense: a journaled request whose dispatch count has
+    reached max_replay_attempts is quarantined at boot — typed
+    quarantined:crash_loop in failures.json, idempotent 'quarantined'
+    answers for same-payload resubmits — instead of re-running."""
+    from cluster_tools_tpu.runtime import journal as journal_mod
+    from cluster_tools_tpu.runtime.server import (
+        QUARANTINE_CRASH_LOOP,
+        ServeRejected,
+        _payload_fingerprint,
+    )
+
+    base = str(tmp_path)
+    data = _mk_input(base, shape=(8, 8, 8))
+    payload = _serve_payload(base, data, "eve", "p1", "seg_p")
+    os.makedirs(os.path.join(base, "srv"), exist_ok=True)
+    j = journal_mod.Journal(_journal_of(base))
+    j.recover()
+    j.append_transition(
+        journal_mod.ACCEPTED, "p1", tenant="eve", payload=payload,
+        fingerprint=_payload_fingerprint(payload),
+    )
+    for attempt in (1, 2):
+        j.append_transition(
+            journal_mod.DISPATCHED, "p1", tenant="eve", attempt=attempt,
+        )
+    j.close()
+
+    server, client = _start_server(
+        base, tenants={"eve": {}}, max_replay_attempts=2,
+    )
+    try:
+        rec = client.request("p1")
+        assert rec["state"] == "quarantined"
+        assert rec["code"] == QUARANTINE_CRASH_LOOP
+        health = client.healthz()["journal"]
+        assert health["quarantined"] == 1 and health["reenqueued"] == 0
+        # same payload: idempotent answer, never re-run; different
+        # payload: collision
+        doc = client.submit(**payload)
+        assert doc["idempotent"] is True and doc["state"] == "quarantined"
+        with pytest.raises(ServeRejected) as ei:
+            client.submit(**_serve_payload(base, data, "eve", "p1", "zz"))
+        assert ei.value.code == admission.REJECT_DUPLICATE
+        # attributed: quarantined + resolved (the quarantine IS the
+        # resolution — the server defended itself), so /status rc stays 0
+        doc = fu.read_json_if_valid(
+            fu.failures_path(os.path.join(base, "srv")))
+        recs = [r for r in doc["records"]
+                if r.get("task") == "server.eve"
+                and r.get("block_id") == "request:p1"]
+        assert recs and recs[0]["resolution"] == QUARANTINE_CRASH_LOOP
+        assert recs[0]["quarantined"] is True
+        assert recs[0]["resolved"] is True
+        assert recs[0]["sites"] == {"journal_replay": 2}
+        assert client.status()["rc"] == 0
+        # the journal itself records the quarantine, so the NEXT restart
+        # answers from the terminal record instead of re-deciding
+        from cluster_tools_tpu.runtime import journal as jm
+
+        folded = jm.fold(jm.scan(_journal_of(base))[0])
+        assert folded["p1"]["state"] == jm.QUARANTINED
+    finally:
+        server.stop()
+
+
+def test_replay_tolerates_torn_journal_tail(tmp_path):
+    """A torn tail (SIGKILL mid-append) never refuses boot: the intact
+    prefix replays, the torn bytes are truncated and surfaced in the
+    health block."""
+    from cluster_tools_tpu.runtime import journal as journal_mod
+    from cluster_tools_tpu.runtime.server import _payload_fingerprint
+
+    base = str(tmp_path)
+    data = _mk_input(base, shape=(8, 8, 8))
+    payload = _serve_payload(base, data, "t", "r1", "seg")
+    os.makedirs(os.path.join(base, "srv"), exist_ok=True)
+    jpath = _journal_of(base)
+    j = journal_mod.Journal(jpath)
+    j.recover()
+    j.append_transition(
+        journal_mod.ACCEPTED, "r1", tenant="t", payload=payload,
+        fingerprint=_payload_fingerprint(payload),
+    )
+    j.append_transition(journal_mod.ACCEPTED, "r2", tenant="t",
+                        payload={"workflow": "connected_components"})
+    j.close()
+    with open(jpath, "r+b") as f:
+        f.truncate(os.path.getsize(jpath) - 7)  # tear r2's record
+
+    server, client = _start_server(base)
+    try:
+        health = client.healthz()["journal"]
+        assert health["torn_bytes_truncated"] > 0
+        assert health["reenqueued"] == 1  # r1 survived, r2 never acked
+        assert client.request("r2") is None
+        assert client.wait("r1", timeout_s=120)["state"] == "done"
+    finally:
+        server.stop()
+
+
 def test_progress_renders_server_view(tmp_path):
     """Satellite: ``make progress TMP=<server base>`` renders the
     per-tenant admission view alongside the block-marker table."""
@@ -475,6 +681,56 @@ def test_progress_renders_server_view(tmp_path):
     if server_view["pid"] is not None and not prog._pid_alive(
             server_view["pid"]):
         assert server_view["stale"]
+
+
+def test_progress_and_report_render_journal_plane(tmp_path):
+    """Satellites: ``make progress`` renders the journal pulse (replayed /
+    re-enqueued / quarantined) and ``failures_report.py --json`` carries a
+    ``journal`` block, so the one machine-readable document covers the
+    durability plane."""
+    from cluster_tools_tpu.runtime import journal as journal_mod
+
+    base = str(tmp_path)
+    data = _mk_input(base, shape=(8, 8, 8))
+    server, client = _start_server(base, tenants={"alice": {}})
+    try:
+        client.submit(**_serve_payload(base, data, "alice", "a1", "seg"))
+        client.wait("a1", timeout_s=120)
+    finally:
+        server.stop()
+    srv = os.path.join(base, "srv")
+
+    spec = importlib.util.spec_from_file_location(
+        "ctt_progress2", os.path.join(REPO_ROOT, "scripts", "progress.py"))
+    prog = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(prog)
+    doc = prog.collect_progress(srv)
+    j = doc["server"]["journal"]
+    # accepted + dispatched + completed for the one request
+    assert j["appended"] == 3 and j["replay_backlog"] == 0
+    text = prog.format_progress(doc)
+    assert "journal:" in text and "3 record(s) appended" in text
+
+    spec = importlib.util.spec_from_file_location(
+        "ctt_failrep", os.path.join(REPO_ROOT, "scripts",
+                                    "failures_report.py"))
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    jdoc = rep.build_json_report(srv, with_lint=False)
+    jblock = jdoc["journal"]
+    assert jblock["n_records"] == 3
+    assert jblock["by_type"] == {
+        "accepted": 1, "dispatched": 1, "completed": 1,
+    }
+    assert jblock["n_replays"] == 0 and jblock["n_quarantined"] == 0
+    assert jblock["torn_tail_bytes"] == 0
+    # format-drift guard: the report's stdlib scanner and the runtime's
+    # reader must agree record for record
+    recs, good, torn = journal_mod.scan(journal_mod.journal_path(srv))
+    assert len(recs) == jblock["n_records"] and torn == 0
+    assert good == jblock["bytes"]
+    # a run without a journal reports null (batch runs unchanged)
+    assert rep.build_json_report(base, with_lint=False)["journal"] is None
 
 
 def test_serve_cli_status_requires_endpoint(tmp_path):
