@@ -51,6 +51,14 @@
 #                     throughput, dispatch counts, and bit-identity into
 #                     BENCH_r07.json; cpu backend, <30 s (a <10 s smoke
 #                     twin runs inside tier1 via tests/test_sharded.py)
+#   bench-ragged    = ragged paged-pool bench (docs/PERFORMANCE.md "Ragged
+#                     sweeps"): an edge/split-heavy sweep on a non-pow2
+#                     27-block grid (clipped edges + 8 forced degrade-
+#                     splits) run per-block vs through the paged block
+#                     pool, recording compiled-dispatch counts (>=8x
+#                     fewer), ragged-lane attribution, and bit-identity
+#                     into BENCH_r11.json; cpu backend, <10 s (a smoke
+#                     twin runs inside tier1 via tests/test_ragged.py)
 #   bench-solve     = distributed-agglomeration bench (docs/PERFORMANCE.md
 #                     "Distributed agglomeration"): the >=100k-edge
 #                     solver-scale instance solved single-host vs over the
@@ -83,7 +91,7 @@ CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
 .PHONY: test lint tier1 tier2 chaos chaos-resource failures-report progress \
-	bench-io bench-sweep bench-fuse bench-solve bench-serve \
+	bench-io bench-sweep bench-fuse bench-ragged bench-solve bench-serve \
 	bench-trajectory serve-smoke supervise-demo native clean
 
 test: lint tier1 tier2 chaos
@@ -122,6 +130,9 @@ bench-sweep:
 
 bench-fuse:
 	JAX_PLATFORMS=cpu $(PY) bench.py --fuse
+
+bench-ragged:
+	JAX_PLATFORMS=cpu $(PY) bench.py --ragged
 
 bench-solve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --solve

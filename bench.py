@@ -825,6 +825,166 @@ def sweep_bench(smoke=False, n_devices=1):
     return rec
 
 
+def ragged_bench(smoke=False, n_devices=1):
+    """Ragged paged-pool config (docs/PERFORMANCE.md "Ragged sweeps").
+
+    The regime real volumes live in: a NON-power-of-two grid (27 blocks of
+    16^3 over a 44^3 volume — every face block volume-edge-clipped, so the
+    un-padded loads come back in many distinct shapes) with FORCED
+    degrade-splits (a seeded ``min_voxels``-gated OOM makes 8 full-size
+    blocks fail at load so they re-execute as 2^3 halo-correct sub-blocks
+    each).  The per-block fallback — what this workload degraded to before
+    the paged block pool — pays one compiled dispatch per block plus one
+    per sub-block; the ragged path packs the mixed-shape lanes AND the
+    split sub-blocks through the paged pool
+    (``parallel/block_pool.py``) and dispatches ONE descriptor-driven
+    program per batch.  Records both arms' dispatch counts from the
+    executor's counters, the ragged-lane attribution (padding lanes,
+    pool pages), warm wall time, and bit-identity (elementwise kernel —
+    the shape-local contract of docs/PERFORMANCE.md "Ragged sweeps").
+
+    ``smoke=True`` is the <10 s tier-1 variant (single rep, no file
+    output); the full run writes BENCH_r11.json next to this script.
+    Emits exactly one JSON line on stdout and returns the record.
+    """
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.runtime import executor as executor_mod
+    from cluster_tools_tpu.runtime import faults as faults_mod
+    from cluster_tools_tpu.runtime import trace as trace_mod
+    from cluster_tools_tpu.runtime.executor import BlockwiseExecutor
+    from cluster_tools_tpu.utils import function_utils as fu
+    from cluster_tools_tpu.utils.volume_utils import Blocking
+
+    shape = (44, 44, 44)
+    block, halo = 16, (4, 4, 4)
+    sharded_batch = 32
+    reps = 1 if smoke else 3
+    rng = np.random.default_rng(0)
+    vol = rng.random(shape).astype(np.float32)
+    blocking = Blocking(shape, (block,) * 3)
+    blocks = [
+        blocking.get_block(i, halo=halo) for i in range(blocking.n_blocks)
+    ]
+    # forced splits: the 8 low-corner-octant blocks have >= 20^3-voxel
+    # outer regions; the min_voxels gate makes every full-size load fail
+    # while their ~16^3 sub-blocks fit — the physical OOM model
+    split_ids = sorted(
+        blocking.grid_position_to_id(pos) for pos in np.ndindex(2, 2, 2)
+    )
+    fault_cfg = {
+        "seed": 7,
+        "faults": [{
+            "site": "load", "kind": "oom", "blocks": split_ids,
+            "min_voxels": 6000, "fail_attempts": 10**6,
+        }],
+    }
+    log(
+        f"ragged bench: volume {shape}, blocks {block}^3 "
+        f"({blocking.n_blocks}-block non-pow2 grid, edge-clipped), "
+        f"{len(split_ids)} forced splits, sharded batch {sharded_batch}"
+    )
+
+    def kernel(b):
+        # elementwise boundary-prep pass (threshold family): microseconds
+        # per block, so dispatch count is the cost that matters — and the
+        # shape-local contract of the ragged path holds trivially
+        return jnp.where(b < jnp.float32(0.5), b * 2 + jnp.float32(0.25),
+                         jnp.float32(1.0))
+
+    def run_arm(mode, ragged):
+        out = np.zeros(shape, np.float32)
+
+        def load(b):
+            return (vol[b.outer_bb],)  # exact clipped shapes — no padding
+
+        def store(b, raw):
+            out[b.bb] = np.asarray(raw)[b.inner_in_outer_bb]
+
+        ex = BlockwiseExecutor(
+            target="local", n_devices=n_devices, io_threads=4,
+            max_retries=2, backoff_base=1e-4,
+        )
+        seconds, delta, summary = None, None, None
+        for rep in range(reps + 1):  # rep 0 warms the compiled programs
+            out[:] = 0
+            faults_mod.configure(fault_cfg)
+            snap = executor_mod.dispatch_snapshot()
+            t0 = time.perf_counter()
+            with trace_mod.task_context(f"ragged_{mode}_{ragged}"):
+                summary = ex.map_blocks(
+                    kernel, blocks, load, store,
+                    failures_path=None, task_name=f"ragged_{mode}",
+                    block_deadline_s=None, watchdog_period_s=None,
+                    store_verify_fn=None,
+                    schedule="morton", sweep_mode=mode,
+                    sharded_batch=sharded_batch, ragged=ragged,
+                    splittable=True, split_halo=halo,
+                    min_block_shape=(4, 4, 4), degrade_wait_s=0.05,
+                )
+            t = time.perf_counter() - t0
+            faults_mod.reset()
+            if rep == 0:
+                continue
+            if seconds is None or t < seconds:
+                seconds = t
+                delta = executor_mod.dispatch_delta(snap)
+        rec = {
+            "seconds": round(seconds, 4),
+            "dispatches": int(delta["batches_dispatched"]),
+            "blocks_per_dispatch": round(
+                delta["blocks_dispatched"]
+                / max(1, delta["batches_dispatched"]), 2
+            ),
+            "ragged_batches": int(delta["ragged_batches"]),
+            "lanes_padded": int(delta["lanes_padded"]),
+            "pages_in_use": int(delta["pages_in_use"]),
+            "n_split": int(summary.get("n_split", 0)),
+            "n_sub_blocks": int(summary.get("n_sub_blocks", 0)),
+        }
+        log(
+            f"ragged bench {mode}/ragged={ragged}: {seconds * 1000:.1f} ms, "
+            f"{rec['dispatches']} dispatches "
+            f"({rec['ragged_batches']} ragged, "
+            f"{rec['n_sub_blocks']} sub-blocks)"
+        )
+        return out, rec
+
+    # the per-block fallback this workload used to degrade to: one
+    # dispatch per block, one jit dispatch per split sub-block
+    out_pb, pb = run_arm("per_block", "off")
+    out_rg, rg = run_arm("sharded", "auto")
+
+    rec = {
+        "metric": "ragged_paged_sweep",
+        "backend": "cpu",
+        "smoke": bool(smoke),
+        "volume": list(shape),
+        "block_shape": [block] * 3,
+        "halo": list(halo),
+        "grid": list(blocking.grid_shape),
+        "n_devices": int(n_devices),
+        "sharded_batch": int(sharded_batch),
+        "forced_split_blocks": len(split_ids),
+        "per_block": pb,
+        "ragged": rg,
+        "dispatch_reduction": round(
+            pb["dispatches"] / max(1, rg["dispatches"]), 2
+        ),
+        "throughput_ratio": round(pb["seconds"] / rg["seconds"], 2),
+        "bit_identical": bool(np.array_equal(out_pb, out_rg)),
+        "schedule": "morton",
+    }
+    print(json.dumps(rec), flush=True)
+    if not smoke:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r11.json"
+        )
+        fu.atomic_write_json(path, rec)
+        log(f"ragged bench done -> {path}")
+    return rec
+
+
 def solve_bench(smoke=False):
     """Distributed-agglomeration config (docs/PERFORMANCE.md "Distributed
     agglomeration"): the >=100k-edge solver-scale instance of BENCH_r06
@@ -2370,6 +2530,8 @@ if __name__ == "__main__":
             io_bench()
         elif "--sweep" in sys.argv or os.environ.get("CT_BENCH_SWEEP"):
             sweep_bench()
+        elif "--ragged" in sys.argv or os.environ.get("CT_BENCH_RAGGED"):
+            ragged_bench(smoke="--smoke" in sys.argv)
         elif "--fuse" in sys.argv or os.environ.get("CT_BENCH_FUSE"):
             fuse_bench()
         elif "--solve" in sys.argv or os.environ.get("CT_BENCH_SOLVE"):

@@ -633,11 +633,13 @@ _SYNC_MARKERS = ("block_until_ready", ".item(", "np.asarray", "np.array(",
                  "device_get", "float(")
 
 #: call names that trace their first argument into a compiled program:
-#: ``jit`` / ``shard_map`` directly, and the batched shard_map wrapper of
-#: the sharded sweep (``parallel/batch_shard.py``) — a kernel passed into
-#: it is vmapped inside one ``shard_map`` program, so the same purity
-#: contract applies.
-_JIT_WRAPPERS = ("jit", "shard_map", "batched_shard_map")
+#: ``jit`` / ``shard_map`` directly, and the batch-sharding wrappers of
+#: the sharded sweep (``parallel/batch_shard.py``): a kernel passed into
+#: ``batched_shard_map`` OR the ragged paged wrapper ``ragged_shard_map``
+#: (docs/PERFORMANCE.md "Ragged sweeps") is vmapped inside one
+#: ``shard_map`` program, so the same purity contract applies.
+_JIT_WRAPPERS = ("jit", "shard_map", "batched_shard_map",
+                 "ragged_shard_map")
 
 
 def _jit_target_names(call: ast.Call) -> List[Tuple[str, Set[str]]]:

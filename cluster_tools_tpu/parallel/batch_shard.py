@@ -133,6 +133,95 @@ def batched_shard_map(
     )
 
 
+def ragged_shard_map(
+    kernel: Callable,
+    mesh: Mesh,
+    batch: int,
+    specs: Sequence,
+    axis_name: str = "blocks",
+    check_vma: bool = False,
+):
+    """One compiled dispatch for a *ragged* (mixed-shape) batch of blocks,
+    driven by the paged block pool's descriptors (:mod:`.block_pool`,
+    docs/PERFORMANCE.md "Ragged sweeps").
+
+    ``specs`` is one :class:`~cluster_tools_tpu.parallel.block_pool.
+    RaggedArgSpec` per kernel argument.  The returned callable takes, in
+    order: one page pool ``[pool_pages, *page_shape]`` per arg (replicated
+    to every device), then per arg a page table ``[batch, pages_per_lane]``
+    and a valid-extent array ``[batch, ndim]`` (both sharded over the
+    batch axis).  Inside one ``shard_map`` program each device vmaps over
+    its lanes: a lane gathers its pages from the pool, reassembles the
+    dense page-aligned array, masks everything beyond its valid extent
+    with the spec's fill value, and runs the kernel — so the Ragged Paged
+    Attention shape (fixed pages + ragged metadata, arXiv:2604.15464)
+    executes variable-shape block work as ONE XLA execution.
+
+    The reconstruction is pure value movement (gather / reshape /
+    transpose / select — no arithmetic), so a lane's kernel input is
+    bit-equal to the host-padded array the dense path would have built at
+    the same padded shape; per-lane numerics are ``vmap``'s, independent
+    of the batch width, which is what keeps the ragged path bit-identical
+    to per-block execution on the lanes' stored regions
+    (tests/test_ragged.py).  ``check_vma=False`` for the same reason as
+    :func:`batched_shard_map`.
+    """
+    n = mesh_n_devices(mesh)
+    batch = int(batch)
+    if batch % n:
+        raise ValueError(
+            f"ragged batch {batch} is not divisible by the {n}-device mesh"
+        )
+    specs = tuple(specs)
+
+    def _reassemble(pool, table, valid, spec):
+        nd = len(spec.grid)
+        pages = pool[table]  # [pages_per_lane, *page_shape]
+        # grid-major tiles -> dense: (g0..gd, p0..pd) interleaved to
+        # (g0, p0, g1, p1, ...) then flattened per axis
+        x = pages.reshape(spec.grid + spec.page_shape)
+        perm = []
+        for ax in range(nd):
+            perm.extend((ax, nd + ax))
+        x = x.transpose(perm).reshape(spec.padded_shape)
+        mask = None
+        for ax in range(nd):
+            m = lax.broadcasted_iota(
+                jnp.int32, spec.padded_shape, ax
+            ) < valid[ax]
+            mask = m if mask is None else (mask & m)
+        fill = jnp.asarray(spec.fill, x.dtype)
+        return jnp.where(mask, x, fill)
+
+    def _sharded_body(*flat):
+        pools = flat[: len(specs)]
+        lanes = flat[len(specs):]  # (table, valid) per arg
+
+        def _lane(*lane_flat):
+            args = []
+            for i, spec in enumerate(specs):
+                table, valid = lane_flat[2 * i], lane_flat[2 * i + 1]
+                args.append(_reassemble(pools[i], table, valid, spec))
+            return kernel(*args)
+
+        # pools are closed over (vmap broadcasts them across lanes)
+        return jax.vmap(_lane)(*lanes)
+
+    spec_in = (
+        tuple(P() for _ in specs)
+        + tuple(P(axis_name) for _ in specs for _ in range(2))
+    )
+    return jax.jit(
+        shard_map(
+            _sharded_body,
+            mesh=mesh,
+            in_specs=spec_in,
+            out_specs=P(axis_name),
+            check_vma=check_vma,
+        )
+    )
+
+
 def exchange_batch_halo(
     x: jnp.ndarray,
     halo: int,

@@ -81,6 +81,8 @@ from __future__ import annotations
 
 import contextlib
 import errno
+import functools
+import inspect
 import itertools
 import math
 import os
@@ -121,9 +123,11 @@ from .supervision import (
 from ..parallel.mesh import backend_devices as get_devices
 from ..parallel.batch_shard import (
     batched_shard_map,
+    ragged_shard_map,
     resolve_sharded_batch,
     use_sharded_sweep,
 )
+from ..parallel import block_pool as block_pool_mod
 
 
 # -- process-wide dispatch metrics -------------------------------------------
@@ -138,6 +142,13 @@ _DISPATCH_COUNTERS = {
     "blocks_dispatched": 0,    # blocks carried by those executions
     "dispatch_wait_s": 0.0,    # dispatch loop stalled on un-overlapped loads
     "sweep_s": 0.0,            # total map_blocks wall time
+    # ragged paged sweeps (docs/PERFORMANCE.md "Ragged sweeps"): batches
+    # that ran mixed-shape/partial work as one program via the paged
+    # block pool, the synthetic padding lanes they carried (discarded on
+    # d2h), and the real pool pages those dispatches referenced
+    "ragged_batches": 0,
+    "lanes_padded": 0,
+    "pages_in_use": 0,
 }
 
 
@@ -155,19 +166,233 @@ def dispatch_delta(snapshot: Dict[str, float]) -> Dict[str, float]:
 
 
 def _record_dispatch_metrics(batches: int, blocks: int, wait_s: float,
-                             sweep_s: float) -> None:
+                             sweep_s: float, ragged_batches: int = 0,
+                             lanes_padded: int = 0,
+                             pages_in_use: int = 0) -> None:
     with _METRICS_LOCK:
         _DISPATCH_COUNTERS["batches_dispatched"] += int(batches)
         _DISPATCH_COUNTERS["blocks_dispatched"] += int(blocks)
         _DISPATCH_COUNTERS["dispatch_wait_s"] += float(wait_s)
         _DISPATCH_COUNTERS["sweep_s"] += float(sweep_s)
+        _DISPATCH_COUNTERS["ragged_batches"] += int(ragged_batches)
+        _DISPATCH_COUNTERS["lanes_padded"] += int(lanes_padded)
+        _DISPATCH_COUNTERS["pages_in_use"] += int(pages_in_use)
 
 
 #: bound on one executor's compiled-program cache (see
 #: :meth:`BlockwiseExecutor._cached_program`); a sweep holds at most a few
-#: programs (sharded, per-block fallback, sub-block), the rest is headroom
-#: for executors reused across many kernels.
+#: programs (sharded, ragged, per-block fallback, sub-block), the rest is
+#: headroom for executors reused across many kernels.
 _PROGRAM_CACHE_SIZE = 16
+
+#: bound on a server-scoped shared cache (docs/SERVING.md): programs for
+#: the repeat-request working set of a resident server.
+SHARED_PROGRAM_CACHE_SIZE = 64
+
+
+class _Unfreezable(Exception):
+    """A captured value that cannot participate in a kernel identity."""
+
+
+def _freeze(obj, seen: set, depth: int = 0):
+    """A hashable, value-equal snapshot of ``obj`` for kernel-identity
+    keys, or :class:`_Unfreezable`.  Containers and callables recurse
+    (bounded, cycle-guarded); arrays / datasets / arbitrary objects refuse
+    — a kernel closing over them only ever hits the instance cache."""
+    if depth > 16:
+        raise _Unfreezable("nesting too deep")
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, np.generic):
+        return ("np", obj.dtype.name, obj.item())
+    oid = id(obj)
+    if oid in seen:
+        raise _Unfreezable("cyclic capture")
+    seen = seen | {oid}
+    if isinstance(obj, (tuple, list)):
+        return ("seq", tuple(_freeze(v, seen, depth + 1) for v in obj))
+    if isinstance(obj, (set, frozenset)):
+        return ("set", frozenset(_freeze(v, seen, depth + 1) for v in obj))
+    if isinstance(obj, dict):
+        return ("map", tuple(
+            (_freeze(k, seen, depth + 1), _freeze(v, seen, depth + 1))
+            for k, v in sorted(obj.items(), key=lambda kv: repr(kv[0]))
+        ))
+    if isinstance(obj, functools.partial):
+        return (
+            "partial",
+            _freeze(obj.func, seen, depth + 1),
+            _freeze(obj.args, seen, depth + 1),
+            _freeze(obj.keywords, seen, depth + 1),
+        )
+    # named code objects a kernel commonly captures via function-local
+    # imports (``import jax.numpy as jnp`` inside run_impl makes jnp a
+    # closure CELL): stable within one process, identified by name
+    if inspect.ismodule(obj):
+        return ("module", obj.__name__)
+    if inspect.isbuiltin(obj) or isinstance(obj, np.ufunc):
+        return ("builtin", getattr(obj, "__module__", None), obj.__name__)
+    if isinstance(obj, type):
+        return ("type", obj.__module__, obj.__qualname__)
+    if inspect.ismethod(obj):
+        return (
+            "method",
+            _freeze(obj.__func__, seen, depth + 1),
+            _freeze(obj.__self__, seen, depth + 1),
+        )
+    if isinstance(obj, np.dtype):
+        return ("dtype", obj.name)
+    if inspect.isfunction(obj):
+        cells = ()
+        if obj.__closure__:
+            vals = []
+            for cell in obj.__closure__:
+                try:
+                    vals.append(_freeze(cell.cell_contents, seen, depth + 1))
+                except ValueError:  # empty cell
+                    vals.append(("empty-cell",))
+            cells = tuple(vals)
+        return (
+            "fn", obj.__module__, obj.__qualname__,
+            _freeze_code(obj.__code__, seen, depth + 1),
+            cells,
+            _freeze(obj.__defaults__, seen, depth + 1),
+            _freeze(obj.__kwdefaults__, seen, depth + 1),
+        )
+    raise _Unfreezable(type(obj).__name__)
+
+
+def _freeze_code(code, seen: set, depth: int):
+    """Behavioral snapshot of a code object: bytecode alone is NOT enough
+    (two kernels calling np.minimum vs np.maximum differ only in
+    ``co_names``; nested lambdas differ only in their own consts), so the
+    freeze carries the referenced names and recurses into nested code."""
+    if depth > 16:
+        raise _Unfreezable("code nesting too deep")
+    consts = tuple(
+        _freeze_code(c, seen, depth + 1) if inspect.iscode(c)
+        else _freeze(c, seen, depth + 1)
+        for c in code.co_consts
+    )
+    return ("code", code.co_code, code.co_names, consts)
+
+
+def kernel_identity(kernel: Callable) -> Optional[tuple]:
+    """A hashable identity for ``kernel`` that two *different* callables
+    share exactly when their code AND captured values are equal: module /
+    qualname / bytecode / recursively frozen closure cells and defaults.
+    This is what lets a server-scoped :class:`ProgramCache` serve a warm
+    compiled program to a repeat request whose task rebuilt its kernel
+    closure (docs/SERVING.md).  Returns None when any captured value
+    cannot be frozen (model checkpoints, datasets, ad-hoc objects) — such
+    kernels stay instance-scoped, which is always safe.
+
+    Module-level globals the kernel references are NOT part of the
+    identity (they are not captured cells); the shared cache therefore
+    assumes module code is stable within the server process — true for a
+    resident server, and why the batch CLI keeps instance scope.
+    Captured dicts freeze by sorted content — Python ``==`` semantics —
+    so a kernel whose *trace* depends on dict insertion order (iterating
+    ``cfg.items()`` into order-sensitive float accumulation) is outside
+    the contract; request configs parsed from JSON documents have stable
+    order anyway.
+    """
+    try:
+        return _freeze(kernel, set())
+    except _Unfreezable:
+        return None
+
+
+class ProgramCache:
+    """Thread-safe bounded LRU of compiled program wrappers.
+
+    Instance-scoped by default (``by_identity=False``): keys include
+    ``id(kernel)``, entries strongly reference the kernel so the id stays
+    valid, and the cache dies with its executor — a cached wrapper can pin
+    a task's captured state (e.g. a model checkpoint), so it must not
+    outlive the task (the PR-7 rationale).
+
+    ``by_identity=True`` is the server-scoped promotion (docs/SERVING.md):
+    keys use :func:`kernel_identity` + the program's mode/width/devices
+    key, so repeat requests through a resident server skip the per-shape
+    compile even though every request builds a fresh kernel closure.  The
+    LRU bound is what bounds the pinned closures; the resident server is
+    exactly the owner that wants warm programs pinned.
+    """
+
+    def __init__(self, max_size: int = _PROGRAM_CACHE_SIZE,
+                 by_identity: bool = False):
+        self.max_size = int(max_size)
+        self.by_identity = bool(by_identity)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.unkeyed = 0  # identity-mode lookups that could not be keyed
+
+    def kernel_key(self, kernel: Callable):
+        if not self.by_identity:
+            return id(kernel)
+        key = kernel_identity(kernel)
+        if key is None:
+            with self._lock:
+                self.unkeyed += 1
+        return key
+
+    def get_or_build(self, kernel: Callable, kernel_key, key: tuple,
+                     builder: Callable):
+        cache_key = (kernel_key, key)
+        with self._lock:
+            hit = self._entries.get(cache_key)
+            if hit is not None:
+                self._entries.move_to_end(cache_key)
+                self.hits += 1
+                return hit[1]
+        # compile outside the lock (it can take seconds); a racing builder
+        # of the same program is harmless — last one in wins the slot.  The
+        # entry holds a strong ref to the kernel, which keeps an id() key
+        # component valid for the entry's lifetime.
+        prog = builder()
+        with self._lock:
+            self.misses += 1
+            self._entries[cache_key] = (kernel, prog)
+            self._entries.move_to_end(cache_key)
+            while len(self._entries) > self.max_size:
+                self._entries.popitem(last=False)
+        return prog
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "programs": len(self._entries),
+                "max_size": self.max_size,
+                "hits": self.hits,
+                "misses": self.misses,
+                "unkeyed": self.unkeyed,
+            }
+
+
+#: the optional process-wide shared program cache.  Installed by the
+#: resident server (``runtime/server.py``) so every executor any request
+#: task builds shares one identity-keyed cache; batch entry points never
+#: install one, keeping the PR-7 instance scope (and its lifetime safety)
+#: for one-shot runs.
+_SHARED_PROGRAM_CACHE: Optional[ProgramCache] = None
+
+
+def install_shared_program_cache(
+    cache: Optional[ProgramCache],
+) -> Optional[ProgramCache]:
+    """Install (or, with None, uninstall) the process-wide shared program
+    cache; returns the previous one."""
+    global _SHARED_PROGRAM_CACHE
+    prev = _SHARED_PROGRAM_CACHE
+    _SHARED_PROGRAM_CACHE = cache
+    return prev
+
+
+def shared_program_cache() -> Optional[ProgramCache]:
+    return _SHARED_PROGRAM_CACHE
 
 
 def get_mesh(
@@ -411,28 +636,29 @@ class BlockwiseExecutor:
         # wrapper strongly references its kernel closure (which can pin a
         # task's captured state, e.g. a model checkpoint), so the cache
         # must die with the executor, not outlive the task process-wide.
-        self._program_cache: "OrderedDict" = OrderedDict()
-        self._program_cache_lock = threading.Lock()
+        # Under a resident server, a SHARED identity-keyed cache
+        # (install_shared_program_cache, docs/SERVING.md) takes precedence
+        # for kernels whose identity is resolvable.
+        self._program_cache = ProgramCache(_PROGRAM_CACHE_SIZE)
+
+    def _program_lookup(self, kernel: Callable) -> Callable:
+        """Resolve the cache route for ``kernel`` ONCE (the identity
+        freeze walks the whole closure — per sweep, not per batch) and
+        return a ``(key, builder) -> program`` lookup bound to it."""
+        shared = shared_program_cache()
+        if shared is not None:
+            kernel_key = shared.kernel_key(kernel)
+            if kernel_key is not None:
+                return functools.partial(
+                    shared.get_or_build, kernel, kernel_key
+                )
+        return functools.partial(
+            self._program_cache.get_or_build, kernel, id(kernel)
+        )
 
     def _cached_program(self, kernel: Callable, key: tuple,
                         builder: Callable):
-        cache_key = (id(kernel), key)
-        with self._program_cache_lock:
-            hit = self._program_cache.get(cache_key)
-            if hit is not None:
-                self._program_cache.move_to_end(cache_key)
-                return hit[1]
-        # compile outside the lock (it can take seconds); a racing builder
-        # of the same program is harmless — last one in wins the slot.  The
-        # entry holds a strong ref to the kernel, which also keeps its id()
-        # component valid for the entry's lifetime.
-        prog = builder()
-        with self._program_cache_lock:
-            self._program_cache[cache_key] = (kernel, prog)
-            self._program_cache.move_to_end(cache_key)
-            while len(self._program_cache) > _PROGRAM_CACHE_SIZE:
-                self._program_cache.popitem(last=False)
-        return prog
+        return self._program_lookup(kernel)(key, builder)
 
     # -- retry/backoff machinery ------------------------------------------
     def _backoff(self, attempt: int) -> float:
@@ -499,6 +725,8 @@ class BlockwiseExecutor:
         schedule: str = "morton",
         sweep_mode: str = "auto",
         sharded_batch: Optional[int] = None,
+        ragged: str = "auto",
+        page_shape: Optional[Sequence[int]] = None,
     ) -> Dict[str, int]:
         """Execute ``kernel`` over ``blocks``; see class docstring.
 
@@ -559,6 +787,36 @@ class BlockwiseExecutor:
         or hangs falls its blocks back to per-block execution, attributed
         ``resolution="degraded:unsharded"``.
 
+        ``ragged`` — mixed-shape handling on the sharded path
+        (docs/PERFORMANCE.md "Ragged sweeps"): ``"auto"`` (default) packs
+        batches the dense program cannot take — mixed-shape lanes from
+        un-padded loads, partial final batches, and (for ``splittable``
+        call sites) degrade-split sub-blocks — through the paged block
+        pool (:mod:`~cluster_tools_tpu.parallel.block_pool`) and runs
+        them as ONE descriptor-driven program per batch, synthetic
+        padding lanes discarded on d2h; ``"on"`` additionally forces
+        uniform full batches through the ragged program; ``"off"``
+        restores the historical behavior (mixed-shape batches and split
+        sub-blocks execute per-block, attributed
+        ``degraded:unsharded``).  Partial uniform batches pack with the
+        lane shape as the page, so every real lane sees exactly the
+        bytes per-block dispatch would have seen (any kernel, bit-
+        identical); mixed-SHAPE lanes run at the batch's page-aligned
+        shape, which is only guaranteed bit-identical on each lane's
+        stored region for shape-local kernels — the same contract as
+        ``splittable``, and why call sites with shape-dependent label
+        encodings keep padding in ``load_fn`` (their batches stay
+        uniform and dense).  ``page_shape`` overrides the pool's page
+        tile (default: chunk-scale, see
+        :func:`~cluster_tools_tpu.parallel.block_pool.
+        default_page_shape`); set it to the dataset chunk shape for
+        chunk-aligned pooling (uniform-lane batches keep the exact
+        lane-shape page regardless — the any-kernel guarantee above is
+        unconditional).  Ragged dispatches are attributed in the
+        dispatch counters (``ragged_batches`` / ``lanes_padded`` /
+        ``pages_in_use`` in io_metrics.json) and on the trace timeline
+        (``executor.dispatch`` spans with ``grain="ragged"``).
+
         Raises RuntimeError naming every block that stays failed after the
         end-of-run quarantine pass, and
         :class:`~cluster_tools_tpu.runtime.supervision.DrainInterrupt`
@@ -581,6 +839,19 @@ class BlockwiseExecutor:
         use_sharded = use_sharded_sweep(
             sweep_mode, self.n_devices, len(blocks), sharded_width
         )
+        if ragged not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown ragged mode {ragged!r} "
+                "(expected 'auto', 'on' or 'off')"
+            )
+        # the paged block pool is a sharded-path feature: per_block mode
+        # dispatches per block anyway, so raggedness costs it nothing
+        use_ragged = use_sharded and ragged != "off"
+        ragged_pool = (
+            block_pool_mod.PagedBlockPool() if use_ragged else None
+        )
+        if page_shape is not None:
+            page_shape = tuple(int(p) for p in page_shape)
         if not blocks:
             return {"n_blocks": 0, "n_quarantined": 0, "n_failed": 0}
         # preemption-aware draining: SIGTERM/SIGUSR1 flip a latch instead
@@ -593,6 +864,9 @@ class BlockwiseExecutor:
         bs = sharded_width if use_sharded else bs0
         n_batches = math.ceil(len(blocks) / bs)
         sharding = NamedSharding(self.mesh, P("blocks"))
+        # page pools of ragged batches are broadcast to every device (each
+        # lane gathers from the whole pool); tables/valid shard over blocks
+        replicated = NamedSharding(self.mesh, P())
         dev_key = tuple(d.id for d in self.devices)
 
         def _vmap_program():
@@ -600,17 +874,20 @@ class BlockwiseExecutor:
                 jax.vmap(kernel), in_shardings=sharding, out_shardings=sharding
             )
 
+        # the cache route (shared identity-keyed under a resident server,
+        # else this executor's instance cache) is resolved once per sweep:
+        # the identity freeze walks the kernel's whole closure
+        cached_program = self._program_lookup(kernel)
+
         if use_sharded:
-            batched_kernel = self._cached_program(
-                kernel, ("sharded", bs, dev_key),
+            batched_kernel = cached_program(
+                ("sharded", bs, dev_key),
                 lambda: batched_shard_map(kernel, self.mesh, bs),
             )
         else:
             # width is carried by the input shapes, not the wrapper: one
             # cached jit(vmap) serves every batch width of this kernel
-            batched_kernel = self._cached_program(
-                kernel, ("vmap", dev_key), _vmap_program
-            )
+            batched_kernel = cached_program(("vmap", dev_key), _vmap_program)
         # the sweep span doubles as the sweep_s clock (docs/OBSERVABILITY.md):
         # trace spans are the one timing source in runtime/ (CT008), and a
         # begin/end pair still measures with the tracer off so the
@@ -619,13 +896,20 @@ class BlockwiseExecutor:
             "executor.sweep", task=task_name, n_blocks=len(blocks),
             sharded=bool(use_sharded),
         )
-        dispatch_stats = {"batches": 0, "blocks": 0, "wait_s": 0.0}
+        dispatch_stats = {
+            "batches": 0, "blocks": 0, "wait_s": 0.0,
+            "ragged_batches": 0, "lanes_padded": 0, "pages_in_use": 0,
+        }
         stats_lock = threading.Lock()
 
-        def _note_dispatch(n_blocks_dispatched: int) -> None:
+        def _note_dispatch(n_blocks_dispatched: int, rb=None) -> None:
             with stats_lock:
                 dispatch_stats["batches"] += 1
                 dispatch_stats["blocks"] += int(n_blocks_dispatched)
+                if rb is not None:
+                    dispatch_stats["ragged_batches"] += 1
+                    dispatch_stats["lanes_padded"] += rb.lanes_padded
+                    dispatch_stats["pages_in_use"] += rb.pages_in_use
 
         # per-block failure bookkeeping (threads: IO pool + dispatch loop)
         failures: Dict[int, Dict[str, Any]] = {}
@@ -730,9 +1014,7 @@ class BlockwiseExecutor:
                 return batched_kernel, bs
             kern = fallback_state.get("kernel")
             if kern is None:
-                kern = self._cached_program(
-                    kernel, ("vmap", dev_key), _vmap_program
-                )
+                kern = cached_program(("vmap", dev_key), _vmap_program)
                 fallback_state["kernel"] = kern
             return kern, bs0
 
@@ -853,6 +1135,12 @@ class BlockwiseExecutor:
             return run
 
         def load_batch(batch_idx: int):
+            """Load one batch; returns ``(blocks, kind, payload)`` where
+            ``kind`` routes the dispatch: ``"dense"`` (stacked arrays for
+            the uniform-shape program), ``"ragged"`` (a packed
+            :class:`~cluster_tools_tpu.parallel.block_pool.RaggedBatch`),
+            ``"mixed"`` (per-lane values the pool could not pack — the
+            per-block program owns them), or ``"empty"``."""
             batch = blocks[batch_idx * bs : (batch_idx + 1) * bs]
             # load_fn may return futures (e.g. io.prefetch.async_loader's
             # tensorstore read futures): issue EVERY read of the batch first,
@@ -892,18 +1180,47 @@ class BlockwiseExecutor:
                 ok_blocks.append(b)
                 per_block.append(val)
             if not ok_blocks:
-                return [], None
-            n_args = len(per_block[0])
+                return [], "empty", None
+            vals = [tuple(np.asarray(x) for x in val) for val in per_block]
+            n_args = len(vals[0])
+            uniform = all(
+                len({v[i].shape for v in vals}) == 1 for i in range(n_args)
+            )
+            full = len(vals) == bs
+            if use_ragged and (not uniform or not full or ragged == "on"):
+                # mixed-shape lanes, a partial batch (ragged tail or
+                # quarantine holes), or a forced ragged sweep: pack through
+                # the paged block pool — one descriptor-driven program
+                # instead of the per-block fallback; padding lanes are
+                # synthesized by the pool and discarded on d2h
+                try:
+                    return ok_blocks, "ragged", ragged_pool.pack(
+                        vals, bs, page_shape=page_shape
+                    )
+                except ValueError:
+                    if uniform:
+                        # uniform lanes the pool refuses (exotic dtypes):
+                        # the dense repeat-pad path below handles them
+                        # exactly as before the pool existed
+                        pass
+                    else:
+                        # mixed-shape lanes that cannot pack: per-block
+                        # execution owns them
+                        return ok_blocks, "mixed", vals
+            if not uniform:
+                # ragged="off" (or per_block mode): mixed shapes cannot
+                # stack — the per-block program owns them
+                return ok_blocks, "mixed", vals
             # pad the partial batch (tail, or quarantine-induced holes) by
             # repeating the last block so the compiled shape stays static;
             # padded outputs are dropped
-            n_pad = bs - len(per_block)
+            n_pad = bs - len(vals)
             if n_pad:
-                per_block = per_block + [per_block[-1]] * n_pad
+                vals = vals + [vals[-1]] * n_pad
             arrays = tuple(
-                np.stack([pb[i] for pb in per_block]) for i in range(n_args)
+                np.stack([pb[i] for pb in vals]) for i in range(n_args)
             )
-            return ok_blocks, arrays
+            return ok_blocks, "dense", arrays
 
         finished_ids: set = set()
 
@@ -1221,7 +1538,7 @@ class BlockwiseExecutor:
                     wait_span = trace_mod.begin(
                         "executor.batch_wait", task=task_name, batch=i
                     )
-                    batch, arrays = pending_loads.pop(0).result()
+                    batch, kind, payload = pending_loads.pop(0).result()
                     waited = wait_span.end(discard=True)
                     if waited > 1e-4:
                         wait_span.end()
@@ -1238,9 +1555,87 @@ class BlockwiseExecutor:
                         write_futures.pop(0).result()
                     if not batch:
                         continue  # every block of this batch was quarantined
-                    batch_bytes = sum(int(a.nbytes) for a in arrays)
+                    if kind == "mixed":
+                        # lanes neither the dense nor the ragged program can
+                        # take (pool off or unpackable): the per-block
+                        # program owns them — on the sharded path that is a
+                        # degrade, attributed like every other fallback
+                        mixed_bytes = sum(
+                            int(x.nbytes) for val in payload for x in val
+                        )
+                        _admit(mixed_bytes, write_futures)
+
+                        def run_mixed(batch=batch, vals=payload,
+                                      nbytes=mixed_bytes):
+                            try:
+                                for blk, val in zip(batch, vals):
+                                    bid = int(blk.block_id)
+                                    if use_sharded:
+                                        note_failure(
+                                            blk, "pack", 1,
+                                            "mixed-shape lanes with the "
+                                            "ragged pool unavailable; "
+                                            "executed per-block",
+                                            quarantine=True,
+                                        )
+                                        with fail_lock:
+                                            sharded_failed_ids.add(bid)
+                                    try:
+                                        out0 = _exec_single(val)
+                                    except Exception:
+                                        note_failure(
+                                            blk, "compute", 1,
+                                            fu.cap_traceback(
+                                                traceback.format_exc()
+                                            ),
+                                            quarantine=True,
+                                        )
+                                        continue
+                                    handle_block_output(blk, out0)
+                                    if use_sharded:
+                                        with fail_lock:
+                                            rec = failures.get(bid)
+                                            done = bool(
+                                                rec and rec["resolved"]
+                                            )
+                                        if done:
+                                            mark_resolved(
+                                                blk, "degraded:unsharded"
+                                            )
+                            finally:
+                                _release_inflight(nbytes)
+
+                        write_futures.append(
+                            pool.submit(_scoped(run_mixed))
+                        )
+                        while len(write_futures) > 2:
+                            write_futures.pop(0).result()
+                        continue
+                    rb = payload if kind == "ragged" else None
+                    if rb is not None:
+                        batch_bytes = rb.nbytes
+                    else:
+                        arrays = payload
+                        batch_bytes = sum(int(a.nbytes) for a in arrays)
                     _admit(batch_bytes, write_futures)
-                    arrays = tuple(jax.device_put(a, sharding) for a in arrays)
+                    if rb is not None:
+                        rep, shd = rb.flat_inputs()
+                        dev_inputs = tuple(
+                            jax.device_put(a, replicated) for a in rep
+                        ) + tuple(
+                            jax.device_put(a, sharding) for a in shd
+                        )
+                        prog = cached_program(
+                            ("ragged", dev_key) + rb.key(),
+                            lambda rb=rb: ragged_shard_map(
+                                kernel, self.mesh, rb.width, rb.specs
+                            ),
+                        )
+                    else:
+                        dev_inputs = tuple(
+                            jax.device_put(a, sharding) for a in arrays
+                        )
+                        prog = batched_kernel
                     try:
                         if use_sharded:
                             # batch-grain fault surface: a device OOM or a
@@ -1269,15 +1664,25 @@ class BlockwiseExecutor:
                         # compiling) speculative dispatch is not this batch's
                         # wall time, and must not cascade into false hangs
                         with dispatch_lock, contextlib.ExitStack() as stack:
+                            span_args = dict(
+                                task=task_name, n_blocks=len(batch),
+                                grain=(
+                                    "ragged" if rb is not None
+                                    else "sharded" if use_sharded
+                                    else "batch"
+                                ),
+                            )
+                            if rb is not None:
+                                # ragged-lane attribution on the timeline:
+                                # how much of the dispatch was padding
+                                span_args["lanes_padded"] = rb.lanes_padded
                             stack.enter_context(trace_mod.span(
-                                "executor.dispatch", task=task_name,
-                                n_blocks=len(batch),
-                                grain="sharded" if use_sharded else "batch",
+                                "executor.dispatch", **span_args
                             ))
                             for blk in batch:
                                 stack.enter_context(_watched(blk, "compute"))
-                            out = batched_kernel(*arrays)
-                        _note_dispatch(len(batch))
+                            out = prog(*dev_inputs)
+                        _note_dispatch(len(batch), rb)
                     except Exception as e:
                         # a compute failure poisons the whole batch; quarantine
                         # all of it — the reduced-batch pass isolates the
@@ -1299,7 +1704,8 @@ class BlockwiseExecutor:
                         _release_inflight(batch_bytes)
                         continue
 
-                    def store_batch(batch=batch, out=out, nbytes=batch_bytes):
+                    def store_batch(batch=batch, out=out, nbytes=batch_bytes,
+                                    rb=rb):
                         # the device->host copy happens HERE, on the IO pool, so
                         # the dispatch loop is free to enqueue the next batch
                         # while this one's outputs stream back.  This copy is
@@ -1318,9 +1724,22 @@ class BlockwiseExecutor:
                                 for blk in batch:
                                     stack.enter_context(_watched(blk, "compute"))
                                 out_np = jax.tree_util.tree_map(np.asarray, out)
+                            if rb is not None:
+                                # the execution is complete once the copy
+                                # above lands: the pool's host buffers are
+                                # safe to recycle for later batches
+                                rb.release()
                             for j, blk in enumerate(batch):
                                 block_out = jax.tree_util.tree_map(
-                                    lambda a: a[j], out_np
+                                    lambda a: (
+                                        a[j] if rb is None
+                                        # ragged lane: crop the page-aligned
+                                        # output back to the lane's valid
+                                        # extent (padding lanes never reach
+                                        # here — only real blocks iterate)
+                                        else rb.crop(j, a[j])
+                                    ),
+                                    out_np,
                                 )
                                 handle_block_output(blk, block_out)
                         finally:
@@ -1374,9 +1793,7 @@ class BlockwiseExecutor:
                 # the SAME kernel function, unbatched + jitted: jit caches
                 # one compiled twin per distinct sub-block shape, each a
                 # smaller allocation than the batch program — the point
-                sub_jit = self._cached_program(
-                    kernel, ("sub",), lambda: jax.jit(kernel)
-                )
+                sub_jit = cached_program(("sub",), lambda: jax.jit(kernel))
 
                 def _sub_exec(val):
                     with dispatch_lock:
@@ -1386,37 +1803,91 @@ class BlockwiseExecutor:
 
                 split_stats = {"splits": 0, "max_depth": 0, "sub_blocks": 0}
 
-                def _run_sub(sub, depth, tracker):
+                def _load_sub(sub):
+                    """Load one sub-block with retries.  Returns
+                    ``("ok", val)``, ``("recurse", None)`` (a resource
+                    failure: the caller splits one level deeper), or
+                    ``("fail", None)`` (attributed, permanently failed)."""
+                    voxels = int(np.prod(sub.outer_shape))
+                    val, last_tb = None, None
+                    for k in range(self.max_retries + 1):
+                        try:
+                            injector.maybe_fail(
+                                "load", sub.block_id, voxels=voxels
+                            )
+                            injector.maybe_hang("load", sub.block_id)
+                            per = load_fn(sub)
+                            val = tuple(
+                                x.result() if hasattr(x, "result") else x
+                                for x in per
+                            )
+                            break
+                        except Exception as e:
+                            last_tb = fu.cap_traceback(traceback.format_exc())
+                            if classify_resource_error(e) is not None:
+                                return "recurse", None
+                            if k < self.max_retries:
+                                time.sleep(self._backoff(k))
+                    if val is None:
+                        note_failure(sub, "load", 1, last_tb, quarantine=True)
+                        return "fail", None
+                    return "ok", val
+
+                def _store_sub(sub, out, depth, tracker):
+                    """Validate + store (+ integrity verify) one sub-block's
+                    output with retries; a resource failure waits for
+                    headroom and recurses one level deeper."""
+                    voxels = int(np.prod(sub.outer_shape))
+                    err = validate(sub, out)
+                    if err is not None:
+                        note_failure(sub, "validate", 1, err, quarantine=True)
+                        return False
+                    if store_fn is None:
+                        return True
+
+                    def _store():
+                        store_fn(sub, out)
+                        if store_verify_fn is not None:
+                            store_verify_fn(sub)
+
+                    last_tb = None
+                    for k in range(self.max_retries + 1):
+                        try:
+                            injector.maybe_fail(
+                                "store", sub.block_id, voxels=voxels
+                            )
+                            injector.maybe_hang("store", sub.block_id)
+                            _store()
+                            return True
+                        except Exception as e:
+                            last_tb = fu.cap_traceback(traceback.format_exc())
+                            resource = classify_resource_error(e)
+                            if resource is not None:
+                                _wait_for_headroom(resource)
+                                return _split_and_run(sub, depth + 1,
+                                                      tracker)
+                            if k < self.max_retries:
+                                time.sleep(self._backoff(k))
+                    note_failure(sub, "store", 1, last_tb, quarantine=True)
+                    return False
+
+                def _run_sub(sub, depth, tracker, val=None):
                     """One sub-block through load -> kernel -> validate ->
                     store(+verify); a resource failure at any stage recurses
                     one level deeper.  Failures are attributed to the parent
-                    block id (sub-blocks carry it)."""
+                    block id (sub-blocks carry it).  ``val`` skips the load
+                    when the caller already holds the arrays (the ragged
+                    sub path falling back after a failed dispatch must not
+                    re-read storage — or burn load-fault attempts)."""
                     voxels = int(np.prod(sub.outer_shape))
                     with faults_mod.block_context(int(sub.block_id)):
-                        # load (retries for ordinary errors, recurse on oom)
-                        val, last_tb = None, None
-                        for k in range(self.max_retries + 1):
-                            try:
-                                injector.maybe_fail(
-                                    "load", sub.block_id, voxels=voxels
-                                )
-                                injector.maybe_hang("load", sub.block_id)
-                                per = load_fn(sub)
-                                val = tuple(
-                                    x.result() if hasattr(x, "result") else x
-                                    for x in per
-                                )
-                                break
-                            except Exception as e:
-                                last_tb = fu.cap_traceback(traceback.format_exc())
-                                if classify_resource_error(e) is not None:
-                                    return _split_and_run(sub, depth + 1,
-                                                          tracker)
-                                if k < self.max_retries:
-                                    time.sleep(self._backoff(k))
                         if val is None:
-                            note_failure(sub, "load", 1, last_tb, quarantine=True)
-                            return False
+                            status, val = _load_sub(sub)
+                            if status == "recurse":
+                                return _split_and_run(sub, depth + 1,
+                                                      tracker)
+                            if status == "fail":
+                                return False
                         # compute at the sub shape
                         try:
                             injector.maybe_fail(
@@ -1430,37 +1901,107 @@ class BlockwiseExecutor:
                                                       tracker)
                             note_failure(sub, "compute", 1, tb, quarantine=True)
                             return False
-                        err = validate(sub, out)
-                        if err is not None:
-                            note_failure(sub, "validate", 1, err, quarantine=True)
-                            return False
-                        if store_fn is None:
-                            return True
-                        # store (+ integrity verify) with retries
-                        def _store():
-                            store_fn(sub, out)
-                            if store_verify_fn is not None:
-                                store_verify_fn(sub)
+                        return _store_sub(sub, out, depth, tracker)
 
-                        for k in range(self.max_retries + 1):
+                def _run_subs_ragged(subs, depth, tracker):
+                    """All sub-blocks of one split parent through the paged
+                    block pool: mixed sub-shapes pack into ragged batches
+                    and execute as ONE program per batch instead of one
+                    ``jit`` dispatch per sub-block (docs/PERFORMANCE.md
+                    "Ragged sweeps") — the split ladder's semantics are
+                    unchanged: per-lane resource failures recurse deeper,
+                    and a failed ragged dispatch falls the chunk back to
+                    the per-sub path (the same program the unsplit
+                    quarantine pass uses)."""
+                    ok = True
+                    ready = []
+                    for sub in subs:
+                        with faults_mod.block_context(int(sub.block_id)):
+                            status, val = _load_sub(sub)
+                            if status == "recurse":
+                                ok &= _split_and_run(sub, depth + 1, tracker)
+                                continue
+                            if status == "fail":
+                                ok = False
+                                continue
                             try:
                                 injector.maybe_fail(
-                                    "store", sub.block_id, voxels=voxels
+                                    "compute", sub.block_id,
+                                    voxels=int(np.prod(sub.outer_shape)),
                                 )
-                                injector.maybe_hang("store", sub.block_id)
-                                _store()
-                                return True
                             except Exception as e:
-                                last_tb = fu.cap_traceback(traceback.format_exc())
-                                resource = classify_resource_error(e)
-                                if resource is not None:
-                                    _wait_for_headroom(resource)
-                                    return _split_and_run(sub, depth + 1,
-                                                          tracker)
-                                if k < self.max_retries:
-                                    time.sleep(self._backoff(k))
-                        note_failure(sub, "store", 1, last_tb, quarantine=True)
-                        return False
+                                tb = fu.cap_traceback(traceback.format_exc())
+                                if classify_resource_error(e) is not None:
+                                    ok &= _split_and_run(sub, depth + 1,
+                                                         tracker)
+                                    continue
+                                note_failure(sub, "compute", 1, tb,
+                                             quarantine=True)
+                                ok = False
+                                continue
+                            ready.append((sub, tuple(
+                                np.asarray(x) for x in val
+                            )))
+                    for start in range(0, len(ready), bs):
+                        chunk = ready[start:start + bs]
+                        width = min(
+                            bs,
+                            -(-len(chunk) // self.n_devices) * self.n_devices,
+                        )
+                        try:
+                            rb = ragged_pool.pack(
+                                [val for _, val in chunk], width,
+                                page_shape=page_shape,
+                            )
+                            prog = cached_program(
+                                ("ragged", dev_key) + rb.key(),
+                                lambda rb=rb: ragged_shard_map(
+                                    kernel, self.mesh, rb.width, rb.specs
+                                ),
+                            )
+                            rep, shd = rb.flat_inputs()
+                            dev_inputs = tuple(
+                                jax.device_put(a, replicated) for a in rep
+                            ) + tuple(
+                                jax.device_put(a, sharding) for a in shd
+                            )
+                            injector.maybe_fail(
+                                "dispatch", chunk[0][0].block_id,
+                                voxels=sum(
+                                    int(np.prod(s.outer_shape))
+                                    for s, _ in chunk
+                                ),
+                            )
+                            injector.maybe_hang(
+                                "dispatch", chunk[0][0].block_id
+                            )
+                            with dispatch_lock:
+                                with trace_mod.span(
+                                    "executor.dispatch", task=task_name,
+                                    n_blocks=len(chunk), grain="ragged",
+                                    lanes_padded=rb.lanes_padded,
+                                ):
+                                    out = prog(*dev_inputs)
+                            out_np = jax.tree_util.tree_map(np.asarray, out)
+                            rb.release()
+                            _note_dispatch(len(chunk), rb)
+                        except Exception:
+                            # the ragged sub dispatch failed (device OOM, a
+                            # wedged device, an unpackable chunk): the
+                            # unchanged per-sub fallback owns these lanes,
+                            # reusing the values already in hand
+                            for sub, val in chunk:
+                                ok &= _run_sub(sub, depth, tracker, val=val)
+                            continue
+                        for j, (sub, _) in enumerate(chunk):
+                            block_out = jax.tree_util.tree_map(
+                                lambda a, j=j: rb.crop(j, np.asarray(a)[j]),
+                                out_np,
+                            )
+                            with faults_mod.block_context(int(sub.block_id)):
+                                ok &= _store_sub(sub, block_out, depth,
+                                                 tracker)
+                    return ok
 
                 def _split_and_run(blk, depth=1, tracker=None):
                     """Recursive 2^d halo-correct split of ``blk``; True when
@@ -1484,6 +2025,12 @@ class BlockwiseExecutor:
                     split_stats["sub_blocks"] += len(subs)
                     if tracker is not None:
                         tracker["depth"] = max(tracker.get("depth", 0), depth)
+                    if use_ragged:
+                        # split sub-blocks stay on the sharded path: one
+                        # ragged program per parent instead of 2^d per-shape
+                        # jit dispatches (docs/PERFORMANCE.md "Ragged
+                        # sweeps")
+                        return _run_subs_ragged(subs, depth, tracker)
                     return all(_run_sub(sub, depth, tracker) for sub in subs)
 
                 # -- quarantine pass: reduced-batch re-attempts -----------------
@@ -1568,6 +2115,9 @@ class BlockwiseExecutor:
                     n_batches=dispatch_stats["batches"],
                     n_quarantined=len(quarantined_ids),
                 ),
+                ragged_batches=dispatch_stats["ragged_batches"],
+                lanes_padded=dispatch_stats["lanes_padded"],
+                pages_in_use=dispatch_stats["pages_in_use"],
             )
 
         unresolved = sorted(
@@ -1628,6 +2178,10 @@ class BlockwiseExecutor:
         }
         if sharded_failed_ids:
             summary["n_unsharded"] = len(sharded_failed_ids)
+        if dispatch_stats["ragged_batches"]:
+            summary["n_ragged_batches"] = dispatch_stats["ragged_batches"]
+            summary["n_lanes_padded"] = dispatch_stats["lanes_padded"]
+            summary["pages_in_use"] = dispatch_stats["pages_in_use"]
         if deadline > 0:
             summary["n_hung"] = sum(
                 1 for rec in failures.values() if "hung" in rec["sites"]

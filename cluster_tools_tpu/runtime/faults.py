@@ -184,6 +184,9 @@ _TORN_SITES = ("journal",)
 #: there models the whole sharded program exceeding device memory, a hang a
 #: wedged device stalling it — either must fall the batch back to per-block
 #: execution (resolution "degraded:unsharded"), which this site exercises.
+#: Ragged paged batches (docs/PERFORMANCE.md "Ragged sweeps") — mixed-shape
+#: main batches AND the degrade ladder's sub-block batches — dispatch
+#: through the same site, so the same faults prove their fallback.
 _HANG_SITES = ("load", "store", "io_read", "io_write", "dispatch")
 _OOM_SITES = ("load", "store", "io_read", "io_write", "compute", "dispatch")
 _ENOSPC_SITES = ("store", "io_write")

@@ -78,6 +78,7 @@ from typing import Any, Dict, List, Optional
 from ..utils import function_utils as fu
 from ..utils import task_utils as tu
 from . import admission as admission_mod
+from . import executor as executor_mod
 from . import faults as faults_mod
 from . import handoff as handoff_mod
 from . import journal as journal_mod
@@ -150,6 +151,7 @@ class PipelineServer:
         port: int = 0,
         journal: bool = True,
         max_replay_attempts: int = 3,
+        program_cache_size: Optional[int] = None,
     ):
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
@@ -190,6 +192,23 @@ class PipelineServer:
         self.host = host
         self.port = int(port)
         self.started_at = trace_mod.walltime()
+        # server-scoped compiled-program cache (ROADMAP item-1 residual):
+        # the PR-7 executor cache is instance-scoped, so a repeat request
+        # re-traced its kernels even when jax's compile cache was warm.
+        # The server owns one identity-keyed cache shared by every
+        # executor its request tasks build (kernel code + frozen captured
+        # config = identity, see executor.kernel_identity), sharpening the
+        # warm split for repeat requests.  Batch entry points never
+        # install one — instance scope stays the one-shot default.
+        # ``program_cache_size=0`` disables.
+        if program_cache_size is None:
+            program_cache_size = executor_mod.SHARED_PROGRAM_CACHE_SIZE
+        self.program_cache: Optional[executor_mod.ProgramCache] = (
+            executor_mod.ProgramCache(
+                max_size=int(program_cache_size), by_identity=True
+            )
+            if int(program_cache_size) > 0 else None
+        )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "PipelineServer":
@@ -210,33 +229,51 @@ class PipelineServer:
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), _RequestHandler
         )
-        self._httpd.pipeline = self  # type: ignore[attr-defined]
-        self._httpd.daemon_threads = True
-        self.port = self._httpd.server_address[1]
-        self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever, name="serve-http", daemon=True
-        )
-        self._http_thread.start()
-        self._heartbeat = HeartbeatWriter(
-            self.base_dir, SERVER_UID, interval_s=2.0
-        ).start()
-        for i in range(self.max_workers):
-            t = threading.Thread(
-                target=self._worker_loop, name=f"serve-worker-{i}", daemon=True
+        # installed only once the risky startup steps (journal recovery,
+        # endpoint bind) have succeeded, and uninstalled again on ANY
+        # later start failure: a process whose server never came up must
+        # keep the batch instance scope — every executor a request task
+        # builds shares this cache only for the server's lifetime
+        if self.program_cache is not None:
+            executor_mod.install_shared_program_cache(self.program_cache)
+        try:
+            self._httpd.pipeline = self  # type: ignore[attr-defined]
+            self._httpd.daemon_threads = True
+            self.port = self._httpd.server_address[1]
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever, name="serve-http",
+                daemon=True,
             )
-            t.start()
-            self._workers.append(t)
-        fu.atomic_write_json(
-            os.path.join(self.base_dir, ENDPOINT_FILENAME),
-            {
-                "host": self.host,
-                "port": self.port,
-                "pid": os.getpid(),
-                "hostname": socket.gethostname(),
-                "time": trace_mod.walltime(),
-            },
-        )
-        self._write_state()
+            self._http_thread.start()
+            self._heartbeat = HeartbeatWriter(
+                self.base_dir, SERVER_UID, interval_s=2.0
+            ).start()
+            for i in range(self.max_workers):
+                t = threading.Thread(
+                    target=self._worker_loop, name=f"serve-worker-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._workers.append(t)
+            fu.atomic_write_json(
+                os.path.join(self.base_dir, ENDPOINT_FILENAME),
+                {
+                    "host": self.host,
+                    "port": self.port,
+                    "pid": os.getpid(),
+                    "hostname": socket.gethostname(),
+                    "time": trace_mod.walltime(),
+                },
+            )
+            self._write_state()
+        except BaseException:
+            # a server that failed to come up must not leave the
+            # identity-keyed cache installed process-wide
+            if (self.program_cache is not None
+                    and executor_mod.shared_program_cache()
+                    is self.program_cache):
+                executor_mod.install_shared_program_cache(None)
+            raise
         return self
 
     def serve_until_drained(self, poll_s: float = 0.2) -> None:
@@ -278,6 +315,9 @@ class PipelineServer:
             self._httpd.server_close()
         if self._journal is not None:
             self._journal.close()
+        if (self.program_cache is not None
+                and executor_mod.shared_program_cache() is self.program_cache):
+            executor_mod.install_shared_program_cache(None)
 
     # -- journal + replay (docs/SERVING.md "Durability") -------------------
     def _journal_append(self, typ: str, request_id: str,
@@ -892,6 +932,13 @@ class PipelineServer:
             # fsync freshness, journal growth, and what this incarnation's
             # replay recovered / re-enqueued / quarantined
             "journal": journal,
+            # the server-scoped compiled-program cache (hits = repeat
+            # requests that skipped a trace/compile; unkeyed = kernels
+            # whose captured state could not be identity-frozen)
+            "programs": (
+                self.program_cache.stats()
+                if self.program_cache is not None else None
+            ),
         }
 
     def _write_state(self) -> None:
@@ -1021,6 +1068,12 @@ class _RequestHandler(BaseHTTPRequestHandler):
                 # fsync age, journal bytes, and the replay backlog — a
                 # liveness probe that can also see the ack contract rot
                 "journal": self.pipeline.journal_health(),
+                # the server-scoped program cache's pulse (docs/SERVING.md
+                # "The server-scoped compiled-program cache")
+                "programs": (
+                    self.pipeline.program_cache.stats()
+                    if self.pipeline.program_cache is not None else None
+                ),
             })
         elif path == "/status":
             self._reply(200, self.pipeline.status())

@@ -264,6 +264,18 @@ def format_io_metrics(tasks, provenance=None) -> list:
                 f"{per:.1f} blocks/dispatch, "
                 f"dispatch wait {wait:.2f}s, overlap efficiency {overlap}"
             )
+        ragged = int(m.get("ragged_batches", 0))
+        if ragged:
+            # ragged paged sweeps (docs/PERFORMANCE.md "Ragged sweeps"):
+            # mixed-shape / partial batches that ran as one program via
+            # the paged block pool instead of per-block fallback
+            lines.append(
+                f"  ragged: {ragged} of those batch(es) paged "
+                f"(mixed-shape/partial lanes), "
+                f"{int(m.get('lanes_padded', 0))} padding lane(s) "
+                f"discarded, {int(m.get('pages_in_use', 0))} pool "
+                f"page(s) in use"
+            )
         # multi-process attribution (io_metrics.json schema v2): when more
         # than one process merged into this task's counters, say which
         # host:pid contributed what — the additive totals alone cannot

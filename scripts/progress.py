@@ -162,6 +162,9 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
             # replay outcome + live backlog; a backlog that is not
             # draining means acknowledged requests are going unserved
             "journal": journal,
+            # the server-scoped compiled-program cache (docs/SERVING.md):
+            # warm repeat requests show up as hits
+            "programs": server_state.get("programs"),
             "journal_backlog_stalled": bool(
                 journal
                 and journal.get("replay_backlog")
@@ -176,6 +179,13 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
         # not as a phantom task row
         heartbeats.pop("server", None)
         uids.discard("server")
+
+    # per-task sweep counters (io_metrics.json, written by the task
+    # runtime next to failures.json): the dispatch-amortization pulse —
+    # including the ragged paged-pool counters (docs/PERFORMANCE.md
+    # "Ragged sweeps") — without needing the full failures report
+    io_doc = _read_json(os.path.join(tmp_folder, "io_metrics.json")) or {}
+    io_tasks = io_doc.get("tasks") or {}
 
     fail_doc = _read_json(os.path.join(tmp_folder, "failures.json")) or {}
     by_task = defaultdict(lambda: {"quarantined": 0, "unresolved": 0,
@@ -224,6 +234,16 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
             state = "stalled?"
         else:
             state = "in-flight"
+        metrics = io_tasks.get(uid) or {}
+        dispatches = None
+        if metrics.get("batches_dispatched"):
+            dispatches = {
+                "batches": int(metrics.get("batches_dispatched", 0)),
+                "blocks": int(metrics.get("blocks_dispatched", 0)),
+                "ragged_batches": int(metrics.get("ragged_batches", 0)),
+                "lanes_padded": int(metrics.get("lanes_padded", 0)),
+                "pages_in_use": int(metrics.get("pages_in_use", 0)),
+            }
         tasks.append({
             "task": uid,
             "state": state,
@@ -235,6 +255,7 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
                 round(hb_age, 1) if hb_age is not None else None
             ),
             "heartbeat_pid_dead": hb_pid_dead,
+            "dispatches": dispatches,
         })
     return {
         "version": 1,
@@ -286,6 +307,17 @@ def _format_server(server) -> list:
         lines.append(
             f"    handoffs resident: {hand['live_entries']} entries, "
             f"{hand.get('live_bytes', 0) / 1e6:.1f}MB"
+        )
+    progs = server.get("programs")
+    if progs:
+        lines.append(
+            f"    programs: {progs.get('programs', 0)} cached "
+            f"(hits {progs.get('hits', 0)}, misses {progs.get('misses', 0)}"
+            + (
+                f", unkeyed {progs['unkeyed']}" if progs.get("unkeyed")
+                else ""
+            )
+            + ")"
         )
     j = server.get("journal")
     if j:
@@ -347,6 +379,16 @@ def format_progress(doc) -> str:
             bits.append(f"ran {float(t['runtime_s']):.2f}s")
         if t["heartbeat_age_s"] is not None:
             bits.append(f"heartbeat {t['heartbeat_age_s']:.1f}s ago")
+        d = t.get("dispatches")
+        if d:
+            disp = f"{d['batches']} dispatch(es)"
+            if d["ragged_batches"]:
+                disp += (
+                    f" ({d['ragged_batches']} ragged, "
+                    f"{d['lanes_padded']} pad lane(s), "
+                    f"{d['pages_in_use']} page(s))"
+                )
+            bits.append(disp)
         lines.append(
             f"  {t['task']:<{width}}  {t['state']:<9}  " + ", ".join(bits)
         )

@@ -44,3 +44,14 @@ def build_sharded(batched_shard_map, mesh):
     # the batched shard_map wrapper traces its kernel like jit/shard_map:
     # the impure call above must be resolved through it
     return batched_shard_map(impure_sharded_kernel, mesh, 16)
+
+
+def impure_ragged_kernel(b):
+    seed = np.random.rand()  # host randomness baked into the ragged program
+    return b + seed
+
+
+def build_ragged(ragged_shard_map, mesh, specs):
+    # the ragged paged wrapper traces its kernel like jit/shard_map: the
+    # impure call above must be resolved through it
+    return ragged_shard_map(impure_ragged_kernel, mesh, 16, specs)

@@ -52,3 +52,12 @@ def pure_sharded_kernel(b):
 def build_sharded(batched_shard_map, mesh):
     # pure kernel through the batched shard_map wrapper: no findings
     return batched_shard_map(pure_sharded_kernel, mesh, 16)
+
+
+def pure_ragged_kernel(b):
+    return jnp.where(b < 0.5, b, b * 2)
+
+
+def build_ragged(ragged_shard_map, mesh, specs):
+    # pure kernel through the ragged paged wrapper: no findings
+    return ragged_shard_map(pure_ragged_kernel, mesh, 16, specs)

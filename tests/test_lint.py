@@ -130,6 +130,18 @@ def test_ct005_resolves_batched_shard_map_kernels():
     assert any("impure_sharded_kernel" in m for m in msgs)
 
 
+def test_ct005_resolves_ragged_shard_map_kernels():
+    """Functions passed into the ragged paged wrapper (the mixed-shape
+    sweep's compiled program, docs/PERFORMANCE.md "Ragged sweeps") are
+    statically resolved like every other jit/shard_map target — and the
+    clean fixture's pure ragged kernel stays quiet."""
+    findings, _ = lint_fixture("ct005_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT005"]
+    assert any("impure_ragged_kernel" in m for m in msgs)
+    findings, _ = lint_fixture("ct005_clean.py")
+    assert [f for f in findings if f.rule == "CT005"] == []
+
+
 def test_ct006_all_violation_classes():
     findings, _ = lint_fixture("ct006_bad.py")
     msgs = [f.message for f in findings if f.rule == "CT006"]

@@ -1,0 +1,479 @@
+"""Ragged paged block pool (docs/PERFORMANCE.md "Ragged sweeps").
+
+Covers the paged-pool packing machinery (``parallel/block_pool.py``), the
+descriptor-driven device program (``ragged_shard_map``) — including the
+padding-lane vs clipped-read property at EVERY ragged width — the
+executor's mixed-shape / forced-split sharded paths (bit-identity against
+the per-block fallback on a non-pow2 clipped grid), the ragged fault
+surface, the ragged dispatch counters end to end (io_metrics.json ->
+failures_report / progress rendering), the server-scoped compiled-program
+cache (kernel identity + shared ProgramCache), and the <10 s smoke twin
+of ``make bench-ragged``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cluster_tools_tpu.parallel import block_pool
+from cluster_tools_tpu.parallel.batch_shard import ragged_shard_map
+from cluster_tools_tpu.runtime import executor as executor_mod
+from cluster_tools_tpu.runtime.executor import (
+    BlockwiseExecutor,
+    ProgramCache,
+    get_mesh,
+    install_shared_program_cache,
+    kernel_identity,
+    shared_program_cache,
+)
+from cluster_tools_tpu.utils import function_utils as fu
+from cluster_tools_tpu.utils.volume_utils import Blocking
+
+
+def elementwise_kernel(b):
+    # the shape-local contract of the ragged path holds trivially for
+    # elementwise kernels: padded lanes crop back to the exact result
+    return jnp.where(b < jnp.float32(0.5), b * 2 + jnp.float32(0.25),
+                     jnp.float32(1.0))
+
+
+# -- pool packing -------------------------------------------------------------
+
+
+def test_pool_pack_descriptors_and_fill_page(rng):
+    pool = block_pool.PagedBlockPool()
+    lanes = [
+        (rng.random((10, 7, 5)).astype(np.float32),),
+        (rng.random((12, 12, 12)).astype(np.float32),),
+        (rng.random((3, 12, 9)).astype(np.float32),),
+    ]
+    rb = pool.pack(lanes, width=8, fills=(1.5,))
+    assert rb.n_lanes == 3 and rb.width == 8 and rb.lanes_padded == 5
+    (spec,) = rb.specs
+    assert spec.page_shape == (8, 8, 8)  # chunk-scale default for mixed
+    assert spec.padded_shape == (16, 16, 16)
+    # slot 0 is the shared fill page
+    assert np.all(rb.pools[0][0] == np.float32(1.5))
+    # padding lanes reference nothing but the fill page, valid extent 0
+    assert np.all(rb.tables[0][3:] == 0)
+    assert np.all(rb.valids[0][3:] == 0)
+    # real pages = the tiles each lane's extent overlaps
+    assert rb.pages_in_use == (2 * 1 * 1) + (2 * 2 * 2) + (1 * 2 * 2)
+    # lane 1 (12^3) reconstructs exactly from its 8 pages
+    assert rb.lane_valid_shape(1) == (12, 12, 12)
+
+
+def test_pool_pack_uniform_uses_lane_shape_page(rng):
+    """Uniform-shape lanes (a partial tail of a dense sweep) use the lane
+    shape itself as the page, so every real lane is one full page — exact
+    bytes, any kernel."""
+    pool = block_pool.PagedBlockPool()
+    lanes = [(rng.random((6, 5, 7)).astype(np.float32),) for _ in range(3)]
+    rb = pool.pack(lanes, width=4)
+    (spec,) = rb.specs
+    assert spec.page_shape == (6, 5, 7) and spec.grid == (1, 1, 1)
+    assert rb.pages_in_use == 3
+    for j, (a,) in enumerate(lanes):
+        assert np.array_equal(rb.pools[0][rb.tables[0][j, 0]], a)
+    # a caller page tile (chunk alignment for MIXED batches) must not
+    # erode the any-kernel exactness of uniform lanes
+    rb2 = pool.pack(lanes, width=4, page_shape=(4, 4, 4))
+    assert rb2.specs[0].page_shape == (6, 5, 7)
+
+
+def test_pool_pack_refuses_unpackable():
+    pool = block_pool.PagedBlockPool()
+    with pytest.raises(ValueError, match="empty"):
+        pool.pack([], width=4)
+    with pytest.raises(ValueError, match="width"):
+        pool.pack([(np.zeros((2, 2)),)] * 3, width=2)
+    with pytest.raises(ValueError, match="rank"):
+        pool.pack([(np.zeros((2, 2)),), (np.zeros((2, 2, 2)),)], width=4)
+    with pytest.raises(ValueError, match="dtype"):
+        pool.pack(
+            [(np.zeros((2, 2), np.float32),),
+             (np.zeros((2, 2), np.int32),)],
+            width=4,
+        )
+    with pytest.raises(ValueError, match="arg count"):
+        pool.pack(
+            [(np.zeros((2, 2)),), (np.zeros((2, 2)), np.zeros((2, 2)))],
+            width=4,
+        )
+
+
+def test_pool_buffer_reuse_and_stale_bytes_masked(rng):
+    """Released buffers are recycled, and a poisoned (stale) buffer cannot
+    leak into results: partial pages are host-refilled and the device mask
+    re-asserts the valid extent."""
+    mesh = get_mesh("local")
+    pool = block_pool.PagedBlockPool()
+    mk = lambda s: (rng.random(s).astype(np.float32),)  # noqa: E731
+    rb = pool.pack([mk((9, 9, 9)), mk((5, 12, 7))], width=8)
+    key = rb.key()
+    # poison the checked-out buffers, then release them for reuse
+    for p in rb.pools:
+        p[:] = np.float32(np.nan)
+    rb.release()
+    lanes = [mk((9, 9, 9)), mk((5, 12, 7))]
+    rb2 = pool.pack(lanes, width=8)
+    assert pool.buffer_reuses >= 1 and rb2.key() == key
+    prog = ragged_shard_map(elementwise_kernel, mesh, rb2.width, rb2.specs)
+    rep, shd = rb2.flat_inputs()
+    out = np.asarray(prog(*rep, *shd))
+    ref = jax.jit(jax.vmap(elementwise_kernel))
+    for j, (a,) in enumerate(lanes):
+        got = rb2.crop(j, out[j])
+        assert np.array_equal(got, np.asarray(ref(a[None]))[0])
+        assert np.isfinite(got).all()
+
+
+# -- the ragged device program ------------------------------------------------
+
+
+def test_ragged_program_parity_at_every_width(rng):
+    """The padding-lane vs clipped-read property: at EVERY ragged width
+    1..batch, each real lane's cropped output is bit-identical to the
+    width-1 vmapped program over the exact clipped read, and the
+    synthetic padding lanes change nothing."""
+    mesh = get_mesh("local")
+    batch = 8
+    pool = block_pool.PagedBlockPool()
+    ref = jax.jit(jax.vmap(elementwise_kernel))
+    shapes = [(10, 7, 5), (12, 12, 12), (3, 12, 9), (12, 1, 12),
+              (5, 5, 5), (7, 11, 2), (12, 9, 4), (8, 8, 8)]
+    lanes = [(rng.random(s).astype(np.float32),) for s in shapes]
+    for width in range(1, batch + 1):
+        real = lanes[:width]
+        rb = pool.pack(real, width=batch)
+        assert rb.lanes_padded == batch - width
+        prog = ragged_shard_map(
+            elementwise_kernel, mesh, rb.width, rb.specs
+        )
+        rep, shd = rb.flat_inputs()
+        out = np.asarray(prog(*rep, *shd))
+        for j, (a,) in enumerate(real):
+            assert np.array_equal(
+                rb.crop(j, out[j]), np.asarray(ref(a[None]))[0]
+            ), f"width {width}, lane {j}"
+        rb.release()
+
+
+def test_ragged_program_rejects_indivisible_batch():
+    mesh = get_mesh("local")
+    n_dev = int(np.prod(mesh.devices.shape))
+    if n_dev == 1:
+        pytest.skip("needs a multi-device mesh")
+    spec = block_pool.RaggedArgSpec((1, 1), (4, 4), "float32", 0, 16)
+    with pytest.raises(ValueError, match="not divisible"):
+        ragged_shard_map(elementwise_kernel, mesh, n_dev + 1, (spec,))
+
+
+# -- executor: mixed-shape sweeps ---------------------------------------------
+
+
+def _grid_blocks(shape, bshape, halo):
+    blocking = Blocking(shape, bshape)
+    return blocking, [
+        blocking.get_block(i, halo=halo) for i in range(blocking.n_blocks)
+    ]
+
+
+def _sweep(vol, blocks, mode, ragged="auto", n_devices=None, fp=None, **kw):
+    out = np.zeros(vol.shape, np.float32)
+
+    def load(b):
+        return (vol[b.outer_bb],)  # exact clipped shapes — no padding
+
+    def store(b, raw):
+        out[b.bb] = np.asarray(raw)[b.inner_in_outer_bb]
+
+    ex = BlockwiseExecutor(
+        target="local", n_devices=n_devices, io_threads=4,
+        backoff_base=1e-4,
+    )
+    snap = executor_mod.dispatch_snapshot()
+    summary = ex.map_blocks(
+        elementwise_kernel, blocks, load, store,
+        failures_path=fp, task_name=f"ragged_{mode}",
+        schedule="morton", sweep_mode=mode, sharded_batch=16,
+        ragged=ragged, **kw,
+    )
+    return out, summary, executor_mod.dispatch_delta(snap)
+
+
+def test_mixed_shape_sweep_one_program_bit_identical(rng):
+    """27-block non-pow2 grid, every face block clipped, loads un-padded:
+    the sharded path packs the mixed shapes through the paged pool — a
+    couple of ragged dispatches instead of one per block — bit-identical
+    to per-block execution."""
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    assert len(blocks) == 27
+    out_pb, _, d_pb = _sweep(vol, blocks, "per_block", "off", n_devices=1)
+    out_rg, summary, d_rg = _sweep(vol, blocks, "sharded")
+    assert np.array_equal(out_pb, out_rg)
+    assert d_pb["batches_dispatched"] == 27
+    assert d_rg["batches_dispatched"] == 2
+    assert d_rg["ragged_batches"] == 2
+    assert d_rg["blocks_dispatched"] == 27
+    assert d_rg["lanes_padded"] == 2 * 16 - 27
+    assert d_rg["pages_in_use"] > 0
+    assert summary["n_ragged_batches"] == 2
+    assert summary["n_lanes_padded"] == 5
+    assert summary["pages_in_use"] == d_rg["pages_in_use"]
+
+
+def test_uniform_partial_tail_packs_ragged_and_exact(rng):
+    """A uniform sweep whose final batch is partial: the tail packs with
+    the lane shape as the page (exact bytes for every real lane) and the
+    padding lanes are discarded — bit-identical, with the padding
+    attributed in the counters."""
+    vol = rng.random((16, 16, 16)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    assert len(blocks) == 8  # sharded_batch=16 -> one partial batch
+    out_pb, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1)
+    out_rg, summary, d_rg = _sweep(vol, blocks, "sharded")
+    assert np.array_equal(out_pb, out_rg)
+    assert d_rg["batches_dispatched"] == 1
+    assert d_rg["ragged_batches"] == 1
+    assert d_rg["lanes_padded"] == 8
+    assert summary["n_lanes_padded"] == 8
+
+
+def test_forced_split_stays_on_sharded_path_bit_identical(rng, inject,
+                                                          tmp_path):
+    """The ISSUE acceptance scenario: min_voxels-gated OOM forces full
+    blocks through the degrade-split ladder.  With the paged pool the
+    2^3 sub-blocks of each parent run as ONE ragged program (attributed
+    degraded:split, ragged dispatches counted) instead of falling to
+    per-sub jit dispatches — and the reassembled volume is bit-identical
+    to the per-block fallback under the same faults."""
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    blocking, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    split_ids = sorted(
+        blocking.grid_position_to_id(pos) for pos in np.ndindex(2, 2, 2)
+    )
+    cfg = {
+        "seed": 3,
+        "faults": [{
+            "site": "load", "kind": "oom", "blocks": split_ids,
+            "min_voxels": 1000, "fail_attempts": 10**6,
+        }],
+    }
+    split_kw = dict(splittable=True, split_halo=(2, 2, 2),
+                    min_block_shape=(2, 2, 2), degrade_wait_s=0.05)
+
+    inject(cfg)
+    out_pb, s_pb, d_pb = _sweep(
+        vol, blocks, "per_block", "off", n_devices=1,
+        fp=str(tmp_path / "f_pb.json"), **split_kw,
+    )
+    inject(cfg)
+    fp = str(tmp_path / "f_rg.json")
+    out_rg, s_rg, d_rg = _sweep(vol, blocks, "sharded", fp=fp, **split_kw)
+    assert np.array_equal(out_pb, out_rg)
+    assert s_rg["n_split"] == len(split_ids)
+    assert s_rg["n_sub_blocks"] == 8 * len(split_ids)
+    # the sharded path held: main batches + one ragged program per split
+    # parent, >= 8x fewer dispatches than the per-block fallback
+    assert d_rg["ragged_batches"] >= 1 + len(split_ids)
+    assert d_pb["batches_dispatched"] >= 8 * d_rg["batches_dispatched"]
+    recs = {
+        r["block_id"]: r
+        for r in json.load(open(fp))["records"]
+    }
+    for bid in split_ids:
+        assert recs[bid]["resolved"]
+        assert recs[bid]["resolution"] == "degraded:split"
+
+
+def test_ragged_off_mixed_shapes_fall_back_attributed(rng, tmp_path):
+    """ragged='off' restores the historical shape contract: mixed-shape
+    lanes execute per-block (the unchanged fallback), attributed
+    degraded:unsharded — and stay bit-identical."""
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    out_pb, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1)
+    fp = str(tmp_path / "failures.json")
+    out_off, _, d_off = _sweep(vol, blocks, "sharded", "off", fp=fp)
+    assert np.array_equal(out_pb, out_off)
+    assert d_off["ragged_batches"] == 0
+    recs = json.load(open(fp))["records"]
+    assert len(recs) == len(blocks)
+    assert all(
+        r["resolved"] and r["resolution"] == "degraded:unsharded"
+        and "pack" in r["sites"]
+        for r in recs
+    )
+
+
+def test_ragged_dispatch_oom_falls_back_per_block(rng, inject, tmp_path):
+    """The batch-grain fault surface covers ragged dispatches: a device
+    OOM at a ragged dispatch quarantines the batch and the per-block
+    program resolves it (degraded:unsharded), bit-identical."""
+    from cluster_tools_tpu.runtime.executor import morton_order
+
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    out_pb, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1)
+    first = int(morton_order(blocks)[0].block_id)
+    inject({
+        "seed": 3,
+        "faults": [{
+            "site": "dispatch", "kind": "oom",
+            "blocks": [first], "fail_attempts": 1,
+        }],
+    })
+    fp = str(tmp_path / "failures.json")
+    out_rg, summary, _ = _sweep(vol, blocks, "sharded", fp=fp)
+    assert np.array_equal(out_pb, out_rg)
+    assert summary["n_unsharded"] >= 1
+    recs = [
+        r for r in json.load(open(fp))["records"]
+        if "dispatch" in r["sites"]
+    ]
+    assert recs and all(
+        r["resolved"] and r["resolution"] == "degraded:unsharded"
+        for r in recs
+    )
+
+
+def test_invalid_ragged_mode_refused(rng):
+    vol = rng.random((8, 8, 8)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), None)
+    with pytest.raises(ValueError, match="ragged"):
+        _sweep(vol, blocks, "sharded", ragged="maybe")
+
+
+# -- server-scoped program cache ----------------------------------------------
+
+
+def _make_kernel(threshold, capture=None):
+    def kernel(x):
+        if capture is not None:
+            return x + capture
+        return jnp.where(x < threshold, x, x * 2)
+
+    return kernel
+
+
+def test_kernel_identity_freezes_code_and_captures():
+    k1, k2 = _make_kernel(0.5), _make_kernel(0.5)
+    assert k1 is not k2
+    i1, i2 = kernel_identity(k1), kernel_identity(k2)
+    assert i1 is not None and i1 == i2
+    # a different captured value is a different identity (sharing the
+    # compiled program would silently reuse the other request's config)
+    assert kernel_identity(_make_kernel(0.75)) != i1
+    # unfreezable captures (arrays, datasets) refuse — instance scope only
+    assert kernel_identity(_make_kernel(0.5, np.ones(3))) is None
+
+
+def test_shared_program_cache_hits_across_executors(rng):
+    """Two executors (two 'requests') building equal kernel closures share
+    one compiled program through an installed identity-keyed cache."""
+    vol = rng.random((16, 8, 8)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), None)
+    cache = ProgramCache(max_size=8, by_identity=True)
+    prev = install_shared_program_cache(cache)
+    try:
+        _sweep(vol, blocks, "sharded")
+        first = cache.stats()
+        _sweep(vol, blocks, "sharded")
+        second = cache.stats()
+    finally:
+        install_shared_program_cache(prev)
+    assert first["misses"] >= 1 and first["hits"] == 0
+    assert second["hits"] >= first["misses"]
+    assert second["misses"] == first["misses"]
+
+
+def test_server_installs_and_removes_shared_cache(tmp_path):
+    from cluster_tools_tpu.runtime.server import PipelineServer
+
+    assert shared_program_cache() is None
+    srv = PipelineServer(str(tmp_path / "srv"), journal=False)
+    srv.start()
+    try:
+        assert shared_program_cache() is srv.program_cache
+        assert srv.program_cache.by_identity
+        assert srv._state_doc()["programs"]["max_size"] > 0
+    finally:
+        srv.stop()
+    assert shared_program_cache() is None
+    # opting out keeps instance scope
+    off = PipelineServer(str(tmp_path / "srv2"), journal=False,
+                         program_cache_size=0)
+    assert off.program_cache is None
+
+
+# -- counters end to end: io_metrics.json -> report / progress ----------------
+
+
+def test_ragged_counters_in_io_metrics_and_reports(rng, tmp_path):
+    from cluster_tools_tpu.runtime.task import BaseTask
+
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+
+    class RaggedTask(BaseTask):
+        task_name = "ragged_metrics_task"
+
+        def run_impl(self):
+            _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+            _, summary, _ = _sweep(vol, blocks, "sharded")
+            return {"n": summary["n_blocks"]}
+
+    task = RaggedTask(str(tmp_path / "tmp"), "")
+    task.run()
+    doc = json.loads(
+        open(fu.io_metrics_path(str(tmp_path / "tmp"))).read()
+    )
+    metrics = doc["tasks"][task.uid]
+    assert metrics["ragged_batches"] == 2
+    assert metrics["lanes_padded"] == 5
+    assert metrics["pages_in_use"] > 0
+
+    import importlib.util
+
+    def load_script(name):
+        spec = importlib.util.spec_from_file_location(
+            name,
+            os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         f"{name}.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    report = load_script("failures_report")
+    lines = "\n".join(report.format_io_metrics(doc["tasks"]))
+    assert "ragged: 2 of those batch(es) paged" in lines
+    assert "5 padding lane(s)" in lines
+
+    progress = load_script("progress")
+    pdoc = progress.collect_progress(str(tmp_path / "tmp"))
+    row = [t for t in pdoc["tasks"] if t["task"] == task.uid][0]
+    assert row["dispatches"]["ragged_batches"] == 2
+    assert row["dispatches"]["lanes_padded"] == 5
+    text = progress.format_progress(pdoc)
+    assert "2 ragged" in text and "5 pad lane(s)" in text
+
+
+# -- bench smoke (the <10 s twin of `make bench-ragged`) ----------------------
+
+
+def test_ragged_bench_smoke():
+    import bench
+
+    rec = bench.ragged_bench(smoke=True)
+    assert rec["bit_identical"] is True
+    assert rec["dispatch_reduction"] >= 8
+    assert rec["ragged"]["ragged_batches"] >= 1
+    assert rec["ragged"]["n_sub_blocks"] == rec["per_block"]["n_sub_blocks"]
+    assert rec["per_block"]["dispatches"] > rec["ragged"]["dispatches"]
