@@ -83,6 +83,13 @@
 #                     resident server, submit concurrent tiny workflows
 #                     from two tenants, assert both complete with
 #                     warm-cache reuse visible in io_metrics; <10 s, cpu
+#   scrub-smoke     = self-healing smoke (docs/SERVING.md "Self-healing"):
+#                     the <10 s tier-1 twin of the corruption chaos e2e —
+#                     an in-process server completes a request, a stored
+#                     block is rotted at rest, the scrubber finds and
+#                     repairs it from lineage, and the output stays
+#                     bit-identical; runs inside tier1 via
+#                     tests/test_selfheal.py
 #   supervise-demo  = smoke-check recipe: watershed workflow on the
 #                     stub-slurm cluster target under an injected job loss,
 #                     printing the supervisor's resubmission log
@@ -92,7 +99,7 @@ TMP ?= /tmp/ctt_run
 
 .PHONY: test lint tier1 tier2 chaos chaos-resource failures-report progress \
 	bench-io bench-sweep bench-fuse bench-ragged bench-solve bench-serve \
-	bench-trajectory serve-smoke supervise-demo native clean
+	bench-trajectory serve-smoke scrub-smoke supervise-demo native clean
 
 test: lint tier1 tier2 chaos
 
@@ -143,6 +150,10 @@ bench-serve:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve.py -q \
 		-k serve_smoke -p no:cacheprovider
+
+scrub-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_selfheal.py -q \
+		-k scrub_smoke -p no:cacheprovider
 
 bench-trajectory:
 	$(PY) scripts/bench_trajectory.py --write

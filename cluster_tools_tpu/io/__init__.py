@@ -11,3 +11,8 @@ from .containers import (
     checksums_enabled,
     open_container,
 )
+from .verified import (
+    MissingSidecarError,
+    ProductCorruptionError,
+    mark_product,
+)

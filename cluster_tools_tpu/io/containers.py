@@ -270,6 +270,39 @@ class _ChecksumIndex:
             except OSError:
                 pass
 
+    def drop(self, region) -> None:
+        """Delete ONE region's sidecar (the injected sidecar-loss fault
+        rides this; stale-overlap semantics stay with
+        :meth:`invalidate_overlaps`)."""
+        if self._mem is not None:
+            with self._lock:
+                self._mem.pop(region, None)
+            return
+        if self._dir is None:
+            return
+        with self._lock:
+            self._known_regions().discard(region)
+        try:
+            os.unlink(os.path.join(self._dir, self._key(region) + ".json"))
+        except OSError:
+            pass
+
+    def regions(self) -> list:
+        """Every region with a recorded sidecar.  Filesystem indexes
+        answer from the DIRECTORY — the scrubber's work list must see
+        sidecars written by other processes/handles, not this handle's
+        incremental cache — memory indexes from the dict."""
+        if self._mem is not None:
+            with self._lock:
+                return list(self._mem)
+        out = []
+        if self._dir is not None and os.path.isdir(self._dir):
+            for fname in os.listdir(self._dir):
+                r = self._parse(fname)
+                if r is not None:
+                    out.append(r)
+        return out
+
 
 # async completion hooks (verify / record digest) ride on prefetch's
 # future-mapping adapter — the async IO paths stay inside the same fault
@@ -283,6 +316,12 @@ class _ChecksumOps:
     ``_write_raw(bb, value)`` (raw write, no sidecar) plus ``_checksums``
     and ``_label`` attributes."""
 
+    #: read-site tag carried into typed ``corrupt:<site>`` errors
+    #: (io/verified.py): "storage" for stored datasets, "memory" for the
+    #: in-memory container, "handoff" for live handoff targets, "spill"
+    #: for a handoff's storage spill copy (stamped by ``spill()``)
+    _read_site = "storage"
+
     def _read_back(self, bb) -> np.ndarray:  # pragma: no cover - abstract
         raise NotImplementedError
 
@@ -291,8 +330,9 @@ class _ChecksumOps:
 
     def _after_write(self, bb, value: np.ndarray, block_id) -> None:
         """Record the region digest, then apply any injected silent
-        corruption (bit-flip the stored bytes, sidecar untouched — only
-        checksum verification can tell)."""
+        corruption (bit-flip the stored bytes — or delete the fresh
+        sidecar, ``mode='sidecar'`` — so only checksum verification, or
+        its absence, can tell)."""
         region = _norm_region(bb, self.shape)
         if region is not None and checksums_enabled():
             if value.shape == _region_shape(region):
@@ -301,11 +341,52 @@ class _ChecksumOps:
                 # broadcast / scalar fill: no digestable identity, but any
                 # previous digest for this box is now stale
                 self._checksums.invalidate_overlaps(region)
-        if _faults().get_injector().chunk_corrupt("io_write", block_id):
+        mode = _faults().get_injector().chunk_corrupt("io_write", block_id)
+        if mode == "sidecar":
+            if region is not None:
+                self._checksums.drop(region)
+        elif mode:
             bad = np.ascontiguousarray(value).copy()
             if bad.size and bad.dtype.itemsize:
                 bad.reshape(-1).view(np.uint8)[0] ^= 0x01
             self._write_raw(bb, bad)
+
+    def _apply_read_rot(self, bb, block_id) -> None:
+        """Injected at-rest damage surfacing at the read site
+        (``kind='corrupt'`` at ``io_read``, runtime/faults.py): flip one
+        STORED byte of the region (sidecar untouched) or delete its
+        sidecar (``mode='sidecar'``) just before the read proceeds — the
+        verifying reader must catch the former, the missing-sidecar
+        policy decides the latter."""
+        mode = _faults().get_injector().chunk_corrupt("io_read", block_id)
+        if not mode:
+            return
+        region = _norm_region(bb, self.shape)
+        if region is None:
+            return
+        if mode == "sidecar":
+            self._checksums.drop(region)
+            return
+        bad = np.ascontiguousarray(self._read_back(bb)).copy()
+        if bad.size and bad.dtype.itemsize:
+            bad.reshape(-1).view(np.uint8)[0] ^= 0x01
+            self._write_raw(bb, bad)
+            # the rot lives on STORAGE: resident clean chunks must not
+            # shadow it, or the read under test never sees the damage
+            inval = getattr(self, "_invalidate_cached_region", None)
+            if inval is not None:
+                inval(bb)
+
+    def _postread(self, bb, arr: np.ndarray, evict=None) -> np.ndarray:
+        """The verifying-reader tail of every region read
+        (:mod:`cluster_tools_tpu.io.verified`): digest verification, the
+        per-store missing-sidecar policy, and the lineage-repair hook on
+        mismatch.  Returns the (possibly repaired and re-read) array;
+        raises the typed ``corrupt:<site>`` error when the bytes stay
+        bad."""
+        from . import verified as _verified
+
+        return _verified.postread(self, bb, arr, evict=evict)
 
     def _verify_read(self, bb, arr: np.ndarray) -> None:
         if not checksums_enabled():
@@ -337,6 +418,21 @@ class _ChecksumOps:
         if region is None or self._checksums.lookup(region) is None:
             return
         self._verify_read(bb, np.asarray(self._read_back(bb)))
+
+    def checksum_regions(self) -> list:
+        """Every region with a recorded digest sidecar — the scrubber's
+        work list (``runtime/scrub.py``).  Disk truth for filesystem-
+        backed indexes: sidecars written by other handles/processes are
+        visible."""
+        return self._checksums.regions()
+
+    def checksum_entry(self, bb) -> Optional[Dict]:
+        """The digest sidecar entry for ``bb``'s exact region (``crc`` /
+        ``dtype`` / ``shape``), or None when unrecorded — lets the
+        scrubber budget bytes without reading the data."""
+        region = _norm_region(bb, self.shape)
+        return None if region is None else self._checksums.lookup(region)
+
 
 # numpy dtype -> zarr v2 dtype string
 def _zarr_dtype(dtype) -> str:
@@ -586,20 +682,16 @@ class Dataset(_ChecksumOps):
     def __getitem__(self, bb) -> np.ndarray:
         bid = _inject("io_read")
         _hang("io_read", bid)
+        self._apply_read_rot(bb, bid)
         plan = self._begin_cached_read(bb)
         if plan is None:
             arr = np.asarray(self._store[bb].read().result())
             _chunk_cache.get_chunk_cache().record_direct(arr.nbytes)
-            self._verify_read(bb, arr)
-            return arr
+            return self._postread(bb, arr)
         arr = self._finish_cached_read(plan)
-        try:
-            self._verify_read(bb, arr)
-        except ChunkCorruptionError:
-            # a failed digest verify must not leave the bad chunks resident
-            self._evict_plan(plan)
-            raise
-        return arr
+        # a failed digest verify must not leave the bad chunks resident:
+        # the verifying reader evicts before attempting lineage repair
+        return self._postread(bb, arr, evict=lambda: self._evict_plan(plan))
 
     def __setitem__(self, bb, value) -> None:
         bid = _inject("io_write", voxels=getattr(value, "size", None))
@@ -623,6 +715,7 @@ class Dataset(_ChecksumOps):
         time (so a batch's chunk IO is in flight together) and assemble +
         verify on ``.result()``."""
         bid = _inject("io_read")
+        self._apply_read_rot(bb, bid)
         plan = self._begin_cached_read(bb)
         if plan is None:
             fut = self._store[bb].read()
@@ -631,20 +724,16 @@ class Dataset(_ChecksumOps):
                 _hang("io_read", bid)
                 arr = np.asarray(raw)
                 _chunk_cache.get_chunk_cache().record_direct(arr.nbytes)
-                self._verify_read(bb, arr)
-                return arr
+                return self._postread(bb, arr)
 
             return _WrappedFuture(fut, finish)
 
         def finish_cached(_):
             _hang("io_read", bid)
             arr = self._finish_cached_read(plan)
-            try:
-                self._verify_read(bb, arr)
-            except ChunkCorruptionError:
-                self._evict_plan(plan)
-                raise
-            return arr
+            return self._postread(
+                bb, arr, evict=lambda: self._evict_plan(plan)
+            )
 
         return _WrappedFuture(_ImmediateFuture(None), finish_cached)
 
@@ -1044,6 +1133,8 @@ class MemoryContainer:
 
 
 class _MemDataset(_ChecksumOps):
+    _read_site = "memory"
+
     def __init__(self, arr: np.ndarray, chunks: Tuple[int, ...]):
         self._arr = arr
         self.chunks = chunks
@@ -1064,9 +1155,9 @@ class _MemDataset(_ChecksumOps):
     def __getitem__(self, bb):
         bid = _inject("io_read")
         _hang("io_read", bid)
+        self._apply_read_rot(bb, bid)
         arr = self._arr[bb].copy()
-        self._verify_read(bb, arr)
-        return arr
+        return self._postread(bb, arr)
 
     def __setitem__(self, bb, value):
         bid = _inject("io_write", voxels=getattr(value, "size", None))
@@ -1078,9 +1169,9 @@ class _MemDataset(_ChecksumOps):
     def read_async(self, bb):
         bid = _inject("io_read")
         _hang("io_read", bid)
+        self._apply_read_rot(bb, bid)
         arr = self._arr[bb].copy()
-        self._verify_read(bb, arr)
-        return _ImmediateFuture(arr)
+        return _ImmediateFuture(self._postread(bb, arr))
 
     def write_async(self, bb, value):
         bid = _inject("io_write", voxels=getattr(value, "size", None))
@@ -1122,6 +1213,8 @@ class HandoffDataset(_ChecksumOps):
       to the stored copy and releases the RAM.  After a spill, storage is
       the single source of truth.
     """
+
+    _read_site = "handoff"
 
     def __init__(self, shape, chunks, dtype, store_factory, label: str,
                  fill_value: int = 0):
@@ -1184,9 +1277,9 @@ class HandoffDataset(_ChecksumOps):
             return self._spilled_ds[bb]
         bid = _inject("io_read")
         _hang("io_read", bid)
+        self._apply_read_rot(bb, bid)
         out = arr[bb].copy()
-        self._verify_read(bb, out)
-        return out
+        return self._postread(bb, out)
 
     def __setitem__(self, bb, value):
         arr = self._arr
@@ -1207,9 +1300,9 @@ class HandoffDataset(_ChecksumOps):
             return self._spilled_ds.read_async(bb)
         bid = _inject("io_read")
         _hang("io_read", bid)
+        self._apply_read_rot(bb, bid)
         out = arr[bb].copy()
-        self._verify_read(bb, out)
-        return _ImmediateFuture(out)
+        return _ImmediateFuture(self._postread(bb, out))
 
     def write_async(self, bb, value):
         arr = self._arr
@@ -1269,6 +1362,16 @@ class HandoffDataset(_ChecksumOps):
                 self._spill_started = False
             raise
         freed = int(arr.nbytes)
+        # the spilled copy keeps the handoff's product identity: reads
+        # from it carry the "spill" corruption site, and the producer's
+        # missing-sidecar policy travels with the data
+        try:
+            ds._read_site = "spill"
+            pol = getattr(self, "_product_policy", None)
+            if pol is not None:
+                ds._product_policy = pol
+        except AttributeError:
+            pass
         # publish the delegate before dropping the array: concurrent
         # readers hold either the array ref (still valid bytes) or see the
         # stored copy — never neither

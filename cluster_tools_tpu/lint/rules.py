@@ -1,4 +1,4 @@
-"""The ctlint rule classes CT001-CT010 (docs/ANALYSIS.md).
+"""The ctlint rule classes CT001-CT011 (docs/ANALYSIS.md).
 
 Every rule is derived from a *real* invariant of this codebase — the
 docstring of each checker names the file/contract it guards.  Rules are
@@ -1458,6 +1458,89 @@ def ct010_journal_discipline(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT011 - verified-read discipline
+# =============================================================================
+
+#: the verifying reader lives in the io package (docs/SERVING.md
+#: "Self-healing"); inside it, raw reads are the implementation
+_CT011_IO_PKG = os.path.join("cluster_tools_tpu", "io") + os.sep
+
+#: sidecar directories whose raw traversal outside io/ bypasses the
+#: dataset API (scrub/repair must use checksum_regions / verify_region)
+_CT011_SIDECAR_DIRS = (".ctt_checksums",)
+
+#: file-read entry points checked for sidecar-path constants
+_CT011_OPENERS = frozenset({"open", "fromfile", "memmap", "load"})
+
+
+def _ct011_outside_io(path: str) -> bool:
+    return _CT011_IO_PKG not in os.path.abspath(path)
+
+
+def ct011_verified_read_discipline(module: LintModule) -> List[Finding]:
+    """Every read of a block product goes through the verifying reader
+    (docs/SERVING.md "Self-healing").  The container read paths
+    (``ds[bb]`` / ``read_async``) ARE the verifying reader — digest
+    verification, the missing-sidecar policy, and lineage repair ride
+    them — so outside ``cluster_tools_tpu/io/`` nothing may:
+
+    (a) call ``_read_back`` (the raw, verification-free region read);
+    (b) read through a dataset's raw ``._store`` handle
+        (``ds._store[bb].read()`` returns whatever bytes are on disk,
+        poisoned or not);
+    (c) ``open()`` / ``np.fromfile`` a digest-sidecar path
+        (``.ctt_checksums``) directly — sidecar state must flow through
+        ``checksum_regions`` / ``checksum_entry`` / ``verify_region`` so
+        the index cache and the policy layer stay coherent.
+    """
+    out: List[Finding] = []
+    if module.tree is None or not _ct011_outside_io(module.path):
+        return out
+    for call in calls_in(module.tree):
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr == "_read_back":
+                out.append(Finding(
+                    "CT011", module.path, call.lineno, call.col_offset,
+                    "raw '_read_back' outside io/: the bytes skip digest "
+                    "verification, the missing-sidecar policy, and "
+                    "lineage repair — read through the dataset API "
+                    "(ds[bb] / read_async), which IS the verifying "
+                    "reader",
+                ))
+                continue
+            if call.func.attr in ("read", "write") and any(
+                isinstance(n, ast.Attribute) and n.attr == "_store"
+                for n in ast.walk(call.func.value)
+            ):
+                out.append(Finding(
+                    "CT011", module.path, call.lineno, call.col_offset,
+                    "raw '._store' access outside io/: a store-handle "
+                    f"'{call.func.attr}' bypasses the verifying reader "
+                    "(and the write-side sidecar recording) — use the "
+                    "dataset API",
+                ))
+                continue
+        seg = last_seg(dotted(call.func))
+        if seg in _CT011_OPENERS:
+            hit = None
+            for n in ast.walk(call):
+                s = str_const(n)
+                if s and any(d in s for d in _CT011_SIDECAR_DIRS):
+                    hit = s
+                    break
+            if hit is not None:
+                out.append(Finding(
+                    "CT011", module.path, call.lineno, call.col_offset,
+                    f"raw '{seg}' of a digest-sidecar path ({hit!r}) "
+                    "outside io/: sidecar state must flow through "
+                    "checksum_regions/checksum_entry/verify_region so "
+                    "the index cache and the missing-sidecar policy "
+                    "stay coherent",
+                ))
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -1472,4 +1555,5 @@ RULES = {
     "CT008": ct008_trace_hygiene,
     "CT009": ct009_server_hygiene,
     "CT010": ct010_journal_discipline,
+    "CT011": ct011_verified_read_discipline,
 }

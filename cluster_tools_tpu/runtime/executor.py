@@ -569,16 +569,30 @@ def region_verifier(
     and raise :class:`~cluster_tools_tpu.io.containers.ChunkCorruptionError`
     if its bytes no longer match the recorded checksum.  Returns None for
     datasets without checksum support (HDF5), so call sites wire it
-    unconditionally."""
+    unconditionally.
+
+    Wiring a verifier also declares the dataset a **block-product store**
+    for the self-healing plane (docs/SERVING.md "Self-healing"): its
+    reads fall under the verifying reader's missing-sidecar policy
+    (``io/verified.py``), and the returned callable carries the dataset +
+    geometry (``.dataset`` / ``.bb_of``) so the executor can register
+    per-block lineage (``runtime/repair.py``) after each verified store —
+    call sites wire ONE knob and get detection, policy, scrub, and repair
+    together."""
     verify = getattr(dataset, "verify_region", None)
     if verify is None:
         return None
+    from ..io import verified as verified_mod
+
+    verified_mod.mark_product(dataset)
     if bb_of is None:
         bb_of = lambda block: block.bb  # noqa: E731 - trivial default
 
     def store_verify(block: Block) -> None:
         verify(bb_of(block))
 
+    store_verify.dataset = dataset
+    store_verify.bb_of = bb_of
     return store_verify
 
 
@@ -1224,6 +1238,48 @@ class BlockwiseExecutor:
 
         finished_ids: set = set()
 
+        def _register_lineage(blk):
+            """Self-healing lineage (docs/SERVING.md, runtime/repair.py):
+            after a verified store, record how to recompute THIS block —
+            re-load the producing inputs, re-run the per-block program,
+            re-store through the ordinary sidecar-recording write path —
+            keyed by the product region the verifier just checked.  Best
+            effort: lineage must never fail a completed block."""
+            ds = getattr(store_verify_fn, "dataset", None) \
+                if store_verify_fn is not None else None
+            if ds is None or store_fn is None:
+                return
+            bb_of = getattr(store_verify_fn, "bb_of", None) \
+                or (lambda b: b.bb)
+
+            def recompute(b=blk):
+                with faults_mod.block_context(int(b.block_id)):
+                    # async loaders return futures; resolve them exactly
+                    # like load_block does before the kernel sees them
+                    val = tuple(
+                        x.result() if hasattr(x, "result") else x
+                        for x in load_fn(b)
+                    )
+                    out = _exec_single(val)
+                    err = validate(b, out)
+                    if err is not None:
+                        raise RuntimeError(
+                            f"lineage recompute of block {b.block_id} "
+                            f"failed validation: {err}"
+                        )
+                    store_fn(b, out)
+
+            try:
+                from . import repair as repair_mod
+
+                repair_mod.register_producer(
+                    ds, bb_of(blk), recompute, task=task_name,
+                    block_id=int(blk.block_id),
+                    failures_path=failures_path,
+                )
+            except Exception:
+                pass
+
         def finish_block(blk):
             """Completion side effects (success marker + block_done kill
             point) at most ONCE per block — with speculation, two copies of
@@ -1233,6 +1289,7 @@ class BlockwiseExecutor:
                 if int(blk.block_id) in finished_ids:
                     return
                 finished_ids.add(int(blk.block_id))
+            _register_lineage(blk)
             if on_block_done is not None:
                 on_block_done(blk)
             injector.kill_point("block_done")

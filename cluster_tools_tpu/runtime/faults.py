@@ -22,10 +22,15 @@ task runtime, and container IO layer call at their failure-relevant sites:
   io_read / io_write site (``kind='hang'``), modelling a stuck kernel or a
   wedged filesystem call.  The executor's per-block deadline watchdog is
   what must notice,
-- :meth:`FaultInjector.chunk_corrupt` — report that a just-written chunk
-  should be silently bit-flipped on storage (``kind='corrupt'``, site
-  ``io_write``).  The container layer applies the flip *after* recording
-  the region's checksum sidecar, so only checksum verification can tell,
+- :meth:`FaultInjector.chunk_corrupt` — report that a stored region should
+  be silently damaged (``kind='corrupt'``).  At site ``io_write`` the
+  container layer bit-flips the chunk *after* recording the region's
+  checksum sidecar; at site ``io_read`` it models at-rest bit rot noticed
+  at read time — the stored bytes are flipped just before the read
+  returns, sidecar untouched, so the verifying reader (``io/verified.py``)
+  must detect it and the lineage repair path must heal it.  ``"mode":
+  "sidecar"`` deletes the region's digest sidecar instead of flipping
+  bytes, exercising the missing-sidecar policy (warn+adopt vs strict),
 - :meth:`FaultInjector.lose_job` — swallow a scheduler submission
   (``kind='job_loss'``, site ``submit``): the submitter gets a job id, the
   scheduler keeps reporting it as running, but nothing ever executes —
@@ -95,6 +100,14 @@ Config schema::
         # silent corruption: block 2's first chunk write is bit-flipped on
         # disk after the checksum sidecar is recorded
         {"site": "io_write", "kind": "corrupt", "blocks": [2]},
+        # at-rest rot, noticed at read: block 2's stored bytes are flipped
+        # right before its first read returns (sidecar intact) — the
+        # verifying reader must raise corrupt:<site>, lineage repair heals
+        {"site": "io_read", "kind": "corrupt", "blocks": [2]},
+        # sidecar loss: block 2's digest sidecar is deleted at its first
+        # read — the per-store missing-sidecar policy decides (adopt/strict)
+        {"site": "io_read", "kind": "corrupt", "blocks": [2],
+         "mode": "sidecar"},
         # lost scheduler job: the first submission is swallowed
         {"site": "submit", "kind": "job_loss", "fail_attempts": 1},
         # preemption: exit hard at the 3rd completed block
@@ -188,6 +201,13 @@ _TORN_SITES = ("journal",)
 #: main batches AND the degrade ladder's sub-block batches — dispatch
 #: through the same site, so the same faults prove their fallback.
 _HANG_SITES = ("load", "store", "io_read", "io_write", "dispatch")
+#: silent-corruption sites (kind='corrupt'): at ``io_write`` the flip lands
+#: after the write's sidecar is recorded; at ``io_read`` the stored bytes
+#: rot just before the read returns (at-rest damage surfacing at the read
+#: site, the verifying reader's to catch).  ``mode='sidecar'`` deletes the
+#: region's digest sidecar instead — the missing-sidecar-policy drill.
+_CORRUPT_SITES = ("io_write", "io_read")
+_CORRUPT_MODES = ("flip", "sidecar")
 _OOM_SITES = ("load", "store", "io_read", "io_write", "compute", "dispatch")
 _ENOSPC_SITES = ("store", "io_write")
 #: "publish" is the handoff-layer site (runtime/handoff.py): the moment a
@@ -385,10 +405,16 @@ class FaultInjector:
                         f"got {site!r}"
                     )
             elif kind == "corrupt":
-                if site != "io_write":
+                if site not in _CORRUPT_SITES:
                     raise ValueError(
-                        "corrupt faults only apply to site='io_write' (a "
-                        "chunk is bit-flipped after it lands on storage)"
+                        f"corrupt fault site must be one of {_CORRUPT_SITES} "
+                        f"(write-time flip vs at-rest rot at read), got "
+                        f"{site!r}"
+                    )
+                if spec.get("mode", "flip") not in _CORRUPT_MODES:
+                    raise ValueError(
+                        f"corrupt fault mode must be one of {_CORRUPT_MODES},"
+                        f" got {spec.get('mode')!r}"
                     )
             elif kind == "job_loss":
                 if site != "submit":
@@ -493,15 +519,22 @@ class FaultInjector:
             if attempt is not None:
                 time.sleep(float(spec.get("seconds", 1.0)))
 
-    def chunk_corrupt(self, site: str, block_id: Optional[int] = None) -> bool:
-        """True if a just-written chunk at this site should be silently
-        bit-flipped on storage (the container layer applies the flip)."""
+    def chunk_corrupt(
+        self, site: str, block_id: Optional[int] = None
+    ) -> Optional[str]:
+        """Corruption mode for a stored region at this site, or None.
+        ``"flip"`` (truthy, the default — existing boolean callers keep
+        working): silently bit-flip the stored bytes, sidecar untouched.
+        ``"sidecar"``: delete the region's digest sidecar instead, so the
+        missing-sidecar policy (``io/verified.py``) is what gets tested.
+        At ``io_write`` the damage lands after the write; at ``io_read``
+        it models at-rest rot surfacing at the read site."""
         if not self.enabled:
-            return False
+            return None
         for idx, spec in enumerate(self.specs):
             if self._active(idx, spec, site, block_id, "corrupt") is not None:
-                return True
-        return False
+                return str(spec.get("mode", "flip"))
+        return None
 
     def force_spill(self) -> bool:
         """True if an in-memory handoff target being declared right now

@@ -87,8 +87,60 @@ DRAINED = "drained"
 TERMINAL_TYPES = (COMPLETED, FAILED, REJECTED, QUARANTINED)
 
 
+#: rotation threshold (bytes) applied on clean boot; 0 disables.  One
+#: ``.old`` segment is kept — rotation stops unbounded growth (the PR-13
+#: residual); in-segment redundancy compaction stays future work.
+DEFAULT_ROTATE_BYTES = 8 << 20
+
+
+def rotate_bytes_default() -> int:
+    """Boot-time rotation threshold (``CTT_JOURNAL_ROTATE_BYTES``)."""
+    try:
+        return int(os.environ.get("CTT_JOURNAL_ROTATE_BYTES", "") or
+                   DEFAULT_ROTATE_BYTES)
+    except ValueError:
+        return DEFAULT_ROTATE_BYTES
+
+
 def journal_path(base_dir: str) -> str:
     return os.path.join(base_dir, JOURNAL_FILENAME)
+
+
+def _frame(record: Dict[str, Any]) -> bytes:
+    payload = json.dumps(
+        record, separators=(",", ":"), sort_keys=True, default=str
+    ).encode()
+    return _HEADER.pack(MAGIC, len(payload), zlib.crc32(payload)) + payload
+
+
+def snapshot_records(ent: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The minimal record sequence that folds back to one request's
+    ``fold()`` entry — what rotation writes into the fresh segment so a
+    later replay reconstructs the same promises from a bounded file."""
+    state = ent.get("state")
+    tenant = ent.get("tenant") or "default"
+    rid = ent["request_id"]
+    out: List[Dict[str, Any]] = []
+    if state == REJECTED:
+        if ent.get("payload") is not None:
+            out.append({"type": ACCEPTED, "request_id": rid,
+                        "tenant": tenant, "payload": ent.get("payload"),
+                        "fingerprint": ent.get("fingerprint")})
+        out.append({"type": REJECTED, "request_id": rid, "tenant": tenant,
+                    "code": ent.get("code")})
+        return out
+    out.append({"type": ACCEPTED, "request_id": rid, "tenant": tenant,
+                "payload": ent.get("payload"),
+                "fingerprint": ent.get("fingerprint")})
+    if ent.get("attempts"):
+        out.append({"type": DISPATCHED, "request_id": rid, "tenant": tenant,
+                    "attempt": int(ent["attempts"])})
+    if state == DRAINED:
+        out.append({"type": DRAINED, "request_id": rid, "tenant": tenant})
+    elif state in (COMPLETED, FAILED, QUARANTINED):
+        out.append({"type": state, "request_id": rid, "tenant": tenant,
+                    "record": ent.get("record")})
+    return out
 
 
 def scan(path: str) -> Tuple[List[Dict[str, Any]], int, int]:
@@ -212,6 +264,8 @@ class Journal:
         self.appended = 0
         self.bytes = 0
         self.torn_bytes_truncated = 0
+        self.rotations = 0
+        self.rotated_from_bytes = 0
         self._last_fsync_mono: Optional[float] = None
 
     # -- recovery ----------------------------------------------------------
@@ -237,6 +291,79 @@ class Journal:
             self.bytes = good
         return records
 
+    def maybe_rotate(self, folded, max_bytes: Optional[int] = None,
+                     keep_terminal: int = 512) -> bool:
+        """Size guard, run on clean boot after replay: past ``max_bytes``
+        (default :func:`rotate_bytes_default`; <=0 disables), snapshot the
+        folded live state into a fresh segment and move the old file to
+        ``<path>.old``.  The snapshot (one compact record sequence per
+        request, :func:`snapshot_records`) folds back to the same per-
+        request promises, so a crash right after rotation replays
+        identically — no acknowledged request is ever only in the
+        archived segment.  Redundancy collapses (repeat dispatches,
+        drain/replay churn, superseded incarnations become one sequence),
+        and terminal entries beyond ``keep_terminal`` — the server's
+        answerable-record cap; older ids are pruned from its memory and
+        cannot be answered idempotently anyway — are dropped, oldest
+        first.  One ``.old`` is kept (a later rotation replaces it):
+        unbounded growth stops here; richer in-segment compaction stays
+        future work (docs/SERVING.md "Durability")."""
+        limit = rotate_bytes_default() if max_bytes is None else int(max_bytes)
+        if limit <= 0:
+            return False
+        with self._lock:
+            if self._fh is None:  # pragma: no cover - misuse guard
+                raise RuntimeError("journal.maybe_rotate before recover()")
+            old_bytes = self.bytes
+        if old_bytes <= limit:
+            return False
+        ents = list((folded or {}).values())
+        terminal = [e for e in ents if e.get("state") in TERMINAL_TYPES]
+        if keep_terminal is not None and len(terminal) > int(keep_terminal):
+            drop = {id(e) for e in terminal[:-int(keep_terminal)]}
+            ents = [e for e in ents if id(e) not in drop]
+        tmp = f"{self.path}.rotate.{os.getpid()}"
+        n = 0
+        with open(tmp, "wb") as f:
+            for ent in ents:
+                for rec in snapshot_records(ent):
+                    f.write(_frame(rec))
+                    n += 1
+            f.flush()
+            os.fsync(f.fileno())
+        with self._lock:
+            self._fh.close()
+            # crash-window discipline: journal.log must EXIST with either
+            # the old or the new content at every instant.  Archive via a
+            # hard link (the old inode gains the .old name while keeping
+            # the journal name), then ONE atomic replace installs the
+            # snapshot — there is no window with the journal missing, so
+            # a kill mid-rotation replays identically from the old file.
+            old = self.path + ".old"
+            try:
+                os.remove(old)
+            except FileNotFoundError:
+                pass
+            os.link(self.path, old)
+            os.replace(tmp, self.path)
+            try:
+                dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass  # dir-entry durability is best-effort
+            self._fh = open(self.path, "ab")
+            self.bytes = os.path.getsize(self.path)
+            self.rotations += 1
+            self.rotated_from_bytes = old_bytes
+        fu.log(
+            f"journal {self.path}: rotated {old_bytes} byte(s) to .old on "
+            f"boot (> {limit}); fresh segment holds {n} snapshot record(s)"
+        )
+        return True
+
     def close(self) -> None:
         with self._lock:
             if self._fh is not None:
@@ -249,11 +376,7 @@ class Journal:
         once the record is durable — callers acknowledge state over HTTP
         strictly after this returns, so an acknowledgement always has a
         journal record behind it (SIGKILL included)."""
-        payload = json.dumps(
-            record, separators=(",", ":"), sort_keys=True, default=str
-        ).encode()
-        frame = _HEADER.pack(MAGIC, len(payload), zlib.crc32(payload)) \
-            + payload
+        frame = _frame(record)
         inj = faults_mod.get_injector()
         with self._lock:
             if self._fh is None:  # pragma: no cover - misuse guard
@@ -303,4 +426,6 @@ class Journal:
                     if last is not None else None
                 ),
                 "torn_bytes_truncated": int(self.torn_bytes_truncated),
+                "rotations": int(self.rotations),
+                "rotated_from_bytes": int(self.rotated_from_bytes),
             }

@@ -82,6 +82,7 @@ from . import executor as executor_mod
 from . import faults as faults_mod
 from . import handoff as handoff_mod
 from . import journal as journal_mod
+from . import scrub as scrub_mod
 from . import trace as trace_mod
 from .supervision import (
     DrainInterrupt,
@@ -152,6 +153,8 @@ class PipelineServer:
         journal: bool = True,
         max_replay_attempts: int = 3,
         program_cache_size: Optional[int] = None,
+        scrub: Optional[Dict[str, Any]] = None,
+        journal_rotate_bytes: Optional[int] = None,
     ):
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
@@ -160,6 +163,27 @@ class PipelineServer:
         self.default_max_jobs = int(default_max_jobs)
         self.max_workers = max(1, int(max_workers))
         self.max_replay_attempts = max(1, int(max_replay_attempts))
+        #: boot-time journal size guard (docs/SERVING.md "Durability"):
+        #: past this many bytes a clean boot snapshots live state into a
+        #: fresh segment and archives the old one as journal.log.old.
+        #: Coerced here so a malformed config value fails loudly at
+        #: construction, not inside the boot's best-effort rotation.
+        self.journal_rotate_bytes = (
+            None if journal_rotate_bytes is None
+            else int(journal_rotate_bytes)
+        )
+        # the resident scrubber (docs/SERVING.md "Self-healing"): walks
+        # digest sidecars of the products this server owns, verifies a
+        # budgeted number of bytes per interval, repairs from lineage.
+        # Config: {"enabled", "interval_s", "bytes_per_interval",
+        # "roots"}; default on with the module's modest budget.
+        scrub_cfg = dict(scrub or {})
+        scrub_roots = [self.base_dir] + list(scrub_cfg.pop("roots", []) or [])
+        self.scrubber: Optional[scrub_mod.Scrubber] = scrub_mod.Scrubber(
+            base_dir=self.base_dir,
+            roots=scrub_roots,
+            **scrub_cfg,
+        )
         # the durable submission journal (docs/SERVING.md "Durability");
         # off only for embedders that explicitly opt out of the ack
         # contract (tests of the pre-journal paths)
@@ -248,6 +272,8 @@ class PipelineServer:
             self._heartbeat = HeartbeatWriter(
                 self.base_dir, SERVER_UID, interval_s=2.0
             ).start()
+            if self.scrubber is not None:
+                self.scrubber.start()
             for i in range(self.max_workers):
                 t = threading.Thread(
                     target=self._worker_loop, name=f"serve-worker-{i}",
@@ -308,6 +334,8 @@ class PipelineServer:
         self._teardown()
 
     def _teardown(self) -> None:
+        if self.scrubber is not None:
+            self.scrubber.stop()
         if self._heartbeat is not None:
             self._heartbeat.stop()
         if self._httpd is not None:
@@ -416,6 +444,17 @@ class PipelineServer:
         for tenant, c in counts.items():
             if any(c.values()):
                 self.controller.restore_counts(tenant, **c)
+        # boot-time size guard (docs/SERVING.md "Durability"): a clean
+        # boot past the threshold snapshots the folded live state into a
+        # fresh segment and archives the old one — unbounded journal
+        # growth stops here (full compaction stays future work)
+        try:
+            # terminal snapshots beyond the in-memory record cap cannot
+            # be answered idempotently anyway — prune them with rotation
+            self._journal.maybe_rotate(folded, self.journal_rotate_bytes,
+                                       keep_terminal=_MAX_RECORDS)
+        except Exception:
+            pass  # rotation is an optimization; the boot must not fail
         self._write_state()
 
     def _reenqueue_replayed(self, ent: Dict[str, Any]) -> None:
@@ -939,6 +978,13 @@ class PipelineServer:
                 self.program_cache.stats()
                 if self.program_cache is not None else None
             ),
+            # the self-healing plane's pulse (docs/SERVING.md
+            # "Self-healing"): scrub position/coverage/findings plus the
+            # verifying-reader and lineage-repair counters
+            "scrub": (
+                self.scrubber.stats()
+                if self.scrubber is not None else None
+            ),
         }
 
     def _write_state(self) -> None:
@@ -1073,6 +1119,13 @@ class _RequestHandler(BaseHTTPRequestHandler):
                 "programs": (
                     self.pipeline.program_cache.stats()
                     if self.pipeline.program_cache is not None else None
+                ),
+                # the self-healing plane (docs/SERVING.md "Self-healing"):
+                # scrub coverage + corruption found/repaired at rest and
+                # at read — rot surfacing here is an SLO breach in waiting
+                "scrub": (
+                    self.pipeline.scrubber.stats()
+                    if self.pipeline.scrubber is not None else None
                 ),
             })
         elif path == "/status":

@@ -695,6 +695,37 @@ class BaseTask:
                     else:
                         completed.add(block_id)
                         self.log_block_success(block_id)
+                        if store_verify_fn is not None and blocking is not None:
+                            # self-healing lineage (runtime/repair.py): a
+                            # verified host-path store registers its
+                            # recompute — re-run process() and re-verify —
+                            # so read-time/scrub corruption of this block
+                            # heals without an operator.  Best effort.
+                            try:
+                                from . import repair as repair_mod
+
+                                ds = getattr(
+                                    store_verify_fn, "dataset", None
+                                )
+                                blk = blocking.get_block(block_id)
+                                bb_of = getattr(
+                                    store_verify_fn, "bb_of", None
+                                ) or (lambda b: b.bb)
+                                if ds is not None:
+                                    def recompute(b=block_id):
+                                        process(b)
+                                        store_verify_fn(
+                                            blocking.get_block(b)
+                                        )
+
+                                    repair_mod.register_producer(
+                                        ds, bb_of(blk), recompute,
+                                        task=self.uid,
+                                        block_id=int(block_id),
+                                        failures_path=self.failures_path,
+                                    )
+                            except Exception:
+                                pass
                         return
                     finally:
                         if watchdog is not None:

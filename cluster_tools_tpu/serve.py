@@ -107,6 +107,11 @@ def main(argv=None) -> int:
         default_max_jobs=int(cfg.get("default_max_jobs", 2)),
         port=args.port,
         max_replay_attempts=int(cfg.get("max_replay_attempts", 3)),
+        # self-healing plane (docs/SERVING.md "Self-healing"): scrubber
+        # knobs ({"enabled", "interval_s", "bytes_per_interval", "roots"})
+        # and the boot-time journal rotation threshold
+        scrub=cfg.get("scrub"),
+        journal_rotate_bytes=cfg.get("journal_rotate_bytes"),
     )
     install_drain_handler()
     server.start()

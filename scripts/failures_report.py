@@ -163,6 +163,61 @@ def format_journal_stats(j) -> list:
     return lines
 
 
+def load_scrub_stats(failures_json_path: str):
+    """The self-healing plane's state (``scrub_state.json`` next to
+    ``failures.json`` — docs/SERVING.md "Self-healing"): scrub coverage
+    and findings plus the verifying-reader and lineage-repair counters.
+    None for runs without a scrubber."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(failures_json_path)),
+        "scrub_state.json",
+    )
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def format_scrub_stats(s) -> list:
+    """Render the scrub block: bytes/regions verified at rest, corruption
+    found and its fate, and the read-side counters the scrub
+    cross-checks."""
+    reader = s.get("reader") or {}
+    rep = s.get("repair") or {}
+    lines = [
+        f"scrubber (scrub_state.json): {s.get('scanned_regions', 0)} "
+        f"region(s) / {_human_bytes(float(s.get('scanned_bytes', 0)))} "
+        f"verified at rest, {s.get('passes', 0)} full pass(es)"
+        + (f", coverage {s['coverage']:.0%} of current pass"
+           if s.get("coverage") is not None else "")
+    ]
+    if s.get("found_corrupt"):
+        lines.append(
+            f"  at-rest corruption: {s['found_corrupt']} found, "
+            f"{s.get('repaired', 0)} repaired from lineage, "
+            f"{s.get('unrepairable', 0)} unrepairable"
+        )
+    if reader.get("corrupt_detected") or reader.get("sidecars_adopted") \
+            or reader.get("strict_missing"):
+        lines.append(
+            f"  verifying reader: {reader.get('corrupt_detected', 0)} "
+            f"corrupt read(s) detected, "
+            f"{reader.get('repaired_reads', 0)} healed in-line, "
+            f"{reader.get('unrepairable_reads', 0)} raised typed; "
+            f"{reader.get('sidecars_adopted', 0)} sidecar(s) adopted, "
+            f"{reader.get('strict_missing', 0)} strict refusal(s)"
+        )
+    if rep.get("unrepairable"):
+        lines.append(
+            f"  {rep['unrepairable']} region(s) quarantined as "
+            "unrepairable (quarantined:unrepairable — operator action "
+            "needed: the lineage could not heal them)"
+        )
+    return lines
+
+
 def _human_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -405,7 +460,8 @@ def summarize(records):
 
 
 def format_report(path, version, summaries, io_tasks=None, provenance=None,
-                  trace_summary=None, journal_stats=None) -> str:
+                  trace_summary=None, journal_stats=None,
+                  scrub_stats=None) -> str:
     lines = [f"failures report: {path} (schema v{version})", ""]
     if not summaries:
         lines.append("no failure records — clean run")
@@ -415,6 +471,8 @@ def format_report(path, version, summaries, io_tasks=None, provenance=None,
             lines.extend(["", *format_trace_summary(trace_summary)])
         if journal_stats:
             lines.extend(["", *format_journal_stats(journal_stats)])
+        if scrub_stats:
+            lines.extend(["", *format_scrub_stats(scrub_stats)])
         return "\n".join(lines)
     n_unresolved = sum(len(s["unresolved"]) for s in summaries)
     all_hosts = sorted({h for s in summaries for h in s["hosts"]})
@@ -448,6 +506,8 @@ def format_report(path, version, summaries, io_tasks=None, provenance=None,
         lines.extend(["", *format_trace_summary(trace_summary)])
     if journal_stats:
         lines.extend(["", *format_journal_stats(journal_stats)])
+    if scrub_stats:
+        lines.extend(["", *format_scrub_stats(scrub_stats)])
     return "\n".join(lines)
 
 
@@ -536,6 +596,10 @@ def build_json_report(tmp_folder: str, with_lint: bool = True):
         # "Durability"): records, replays, quarantines, torn-tail
         # truncations — null for runs without a journal
         "journal": load_journal_stats(fpath),
+        # the self-healing plane (docs/SERVING.md "Self-healing"): scrub
+        # coverage/findings + verifying-reader + lineage-repair counters
+        # — null for runs without a scrubber
+        "scrub": load_scrub_stats(fpath),
         "lint": run_repo_lint() if with_lint else None,
     }
     return doc
@@ -619,6 +683,7 @@ def main(argv) -> int:
         format_report(
             path, version, summarize(records), io_tasks, provenance,
             load_trace_summary(path), load_journal_stats(path),
+            load_scrub_stats(path),
         )
     )
     return 0

@@ -165,6 +165,15 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
             # the server-scoped compiled-program cache (docs/SERVING.md):
             # warm repeat requests show up as hits
             "programs": server_state.get("programs"),
+            # the self-healing plane (docs/SERVING.md "Self-healing"):
+            # scrub coverage + corruption found/repaired.  scrub_state.json
+            # first — the scrubber refreshes it every slice, while the
+            # server_state copy only refreshes on request events and goes
+            # stale between them
+            "scrub": (
+                _read_json(os.path.join(tmp_folder, "scrub_state.json"))
+                or server_state.get("scrub")
+            ),
             "journal_backlog_stalled": bool(
                 journal
                 and journal.get("replay_backlog")
@@ -339,6 +348,28 @@ def _format_server(server) -> list:
             line += (
                 f"; torn tail truncated ({j['torn_bytes_truncated']}B)"
             )
+        if j.get("rotations"):
+            line += (
+                f"; rotated to .old ({j.get('rotated_from_bytes', 0)}B)"
+            )
+        lines.append(line)
+    sc = server.get("scrub")
+    if sc:
+        cov = (
+            f", {sc['coverage']:.0%} of pass"
+            if sc.get("coverage") is not None else ""
+        )
+        line = (
+            f"    scrub: {sc.get('scanned_regions', 0)} region(s) / "
+            f"{sc.get('scanned_bytes', 0) / 1e6:.1f}MB verified at rest, "
+            f"{sc.get('passes', 0)} pass(es){cov}"
+        )
+        if sc.get("found_corrupt"):
+            line += (
+                f"; CORRUPTION: {sc['found_corrupt']} found, "
+                f"{sc.get('repaired', 0)} repaired, "
+                f"{sc.get('unrepairable', 0)} unrepairable"
+            )
         lines.append(line)
     return lines
 
@@ -363,6 +394,12 @@ def format_progress(doc) -> str:
                 "  WARNING: journal replay backlog is not draining — "
                 "acknowledged requests are re-enqueued but nothing is "
                 "completing them; check the server's workers"
+            )
+        if (doc["server"].get("scrub") or {}).get("unrepairable"):
+            lines.append(
+                "  WARNING: scrubber found corruption lineage could not "
+                "repair (quarantined:unrepairable) — the stored product "
+                "is damaged; see failures.json / make failures-report"
             )
     if not tasks:
         lines.append("  no tasks seen yet (no markers, manifests, "

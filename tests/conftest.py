@@ -52,3 +52,73 @@ def inject():
 
     yield faults.configure
     faults.reset()
+
+
+def _child_serve_pids():
+    """Pids of live ``cluster_tools_tpu.serve`` processes whose parent is
+    THIS test process — the leak signature: a serve-spawning test that
+    raised before its ``finally`` reap."""
+    me = os.getpid()
+    out = []
+    try:
+        proc_entries = os.listdir("/proc")
+    except OSError:
+        return out  # no /proc (non-Linux host): nothing to reap
+    for pid in proc_entries:
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace").replace("\x00", " ")
+            with open(f"/proc/{pid}/stat") as f:
+                stat = f.read()
+        except OSError:
+            continue
+        if "cluster_tools_tpu.serve" not in cmd:
+            continue
+        # ppid is field 4, after the parenthesized (and possibly
+        # space-containing) comm field
+        try:
+            ppid = int(stat.rsplit(")", 1)[1].split()[1])
+        except (IndexError, ValueError):
+            continue
+        if ppid == me:
+            out.append(int(pid))
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _reap_leaked_servers():
+    """Backstop for leaked resident servers: any ``serve`` subprocess this
+    test spawned and did not reap is SIGKILLed after the test.  A stray
+    server burns CPU for the rest of the suite — past tier-1 timeouts with
+    ZERO failures traced to exactly this — so the guard is unconditional
+    and loud."""
+    import signal
+    import sys
+    import time
+
+    yield
+    leaked = _child_serve_pids()
+    for pid in leaked:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            continue
+    for pid in leaked:
+        # reap the zombie so later /proc scans (and the chaos suite's
+        # stray-server asserts) don't count a corpse as a live server
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                done, _ = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                break
+            if done:
+                break
+            time.sleep(0.05)
+    if leaked:
+        print(
+            f"\n[conftest] reaped {len(leaked)} leaked serve process(es): "
+            f"{leaked}", file=sys.stderr,
+        )

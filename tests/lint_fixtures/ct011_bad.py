@@ -1,0 +1,23 @@
+"""CT011 fixture: raw block-product reads that bypass the verifying
+reader (io/verified.py) — every form must fire."""
+
+import os
+
+import numpy as np
+
+
+def raw_read_back(ds, bb):
+    # raw region read: skips digest verification + lineage repair
+    return ds._read_back(bb)
+
+
+def raw_store_read(ds, bb):
+    # reading through the raw tensorstore handle returns poisoned bytes
+    return np.asarray(ds._store[bb].read().result())
+
+
+def raw_sidecar_open(dataset_dir, region_key):
+    # sidecar state must flow through checksum_regions/checksum_entry
+    path = os.path.join(dataset_dir, ".ctt_checksums", region_key + ".json")
+    with open(path) as f:
+        return f.read()

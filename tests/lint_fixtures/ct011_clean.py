@@ -1,0 +1,22 @@
+"""CT011 clean twin: product reads through the dataset API — the
+container read paths ARE the verifying reader — and sidecar state via
+the public checksum accessors."""
+
+import numpy as np
+
+
+def verified_reads(ds, bb):
+    arr = ds[bb]
+    fut = ds.read_async(bb)
+    return arr, np.asarray(fut.result())
+
+
+def integrity_surface(ds, bb):
+    ds.verify_region(bb)
+    return ds.checksum_regions(), ds.checksum_entry(bb)
+
+
+def ordinary_open(path):
+    # opening non-sidecar files is not this rule's business
+    with open(path) as f:
+        return f.read()

@@ -1017,3 +1017,147 @@ def test_chaos_serve_sigkill_journal_replay_and_quarantine(tmp_path):
     finally:
         reap_process(proc4)
     assert stray_serve_pids() == []
+
+
+def test_chaos_serve_self_healing_corruption(tmp_path):
+    """ISSUE 15 acceptance: the self-healing data plane under live
+    two-tenant traffic.
+
+    - **read path**: a published block product (the block-components
+      labels the Write task consumes) is silently rotted by the injected
+      ``corrupt`` fault at site ``io_read`` (bytes flipped under an
+      intact sidecar).  The verifying reader detects it mid-request, the
+      lineage repair engine recomputes the block from its producing
+      task's inputs, and the request completes BIT-IDENTICAL to the
+      fault-free reference with ZERO client resubmission;
+    - **at rest**: after the traffic, a block of the final published
+      segmentation is rotted on storage while nobody reads it.  The
+      resident scrubber independently finds it within its budgeted scan,
+      repairs it from lineage, and the product returns to bit-identical
+      bytes — visible in /healthz, /status, and scrub_state.json.
+    """
+    import time
+
+    root = str(tmp_path)
+    rng = np.random.default_rng(SEED)
+    vol = (rng.random((16, 16, 16)) > 0.5).astype("float32")
+    data = os.path.join(root, "data.zarr")
+    ds = file_reader(data).create_dataset(
+        "mask", shape=vol.shape, chunks=(8, 8, 8), dtype="float32")
+    ds[...] = vol
+
+    # -- reference: fault-free cold batch run (memory_handoffs on,
+    # matching the server's resident-owner default) -----------------------
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.connected_components import (
+        ConnectedComponentsWorkflow,
+    )
+
+    ref_dir = os.path.join(root, "ref")
+    os.makedirs(os.path.join(ref_dir, "config"), exist_ok=True)
+    with open(os.path.join(ref_dir, "config", "global.config"), "w") as f:
+        json.dump({"block_shape": [8, 8, 8], "memory_handoffs": True}, f)
+    assert build([ConnectedComponentsWorkflow(
+        tmp_folder=os.path.join(ref_dir, "tmp"),
+        config_dir=os.path.join(ref_dir, "config"),
+        max_jobs=2, target="local",
+        input_path=data, input_key="mask",
+        output_path=data, output_key="ref_seg", threshold=0.5,
+    )])
+    ref_seg = np.asarray(file_reader(data, "r")["ref_seg"][...])
+
+    # -- the server: read-rot armed at the write task's product reads ------
+    srv = os.path.join(root, "srv")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["CTT_FAULTS"] = json.dumps({
+        "seed": SEED,
+        # one-shot silent rot of the block-components product, surfacing
+        # at the write task's first block read — sidecar intact, so ONLY
+        # the verifying reader can tell.  No "blocks" gate (host-path
+        # reads carry no block context); the task gate is process-global,
+        # so the server runs max_workers=1 to pin the firing to the write
+        # task's own reads
+        "faults": [{"site": "io_read", "kind": "corrupt",
+                    "tasks": ["write"]}],
+    })
+    config = {
+        "scrub": {"interval_s": 0.2, "bytes_per_interval": 1 << 30,
+                  "roots": [root]},
+    }
+
+    def payload(tenant, rid, out_key):
+        return dict(
+            tenant=tenant, request_id=rid,
+            workflow="connected_components",
+            config=dict(
+                tmp_folder=os.path.join(root, "req_" + rid),
+                global_config={"block_shape": [8, 8, 8]},
+                params=dict(input_path=data, input_key="mask",
+                            output_path=data, output_key=out_key,
+                            threshold=0.5),
+            ),
+        )
+
+    requests = [("alice", f"a{i}", f"seg_a{i}") for i in range(2)] \
+        + [("bob", f"b{i}", f"seg_b{i}") for i in range(2)]
+
+    proc, client = _start_serve(srv, env, max_workers=1, config=config)
+    try:
+        for tenant, rid, key in requests:
+            client.submit(**payload(tenant, rid, key))
+        for tenant, rid, key in requests:
+            rec = client.wait(rid, timeout_s=240)
+            # zero client resubmission: the one submit above completed
+            assert rec["state"] == "done", (rid, rec)
+        for _t, _r, key in requests:
+            np.testing.assert_array_equal(
+                np.asarray(file_reader(data, "r")[key][...]), ref_seg,
+                err_msg=key,
+            )
+        # the read-path heal is attributed: the injected rot fired in ONE
+        # request's write task and was repaired from block_components
+        # lineage (repaired:lineage, resolved)
+        healed = []
+        for _t, rid, _k in requests:
+            doc = json.load(open(os.path.join(root, "req_" + rid,
+                                              "failures.json"))) \
+                if os.path.exists(os.path.join(root, "req_" + rid,
+                                               "failures.json")) else {}
+            healed += [r for r in doc.get("records", [])
+                       if r.get("resolution") == "repaired:lineage"]
+        assert healed, "injected read-rot was never repaired from lineage"
+        assert all(r["resolved"] for r in healed)
+        scrub_doc = client.healthz()["scrub"]
+        assert scrub_doc["repair"]["repaired"] >= 1
+        assert scrub_doc["reader"]["corrupt_detected"] >= 1
+        assert scrub_doc["reader"]["repaired_reads"] >= 1
+
+        # -- at-rest rot, healed by the scrubber alone ---------------------
+        seg = file_reader(data)["seg_a0"]
+        bb = tuple(slice(0, 8) for _ in range(3))
+        bad = seg._read_back(bb).copy()
+        bad[0, 0, 0] += 1
+        seg._write_raw(bb, bad)
+        found0 = scrub_doc.get("found_corrupt", 0)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            sc = client.healthz().get("scrub") or {}
+            if sc.get("found_corrupt", 0) > found0 \
+                    and sc.get("unrepairable", 0) == 0 \
+                    and sc.get("repaired", 0) >= 1:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"scrubber never healed at-rest rot: {client.healthz()}")
+        np.testing.assert_array_equal(
+            np.asarray(file_reader(data, "r")["seg_a0"][...]), ref_seg)
+        with open(os.path.join(srv, "scrub_state.json")) as f:
+            state = json.load(f)
+        assert state["found_corrupt"] >= 1 and state["repaired"] >= 1
+        assert client.status()["rc"] == 0  # every heal is a resolution
+    finally:
+        reap_process(proc)
+    assert stray_serve_pids() == []

@@ -1,0 +1,496 @@
+"""The self-healing data plane (docs/SERVING.md "Self-healing"): the
+verifying reader's typed ``corrupt:<site>`` errors and missing-sidecar
+policy, lineage-driven repair through the executor and host scaffold, the
+resident scrubber, journal evidence in the failure report, and the
+``make scrub-smoke`` tier-1 twin of the corruption chaos e2e.
+
+The byte-offset property test mirrors the journal torn-tail test's style:
+corruption is proven detectable at EVERY byte of a stored block, not at a
+hand-picked offset.  CPU-only, tier-1 fast."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.io import verified
+from cluster_tools_tpu.io.containers import ChunkCorruptionError, open_container
+from cluster_tools_tpu.io.verified import (
+    MissingSidecarError,
+    ProductCorruptionError,
+)
+from cluster_tools_tpu.runtime import faults, handoff, repair, scrub
+from cluster_tools_tpu.runtime.executor import (
+    BlockwiseExecutor,
+    region_verifier,
+)
+from cluster_tools_tpu.utils import function_utils as fu
+from cluster_tools_tpu.utils.volume_utils import Blocking, file_reader
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_selfheal_state(monkeypatch):
+    """Every test starts with empty lineage/scrub registries, zeroed
+    reader counters, no injector, and the chunk cache OFF — these tests
+    rot bytes on storage and must observe them on the next read."""
+    monkeypatch.setenv("CTT_CHUNK_CACHE", "0")
+    repair.reset()
+    scrub.reset_targets()
+    verified.reset_stats()
+    faults.configure(None)
+    handoff.reset()
+    yield
+    repair.reset()
+    scrub.reset_targets()
+    verified.reset_stats()
+    faults.configure(None)
+    handoff.reset()
+
+
+def _mk_product(tmp_path, shape=(4, 4), chunks=(4, 4), dtype="uint16",
+                key="a"):
+    """A small uncompressed product dataset with one written (and
+    digest-recorded) block region."""
+    f = open_container(os.path.join(str(tmp_path), "prod.zarr"))
+    ds = f.create_dataset(key, shape=shape, chunks=chunks, dtype=dtype,
+                          compression=None)
+    data = np.arange(int(np.prod(shape)), dtype=dtype).reshape(shape)
+    bb = tuple(slice(0, c) for c in chunks)
+    ds[bb] = data[bb]
+    verified.mark_product(ds)
+    return ds, data, bb
+
+
+def _chunk_file(ds):
+    """The single raw (uncompressed) chunk file behind a one-chunk
+    dataset."""
+    # label is "<container>:<key>"
+    container, key = ds._label.rsplit(":", 1)
+    d = os.path.join(container, key)
+    files = [f for f in os.listdir(d) if not f.startswith(".")]
+    assert len(files) == 1, files
+    return os.path.join(d, files[0])
+
+
+def _sidecar_file(ds, bb):
+    container, key = ds._label.rsplit(":", 1)
+    sdir = os.path.join(container, key, ".ctt_checksums")
+    files = [f for f in os.listdir(sdir) if f.endswith(".json")]
+    assert len(files) == 1, files
+    return os.path.join(sdir, files[0])
+
+
+# -- the verifying reader: corruption at every byte offset --------------------
+
+
+def test_corruption_detected_at_every_byte_offset(tmp_path):
+    """Property test (torn-tail style): flip each byte of the stored
+    block, one at a time — EVERY offset must surface as the typed
+    corrupt:storage error, and restoring the byte must restore clean
+    reads.  No lineage is registered, so nothing can silently 'repair'
+    the flip away."""
+    ds, data, bb = _mk_product(tmp_path)
+    chunk = _chunk_file(ds)
+    raw = open(chunk, "rb").read()
+    assert len(raw) == data.nbytes  # uncompressed: the property is total
+    for off in range(len(raw)):
+        bad = bytearray(raw)
+        bad[off] ^= 0x01
+        with open(chunk, "wb") as f:
+            f.write(bytes(bad))
+        with pytest.raises(ProductCorruptionError) as ei:
+            ds[bb]
+        assert ei.value.code == "corrupt:storage"
+        with open(chunk, "wb") as f:
+            f.write(raw)
+    np.testing.assert_array_equal(ds[bb], data)
+    st = verified.stats()
+    assert st["corrupt_detected"] == data.nbytes
+    assert st["unrepairable_reads"] == data.nbytes
+    assert repair.stats()["no_lineage"] == data.nbytes
+
+
+def test_missing_sidecar_policy_adopt_then_verifies(tmp_path):
+    """Default (adopt) policy: a product read whose sidecar vanished is
+    hash-and-adopted — and the adopted digest is real: corrupting the
+    bytes afterwards is detected against it."""
+    ds, data, bb = _mk_product(tmp_path)
+    os.unlink(_sidecar_file(ds, bb))
+    out = ds[bb]  # adopts, does not raise
+    np.testing.assert_array_equal(out, data)
+    assert verified.stats()["sidecars_adopted"] == 1
+    assert os.path.exists(_sidecar_file(ds, bb))  # re-recorded
+    raw = open(_chunk_file(ds), "rb").read()
+    with open(_chunk_file(ds), "wb") as f:
+        f.write(bytes([raw[0] ^ 1]) + raw[1:])
+    with pytest.raises(ProductCorruptionError):
+        ds[bb]
+
+
+def test_missing_sidecar_policy_strict_refuses(tmp_path):
+    ds, data, bb = _mk_product(tmp_path)
+    verified.mark_product(ds, policy="strict")
+    os.unlink(_sidecar_file(ds, bb))
+    with pytest.raises(MissingSidecarError) as ei:
+        ds[bb]
+    assert ei.value.code == "corrupt:storage:missing_sidecar"
+    assert verified.stats()["strict_missing"] == 1
+
+
+def test_truncated_sidecar_treated_as_missing(tmp_path):
+    """A torn sidecar JSON is unverifiable — same policy surface as a
+    deleted one (adopt re-records; strict refuses)."""
+    ds, data, bb = _mk_product(tmp_path)
+    side = _sidecar_file(ds, bb)
+    full = open(side).read()
+    for cut in (0, 1, len(full) // 2, len(full) - 1):
+        with open(side, "w") as f:
+            f.write(full[:cut])
+        verified.mark_product(ds, policy="strict")
+        with pytest.raises(MissingSidecarError):
+            ds[bb]
+        verified.mark_product(ds, policy="adopt")
+        np.testing.assert_array_equal(ds[bb], data)  # adopts
+        # adoption rewrote a full sidecar; restore the torn state baseline
+        assert json.load(open(side))["crc"] is not None
+
+
+def test_unmarked_and_unaligned_reads_never_policed(tmp_path):
+    """Raw inputs (unmarked) and halo/slab reads (not chunk-aligned) are
+    outside the policy's jurisdiction even under strict."""
+    ds, data, bb = _mk_product(tmp_path, shape=(8, 8), chunks=(4, 4))
+    verified.mark_product(ds, policy="strict")
+    # chunk-aligned but never-written region: strict refuses...
+    with pytest.raises(MissingSidecarError):
+        ds[(slice(4, 8), slice(4, 8))]
+    # ...but a slab read (not chunk-aligned) is fine
+    np.testing.assert_array_equal(
+        ds[(slice(3, 5), slice(0, 4))].shape, (2, 4)
+    )
+    # and an unmarked dataset is never judged at all
+    f = open_container(os.path.join(str(tmp_path), "raw.zarr"))
+    raw = f.create_dataset("r", shape=(4, 4), chunks=(4, 4), dtype="uint8",
+                           compression=None)
+    raw[(slice(0, 4), slice(0, 4))]  # no sidecar, no error
+
+
+# -- injected read-site rot (kind='corrupt' at io_read) -----------------------
+
+
+def test_injected_read_rot_flip_mode(tmp_path, inject):
+    ds, data, bb = _mk_product(tmp_path)
+    inject({"faults": [{"site": "io_read", "kind": "corrupt",
+                        "blocks": [7]}]})
+    with faults.block_context(7):
+        with pytest.raises(ProductCorruptionError) as ei:
+            ds[bb]
+    assert ei.value.code == "corrupt:storage"
+    # the flip landed on STORAGE (one-shot): a later uninjected read of
+    # the same region still sees it
+    faults.configure(None)
+    with pytest.raises(ProductCorruptionError):
+        ds[bb]
+
+
+def test_injected_read_rot_sidecar_mode(tmp_path, inject):
+    ds, data, bb = _mk_product(tmp_path)
+    verified.mark_product(ds, policy="strict")
+    inject({"faults": [{"site": "io_read", "kind": "corrupt",
+                        "mode": "sidecar", "blocks": [7]}]})
+    with faults.block_context(7):
+        with pytest.raises(MissingSidecarError):
+            ds[bb]
+    assert not os.path.exists(
+        os.path.join(os.path.dirname(_chunk_file(ds)), ".ctt_checksums",
+                     "r_0-4_0-4.json")
+    )
+
+
+# -- lineage-driven repair ----------------------------------------------------
+
+
+def _run_double_sweep(tmp_path):
+    """A tiny executor sweep (out = 2 * input) with the full hardened
+    store path: region_verifier wires product marking + lineage."""
+    f = open_container(os.path.join(str(tmp_path), "sweep.zarr"))
+    out = f.create_dataset("o", shape=(8, 8), chunks=(4, 4),
+                           dtype="float32", compression=None)
+    inp = np.arange(64, dtype="float32").reshape(8, 8)
+    blocking = Blocking((8, 8), (4, 4))
+    blocks = [blocking.get_block(i) for i in range(4)]
+    failures = os.path.join(str(tmp_path), "failures.json")
+    ex = BlockwiseExecutor(target="local", backoff_base=1e-4)
+    ex.map_blocks(
+        lambda x: x * 2, blocks,
+        lambda b: (inp[b.bb],),
+        lambda b, raw: out.__setitem__(b.bb, np.asarray(raw)),
+        store_verify_fn=region_verifier(out),
+        failures_path=failures,
+        task_name="double",
+    )
+    return out, inp * 2, blocking, failures
+
+
+def test_executor_registers_lineage_and_read_heals(tmp_path):
+    """The closed loop: a verified executor store registers lineage; rot
+    the stored block at rest; the NEXT plain read detects, recomputes
+    from the producing inputs, re-publishes, re-verifies, and returns
+    clean bytes — the caller never sees the corruption, and the repair is
+    attributed (repaired:lineage, resolved) in failures.json."""
+    out, expected, blocking, failures = _run_double_sweep(tmp_path)
+    assert repair.stats()["producers"] == 4
+    bb = blocking.get_block(2).bb
+    bad = out._read_back(bb).copy()
+    bad[0, 0] += 1.0
+    out._write_raw(bb, bad)
+    healed = out[bb]  # an ordinary read — healing is transparent
+    np.testing.assert_array_equal(healed, expected[bb])
+    st = repair.stats()
+    assert st["repaired"] == 1 and st["unrepairable"] == 0
+    assert verified.stats()["repaired_reads"] == 1
+    doc = fu.read_json_if_valid(failures)
+    recs = [r for r in doc["records"]
+            if r.get("resolution") == repair.REPAIRED_LINEAGE]
+    assert recs and recs[0]["resolved"] is True
+    assert recs[0]["block_id"] == 2
+    # the region verifies at rest again
+    out.verify_region(bb)
+
+
+def test_lineage_recompute_resolves_async_load_futures(tmp_path):
+    """A task with an async loader (load_fn returning futures, like the
+    prefetching paths) must stay repairable: the recompute closure
+    resolves futures exactly like load_block does."""
+    f = open_container(os.path.join(str(tmp_path), "sweep.zarr"))
+    out = f.create_dataset("o", shape=(8, 8), chunks=(4, 4),
+                           dtype="float32", compression=None)
+    src = f.create_dataset("i", shape=(8, 8), chunks=(4, 4),
+                           dtype="float32", compression=None)
+    src[...] = np.arange(64, dtype="float32").reshape(8, 8)
+    blocking = Blocking((8, 8), (4, 4))
+    blocks = [blocking.get_block(i) for i in range(4)]
+    ex = BlockwiseExecutor(target="local", backoff_base=1e-4)
+    ex.map_blocks(
+        lambda x: x + 1, blocks,
+        lambda b: (src.read_async(b.bb),),  # future-returning loader
+        lambda b, raw: out.__setitem__(b.bb, np.asarray(raw)),
+        store_verify_fn=region_verifier(out),
+        failures_path=os.path.join(str(tmp_path), "failures.json"),
+        task_name="async_inc",
+    )
+    bb = blocking.get_block(3).bb
+    bad = out._read_back(bb).copy()
+    bad[0, 0] += 9.0
+    out._write_raw(bb, bad)
+    healed = out[bb]
+    np.testing.assert_array_equal(healed, src[bb] + 1)
+    assert repair.stats()["repaired"] == 1
+    assert repair.stats()["unrepairable"] == 0
+
+
+def test_repair_budget_degrades_to_unrepairable(tmp_path, monkeypatch):
+    """When the lineage itself cannot produce clean bytes (damaged
+    inputs model: the recompute raises), the bounded budget degrades to
+    quarantined:unrepairable — attributed, unresolved, and fail-fast
+    afterwards."""
+    monkeypatch.setenv("CTT_REPAIR_BUDGET", "2")
+    ds, data, bb = _mk_product(tmp_path)
+    failures = os.path.join(str(tmp_path), "failures.json")
+
+    def broken_recompute():
+        raise RuntimeError("upstream inputs are damaged too")
+
+    repair.register_producer(ds, bb, broken_recompute, task="prod",
+                             block_id=0, failures_path=failures)
+    raw = open(_chunk_file(ds), "rb").read()
+    with open(_chunk_file(ds), "wb") as f:
+        f.write(bytes([raw[0] ^ 1]) + raw[1:])
+    for _ in range(3):  # 2 budgeted attempts + 1 fail-fast
+        with pytest.raises(ProductCorruptionError):
+            ds[bb]
+    st = repair.stats()
+    assert st["failed"] == 2  # the third read never re-attempted
+    assert st["unrepairable"] == 1
+    doc = fu.read_json_if_valid(failures)
+    recs = [r for r in doc["records"]
+            if r.get("resolution") == repair.QUARANTINE_UNREPAIRABLE]
+    assert recs and recs[0]["quarantined"] is True
+    assert recs[0]["resolved"] is False  # operator action needed
+
+
+# -- the scrubber -------------------------------------------------------------
+
+
+def test_scrubber_finds_and_repairs_at_rest(tmp_path):
+    """At-rest rot with live lineage: one budgeted scan finds the bad
+    region, repairs it from the producer, and the bytes verify again —
+    without anyone reading the data."""
+    out, expected, blocking, failures = _run_double_sweep(tmp_path)
+    bb = blocking.get_block(1).bb
+    bad = out._read_back(bb).copy()
+    bad[1, 1] += 3.0
+    out._write_raw(bb, bad)
+    s = scrub.Scrubber(base_dir=str(tmp_path), enabled=False)
+    scanned = s.scan_once(budget_bytes=1 << 30)
+    assert scanned >= 4  # every recorded region of the sweep
+    st = s.stats()
+    assert st["found_corrupt"] == 1 and st["repaired"] == 1
+    assert st["passes"] == 1 and st["unrepairable"] == 0
+    np.testing.assert_array_equal(out[...], expected)
+    state = json.load(open(os.path.join(str(tmp_path),
+                                        "scrub_state.json")))
+    assert state["found_corrupt"] == 1
+    assert state["repair"]["repaired"] == 1
+
+
+def test_scrubber_discovers_at_rest_targets_from_roots(tmp_path):
+    """Root walking: with NO live registry (a restarted process), sidecar
+    dirs under the scrub roots are discovered and verified; rot with no
+    lineage is found and counted unrepairable rather than hidden."""
+    ds, data, bb = _mk_product(tmp_path)
+    repair.reset()
+    scrub.reset_targets()
+    raw = open(_chunk_file(ds), "rb").read()
+    with open(_chunk_file(ds), "wb") as f:
+        f.write(bytes([raw[0] ^ 1]) + raw[1:])
+    s = scrub.Scrubber(base_dir=str(tmp_path), roots=[str(tmp_path)],
+                       enabled=False)
+    assert s.scan_once(budget_bytes=1 << 30) == 1
+    st = s.stats()
+    assert st["found_corrupt"] == 1 and st["unrepairable"] == 1
+    assert st["repair"]["no_lineage"] >= 1
+
+
+def test_scrubber_budget_and_cursor_resume(tmp_path):
+    """The byte budget is honored per slice and the cursor resumes where
+    the last slice stopped — coverage accrues across slices into a full
+    pass."""
+    out, expected, blocking, _ = _run_double_sweep(tmp_path)
+    s = scrub.Scrubber(base_dir=str(tmp_path), enabled=False)
+    # each region is 4*4*4 = 64 bytes; a 1-byte budget scans exactly one
+    for i in range(4):
+        assert s.scan_once(budget_bytes=1) == 1
+    assert s.stats()["passes"] == 1
+    assert s.stats()["scanned_regions"] == 4
+
+
+# -- the scrub-smoke server scenario (make scrub-smoke) -----------------------
+
+
+def _load_failures_report_module():
+    import importlib.util
+
+    path = os.path.join(REPO_ROOT, "scripts", "failures_report.py")
+    spec = importlib.util.spec_from_file_location("_fr_selfheal", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_scrub_smoke_server_self_heals(tmp_path):
+    """The <10 s tier-1 twin of the corruption chaos e2e: a resident
+    server completes a request; a block of the published product is
+    rotted at rest; the scrubber independently finds it and repairs it
+    from lineage; the product is bit-identical to its pre-rot bytes; the
+    healing shows up in /healthz, /status, scrub_state.json, and the
+    machine-readable failures report."""
+    import time
+
+    from cluster_tools_tpu.runtime.server import PipelineServer, ServeClient
+
+    base = str(tmp_path)
+    rng = np.random.default_rng(11)
+    vol = (rng.random((16, 16, 16)) > 0.5).astype("float32")
+    data = os.path.join(base, "data.zarr")
+    src = file_reader(data).create_dataset(
+        "mask", shape=vol.shape, chunks=(8, 8, 8), dtype="float32")
+    src[...] = vol
+
+    srv = os.path.join(base, "srv")
+    server = PipelineServer(
+        base_dir=srv, max_workers=1,
+        scrub={"interval_s": 0.1, "bytes_per_interval": 1 << 30,
+               "roots": [base]},
+    ).start()
+    client = ServeClient(server.host, server.port)
+    try:
+        client.submit(
+            tenant="alice", request_id="r1",
+            workflow="connected_components",
+            config=dict(
+                tmp_folder=os.path.join(base, "req_r1"),
+                global_config={"block_shape": [8, 8, 8]},
+                params=dict(input_path=data, input_key="mask",
+                            output_path=data, output_key="seg",
+                            threshold=0.5),
+            ),
+        )
+        assert client.wait("r1", timeout_s=120)["state"] == "done"
+        seg = file_reader(data)["seg"]
+        clean = np.asarray(seg[...])
+
+        # rot one stored block region at rest (sidecar intact): nobody
+        # reads it — only the scrubber can notice
+        bb = tuple(slice(0, 8) for _ in range(3))
+        bad = seg._read_back(bb).copy()
+        bad[0, 0, 0] += 1
+        seg._write_raw(bb, bad)
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            sc = client.healthz().get("scrub") or {}
+            if sc.get("found_corrupt", 0) >= 1 and sc.get("repaired", 0) >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"scrubber never healed the rot: {client.healthz()}"
+            )
+        assert sc["unrepairable"] == 0
+        # the healed product is BIT-IDENTICAL to its pre-rot bytes
+        np.testing.assert_array_equal(
+            np.asarray(file_reader(data)["seg"][...]), clean
+        )
+        # surfaced on every plane: /status, scrub_state.json, the report
+        status = client.status()
+        assert status["server"]["scrub"]["repaired"] >= 1
+        assert status["rc"] == 0  # repaired:lineage is resolved
+        state = json.load(open(os.path.join(srv, "scrub_state.json")))
+        assert state["found_corrupt"] >= 1
+        rep = _load_failures_report_module()
+        jdoc = rep.build_json_report(srv, with_lint=False)
+        assert jdoc["scrub"]["repaired"] >= 1
+        assert jdoc["scrub"]["repair"]["repaired"] >= 1
+        # repaired:lineage attributed in the producing task's failures
+        req_doc = fu.read_json_if_valid(
+            fu.failures_path(os.path.join(base, "req_r1")))
+        recs = [r for r in (req_doc or {}).get("records", [])
+                if r.get("resolution") == repair.REPAIRED_LINEAGE]
+        assert recs and recs[0]["resolved"] is True
+    finally:
+        server.stop()
+
+
+def test_report_renders_scrub_block(tmp_path):
+    """failures_report --json carries the scrub plane; the text renderer
+    shows findings and their fate."""
+    rep = _load_failures_report_module()
+    base = str(tmp_path)
+    fu.atomic_write_json(os.path.join(base, "scrub_state.json"), {
+        "version": 1, "scanned_regions": 5, "scanned_bytes": 320,
+        "passes": 2, "found_corrupt": 2, "repaired": 1, "unrepairable": 1,
+        "coverage": 0.5,
+        "reader": {"corrupt_detected": 3, "repaired_reads": 1,
+                   "unrepairable_reads": 1, "sidecars_adopted": 1,
+                   "strict_missing": 0},
+        "repair": {"repaired": 1, "unrepairable": 1},
+    })
+    doc = rep.build_json_report(base, with_lint=False)
+    assert doc["scrub"]["found_corrupt"] == 2
+    text = "\n".join(rep.format_scrub_stats(doc["scrub"]))
+    assert "at-rest corruption: 2 found" in text
+    assert "quarantined as \nunrepairable" not in text  # sane wrapping
+    assert "unrepairable" in text

@@ -731,6 +731,17 @@ def test_progress_and_report_render_journal_plane(tmp_path):
     assert good == jblock["bytes"]
     # a run without a journal reports null (batch runs unchanged)
     assert rep.build_json_report(base, with_lint=False)["journal"] is None
+    # the self-healing plane rides the same document: the resident
+    # server's scrubber wrote scrub_state.json next to failures.json
+    sblock = jdoc["scrub"]
+    assert sblock is not None
+    for key in ("passes", "scanned_regions", "scanned_bytes",
+                "found_corrupt", "repaired", "unrepairable", "reader",
+                "repair"):
+        assert key in sblock, key
+    assert sblock["found_corrupt"] == 0 and sblock["unrepairable"] == 0
+    # a run without a scrubber reports null
+    assert rep.build_json_report(base, with_lint=False)["scrub"] is None
 
 
 def test_serve_cli_status_requires_endpoint(tmp_path):

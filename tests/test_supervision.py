@@ -75,8 +75,18 @@ def test_injector_chunk_corrupt_gating():
     assert inj.chunk_corrupt("io_write", 3)
     assert inj.chunk_corrupt("io_write", 3)
     assert not inj.chunk_corrupt("io_write", 3)  # attempts exhausted
-    with pytest.raises(ValueError, match="corrupt faults"):
+    with pytest.raises(ValueError, match="corrupt fault site"):
         FaultInjector({"faults": [{"site": "load", "kind": "corrupt"}]})
+    with pytest.raises(ValueError, match="corrupt fault mode"):
+        FaultInjector({"faults": [{"site": "io_read", "kind": "corrupt",
+                                   "mode": "nonsense"}]})
+    # read-site rot returns the mode (truthy) so boolean callers work
+    inj2 = FaultInjector(
+        {"faults": [{"site": "io_read", "kind": "corrupt",
+                     "mode": "sidecar"}]}
+    )
+    assert inj2.chunk_corrupt("io_read") == "sidecar"
+    assert inj2.chunk_corrupt("io_read") is None
 
 
 def test_injector_job_loss_gating():
