@@ -59,6 +59,14 @@
 #                     fewer), ragged-lane attribution, and bit-identity
 #                     into BENCH_r11.json; cpu backend, <10 s (a smoke
 #                     twin runs inside tier1 via tests/test_ragged.py)
+#   bench-device    = device-resident data-plane bench (docs/PERFORMANCE.md
+#                     "Device-resident data plane"): the BENCH_r11 ragged
+#                     grid swept host-staged vs through the HBM-resident
+#                     content-addressed page pool, recording h2d bytes
+#                     (warm re-sweeps re-address resident pages), dispatch
+#                     wall time, hit/reuse attribution, and bit-identity
+#                     into BENCH_r12.json; cpu backend, <10 s (a smoke
+#                     twin runs inside tier1 via tests/test_device_plane.py)
 #   bench-solve     = distributed-agglomeration bench (docs/PERFORMANCE.md
 #                     "Distributed agglomeration"): the >=100k-edge
 #                     solver-scale instance solved single-host vs over the
@@ -75,7 +83,7 @@
 #                     per-tenant fairness into BENCH_r10.json; cpu
 #                     backend (a <10 s smoke twin runs inside tier1 via
 #                     tests/test_serve.py)
-#   bench-trajectory= aggregate the BENCH_r01..r10 headline numbers into
+#   bench-trajectory= aggregate the BENCH_r01..r12 headline numbers into
 #                     one table (stdout + rewritten into docs/PERFORMANCE.md
 #                     "Performance trajectory"), so the perf history is
 #                     readable without opening ten JSON files
@@ -98,7 +106,8 @@ CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
 .PHONY: test lint tier1 tier2 chaos chaos-resource failures-report progress \
-	bench-io bench-sweep bench-fuse bench-ragged bench-solve bench-serve \
+	bench-io bench-sweep bench-fuse bench-ragged bench-device bench-solve \
+	bench-serve \
 	bench-trajectory serve-smoke scrub-smoke supervise-demo native clean
 
 test: lint tier1 tier2 chaos
@@ -140,6 +149,9 @@ bench-fuse:
 
 bench-ragged:
 	JAX_PLATFORMS=cpu $(PY) bench.py --ragged
+
+bench-device:
+	JAX_PLATFORMS=cpu $(PY) bench.py --device-plane
 
 bench-solve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --solve

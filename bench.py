@@ -580,6 +580,7 @@ def sweep_bench(smoke=False, n_devices=1):
                     schedule="morton",
                     sweep_mode=mode,
                     sharded_batch=sharded_batch,
+                    device_pool="off",  # dense sweep: no paged staging
                 )
 
         run_onces[mode] = run_once
@@ -919,6 +920,7 @@ def ragged_bench(smoke=False, n_devices=1):
                     store_verify_fn=None,
                     schedule="morton", sweep_mode=mode,
                     sharded_batch=sharded_batch, ragged=ragged,
+                    device_pool="off",  # measures the host-staged baseline
                     splittable=True, split_halo=halo,
                     min_block_shape=(4, 4, 4), degrade_wait_s=0.05,
                 )
@@ -982,6 +984,139 @@ def ragged_bench(smoke=False, n_devices=1):
         )
         fu.atomic_write_json(path, rec)
         log(f"ragged bench done -> {path}")
+    return rec
+
+
+def device_plane_bench(smoke=False, n_devices=1):
+    """Device-resident data plane (docs/PERFORMANCE.md "Device-resident
+    data plane").
+
+    The BENCH_r11 ragged grid (27 mixed-shape blocks of 16^3 over a 44^3
+    volume, every face block edge-clipped) swept twice per arm —
+    host-staged (``device_pool="off"``: every batch re-uploads its page
+    pool) vs device-resident (the content-addressed HBM pool of
+    ``parallel/device_pool.py``: pages upload once, later batches and the
+    warm re-sweep re-address resident slots).  Records each arm's warm
+    dispatch wall time and h2d traffic from the device-plane counters,
+    the resident arm's hit/reuse attribution, and bit-identity of the
+    outputs — the pool must be a pure staging change.
+
+    ``smoke=True`` is the <10 s tier-1 variant (single rep, no file
+    output); the full run writes BENCH_r12.json next to this script.
+    Emits exactly one JSON line on stdout and returns the record.
+    """
+    import jax.numpy as jnp
+
+    from cluster_tools_tpu.parallel import device_pool as device_pool_mod
+    from cluster_tools_tpu.runtime import executor as executor_mod
+    from cluster_tools_tpu.runtime import trace as trace_mod
+    from cluster_tools_tpu.runtime.executor import BlockwiseExecutor
+    from cluster_tools_tpu.utils import function_utils as fu
+    from cluster_tools_tpu.utils.volume_utils import Blocking
+
+    shape = (44, 44, 44)
+    block, halo = 16, (4, 4, 4)
+    sharded_batch = 32
+    reps = 1 if smoke else 3
+    rng = np.random.default_rng(0)
+    vol = rng.random(shape).astype(np.float32)
+    blocking = Blocking(shape, (block,) * 3)
+    blocks = [
+        blocking.get_block(i, halo=halo) for i in range(blocking.n_blocks)
+    ]
+    log(
+        f"device-plane bench: volume {shape}, blocks {block}^3 "
+        f"({blocking.n_blocks}-block non-pow2 grid, edge-clipped), "
+        f"host-staged vs device-resident, sharded batch {sharded_batch}"
+    )
+
+    def kernel(b):
+        return jnp.where(b < jnp.float32(0.5), b * 2 + jnp.float32(0.25),
+                         jnp.float32(1.0))
+
+    def run_arm(dev):
+        out = np.zeros(shape, np.float32)
+
+        def load(b):
+            return (vol[b.outer_bb],)
+
+        def store(b, raw):
+            out[b.bb] = np.asarray(raw)[b.inner_in_outer_bb]
+
+        ex = BlockwiseExecutor(
+            target="local", n_devices=n_devices, io_threads=4,
+            max_retries=2, backoff_base=1e-4,
+        )
+        device_pool_mod.reset()  # each arm starts from a cold pool
+        seconds, delta, summary = None, None, None
+        for rep in range(reps + 1):  # rep 0 warms programs (and arenas)
+            out[:] = 0
+            snap = device_pool_mod.snapshot()
+            t0 = time.perf_counter()
+            with trace_mod.task_context(f"device_plane_{dev}"):
+                summary = ex.map_blocks(
+                    kernel, blocks, load, store,
+                    failures_path=None, task_name=f"device_plane_{dev}",
+                    block_deadline_s=None, watchdog_period_s=None,
+                    store_verify_fn=None,
+                    schedule="morton", sweep_mode="sharded",
+                    sharded_batch=sharded_batch, ragged="auto",
+                    device_pool=dev,
+                )
+            t = time.perf_counter() - t0
+            if rep == 0:
+                continue
+            if seconds is None or t < seconds:
+                seconds = t
+                delta = device_pool_mod.delta(snap)
+        rec = {
+            "seconds": round(seconds, 4),
+            "h2d_bytes": int(delta["h2d_bytes"]),
+            "bytes_not_staged": int(delta["bytes_not_staged"]),
+            "device_pool_hits": int(delta["device_pool_hits"]),
+            "device_batches_staged": int(delta["device_batches_staged"]),
+            "resident_bytes": int(
+                summary.get("device_pool_resident_bytes", 0)
+            ),
+        }
+        log(
+            f"device-plane bench {dev}: {seconds * 1000:.1f} ms, "
+            f"{rec['h2d_bytes']} h2d B, "
+            f"{rec['bytes_not_staged']} B not staged "
+            f"({rec['device_pool_hits']} page hits)"
+        )
+        return out, rec
+
+    out_host, host = run_arm("off")
+    out_dev, dev = run_arm("on")
+    device_pool_mod.reset()
+
+    rec = {
+        "metric": "device_resident_data_plane",
+        "backend": "cpu",
+        "smoke": bool(smoke),
+        "volume": list(shape),
+        "block_shape": [block] * 3,
+        "halo": list(halo),
+        "grid": list(blocking.grid_shape),
+        "n_devices": int(n_devices),
+        "sharded_batch": int(sharded_batch),
+        "host_staged": host,
+        "device_resident": dev,
+        "h2d_reduction": round(
+            host["h2d_bytes"] / max(1, dev["h2d_bytes"]), 2
+        ),
+        "wall_ratio": round(host["seconds"] / dev["seconds"], 2),
+        "bit_identical": bool(np.array_equal(out_host, out_dev)),
+        "schedule": "morton",
+    }
+    print(json.dumps(rec), flush=True)
+    if not smoke:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r12.json"
+        )
+        fu.atomic_write_json(path, rec)
+        log(f"device-plane bench done -> {path}")
     return rec
 
 
@@ -2532,6 +2667,9 @@ if __name__ == "__main__":
             sweep_bench()
         elif "--ragged" in sys.argv or os.environ.get("CT_BENCH_RAGGED"):
             ragged_bench(smoke="--smoke" in sys.argv)
+        elif "--device-plane" in sys.argv \
+                or os.environ.get("CT_BENCH_DEVICE_PLANE"):
+            device_plane_bench(smoke="--smoke" in sys.argv)
         elif "--fuse" in sys.argv or os.environ.get("CT_BENCH_FUSE"):
             fuse_bench()
         elif "--solve" in sys.argv or os.environ.get("CT_BENCH_SOLVE"):

@@ -96,7 +96,10 @@ def _package_root(path: str) -> Optional[str]:
 #: ``sweep_mode`` selects the sharded executor path (one compiled program
 #: per Morton batch, docs/PERFORMANCE.md "Sharded sweeps") — enforcing it
 #: here means the new path is only reachable through config-plumbed call
-#: sites, exactly like the per-block knobs.
+#: sites, exactly like the per-block knobs.  ``device_pool`` gates the
+#: HBM-resident page pool for ragged sweeps (docs/PERFORMANCE.md
+#: "Device-resident data plane"): a site that cannot turn it off from
+#: config cannot reach the host-staged twin when HBM is contended.
 MAP_BLOCKS_KNOBS = frozenset({
     "failures_path",
     "task_name",
@@ -105,6 +108,7 @@ MAP_BLOCKS_KNOBS = frozenset({
     "store_verify_fn",
     "schedule",
     "sweep_mode",
+    "device_pool",
 })
 
 #: constructor knobs: IO pool width and the per-block retry budget must be
@@ -937,6 +941,12 @@ def ct006_drain_safety(module: LintModule) -> List[Finding]:
 #: spill twin can be created (positionally: path, key, shape, chunks, dtype)
 _CT007_CREATE_KWS = ("shape", "chunks", "dtype")
 
+#: kwargs a device-rung publish must carry (positionally: path, arrays,
+#: producer, failures_path): without them the demote-to-host / host-staged
+#: fallback cannot be attributed (``degraded:host_staged`` in failures.json)
+#: and the device handoff's spill contract is silently broken.
+_CT007_DEVICE_PUBLISH_KWS = ("producer", "failures_path")
+
 
 def ct007_memory_target_contract(module: LintModule) -> List[Finding]:
     """A task that declares a ``MemoryTarget`` output must wire the spill
@@ -951,6 +961,12 @@ def ct007_memory_target_contract(module: LintModule) -> List[Finding]:
     the module so integrity verification covers the in-memory data plane —
     a handoff without a verifier is a storage boundary the PR-3 corruption
     defense cannot see.
+
+    Device-rung declarations (``publish_device_arrays``) carry the same
+    obligation one rung up: every publish must wire ``producer`` +
+    ``failures_path`` so a demotion or host-staged fallback stays
+    attributable (``degraded:host_staged``) instead of silently vanishing
+    from the failure ledger.
     """
     if module.name in ("task.py", "handoff.py") \
             and "lint_fixtures" not in module.path:
@@ -1025,6 +1041,28 @@ def ct007_memory_target_contract(module: LintModule) -> List[Finding]:
             call = node.value
             if last_seg(dotted(call.func)) == "handoff_dataset":
                 _check(call, None)
+
+    for call in calls_in(module.tree):
+        if last_seg(dotted(call.func)) != "publish_device_arrays":
+            continue
+        present, splat = kw_names(call)
+        if splat:
+            continue  # wiring forwarded wholesale; not statically checkable
+        pos = len(call.args)
+        # positional args fill path, arrays, producer, failures_path
+        missing = [
+            k for i, k in enumerate(_CT007_DEVICE_PUBLISH_KWS)
+            if pos < 3 + i and k not in present
+        ]
+        if missing:
+            out.append(Finding(
+                "CT007", module.path, call.lineno, call.col_offset,
+                f"device handoff publish misses its spill contract "
+                f"{missing}: a demotion or host-staged fallback from the "
+                "device rung cannot be attributed (degraded:host_staged "
+                "in failures.json) without the producer identity and the "
+                "failure-ledger path",
+            ))
     return out
 
 

@@ -42,10 +42,28 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..compat import shard_map
+from . import device_pool as device_pool_mod
 
 
 def mesh_n_devices(mesh: Mesh) -> int:
     return int(np.prod(mesh.devices.shape))
+
+
+def slab_sweep_device_feed_ok(
+    shape: Sequence[int], extent: int, halo: int
+) -> bool:
+    """True when the batch geometry allows the inner-only-load device feed:
+    axis-0 decomposes into whole slabs of ``extent`` (no ragged tail — tails
+    would need per-block host reads anyway) and the halo fits inside one
+    slab so :func:`exchange_batch_halo` can rebuild every interior halo from
+    batch-neighbor data alone."""
+    size = int(shape[0])
+    return (
+        extent > 0
+        and 0 <= halo <= extent
+        and size >= extent
+        and size % extent == 0
+    )
 
 
 def resolve_sharded_batch(
@@ -297,7 +315,7 @@ def exchange_batch_halo(
 
 
 def sharded_slab_sweep(
-    vol: np.ndarray,
+    vol,
     kernel: Callable,
     mesh: Mesh,
     extent: int,
@@ -305,7 +323,8 @@ def sharded_slab_sweep(
     batch: Optional[int] = None,
     fill=0.0,
     axis_name: str = "blocks",
-) -> np.ndarray:
+    keep_on_device: bool = False,
+):
     """Sweep ``vol`` decomposed into axis-0 slabs of ``extent`` as
     batch-sharded programs with device-side halo exchange.
 
@@ -319,6 +338,16 @@ def sharded_slab_sweep(
     with synthetic slabs whose leading rows carry the true ``hi_edge`` (so
     the last real slab still sees its correct halo) and the padded outputs
     are dropped.  Returns the per-slab kernel outputs stacked along axis 0.
+
+    ``vol`` may be a host :class:`numpy.ndarray` (each batch's stack is
+    uploaded, counted as ``h2d_bytes``) or an already device-resident
+    :class:`jax.Array` — e.g. the payload of a device handoff
+    (:func:`~cluster_tools_tpu.runtime.handoff.resolve_device_arrays`) — in
+    which case batches are sliced and stacked on device and the skipped
+    upload is counted as ``bytes_not_staged``.  With ``keep_on_device=True``
+    the result stays a :class:`jax.Array` (no device-to-host copy), ready
+    to feed the next device consumer or a device handoff publish; the
+    default materializes the host array and counts ``d2h_bytes``.
     """
     n_dev = mesh_n_devices(mesh)
     size = int(vol.shape[0])
@@ -334,8 +363,11 @@ def sharded_slab_sweep(
         batch = min(n_slabs, max(n_dev, 8))
     batch = ((int(batch) + n_dev - 1) // n_dev) * n_dev
 
-    slab_shape = (extent,) + vol.shape[1:]
-    edge_shape = (halo,) + vol.shape[1:]
+    on_device = isinstance(vol, jax.Array)
+    xp = jnp if on_device else np
+    slab_shape = (extent,) + tuple(vol.shape[1:])
+    edge_shape = (halo,) + tuple(vol.shape[1:])
+    itemsize = np.dtype(vol.dtype).itemsize
 
     def _body(stack, lo, hi):
         halod = exchange_batch_halo(
@@ -357,11 +389,11 @@ def sharded_slab_sweep(
 
     from ..runtime import trace as trace_mod
 
-    fill_edge = np.full(edge_shape, fill, vol.dtype)
+    fill_edge = xp.full(edge_shape, fill, vol.dtype)
     outs = []
     for start in range(0, n_slabs, batch):
         idxs = list(range(start, min(start + batch, n_slabs)))
-        stack = np.stack([vol[i * extent:(i + 1) * extent] for i in idxs])
+        stack = xp.stack([vol[i * extent:(i + 1) * extent] for i in idxs])
         lo = (
             vol[start * extent - halo:start * extent]
             if start > 0 else fill_edge
@@ -376,14 +408,33 @@ def sharded_slab_sweep(
             # padding slabs lead with the real hi edge so the last REAL
             # slab's device-side succ halo is still its true neighbor data;
             # the rest of the pad (and its outputs) are discarded
-            pad = np.zeros(slab_shape, vol.dtype)
-            pad[:halo] = hi
-            stack = np.concatenate([stack, np.stack([pad] * n_pad)], axis=0)
+            if on_device:
+                tail = jnp.full(
+                    (extent - halo,) + slab_shape[1:], 0, vol.dtype
+                )
+                pad = jnp.concatenate([hi, tail], axis=0)
+            else:
+                pad = np.zeros(slab_shape, vol.dtype)
+                pad[:halo] = hi
+            stack = xp.concatenate(
+                [stack, xp.stack([pad] * n_pad)], axis=0
+            )
+        feed_bytes = int(np.prod(stack.shape)) * itemsize
+        if on_device:
+            device_pool_mod.bump("bytes_not_staged", feed_bytes)
+        else:
+            device_pool_mod.record_h2d(feed_bytes)
         # one span per sharded slab program — the device-halo twin of the
         # executor's dispatch spans (docs/OBSERVABILITY.md)
         with trace_mod.span(
-            "shard.slab_batch", start=start, n_slabs=len(idxs)
+            "shard.slab_batch", start=start, n_slabs=len(idxs),
+            feed="device" if on_device else "host",
         ):
-            out = np.asarray(prog(stack, lo, hi))
+            out = prog(stack, lo, hi)
+            if not keep_on_device:
+                out = np.asarray(out)
+                device_pool_mod.record_d2h(int(out.nbytes))
         outs.append(out[: len(idxs)])
+    if keep_on_device:
+        return jnp.concatenate(outs, axis=0)
     return np.concatenate(outs, axis=0)

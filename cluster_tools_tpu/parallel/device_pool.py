@@ -1,0 +1,419 @@
+"""HBM-resident page pool: upload pages once, re-address them per batch.
+
+The ragged paged pool (:mod:`.block_pool`, docs/PERFORMANCE.md "Ragged
+sweeps") made mixed-shape batches ONE program, but its pools are host
+arrays re-staged with ``device_put`` every batch — the h2d copy is paid
+for every page of every batch even when consecutive batches (or warm
+re-sweeps of the same data) carry identical bytes.  This module is the
+device rung of that design (ROADMAP item 2, the "communicate on the
+accelerator" thesis of arXiv:2112.09017): a **persistent device
+allocation** per ``(page_shape, dtype)`` class, with pages addressed by
+*content* so the page-table indirection that already bounds the compiled
+program population now also bounds the h2d traffic —
+
+- a :class:`_DeviceArena` is one resident ``[capacity, *page_shape]``
+  buffer (replicated over the mesh, exactly like the host pools were),
+  its slots assigned to page *contents* (crc32 of the bytes) under an
+  LRU.  The fill page, every repeated page, and every page of a warm
+  re-sweep hit the resident slot and cost zero h2d bytes,
+- :meth:`DevicePagePool.stage` rewrites a packed
+  :class:`~cluster_tools_tpu.parallel.block_pool.RaggedBatch`'s page
+  tables against the arena slots, uploads ONLY the missing pages (one
+  ``device_put`` + one jitted scatter per batch, miss counts quantized
+  to powers of two so the scatter's compile population stays bounded),
+  and returns a :class:`StagedBatch` whose specs carry the arena
+  capacity — the same descriptor-driven program shape, fed from HBM,
+- arena capacities are quantized powers of two under a byte budget
+  (``device_pool_bytes`` task knob / ``CTT_DEVICE_POOL_BYTES``, kill
+  switch ``CTT_DEVICE_POOL=0``).  RESOURCE_EXHAUSTED while uploading
+  rides the PR-4 degrade ladder: evict everything, retry once, then
+  raise :class:`DevicePoolExhausted` — the executor falls that batch
+  back to per-batch host staging, attributed ``degraded:host_staged``
+  in failures.json (tests/test_device_plane.py).
+
+Counters follow the chunk-cache snapshot/delta pattern (``h2d_bytes`` /
+``d2h_bytes`` / ``device_pool_hits`` / ``device_pool_misses`` /
+``device_pool_evictions`` / ``bytes_not_staged`` /
+``device_handoffs_served`` / ``host_staged_fallbacks``): the task
+runtime snapshots around each task and merges the delta into
+``io_metrics.json``, so the avoided h2d traffic is observable per task
+(docs/PERFORMANCE.md "Device-resident data plane").  ``d2h_bytes`` and
+``device_handoffs_served`` are *recorded* here but *bumped* by the
+executor's d2h copies and the handoff registry's device rung
+(:mod:`~cluster_tools_tpu.runtime.handoff`) — one counter plane for the
+whole device-resident data path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .block_pool import RaggedArgSpec, RaggedBatch, _quantize_pages
+
+#: default resident-pool byte budget per process when neither the task
+#: knob nor ``CTT_DEVICE_POOL_BYTES`` says otherwise: big enough for the
+#: chunk-scale page working set of a sweep, small next to device memory.
+DEFAULT_POOL_BYTES = 256 << 20
+
+#: counter names, fixed so snapshots/deltas stay schema-stable
+STAT_KEYS = (
+    "h2d_bytes",
+    "d2h_bytes",
+    "device_pool_hits",
+    "device_pool_misses",
+    "device_pool_evictions",
+    "device_batches_staged",
+    "host_staged_fallbacks",
+    "bytes_not_staged",
+    "device_handoffs_served",
+)
+
+_METRICS_LOCK = threading.Lock()
+_COUNTERS: Dict[str, float] = {k: 0 for k in STAT_KEYS}
+
+
+def snapshot() -> Dict[str, float]:
+    """Current process-wide device-plane counters (monotonic; diff two
+    snapshots with :func:`delta` to attribute a task's share)."""
+    with _METRICS_LOCK:
+        return dict(_COUNTERS)
+
+
+def delta(snap: Dict[str, float]) -> Dict[str, float]:
+    cur = snapshot()
+    return {k: cur[k] - snap.get(k, 0) for k in cur}
+
+
+def bump(key: str, n: float = 1) -> None:
+    with _METRICS_LOCK:
+        _COUNTERS[key] += n
+
+
+def record_h2d(nbytes: int) -> None:
+    """Attribute ``nbytes`` of host->device traffic (every ``device_put``
+    on the executor's dispatch paths reports here)."""
+    bump("h2d_bytes", int(nbytes))
+
+
+def record_d2h(nbytes: int) -> None:
+    """Attribute ``nbytes`` of device->host traffic (the executor's
+    output copies and the handoff registry's device->memory demotions)."""
+    bump("d2h_bytes", int(nbytes))
+
+
+def device_pool_enabled() -> bool:
+    """Process-level kill switch for the WHOLE device-resident data plane
+    (resident page pool AND device handoffs): ``CTT_DEVICE_POOL=0``.
+    Tasks additionally gate on their ``device_pool`` /
+    ``device_handoffs`` config knobs."""
+    return os.environ.get("CTT_DEVICE_POOL", "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def device_pool_budget(explicit: Optional[int] = None) -> int:
+    """Byte budget for resident device allocations: the task's
+    ``device_pool_bytes`` knob when given, else ``CTT_DEVICE_POOL_BYTES``,
+    else :data:`DEFAULT_POOL_BYTES`."""
+    if explicit is not None:
+        return max(0, int(explicit))
+    env = os.environ.get("CTT_DEVICE_POOL_BYTES")
+    if env:
+        return max(0, int(env))
+    return DEFAULT_POOL_BYTES
+
+
+class DevicePoolExhausted(Exception):
+    """The resident pool cannot hold a batch even after evicting
+    everything (budget too small, or device RESOURCE_EXHAUSTED persisted
+    through the evict+retry rung).  Deliberately NOT a MemoryError: the
+    executor must catch it as the typed "fall back to host staging"
+    signal, never quarantine blocks over it."""
+
+
+def _content_key(page: np.ndarray) -> int:
+    # content addressing: identical bytes share one resident slot, which
+    # is what makes the fill page, repeated pages, and warm re-sweeps
+    # free.  crc32 collisions would alias two pages; at chunk-scale page
+    # counts (thousands per sweep) the 2^-32 rate is accepted — the same
+    # digest the PR-3 integrity sidecars stand on.
+    return zlib.crc32(np.ascontiguousarray(page).tobytes())
+
+
+def _quantize_count(n: int) -> int:
+    """Round an upload width up to a power of two (>= 1): the scatter
+    update is jitted per width, so unquantized widths would compile one
+    executable per distinct miss count."""
+    cap = 1
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+class StagedBatch:
+    """A ragged batch staged against the resident device pools: the same
+    descriptor surface as :class:`~cluster_tools_tpu.parallel.block_pool.
+    RaggedBatch` (specs / width / tables / valids / ``key()``), but the
+    pools are live jax arrays in HBM and the specs carry the arena
+    capacities — the compiled program gathers straight from the resident
+    allocation."""
+
+    def __init__(self, specs, pools, tables, valids, width, staged_bytes,
+                 reused_bytes):
+        self.specs: Tuple[RaggedArgSpec, ...] = tuple(specs)
+        self.pools = pools            # jax arrays, device-resident
+        self.tables: List[np.ndarray] = tables
+        self.valids: List[np.ndarray] = valids
+        self.width = int(width)
+        self.staged_bytes = int(staged_bytes)   # h2d paid for this batch
+        self.reused_bytes = int(reused_bytes)   # h2d avoided (hits)
+
+    def key(self) -> tuple:
+        return (self.width, self.specs)
+
+    def flat_inputs(self):
+        """``(replicated, sharded)`` like RaggedBatch.flat_inputs, except
+        the replicated pools are ALREADY on device — the caller only
+        device_puts the (tiny) tables and valid extents."""
+        sharded: List[np.ndarray] = []
+        for t, v in zip(self.tables, self.valids):
+            sharded.extend((t, v))
+        return list(self.pools), sharded
+
+
+class _DeviceArena:
+    """One persistent device buffer per ``(page_shape, dtype)`` class:
+    ``[capacity, *page_shape]`` replicated over the mesh, slots assigned
+    to page contents under an LRU.  Updates are functional
+    (``pool.at[slots].set(staged)``) — a previously staged batch keeps
+    its own (immutable) pool version, so eviction can never corrupt an
+    in-flight dispatch.  Staging is serialized per arena: the slot table
+    and the current pool version must advance atomically, or a second
+    thread could observe its content registered as a hit before the
+    first thread's scatter produced the version holding those bytes
+    (the slot would read as zeros in the version it captured)."""
+
+    def __init__(self, page_shape, dtype, capacity, replicated):
+        import jax
+        import jax.numpy as jnp
+
+        self.page_shape = tuple(int(p) for p in page_shape)
+        self.dtype = np.dtype(dtype)
+        self.capacity = int(capacity)
+        self.page_nbytes = int(
+            np.prod(self.page_shape, dtype=np.int64)
+        ) * self.dtype.itemsize
+        self.pool = jax.device_put(
+            jnp.zeros((self.capacity,) + self.page_shape, self.dtype),
+            replicated,
+        )
+        self._replicated = replicated
+        self._lock = threading.Lock()
+        # content crc -> slot, in LRU order (oldest first)
+        self.slots: "OrderedDict[int, int]" = OrderedDict()
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._update = jax.jit(
+            lambda pool, idx, pages: pool.at[idx].set(pages),
+            donate_argnums=(),
+        )
+
+    @property
+    def nbytes(self) -> int:
+        return self.capacity * self.page_nbytes
+
+    def _take_slot(self) -> int:
+        if self._free:
+            return self._free.pop()
+        # LRU eviction: the oldest content loses its slot.  Purely a
+        # mapping change — the resident bytes are overwritten by the next
+        # scatter, and older pool versions held by in-flight batches are
+        # immutable.
+        _, slot = self.slots.popitem(last=False)
+        bump("device_pool_evictions")
+        return slot
+
+    def stage_pages(self, host_pool: np.ndarray, n_used: int):
+        """Map host slots ``[0, n_used)`` to resident slots, uploading
+        only contents the arena does not hold.  Returns the
+        ``host_slot -> device_slot`` mapping array and the pool version
+        that holds every mapped slot's bytes (the pair is atomic — a
+        caller must dispatch against exactly this version)."""
+        import jax
+
+        with self._lock:
+            return self._stage_pages_locked(host_pool, n_used, jax)
+
+    def _stage_pages_locked(self, host_pool: np.ndarray, n_used: int, jax):
+        mapping = np.zeros(n_used, np.int32)
+        miss_slots: List[int] = []
+        miss_pages: List[np.ndarray] = []
+        for s in range(n_used):
+            key = _content_key(host_pool[s])
+            slot = self.slots.get(key)
+            if slot is not None:
+                self.slots.move_to_end(key)
+                bump("device_pool_hits")
+                bump("bytes_not_staged", self.page_nbytes)
+            else:
+                slot = self._take_slot()
+                self.slots[key] = slot
+                bump("device_pool_misses")
+                miss_slots.append(slot)
+                miss_pages.append(host_pool[s])
+            mapping[s] = slot
+        if miss_slots:
+            # quantize the upload width (compile-population bound): the
+            # pad repeats the last (slot, page) pair — same slot, same
+            # bytes, a benign duplicate write
+            width = _quantize_count(len(miss_slots))
+            while len(miss_slots) < width:
+                miss_slots.append(miss_slots[-1])
+                miss_pages.append(miss_pages[-1])
+            stacked = np.stack(miss_pages)
+            record_h2d(stacked.nbytes)
+            staged = jax.device_put(stacked, self._replicated)
+            idx = jax.device_put(
+                np.asarray(miss_slots, np.int32), self._replicated
+            )
+            self.pool = self._update(self.pool, idx, staged)
+        return mapping, self.pool
+
+
+class DevicePagePool:
+    """Process-wide manager of the resident arenas, one per ``(device
+    set, page_shape, dtype)`` class, under one byte budget.  Thread-safe
+    end to end: arena lookup/growth serializes here, page staging
+    serializes per arena — concurrent executors (the server's worker
+    pool) share the resident pages safely."""
+
+    def __init__(self, budget: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._arenas: "OrderedDict[tuple, _DeviceArena]" = OrderedDict()
+        self._budget = device_pool_budget(budget)
+
+    def evict_all(self) -> None:
+        """Drop every arena (the degrade ladder's evict rung, and the
+        test hook): resident bytes are released as soon as no in-flight
+        batch references the pool versions."""
+        with self._lock:
+            self._arenas.clear()
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(a.nbytes for a in self._arenas.values())
+
+    def _arena_for(self, spec: RaggedArgSpec, need_pages: int,
+                   dev_key, replicated) -> _DeviceArena:
+        akey = (dev_key, spec.page_shape, spec.dtype)
+        page_nbytes = int(
+            np.prod(spec.page_shape, dtype=np.int64)
+        ) * np.dtype(spec.dtype).itemsize
+        cap_budget = self._budget // max(1, page_nbytes)
+        if need_pages > cap_budget:
+            raise DevicePoolExhausted(
+                f"batch needs {need_pages} pages of {page_nbytes} B but "
+                f"the device pool budget ({self._budget} B) holds at most "
+                f"{cap_budget}"
+            )
+        with self._lock:
+            arena = self._arenas.get(akey)
+            if arena is not None and arena.capacity >= need_pages:
+                self._arenas.move_to_end(akey)
+                return arena
+            # grow = a fresh arena at the next quantized capacity (the
+            # pool's leading dim is a compile key, so growth is a planned
+            # recompile, not a per-batch one); old mappings die with it
+            capacity = min(_quantize_pages(need_pages), cap_budget)
+            arena = _DeviceArena(
+                spec.page_shape, spec.dtype, capacity, replicated
+            )
+            self._arenas[akey] = arena
+            # budget across arenas: evict oldest whole arenas until the
+            # resident total fits (never the one just built)
+            while (
+                sum(a.nbytes for a in self._arenas.values()) > self._budget
+                and len(self._arenas) > 1
+            ):
+                self._arenas.popitem(last=False)
+                bump("device_pool_evictions")
+            return arena
+
+    def _stage(self, rb: RaggedBatch, dev_key, replicated) -> StagedBatch:
+        specs: List[RaggedArgSpec] = []
+        pools = []
+        tables: List[np.ndarray] = []
+        staged0 = snapshot()
+        for spec, pool, table in zip(rb.specs, rb.pools, rb.tables):
+            n_used = int(table.max()) + 1
+            arena = self._arena_for(spec, n_used, dev_key, replicated)
+            mapping, pool_version = arena.stage_pages(pool, n_used)
+            specs.append(spec._replace(pool_pages=arena.capacity))
+            pools.append(pool_version)
+            tables.append(mapping[table])
+        moved = delta(staged0)
+        bump("device_batches_staged")
+        return StagedBatch(
+            specs, pools, tables, list(rb.valids), rb.width,
+            staged_bytes=int(moved["h2d_bytes"]),
+            reused_bytes=int(moved["bytes_not_staged"]),
+        )
+
+    def stage(self, rb: RaggedBatch, dev_key, replicated,
+              block_id: Optional[int] = None) -> StagedBatch:
+        """Stage ``rb`` against the resident arenas; the PR-4 ladder on
+        RESOURCE_EXHAUSTED: evict every arena, retry once at full size,
+        then raise :class:`DevicePoolExhausted` so the executor falls
+        back to per-batch host staging (``degraded:host_staged``)."""
+        from ..runtime import faults as faults_mod
+        from ..runtime.executor import classify_resource_error
+
+        injector = faults_mod.get_injector()
+        for attempt in (0, 1):
+            try:
+                # "h2d" fault site: an injected RESOURCE_EXHAUSTED at
+                # page upload models the resident allocation not fitting
+                injector.maybe_fail("h2d", block_id, voxels=rb.nbytes)
+                return self._stage(rb, dev_key, replicated)
+            except DevicePoolExhausted:
+                raise
+            except Exception as e:
+                if classify_resource_error(e) is None:
+                    raise
+                self.evict_all()
+                if attempt:
+                    raise DevicePoolExhausted(
+                        f"device pool RESOURCE_EXHAUSTED persisted after "
+                        f"evicting all resident arenas: {e}"
+                    ) from e
+
+
+_pool: Optional[DevicePagePool] = None
+_pool_lock = threading.Lock()
+
+
+def get_device_pool(budget: Optional[int] = None) -> DevicePagePool:
+    """The process-wide resident pool (created on first use).  An
+    explicit ``budget`` (the task's ``device_pool_bytes`` knob) re-scopes
+    the budget for subsequent staging — the arenas themselves persist,
+    which is the point."""
+    global _pool
+    if _pool is None:
+        with _pool_lock:
+            if _pool is None:
+                _pool = DevicePagePool(budget)
+    if budget is not None:
+        _pool._budget = device_pool_budget(budget)
+    return _pool
+
+
+def reset() -> None:
+    """Drop the resident pool and its arenas (tests)."""
+    global _pool
+    with _pool_lock:
+        _pool = None

@@ -128,6 +128,7 @@ from ..parallel.batch_shard import (
     use_sharded_sweep,
 )
 from ..parallel import block_pool as block_pool_mod
+from ..parallel import device_pool as device_pool_mod
 
 
 # -- process-wide dispatch metrics -------------------------------------------
@@ -741,6 +742,8 @@ class BlockwiseExecutor:
         sharded_batch: Optional[int] = None,
         ragged: str = "auto",
         page_shape: Optional[Sequence[int]] = None,
+        device_pool: str = "auto",
+        device_pool_bytes: Optional[int] = None,
     ) -> Dict[str, int]:
         """Execute ``kernel`` over ``blocks``; see class docstring.
 
@@ -831,6 +834,25 @@ class BlockwiseExecutor:
         ``pages_in_use`` in io_metrics.json) and on the trace timeline
         (``executor.dispatch`` spans with ``grain="ragged"``).
 
+        ``device_pool`` — HBM-resident staging of ragged batches
+        (docs/PERFORMANCE.md "Device-resident data plane"): ``"auto"``
+        (default) stages ragged batches through the persistent
+        content-addressed device page pool
+        (:mod:`~cluster_tools_tpu.parallel.device_pool`) when the ragged
+        path is active and ``CTT_DEVICE_POOL`` is not 0 — pages whose
+        bytes are already resident cost zero h2d traffic; ``"off"``
+        restores the per-batch ``device_put`` staging.  A staging
+        RESOURCE_EXHAUSTED rides the degrade ladder (evict the resident
+        arenas, retry, then per-batch host staging for that batch,
+        attributed ``resolution="degraded:host_staged"`` once per sweep)
+        — bit-identical either way.  ``device_pool_bytes`` caps the
+        resident allocation (None: ``CTT_DEVICE_POOL_BYTES``, default
+        256 MiB).  Traffic is attributed in the device-plane counters
+        (``h2d_bytes`` / ``d2h_bytes`` / ``device_pool_hits`` /
+        ``bytes_not_staged`` in io_metrics.json) and host-staged uploads
+        on the timeline (``executor.h2d`` spans — absent on the
+        resident-pool happy path).
+
         Raises RuntimeError naming every block that stays failed after the
         end-of-run quarantine pass, and
         :class:`~cluster_tools_tpu.runtime.supervision.DrainInterrupt`
@@ -863,6 +885,20 @@ class BlockwiseExecutor:
         use_ragged = use_sharded and ragged != "off"
         ragged_pool = (
             block_pool_mod.PagedBlockPool() if use_ragged else None
+        )
+        if device_pool not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown device_pool mode {device_pool!r} "
+                "(expected 'auto', 'on' or 'off')"
+            )
+        # the resident HBM pool rides the ragged path (its page tables are
+        # the re-addressing mechanism); the process kill switch wins over
+        # any per-call mode
+        dev_pool = (
+            device_pool_mod.get_device_pool(device_pool_bytes)
+            if use_ragged and device_pool != "off"
+            and device_pool_mod.device_pool_enabled()
+            else None
         )
         if page_shape is not None:
             page_shape = tuple(int(p) for p in page_shape)
@@ -1037,6 +1073,7 @@ class BlockwiseExecutor:
             tree as numpy arrays."""
             kern, width = _per_block_kernel()
             stacked = tuple(np.stack([x] * width) for x in val)
+            device_pool_mod.record_h2d(sum(int(a.nbytes) for a in stacked))
             stacked = tuple(jax.device_put(a, sharding) for a in stacked)
             # span starts AFTER the lock is held — same grain semantics as
             # the sharded path, so executor.dispatch never bills another
@@ -1046,7 +1083,91 @@ class BlockwiseExecutor:
                                     task=task_name, grain="per_block"):
                     out = kern(*stacked)
             _note_dispatch(1)
-            return jax.tree_util.tree_map(lambda a: np.asarray(a)[0], out)
+            out_np = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], out)
+            device_pool_mod.record_d2h(sum(
+                int(a.nbytes) for a in jax.tree_util.tree_leaves(out_np)
+            ))
+            return out_np
+
+        # one degraded:host_staged record per sweep (the counter still
+        # ticks per fallen-back batch): pool exhaustion is a sweep-level
+        # condition, not a per-block fault
+        device_fallback = {"recorded": False}
+
+        def _stage_ragged_inputs(rb, block_id):
+            """Device inputs + compiled program for one ragged batch.
+            With the resident pool on, pages already in HBM are re-
+            addressed instead of re-uploaded (the device-resident data
+            plane); pool exhaustion — after its internal evict+retry rung
+            — falls THIS batch back to per-batch host staging, attributed
+            ``degraded:host_staged``.  Bit-identical either way: the same
+            page bytes reach the same descriptor-driven program."""
+            if dev_pool is not None:
+                try:
+                    sb = dev_pool.stage(
+                        rb, dev_key, replicated, block_id=block_id
+                    )
+                except device_pool_mod.DevicePoolExhausted as e:
+                    device_pool_mod.bump("host_staged_fallbacks")
+                    trace_mod.instant(
+                        "degraded:host_staged", task=task_name,
+                        block=int(block_id),
+                    )
+                    if not device_fallback["recorded"] and failures_path:
+                        device_fallback["recorded"] = True
+                        try:
+                            fu.record_failures(
+                                failures_path,
+                                f"{task_name}.device_pool",
+                                [{
+                                    "block_id": None,
+                                    "sites": {"h2d": 1},
+                                    "error": fu.cap_traceback(str(e)),
+                                    "quarantined": False,
+                                    "resolved": True,
+                                    "resolution": "degraded:host_staged",
+                                }],
+                            )
+                        except Exception:
+                            pass
+                else:
+                    rep, shd = sb.flat_inputs()
+                    # the pools are already resident; only the (tiny)
+                    # remapped tables + valid extents cross the host bus
+                    device_pool_mod.record_h2d(
+                        sum(int(a.nbytes) for a in shd)
+                    )
+                    dev_inputs = tuple(rep) + tuple(
+                        jax.device_put(a, sharding) for a in shd
+                    )
+                    prog = cached_program(
+                        ("ragged", dev_key) + sb.key(),
+                        lambda sb=sb: ragged_shard_map(
+                            kernel, self.mesh, sb.width, sb.specs
+                        ),
+                    )
+                    return dev_inputs, prog
+            # host staging: the per-batch device_put of pools + tables
+            # (the pre-pool path, and the ladder's fallback rung) — a
+            # REAL h2d transfer, visible on the timeline
+            rep, shd = rb.flat_inputs()
+            with trace_mod.span(
+                "executor.h2d", task=task_name, nbytes=int(rb.nbytes),
+                grain="ragged",
+            ):
+                dev_inputs = tuple(
+                    jax.device_put(a, replicated) for a in rep
+                ) + tuple(
+                    jax.device_put(a, sharding) for a in shd
+                )
+            device_pool_mod.record_h2d(rb.nbytes)
+            prog = cached_program(
+                ("ragged", dev_key) + rb.key(),
+                lambda rb=rb: ragged_shard_map(
+                    kernel, self.mesh, rb.width, rb.specs
+                ),
+            )
+            return dev_inputs, prog
 
         spec_pool: Optional[ThreadPoolExecutor] = None
         spec_futures: List[Future] = []
@@ -1676,22 +1797,18 @@ class BlockwiseExecutor:
                         batch_bytes = sum(int(a.nbytes) for a in arrays)
                     _admit(batch_bytes, write_futures)
                     if rb is not None:
-                        rep, shd = rb.flat_inputs()
-                        dev_inputs = tuple(
-                            jax.device_put(a, replicated) for a in rep
-                        ) + tuple(
-                            jax.device_put(a, sharding) for a in shd
-                        )
-                        prog = cached_program(
-                            ("ragged", dev_key) + rb.key(),
-                            lambda rb=rb: ragged_shard_map(
-                                kernel, self.mesh, rb.width, rb.specs
-                            ),
+                        dev_inputs, prog = _stage_ragged_inputs(
+                            rb, batch[0].block_id
                         )
                     else:
-                        dev_inputs = tuple(
-                            jax.device_put(a, sharding) for a in arrays
-                        )
+                        with trace_mod.span(
+                            "executor.h2d", task=task_name,
+                            nbytes=int(batch_bytes), grain="dense",
+                        ):
+                            dev_inputs = tuple(
+                                jax.device_put(a, sharding) for a in arrays
+                            )
+                        device_pool_mod.record_h2d(batch_bytes)
                         prog = batched_kernel
                     try:
                         if use_sharded:
@@ -1781,6 +1898,10 @@ class BlockwiseExecutor:
                                 for blk in batch:
                                     stack.enter_context(_watched(blk, "compute"))
                                 out_np = jax.tree_util.tree_map(np.asarray, out)
+                            device_pool_mod.record_d2h(sum(
+                                int(a.nbytes)
+                                for a in jax.tree_util.tree_leaves(out_np)
+                            ))
                             if rb is not None:
                                 # the execution is complete once the copy
                                 # above lands: the pool's host buffers are
@@ -1853,10 +1974,18 @@ class BlockwiseExecutor:
                 sub_jit = cached_program(("sub",), lambda: jax.jit(kernel))
 
                 def _sub_exec(val):
+                    device_pool_mod.record_h2d(
+                        sum(int(np.asarray(x).nbytes) for x in val)
+                    )
                     with dispatch_lock:
                         out = sub_jit(*val)
                     _note_dispatch(1)
-                    return jax.tree_util.tree_map(np.asarray, out)
+                    out_np = jax.tree_util.tree_map(np.asarray, out)
+                    device_pool_mod.record_d2h(sum(
+                        int(a.nbytes)
+                        for a in jax.tree_util.tree_leaves(out_np)
+                    ))
+                    return out_np
 
                 split_stats = {"splits": 0, "max_depth": 0, "sub_blocks": 0}
 
@@ -2010,17 +2139,12 @@ class BlockwiseExecutor:
                                 [val for _, val in chunk], width,
                                 page_shape=page_shape,
                             )
-                            prog = cached_program(
-                                ("ragged", dev_key) + rb.key(),
-                                lambda rb=rb: ragged_shard_map(
-                                    kernel, self.mesh, rb.width, rb.specs
-                                ),
-                            )
-                            rep, shd = rb.flat_inputs()
-                            dev_inputs = tuple(
-                                jax.device_put(a, replicated) for a in rep
-                            ) + tuple(
-                                jax.device_put(a, sharding) for a in shd
+                            # split sub-blocks stage through the resident
+                            # pool too (half-size pages of a split parent
+                            # are fresh content, but the fill page and
+                            # repeated retries hit)
+                            dev_inputs, prog = _stage_ragged_inputs(
+                                rb, chunk[0][0].block_id
                             )
                             injector.maybe_fail(
                                 "dispatch", chunk[0][0].block_id,
@@ -2040,6 +2164,10 @@ class BlockwiseExecutor:
                                 ):
                                     out = prog(*dev_inputs)
                             out_np = jax.tree_util.tree_map(np.asarray, out)
+                            device_pool_mod.record_d2h(sum(
+                                int(a.nbytes)
+                                for a in jax.tree_util.tree_leaves(out_np)
+                            ))
                             rb.release()
                             _note_dispatch(len(chunk), rb)
                         except Exception:
@@ -2239,6 +2367,9 @@ class BlockwiseExecutor:
             summary["n_ragged_batches"] = dispatch_stats["ragged_batches"]
             summary["n_lanes_padded"] = dispatch_stats["lanes_padded"]
             summary["pages_in_use"] = dispatch_stats["pages_in_use"]
+        if dev_pool is not None:
+            summary["device_pool"] = "on"
+            summary["device_pool_resident_bytes"] = dev_pool.resident_bytes()
         if deadline > 0:
             summary["n_hung"] = sum(
                 1 for rec in failures.values() if "hung" in rec["sites"]

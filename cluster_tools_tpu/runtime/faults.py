@@ -208,7 +208,15 @@ _HANG_SITES = ("load", "store", "io_read", "io_write", "dispatch")
 #: region's digest sidecar instead — the missing-sidecar-policy drill.
 _CORRUPT_SITES = ("io_write", "io_read")
 _CORRUPT_MODES = ("flip", "sidecar")
-_OOM_SITES = ("load", "store", "io_read", "io_write", "compute", "dispatch")
+#: "h2d" is the device-pool staging site (parallel/device_pool.py): an oom
+#: there models the resident HBM page pool failing to hold a batch's pages
+#: — the stage must ride the degrade ladder (evict + retry, then per-batch
+#: host staging, resolution "degraded:host_staged").  "publish" doubles as
+#: an oom site for the DEVICE handoff rung (runtime/handoff.py): an oom at
+#: a device-array publish must fall the payload back to the host memory
+#: rung with the same attribution, bit-identically.
+_OOM_SITES = ("load", "store", "io_read", "io_write", "compute", "dispatch",
+              "h2d", "publish")
 _ENOSPC_SITES = ("store", "io_write")
 #: "publish" is the handoff-layer site (runtime/handoff.py): the moment a
 #: task declares an in-memory target for a dataset or artifact.  A spill

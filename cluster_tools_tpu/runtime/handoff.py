@@ -44,6 +44,26 @@ config knob (default off) is what call sites gate on.  Counters
 chunk-cache snapshot/delta pattern: the task runtime snapshots around each
 task and merges the delta into ``io_metrics.json``, rendered by
 ``scripts/failures_report.py``.
+
+**The device rung** (docs/PERFORMANCE.md "Device-resident data plane"):
+above the memory rung sits ``kind="device_arrays"`` — the payload is a
+dict of live *jax* arrays, so a fused consumer resolves its producer's
+output without even the host copy (:func:`publish_device_arrays` /
+:func:`resolve_device_arrays`; the per-task ``device_handoffs`` knob and
+the ``CTT_DEVICE_POOL=0`` kill switch gate it).  The ladder reads device
+-> memory -> storage: device-budget pressure (the shared
+``device_pool_bytes`` / ``CTT_DEVICE_POOL_BYTES`` envelope) demotes the
+oldest device entries to the memory rung (one d2h copy, counted
+``d2h_bytes``), a host-side consumer demotes on resolve, and an injected
+RESOURCE_EXHAUSTED at site ``publish`` falls the publish itself back to
+the memory rung, attributed ``resolution="degraded:host_staged"``.  CRC32
+digests are computed at the demotion boundary — the FIRST point the bytes
+materialize on host — and verified when the entry later spills to
+storage, so the device rung keeps the PR-3 integrity contract without
+ever checksumming device memory.  Device entries are excluded from
+:func:`live_bytes` / :func:`spill_for_headroom` (they hold HBM, not host
+RAM — demoting them under *host* pressure would make that pressure
+worse); ``device_live_bytes`` tracks their footprint separately.
 """
 
 from __future__ import annotations
@@ -73,6 +93,9 @@ STAT_KEYS = (
     "handoff_fallbacks",
     "bytes_not_stored",
     "bytes_spilled",
+    "device_handoffs_published",
+    "device_handoffs_served",
+    "device_handoffs_demoted",
 )
 
 
@@ -159,12 +182,12 @@ class _Entry:
     __slots__ = (
         "kind", "identity", "path", "key", "obj", "nbytes", "complete",
         "spilled", "spilling", "spill_reason", "producer", "failures_path",
-        "recorded",
+        "recorded", "device_crcs",
     )
 
     def __init__(self, kind, identity, path, key, obj, nbytes, producer,
                  failures_path):
-        self.kind = kind                # "dataset" | "arrays"
+        self.kind = kind                # "dataset" | "arrays" | "device_arrays"
         self.identity = identity
         self.path = path
         self.key = key
@@ -177,6 +200,9 @@ class _Entry:
         self.producer = producer
         self.failures_path = failures_path
         self.recorded = False           # degraded:spilled written once
+        # per-array CRC32s stamped when a device entry's bytes FIRST
+        # materialize on host (demotion); verified at the storage spill
+        self.device_crcs: Optional[Dict[str, int]] = None
 
 
 class HandoffRegistry:
@@ -198,10 +224,24 @@ class HandoffRegistry:
 
     # -- bookkeeping -------------------------------------------------------
     def live_bytes(self) -> int:
-        """Bytes of payloads currently resident in host RAM."""
+        """Bytes of payloads currently resident in host RAM.  Device-rung
+        entries are HBM, not host RAM — they count in
+        :meth:`device_live_bytes` instead (and demoting one under host
+        pressure would *add* host bytes, so they must not look like
+        reclaimable headroom here)."""
         with self._lock:
             return sum(
-                e.nbytes for e in self._entries.values() if not e.spilled
+                e.nbytes for e in self._entries.values()
+                if not e.spilled and e.kind != "device_arrays"
+            )
+
+    def device_live_bytes(self) -> int:
+        """Bytes of device-rung payloads currently resident in HBM."""
+        with self._lock:
+            return sum(
+                e.nbytes for e in self._entries.values()
+                if e.kind == "device_arrays" and not e.spilled
+                and e.obj is not None
             )
 
     def claim_spill(self, entry: _Entry) -> bool:
@@ -251,6 +291,7 @@ class HandoffRegistry:
         trace_mod.instant(
             "handoff.publish", identity=entry.identity,
             nbytes=int(entry.nbytes), spilled=bool(entry.spilled),
+            kind=entry.kind,
         )
 
     def entries_of(self, producer: str) -> List[_Entry]:
@@ -261,11 +302,24 @@ class HandoffRegistry:
 
     def spill_candidates(self) -> List[_Entry]:
         """Complete, still-resident, unclaimed entries, oldest first (the
-        LRU order a headroom spill should flush)."""
+        LRU order a headroom spill should flush).  Device-rung entries are
+        excluded: spilling exists to free host RAM, and a device entry
+        holds none until demoted (see :meth:`live_bytes`)."""
         with self._lock:
             return [
                 e for e in self._entries.values()
                 if e.complete and not e.spilled and not e.spilling
+                and e.kind != "device_arrays"
+            ]
+
+    def demotion_candidates(self) -> List[_Entry]:
+        """Live device-rung entries, oldest first — the order
+        device-budget pressure walks when demoting to the memory rung."""
+        with self._lock:
+            return [
+                e for e in self._entries.values()
+                if e.kind == "device_arrays" and e.complete
+                and not e.spilled and not e.spilling and e.obj is not None
             ]
 
 
@@ -302,6 +356,11 @@ def delta(snap: Dict[str, float]) -> Dict[str, float]:
 
 def live_bytes() -> int:
     return get_registry().live_bytes()
+
+
+def device_live_bytes() -> int:
+    """HBM bytes held by live device-rung handoffs."""
+    return get_registry().device_live_bytes()
 
 
 def live_entries() -> int:
@@ -571,9 +630,31 @@ def _is_npy(path: str) -> bool:
     return path.endswith(".npy")
 
 
-def _write_artifact(path: str, arrays: Dict[str, np.ndarray]) -> None:
+def _write_artifact(
+    path: str,
+    arrays: Dict[str, np.ndarray],
+    expected_crcs: Optional[Dict[str, int]] = None,
+) -> None:
     """Spill one artifact: atomic npz/npy write + a CRC32 sidecar, so a
-    fallback load can verify the stored bytes like any chunk read."""
+    fallback load can verify the stored bytes like any chunk read.
+
+    ``expected_crcs`` (device-rung entries only) are the digests stamped
+    when the payload first materialized on host — a mismatch here means
+    the host copy rotted between demotion and spill, and the spill must
+    fail loudly (the memory copy stays the only copy) rather than
+    checksum-bless corrupt bytes."""
+    crcs = {
+        name: zlib.crc32(np.ascontiguousarray(a).tobytes())
+        for name, a in arrays.items()
+    }
+    if expected_crcs:
+        from ..io.containers import ChunkCorruptionError
+
+        for name, want in expected_crcs.items():
+            if name in crcs and crcs[name] != want:
+                raise ChunkCorruptionError(
+                    f"{path}[{name}]", (), want, crcs[name]
+                )
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -587,13 +668,7 @@ def _write_artifact(path: str, arrays: Dict[str, np.ndarray]) -> None:
     os.replace(tmp, path)
     fu.atomic_write_json(
         _crc_sidecar_path(path),
-        {
-            "algo": "crc32",
-            "arrays": {
-                name: zlib.crc32(np.ascontiguousarray(a).tobytes())
-                for name, a in arrays.items()
-            },
-        },
+        {"algo": "crc32", "arrays": crcs},
     )
 
 
@@ -653,6 +728,205 @@ def publish_arrays(
     return entry
 
 
+# -- the device rung ----------------------------------------------------------
+
+
+def _record_host_staged(producer, failures_path, identity, reason,
+                        err=None) -> None:
+    """One ``degraded:host_staged`` failures.json record per fallen-back
+    device publish — the device rung's attribution contract (the task key
+    is ``<producer>.device_handoff`` so it can never merge-collide with
+    the memory rung's ``<producer>.handoff`` spill records)."""
+    if not failures_path:
+        return
+    try:
+        fu.record_failures(
+            failures_path,
+            f"{producer}.device_handoff",
+            [{
+                "block_id": None,
+                "sites": {"publish": 1},
+                "error": None if err is None else fu.cap_traceback(str(err)),
+                "quarantined": False,
+                "resolved": True,
+                "resolution": "degraded:host_staged",
+                "handoff": identity,
+                "reason": reason,
+            }],
+        )
+    except Exception:
+        pass  # attribution is best-effort; the fallback itself landed
+
+
+def publish_device_arrays(
+    path: str,
+    arrays: Dict[str, Any],
+    producer: str,
+    failures_path: Optional[str] = None,
+) -> _Entry:
+    """Producer-side publish on the DEVICE rung: ``arrays`` (jax arrays —
+    typically still resident from the producing computation — or host
+    arrays uploaded here, counted ``h2d_bytes``) stay live in HBM under
+    the artifact identity, so a fused consumer's
+    :func:`resolve_device_arrays` serves them with ZERO host bytes.
+
+    The ladder down: kill switch (``CTT_DEVICE_POOL=0``) off -> the memory
+    rung verbatim; a resource failure (an injected oom at site
+    ``publish``, a real RESOURCE_EXHAUSTED while uploading, or the shared
+    device byte budget rejecting even after demoting elder entries) ->
+    one d2h copy + the memory rung, attributed
+    ``resolution="degraded:host_staged"`` — consumers keep resolving
+    bit-identically either way."""
+    from ..parallel import device_pool as device_pool_mod
+
+    def _host(reason, err=None):
+        host = {}
+        for name, a in arrays.items():
+            h = np.asarray(a)
+            if not isinstance(a, np.ndarray):
+                # the payload was device-resident: falling back is a real
+                # d2h copy, attributed like any other
+                device_pool_mod.record_d2h(h.nbytes)
+            host[name] = h
+        entry = publish_arrays(path, host, producer, failures_path)
+        if reason is not None:
+            device_pool_mod.bump("host_staged_fallbacks")
+            _record_host_staged(producer, failures_path, entry.identity,
+                                reason, err=err)
+        return entry
+
+    if not device_pool_mod.device_pool_enabled():
+        return _host(None)
+
+    from . import faults as faults_mod
+    from .executor import classify_resource_error
+
+    reg = get_registry()
+    identity = artifact_identity(path)
+    try:
+        faults_mod.get_injector().maybe_fail("publish", None)
+        import jax
+
+        held: Dict[str, Any] = {}
+        nbytes = 0
+        for name, a in arrays.items():
+            if not isinstance(a, jax.Array):
+                a = np.asarray(a)
+                device_pool_mod.record_h2d(a.nbytes)
+                a = jax.device_put(a)
+            held[name] = a
+            nbytes += int(a.nbytes)
+        # device-budget admission (the HBM envelope shared with the page
+        # pool): demote the oldest device entries first, and if the new
+        # payload still does not fit, ride the resource ladder below
+        budget = device_pool_mod.device_pool_budget()
+        if device_live_bytes() + nbytes > budget:
+            demote_for_device_headroom(need_bytes=nbytes)
+        if device_live_bytes() + nbytes > budget:
+            raise MemoryError(
+                f"device handoff budget RESOURCE_EXHAUSTED: {nbytes} B "
+                f"payload over the {budget} B device envelope"
+            )
+    except Exception as e:
+        if classify_resource_error(e) is None:
+            raise
+        return _host("oom", err=e)
+    entry = _Entry("device_arrays", identity, path, None, held, nbytes,
+                   producer, failures_path)
+    entry.complete = True
+    reg.put(entry)
+    reg.bump("handoffs_published")
+    reg.bump("device_handoffs_published")
+    reg.bump("bytes_not_stored", nbytes)
+    return entry
+
+
+def resolve_device_arrays(path: str) -> Dict[str, Any]:
+    """Consumer-side resolve on the device rung: the live jax arrays when
+    the device entry is live (zero host bytes — counted
+    ``device_handoffs_served`` and, in the device-plane counters,
+    ``bytes_not_staged``), else the memory/storage rungs via
+    :func:`load_arrays` (host arrays the consumer may re-upload)."""
+    from . import trace as trace_mod
+    from ..parallel import device_pool as device_pool_mod
+
+    reg = get_registry()
+    entry = reg.get(artifact_identity(path))
+    if entry is not None and entry.kind == "device_arrays" \
+            and not entry.spilled and entry.obj is not None:
+        reg.bump("handoffs_served")
+        reg.bump("device_handoffs_served")
+        device_pool_mod.bump("device_handoffs_served")
+        device_pool_mod.bump("bytes_not_staged", entry.nbytes)
+        trace_mod.instant(
+            "handoff.resolve", identity=entry.identity, served="device"
+        )
+        return dict(entry.obj)
+    return load_arrays(path)
+
+
+def _demote_device_entry(entry: _Entry, reason: str) -> int:
+    """Demote one device-rung entry to the memory rung: ONE d2h copy
+    (counted ``d2h_bytes``), frozen read-only, CRC32s stamped here — the
+    first point the bytes exist on host — for the storage spill boundary
+    to verify.  Returns the HBM bytes released (0 when another thread
+    holds the claim).  The entry stays resolvable throughout: consumers
+    see either the device payload or the finished host copy."""
+    from . import trace as trace_mod
+    from ..parallel import device_pool as device_pool_mod
+
+    reg = get_registry()
+    if not reg.claim_spill(entry):
+        return 0
+    ok = False
+    try:
+        with trace_mod.span(
+            "handoff.demote", identity=entry.identity, reason=reason,
+            nbytes=int(entry.nbytes),
+        ):
+            host = {}
+            for name, a in entry.obj.items():
+                h = np.asarray(a)
+                device_pool_mod.record_d2h(h.nbytes)
+                host[name] = h
+            frozen = _freeze(host)
+            crcs = {
+                name: zlib.crc32(np.ascontiguousarray(a).tobytes())
+                for name, a in frozen.items()
+            }
+        with reg._lock:
+            entry.obj = frozen
+            entry.device_crcs = crcs
+            entry.kind = "arrays"
+        ok = True
+    finally:
+        # release the claim WITHOUT flipping spilled: the entry is now an
+        # ordinary memory-rung artifact, eligible for normal spilling
+        reg.finish_spill(entry, False, reason)
+    if not ok:
+        return 0
+    reg.bump("device_handoffs_demoted")
+    return entry.nbytes
+
+
+def demote_for_device_headroom(need_bytes: Optional[int] = None) -> int:
+    """Demote live device-rung entries to the memory rung, oldest first,
+    until ``need_bytes`` fits the device byte budget (None: demote
+    everything).  The device analogue of :func:`spill_for_headroom` —
+    HBM pressure resolves downward to host RAM, never sideways.  Returns
+    HBM bytes released."""
+    from ..parallel import device_pool as device_pool_mod
+
+    budget = device_pool_mod.device_pool_budget()
+    freed = 0
+    for entry in get_registry().demotion_candidates():
+        if need_bytes is not None \
+                and device_live_bytes() + need_bytes <= budget:
+            break
+        freed += _demote_device_entry(entry, "device_budget")
+    return freed
+
+
 def load_arrays(path: str) -> Dict[str, np.ndarray]:
     """Consumer-side load of an array artifact: the live in-memory payload
     when one exists (``handoffs_served``), else the file — verified against
@@ -662,6 +936,10 @@ def load_arrays(path: str) -> Dict[str, np.ndarray]:
 
     reg = get_registry()
     entry = reg.get(artifact_identity(path))
+    if entry is not None and entry.kind == "device_arrays":
+        # a HOST consumer of a device-rung entry: demote it (the one d2h
+        # copy, stamping the CRCs) and serve the host views below
+        _demote_device_entry(entry, "host_consumer")
     if entry is not None and entry.kind == "arrays":
         obj = entry.obj
         if not entry.spilled and obj is not None:
@@ -711,8 +989,8 @@ def forget_artifact(path: str) -> None:
 def array_exists(path: str) -> bool:
     """True when the artifact is resolvable — live in memory or on disk."""
     entry = get_registry().get(artifact_identity(path))
-    if entry is not None and entry.kind == "arrays" and not entry.spilled \
-            and entry.obj is not None:
+    if entry is not None and entry.kind in ("arrays", "device_arrays") \
+            and not entry.spilled and entry.obj is not None:
         return True
     return os.path.exists(path)
 
@@ -749,7 +1027,10 @@ def _spill_entry(entry: _Entry, reason: str) -> int:
             if entry.kind == "dataset":
                 freed = obj.spill()
             else:
-                _write_artifact(entry.path, obj)
+                # demoted device entries carry the CRCs stamped when their
+                # bytes first hit host RAM: the spill boundary verifies them
+                _write_artifact(entry.path, obj,
+                                expected_crcs=entry.device_crcs)
                 freed = entry.nbytes
         ok = True
     except Exception:

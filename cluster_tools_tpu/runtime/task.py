@@ -183,7 +183,14 @@ class BaseTask:
         :meth:`save_handoff_arrays` stay in host RAM and downstream tasks
         consume them without a storage round-trip, with spill-to-storage
         (byte-budget admission, headroom probes, forced ``spill`` faults)
-        as the universal fallback.  ``solver_shards`` / ``reduce_fanout`` /
+        as the universal fallback.  ``device_pool`` (``"auto"``/``"on"``/
+        ``"off"``) and ``device_pool_bytes`` drive the HBM-resident page
+        pool on ragged sweeps, and ``device_handoffs`` (default off) keeps
+        :meth:`save_handoff_device_arrays` outputs resident in device
+        memory for fused consumers — the device-resident data plane
+        (docs/PERFORMANCE.md), with host staging / the memory rung as the
+        ladder below and ``CTT_DEVICE_POOL=0`` as the kill switch.
+        ``solver_shards`` / ``reduce_fanout`` /
         ``solver_workers`` shard the global agglomeration/multicut solve
         over an octant reduce tree (docs/PERFORMANCE.md "Distributed
         agglomeration"; ``parallel/reduce_tree.py``): ``solver_shards=1``
@@ -213,6 +220,9 @@ class BaseTask:
             "degrade_wait_s": 5.0,
             "inflight_byte_budget": None,
             "memory_handoffs": False,
+            "device_pool": "auto",
+            "device_pool_bytes": None,
+            "device_handoffs": False,
             "solver_shards": 1,
             "reduce_fanout": 2,
             "solver_workers": 1,
@@ -253,6 +263,7 @@ class BaseTask:
         from . import executor as executor_mod
 
         from ..ops import contraction as contraction_mod
+        from ..parallel import device_pool as device_pool_mod
         from ..parallel import reduce_tree as reduce_tree_mod
 
         self.logger.info(f"start {self.task_name} (target={self.target})")
@@ -275,6 +286,7 @@ class BaseTask:
         io_snap = chunk_cache.snapshot()
         disp_snap = executor_mod.dispatch_snapshot()
         handoff_snap = handoff_mod.snapshot()
+        device_snap = device_pool_mod.snapshot()
         solver_snap = contraction_mod.solver_snapshot()
         tree_snap = reduce_tree_mod.solve_snapshot()
         ok = False
@@ -312,6 +324,12 @@ class BaseTask:
         handoff_metrics = handoff_mod.delta(handoff_snap)
         if any(handoff_metrics.values()):
             io_metrics.update(handoff_metrics)
+        # device-plane attribution (docs/PERFORMANCE.md "Device-resident
+        # data plane"): h2d/d2h traffic, resident-pool hit rates, and the
+        # bytes fused consumers never re-staged, per task
+        device_metrics = device_pool_mod.delta(device_snap)
+        if any(device_metrics.values()):
+            io_metrics.update(device_metrics)
         # solver attribution: contraction-engine calls/rounds/edge counts
         # plus the reduce tree's per-level solve/merge movement, so the
         # global solve is as observable as the I/O and dispatch paths
@@ -512,6 +530,69 @@ class BaseTask:
             np.save(path, array)
             return
         entry = handoff.publish_arrays(
+            path, {"data": array}, producer=self.uid,
+            failures_path=self.failures_path,
+        )
+        self._memory_targets.append(MemoryTarget(entry))
+
+    def _device_handoffs_on(self) -> bool:
+        """Device-rung handoffs: the ``device_handoffs`` config knob on
+        top of everything :meth:`_handoffs_on` already requires, plus the
+        ``CTT_DEVICE_POOL`` process kill switch."""
+        if not self._handoffs_on():
+            return False
+        from ..parallel import device_pool
+
+        if not device_pool.device_pool_enabled():
+            return False
+        try:
+            cfg = self.get_config()
+        except Exception:
+            return False
+        return bool(cfg.get("device_handoffs", False))
+
+    def save_handoff_device_arrays(self, path, **arrays):
+        """Device-rung twin of :meth:`save_handoff_arrays`
+        (docs/PERFORMANCE.md "Device-resident data plane"): with
+        ``device_handoffs`` on, the named arrays (jax arrays stay
+        resident; host arrays are uploaded) live in DEVICE memory under
+        the artifact identity, and a fused consumer's
+        :func:`~cluster_tools_tpu.runtime.handoff.resolve_device_arrays`
+        serves them without a single host byte.  The ladder below is
+        automatic: the knob (or kill switch) off lands on the memory rung
+        / plain npz exactly like :meth:`save_handoff_arrays`, and a
+        resource failure at publish falls back to the memory rung
+        attributed ``degraded:host_staged``.
+
+        Contract (docs/ANALYSIS.md CT007): a device-handoff declaration
+        must carry its spill wiring — the registry needs ``producer`` and
+        ``failures_path`` to demote, spill, and attribute without the
+        task on the stack; this method passes both."""
+        from . import handoff
+
+        if not self._device_handoffs_on():
+            import numpy as np
+
+            # jax payloads land on host here — the one d2h the ladder costs
+            return self.save_handoff_arrays(path, **{
+                k: np.asarray(v) for k, v in arrays.items()
+            })
+        entry = handoff.publish_device_arrays(
+            path, arrays, producer=self.uid,
+            failures_path=self.failures_path,
+        )
+        self._memory_targets.append(MemoryTarget(entry))
+
+    def save_handoff_device_array(self, path, array):
+        """Single-array (`.npy`) twin of
+        :meth:`save_handoff_device_arrays`."""
+        from . import handoff
+
+        if not self._device_handoffs_on():
+            import numpy as np
+
+            return self.save_handoff_array(path, np.asarray(array))
+        entry = handoff.publish_device_arrays(
             path, {"data": array}, producer=self.uid,
             failures_path=self.failures_path,
         )

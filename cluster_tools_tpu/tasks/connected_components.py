@@ -186,6 +186,8 @@ class BlockComponentsBase(BaseTask):
             schedule=str(cfg.get("block_schedule") or "morton"),
             sweep_mode=str(cfg.get("sweep_mode") or "auto"),
             sharded_batch=cfg.get("sharded_batch"),
+            device_pool=str(cfg.get("device_pool") or "auto"),
+            device_pool_bytes=cfg.get("device_pool_bytes"),
             # degrade on OOM/ENOSPC; never splittable: the per-block CC
             # decomposition (and the min-voxel label of a component crossing
             # a would-be split plane) changes under sub-block re-execution

@@ -707,6 +707,8 @@ class IlastikPredictionBase(BaseTask):
             schedule=str(cfg.get("block_schedule") or "morton"),
             sweep_mode=str(cfg.get("sweep_mode") or "auto"),
             sharded_batch=cfg.get("sharded_batch"),
+            device_pool=str(cfg.get("device_pool") or "auto"),
+            device_pool_bytes=cfg.get("device_pool_bytes"),
             # opt-in OOM split (config allow_block_split): filter-bank +
             # per-voxel classifier is shape-local, so sub-block outputs tile
             # the parent exactly when halo covers the largest filter support

@@ -228,6 +228,8 @@ class InferenceBase(BaseTask):
             schedule=str(cfg.get("block_schedule") or "morton"),
             sweep_mode=str(cfg.get("sweep_mode") or "auto"),
             sharded_batch=cfg.get("sharded_batch"),
+            device_pool=str(cfg.get("device_pool") or "auto"),
+            device_pool_bytes=cfg.get("device_pool_bytes"),
             # opt-in OOM split (config allow_block_split): the conv kernel
             # is shape-local, so sub-block outputs tile the parent's region
             # exactly when halo covers the receptive field and the

@@ -396,6 +396,11 @@ class WatershedBase(_WsTaskBase):
                 # degrade fallback
                 sweep_mode=str(cfg.get("sweep_mode") or "auto"),
                 sharded_batch=cfg.get("sharded_batch"),
+                # HBM-resident page pool for ragged sweeps: pages upload
+                # once, re-address per batch (docs/PERFORMANCE.md
+                # "Device-resident data plane")
+                device_pool=str(cfg.get("device_pool") or "auto"),
+                device_pool_bytes=cfg.get("device_pool_bytes"),
                 # degrade policy: OOM/ENOSPC blocks wait for headroom and
                 # re-execute instead of burning same-size retries.  NEVER
                 # splittable: the label encoding (block_id * (n_outer+1) +
@@ -597,6 +602,8 @@ class TwoPassWatershedBase(_WsTaskBase):
             schedule=str(cfg.get("block_schedule") or "morton"),
             sweep_mode=str(cfg.get("sweep_mode") or "auto"),
             sharded_batch=cfg.get("sharded_batch"),
+            device_pool=str(cfg.get("device_pool") or "auto"),
+            device_pool_bytes=cfg.get("device_pool_bytes"),
             # same degrade policy as the single-pass task; never splittable
             # (outer-shape-dependent label encoding, see WatershedBase)
             splittable=False,

@@ -6,7 +6,7 @@ from cluster_tools_tpu.utils.volume_utils import file_reader
 
 def unhardened_map_blocks(kernel, blocks, load, store, self):
     # missing block_deadline_s / watchdog_period_s / store_verify_fn /
-    # schedule / sweep_mode / failures_path / task_name
+    # schedule / sweep_mode / device_pool / failures_path / task_name
     executor = BlockwiseExecutor(target="local")  # missing io_threads/max_retries
     executor.map_blocks(kernel, blocks, load, store)
 
@@ -30,6 +30,30 @@ def sharded_path_without_knob(kernel, blocks, load, store, self, cfg, out):
         watchdog_period_s=cfg.get("watchdog_period_s"),
         store_verify_fn=None,
         schedule="morton",
+        device_pool="auto",
+    )
+
+
+def ragged_path_without_device_knob(kernel, blocks, load, store, self, cfg):
+    # plumbs everything EXCEPT device_pool: the HBM-resident page pool must
+    # be selectable (and switch-off-able) from config at every call site
+    executor = BlockwiseExecutor(
+        target="local",
+        io_threads=int(cfg.get("io_threads") or 4),
+        max_retries=int(cfg.get("io_retries", 2)),
+    )
+    executor.map_blocks(
+        kernel,
+        blocks,
+        load,
+        store,
+        failures_path=self.failures_path,
+        task_name=self.uid,
+        block_deadline_s=cfg.get("block_deadline_s"),
+        watchdog_period_s=cfg.get("watchdog_period_s"),
+        store_verify_fn=None,
+        schedule="morton",
+        sweep_mode=str(cfg.get("sweep_mode") or "auto"),
     )
 
 

@@ -23,6 +23,8 @@ def hardened_map_blocks(kernel, blocks, load, store, cfg, self, out):
         schedule=str(cfg.get("block_schedule") or "morton"),
         sweep_mode=str(cfg.get("sweep_mode") or "auto"),
         sharded_batch=cfg.get("sharded_batch"),
+        device_pool=str(cfg.get("device_pool") or "auto"),
+        device_pool_bytes=cfg.get("device_pool_bytes"),
     )
 
 

@@ -23,3 +23,10 @@ class BadTask:
             path=cfg["output_path"], key="k4", chunks=(4, 4), dtype="uint64",
         )
         return out, unverified, kwonly
+
+    def publish(self, handoff, arrays):
+        # device-rung publish without producer/failures_path: a demotion or
+        # host-staged fallback would vanish from the failure ledger
+        handoff.publish_device_arrays("/tmp/h.npz", arrays)
+        # producer alone is not enough: the ledger path is still missing
+        handoff.publish_device_arrays("/tmp/h2.npz", arrays, producer="t")

@@ -29,3 +29,15 @@ class GoodTask:
         fwd = self.handoff_dataset(cfg["output_path"], "k3", **kw)
         verify3 = region_verifier(fwd)
         return out, verify, verify2, verify3, verify4
+
+    def publish(self, handoff, arrays):
+        # device-rung publish with the full spill contract: producer for
+        # attribution, failures_path for the degraded:host_staged record
+        handoff.publish_device_arrays(
+            "/tmp/h.npz", arrays,
+            producer=self.uid, failures_path=self.failures_path,
+        )
+        # the positional form is equally wired
+        handoff.publish_device_arrays(
+            "/tmp/h2.npz", arrays, self.uid, self.failures_path,
+        )

@@ -1,0 +1,566 @@
+"""Device-resident data plane (docs/PERFORMANCE.md "Device-resident data
+plane"; ``parallel/device_pool.py`` + the device rung of
+``runtime/handoff.py`` + the ``batch_shard`` device feed).
+
+Covers the HBM-resident page pool (content-addressed reuse across batches
+and warm re-sweeps, bit-identity against host staging), the degrade
+ladder on BOTH device rungs — an injected RESOURCE_EXHAUSTED at page
+upload (site ``h2d``) and at device-handoff publish (site ``publish``)
+must fall back to host staging / the memory rung, attributed
+``degraded:host_staged``, bit-identically — device-budget demotion with
+CRC verification at the storage-spill boundary, the inner-only-load
+device feed of ``sharded_slab_sweep``, and the fused two-task acceptance
+workflow: producer output resolved by a fused consumer with ZERO
+intermediate host-RAM bytes, bit-identical to the ``CTT_DEVICE_POOL=0``
+host-staged twin.
+"""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cluster_tools_tpu.io.containers import ChunkCorruptionError
+from cluster_tools_tpu.parallel import batch_shard, device_pool
+from cluster_tools_tpu.runtime import faults, handoff
+from cluster_tools_tpu.runtime import executor as executor_mod
+from cluster_tools_tpu.runtime import trace as trace_mod
+from cluster_tools_tpu.runtime.executor import BlockwiseExecutor, get_mesh
+from cluster_tools_tpu.runtime.task import BaseTask, build
+from cluster_tools_tpu.utils import function_utils as fu
+from cluster_tools_tpu.utils.volume_utils import Blocking
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planes():
+    device_pool.reset()
+    handoff.reset()
+    faults.configure(None)
+    trace_mod.reset()
+    yield
+    device_pool.reset()
+    handoff.reset()
+    faults.configure(None)
+    trace_mod.reset()
+
+
+def elementwise_kernel(b):
+    return jnp.where(b < jnp.float32(0.5), b * 2 + jnp.float32(0.25),
+                     jnp.float32(1.0))
+
+
+def _grid_blocks(shape, bshape, halo):
+    blocking = Blocking(shape, bshape)
+    return blocking, [
+        blocking.get_block(i, halo=halo) for i in range(blocking.n_blocks)
+    ]
+
+
+def _sweep(vol, blocks, mode, ragged="auto", n_devices=None, fp=None,
+           dev="auto", dev_bytes=None, **kw):
+    out = np.zeros(vol.shape, np.float32)
+
+    def load(b):
+        return (vol[b.outer_bb],)
+
+    def store(b, raw):
+        out[b.bb] = np.asarray(raw)[b.inner_in_outer_bb]
+
+    ex = BlockwiseExecutor(
+        target="local", n_devices=n_devices, io_threads=4,
+        backoff_base=1e-4,
+    )
+    snap = device_pool.snapshot()
+    summary = ex.map_blocks(
+        elementwise_kernel, blocks, load, store,
+        failures_path=fp, task_name=f"ragged_{mode}",
+        schedule="morton", sweep_mode=mode, sharded_batch=16,
+        ragged=ragged, device_pool=dev, device_pool_bytes=dev_bytes, **kw,
+    )
+    return out, summary, device_pool.delta(snap)
+
+
+# -- the resident page pool ---------------------------------------------------
+
+
+def test_resident_pool_reuses_pages_bit_identical(rng):
+    """The tentpole contract: a mixed-shape sweep staged through the
+    resident pool is bit-identical to host staging, and a warm re-sweep
+    of the same bytes re-addresses resident pages instead of re-uploading
+    them — h2d traffic collapses to the (tiny) page tables."""
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    out_pb, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1,
+                          dev="off")
+    out_cold, summary, d_cold = _sweep(vol, blocks, "sharded")
+    assert np.array_equal(out_pb, out_cold)
+    assert summary["device_pool"] == "on"
+    assert summary["device_pool_resident_bytes"] > 0
+    assert d_cold["device_batches_staged"] > 0
+    assert d_cold["device_pool_misses"] > 0
+    assert d_cold["h2d_bytes"] > 0
+    # same bytes again: every page is already resident
+    out_warm, _, d_warm = _sweep(vol, blocks, "sharded")
+    assert np.array_equal(out_pb, out_warm)
+    assert d_warm["device_pool_hits"] > 0
+    assert d_warm["bytes_not_staged"] > 0
+    assert d_warm["device_pool_misses"] == 0
+    assert d_warm["h2d_bytes"] < d_cold["h2d_bytes"]
+
+
+def test_concurrent_executors_share_the_pool_bit_identical(rng):
+    """Two executors staging into the shared arena concurrently (the
+    server's worker pool): a thread must never dispatch against a pool
+    version that predates the scatter for a slot it was handed as a hit
+    — the exact race that served all-zero pages to one of two identical
+    tenant requests before staging and version capture were made atomic
+    per arena."""
+    import threading
+
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    ref, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1,
+                       dev="off")
+
+    outs, errs = {}, []
+    gate = threading.Barrier(2)
+
+    def worker(name):
+        try:
+            gate.wait(timeout=30)
+            # same bytes from both threads: maximal hit-on-in-flight-miss
+            # overlap in the shared content-addressed arena
+            outs[name], _, _ = _sweep(vol, blocks, "sharded")
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append((name, e))
+
+    for trial in range(3):
+        device_pool.reset()
+        outs.clear()
+        gate.reset()
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        assert np.array_equal(outs["a"], ref), f"trial {trial}: a diverged"
+        assert np.array_equal(outs["b"], ref), f"trial {trial}: b diverged"
+
+
+def test_fill_and_repeated_pages_hit_within_one_sweep(rng):
+    """Content addressing pays off inside a single cold sweep too: the
+    shared fill page and any repeated page bytes land one resident slot."""
+    vol = np.zeros((20, 20, 20), np.float32)  # every full page identical
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    _, _, d = _sweep(vol, blocks, "sharded")
+    assert d["device_pool_hits"] > 0
+    assert d["bytes_not_staged"] > 0
+
+
+def test_device_pool_off_restores_host_staging_spans(rng):
+    """``device_pool="off"`` is the pre-pool path: per-batch uploads,
+    visible as ``executor.h2d`` spans — spans the resident-pool happy
+    path must NOT emit (that absence is the acceptance criterion's
+    no-host-copy proof)."""
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+
+    trace_mod.configure(enabled=True)
+    _, summary_off, d_off = _sweep(vol, blocks, "sharded", dev="off")
+    names = [e["name"] for e in trace_mod._get().snapshot_events()]
+    assert "executor.h2d" in names
+    assert d_off["device_batches_staged"] == 0
+    assert "device_pool" not in summary_off
+
+    trace_mod.configure(enabled=True)
+    _, _, d_on = _sweep(vol, blocks, "sharded")
+    names = [e["name"] for e in trace_mod._get().snapshot_events()]
+    assert d_on["device_batches_staged"] > 0
+    assert "executor.h2d" not in names
+
+
+def test_kill_switch_disables_whole_plane(rng, monkeypatch):
+    """``CTT_DEVICE_POOL=0`` kills pool AND device handoffs regardless of
+    per-call knobs; publishes fall to the memory rung silently (no
+    fallback attribution — nothing degraded, the plane is simply off)."""
+    monkeypatch.setenv("CTT_DEVICE_POOL", "0")
+    vol = rng.random((16, 16, 16)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    _, summary, d = _sweep(vol, blocks, "sharded", dev="on")
+    assert "device_pool" not in summary
+    assert d["device_batches_staged"] == 0
+
+    snap = device_pool.snapshot()
+    entry = handoff.publish_device_arrays(
+        "/tmp/dead.npz", {"x": np.arange(4.0)}, producer="p.0")
+    assert entry.kind == "arrays"
+    assert device_pool.delta(snap)["host_staged_fallbacks"] == 0
+
+
+# -- degrade ladder: injected RESOURCE_EXHAUSTED on the device rungs ----------
+
+
+def test_h2d_oom_rides_ladder_to_host_staging(rng, inject, tmp_path):
+    """Satellite 3a: a persistent RESOURCE_EXHAUSTED at page upload (site
+    ``h2d``) exhausts the pool's evict+retry rung and falls every batch
+    back to host staging — attributed ``degraded:host_staged`` in
+    failures.json, bit-identical to the unfaulted baseline."""
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    out_pb, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1,
+                          dev="off")
+    inject({
+        "seed": 3,
+        "faults": [{"site": "h2d", "kind": "oom",
+                    "fail_attempts": 10**6}],
+    })
+    fp = str(tmp_path / "failures.json")
+    out, summary, d = _sweep(vol, blocks, "sharded", fp=fp)
+    assert np.array_equal(out_pb, out)
+    assert d["host_staged_fallbacks"] > 0
+    assert d["device_batches_staged"] == 0
+    recs = [
+        r for r in json.load(open(fp))["records"]
+        if r["task"] == "ragged_sharded.device_pool"
+    ]
+    assert len(recs) == 1  # once per sweep, not per batch
+    assert recs[0]["sites"] == {"h2d": 1}
+    assert recs[0]["resolved"]
+    assert recs[0]["resolution"] == "degraded:host_staged"
+
+
+def test_budget_too_small_falls_back_without_faults(rng, tmp_path):
+    """The real (no-injection) exhaustion path: a budget smaller than one
+    batch's page class raises DevicePoolExhausted pre-allocation and the
+    sweep completes host-staged, attributed the same way."""
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    out_pb, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1,
+                          dev="off")
+    fp = str(tmp_path / "failures.json")
+    out, _, d = _sweep(vol, blocks, "sharded", fp=fp, dev_bytes=1024)
+    assert np.array_equal(out_pb, out)
+    assert d["host_staged_fallbacks"] > 0
+    recs = json.load(open(fp))["records"]
+    assert any(r["resolution"] == "degraded:host_staged" for r in recs)
+
+
+def test_transient_h2d_oom_evicts_and_retries(rng, inject):
+    """One-shot RESOURCE_EXHAUSTED at upload: the ladder's first rung
+    (evict everything, retry once) absorbs it — no host-staged fallback,
+    the sweep stays on the resident pool."""
+    vol = rng.random((16, 16, 16)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    inject({
+        "seed": 3,
+        "faults": [{"site": "h2d", "kind": "oom", "fail_attempts": 1}],
+    })
+    out, _, d = _sweep(vol, blocks, "sharded")
+    assert d["host_staged_fallbacks"] == 0
+    assert d["device_batches_staged"] > 0
+    faults.configure(None)
+    out_pb, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1,
+                          dev="off")
+    assert np.array_equal(out_pb, out)
+
+
+def test_publish_oom_falls_to_memory_rung(inject, tmp_path):
+    """Satellite 3b: an injected RESOURCE_EXHAUSTED at device-handoff
+    publish lands the payload on the memory rung (one d2h copy),
+    attributed ``degraded:host_staged`` under the producer's
+    ``.device_handoff`` task key — and consumers resolve bit-identically."""
+    payload = jnp.arange(32.0).reshape(4, 8)
+    want = np.asarray(payload)
+    inject({
+        "faults": [{"site": "publish", "kind": "oom",
+                    "fail_attempts": 10**6}],
+    })
+    fp = str(tmp_path / "failures.json")
+    path = str(tmp_path / "probs.npz")
+    snap = device_pool.snapshot()
+    entry = handoff.publish_device_arrays(
+        path, {"x": payload}, producer="prod.0", failures_path=fp)
+    assert entry.kind == "arrays"  # memory rung, not device
+    d = device_pool.delta(snap)
+    assert d["host_staged_fallbacks"] == 1
+    assert d["d2h_bytes"] == want.nbytes
+    got = handoff.resolve_device_arrays(path)
+    np.testing.assert_array_equal(np.asarray(got["x"]), want)
+    recs = [
+        r for r in json.load(open(fp))["records"]
+        if r["task"] == "prod.0.device_handoff"
+    ]
+    assert len(recs) == 1
+    assert recs[0]["sites"] == {"publish": 1}
+    assert recs[0]["resolution"] == "degraded:host_staged"
+    assert recs[0]["reason"] == "oom"
+
+
+# -- device rung: demotion ladder + CRC at the spill boundary -----------------
+
+
+def test_device_budget_demotes_oldest_to_memory_rung(tmp_path, monkeypatch):
+    """HBM pressure resolves DOWNWARD: a publish over the device envelope
+    demotes the oldest device entry to the memory rung (one counted d2h
+    copy) and both stay resolvable bit-identically."""
+    a = jnp.arange(1024.0)          # 4 KiB
+    b = jnp.arange(1024.0) * 2
+    monkeypatch.setenv("CTT_DEVICE_POOL_BYTES", str(6 * 1024))
+    pa, pb = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    snap = handoff.snapshot()
+    dsnap = device_pool.snapshot()
+    ea = handoff.publish_device_arrays(pa, {"x": a}, producer="p.0")
+    assert ea.kind == "device_arrays"
+    eb = handoff.publish_device_arrays(pb, {"x": b}, producer="p.0")
+    assert eb.kind == "device_arrays"
+    assert ea.kind == "arrays"      # demoted to make room
+    assert ea.device_crcs is not None  # CRCs stamped at first host copy
+    d = handoff.delta(snap)
+    assert d["device_handoffs_demoted"] == 1
+    assert device_pool.delta(dsnap)["d2h_bytes"] >= 4096
+    np.testing.assert_array_equal(
+        np.asarray(handoff.resolve_device_arrays(pa)["x"]), np.asarray(a))
+    np.testing.assert_array_equal(
+        np.asarray(handoff.resolve_device_arrays(pb)["x"]), np.asarray(b))
+
+
+def test_host_consumer_demotes_device_entry(tmp_path):
+    """A host-side ``load_arrays`` of a device entry demotes it (the one
+    unavoidable d2h) and serves read-only host arrays."""
+    path = str(tmp_path / "h.npz")
+    handoff.publish_device_arrays(
+        path, {"x": jnp.arange(8.0)}, producer="p.0")
+    got = handoff.load_arrays(path)
+    assert isinstance(got["x"], np.ndarray)
+    assert not got["x"].flags.writeable
+    np.testing.assert_array_equal(got["x"], np.arange(8.0))
+    entry = handoff.get_registry().get(handoff.artifact_identity(path))
+    assert entry.kind == "arrays" and entry.device_crcs is not None
+
+
+def test_demoted_entry_spills_with_crc_verified(tmp_path):
+    """The spill boundary verifies the CRCs stamped at demotion (the
+    first host materialization): an intact demoted entry spills with a
+    matching sidecar; a rotted host copy fails the spill LOUDLY instead
+    of checksum-blessing corrupt bytes."""
+    path = str(tmp_path / "h.npz")
+    handoff.publish_device_arrays(
+        path, {"x": jnp.arange(8.0)}, producer="p.0")
+    handoff.load_arrays(path)  # demote: stamps device_crcs
+    entry = handoff.get_registry().get(handoff.artifact_identity(path))
+    freed = handoff._spill_entry(entry, "test")
+    assert freed == entry.nbytes and entry.spilled
+    sidecar = json.load(open(path + ".crc.json"))
+    assert sidecar["arrays"]["x"] == entry.device_crcs["x"]
+    # the spilled file round-trips through the verified fallback load
+    handoff.reset()
+    np.testing.assert_array_equal(
+        handoff.load_arrays(path)["x"], np.arange(8.0))
+
+    # rotted host copy: the stamped CRC no longer matches -> loud failure
+    with pytest.raises(ChunkCorruptionError):
+        handoff._write_artifact(
+            str(tmp_path / "rot.npz"), {"x": np.arange(8.0)},
+            expected_crcs={"x": entry.device_crcs["x"] ^ 1},
+        )
+
+
+# -- the batch_shard device feed ----------------------------------------------
+
+
+def test_slab_sweep_device_feed_bit_identical_and_resident(rng):
+    """Tentpole (c): a device-resident volume (a device handoff payload)
+    feeds ``sharded_slab_sweep`` without host copies — sliced and stacked
+    on device, counted ``bytes_not_staged`` — and with
+    ``keep_on_device=True`` the result never visits host RAM either.
+    Bit-identical to the host-fed sweep, including the padded tail."""
+    mesh = get_mesh("local")
+    vol = rng.random((32, 6, 6)).astype(np.float32)
+    kern = lambda x: x[1:-1] * jnp.float32(2) + jnp.float32(0.5)  # noqa: E731
+
+    snap = device_pool.snapshot()
+    host_out = batch_shard.sharded_slab_sweep(vol, kern, mesh, 8, 1)
+    d_host = device_pool.delta(snap)
+    assert d_host["h2d_bytes"] > 0 and d_host["bytes_not_staged"] == 0
+
+    snap = device_pool.snapshot()
+    dev_out = batch_shard.sharded_slab_sweep(
+        jax.device_put(vol), kern, mesh, 8, 1, keep_on_device=True)
+    d_dev = device_pool.delta(snap)
+    assert isinstance(dev_out, jax.Array)
+    assert d_dev["bytes_not_staged"] > 0 and d_dev["h2d_bytes"] == 0
+    assert np.array_equal(host_out, np.asarray(dev_out))
+
+
+def test_slab_sweep_geometry_gate():
+    assert batch_shard.slab_sweep_device_feed_ok((32, 6, 6), 8, 2)
+    assert not batch_shard.slab_sweep_device_feed_ok((30, 6, 6), 8, 2)
+    assert not batch_shard.slab_sweep_device_feed_ok((32, 6, 6), 8, 9)
+    assert not batch_shard.slab_sweep_device_feed_ok((4, 6, 6), 8, 2)
+
+
+# -- the fused two-task acceptance workflow -----------------------------------
+
+
+class _DeviceProducer(BaseTask):
+    """Computes on device and publishes the result on the device rung."""
+
+    task_name = "dev_producer"
+
+    def run_impl(self):
+        cfg = self.get_config()
+        x = jnp.arange(4096, dtype=jnp.float32).reshape(16, 16, 16)
+        probs = jnp.tanh(x * jnp.float32(1e-3)) + jnp.float32(0.125)
+        self.save_handoff_device_arrays(cfg["handoff_path"], probs=probs)
+        self.log_block_success(0)
+        return {"n_blocks": 1}
+
+
+class _DeviceConsumer(BaseTask):
+    """Resolves the producer's payload (device rung when live) and writes
+    the terminal output — the only host bytes in the workflow."""
+
+    task_name = "dev_consumer"
+
+    def run_impl(self):
+        cfg = self.get_config()
+        got = handoff.resolve_device_arrays(cfg["handoff_path"])
+        out = jnp.sqrt(jnp.asarray(got["probs"])) * jnp.float32(3)
+        np.save(cfg["final_path"], np.asarray(out))
+        self.log_block_success(0)
+        return {"n_blocks": 1}
+
+
+def _run_fused(tmp_path, sub):
+    base = os.path.join(str(tmp_path), sub)
+    cdir = os.path.join(base, "config")
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, "global.config"), "w") as f:
+        json.dump({"memory_handoffs": True, "device_handoffs": True}, f)
+    kw = dict(
+        tmp_folder=os.path.join(base, "tmp"),
+        config_dir=cdir,
+        handoff_path=os.path.join(base, "probs.npz"),
+        final_path=os.path.join(base, "final.npy"),
+    )
+    prod, cons = _DeviceProducer(**kw), _DeviceConsumer(**kw)
+    assert build([prod])
+    assert build([cons])
+    return prod, cons, np.load(kw["final_path"])
+
+
+def test_fused_workflow_zero_intermediate_host_bytes(tmp_path, monkeypatch):
+    """THE acceptance scenario: producer -> consumer through the device
+    rung with zero intermediate host-RAM bytes — io_metrics shows
+    ``device_handoffs_served > 0`` and ``bytes_not_staged > 0``, the
+    trace carries the publish (kind=device_arrays) and the device-served
+    resolve with NO h2d/d2h/demote events between them — bit-identical
+    to the ``CTT_DEVICE_POOL=0`` host-staged twin."""
+    trace_mod.configure(enabled=True)
+    snap = device_pool.snapshot()
+    prod, cons, final = _run_fused(tmp_path, "dev")
+    d = device_pool.delta(snap)
+    assert d["device_handoffs_served"] == 1
+    assert d["bytes_not_staged"] > 0
+    assert d["d2h_bytes"] == 0  # the intermediate never touched host RAM
+    assert d["h2d_bytes"] == 0  # ...and was never re-uploaded
+
+    events = trace_mod._get().snapshot_events()
+    pub = [e for e in events if e["name"] == "handoff.publish"]
+    res = [e for e in events if e["name"] == "handoff.resolve"]
+    assert pub and pub[0]["args"]["kind"] == "device_arrays"
+    assert res and res[0]["args"]["served"] == "device"
+    between = [
+        e for e in events
+        if pub[0]["ts"] <= e["ts"] <= res[0]["ts"]
+        and e["name"] in ("executor.h2d", "executor.d2h", "handoff.demote")
+    ]
+    assert between == []
+
+    # per-task attribution in io_metrics.json
+    with open(fu.io_metrics_path(prod.tmp_folder)) as f:
+        tasks = json.load(f)["tasks"]
+    assert tasks[prod.uid]["device_handoffs_published"] == 1
+    assert tasks[prod.uid]["bytes_not_stored"] > 0
+    assert tasks[cons.uid]["device_handoffs_served"] == 1
+    assert tasks[cons.uid]["bytes_not_staged"] > 0
+
+    # the host-staged twin (kill switch): byte-identical terminal output
+    device_pool.reset()
+    handoff.reset()
+    monkeypatch.setenv("CTT_DEVICE_POOL", "0")
+    _, _, final_host = _run_fused(tmp_path, "host")
+    assert np.array_equal(final, final_host)
+
+
+def test_task_device_knob_gates_rung(tmp_path):
+    """Without the ``device_handoffs`` config knob the same task helper
+    publishes on the MEMORY rung (host arrays) — the device rung is
+    opt-in per task, not ambient."""
+    base = os.path.join(str(tmp_path), "gated")
+    cdir = os.path.join(base, "config")
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, "global.config"), "w") as f:
+        json.dump({"memory_handoffs": True}, f)  # no device_handoffs
+    prod = _DeviceProducer(
+        tmp_folder=os.path.join(base, "tmp"), config_dir=cdir,
+        handoff_path=os.path.join(base, "probs.npz"),
+        final_path=os.path.join(base, "final.npy"),
+    )
+    assert build([prod])
+    entry = handoff.get_registry().get(
+        handoff.artifact_identity(os.path.join(base, "probs.npz")))
+    assert entry is not None and entry.kind == "arrays"
+
+
+# -- tier-2: compile-heavy e2e variants ---------------------------------------
+
+
+@pytest.mark.slow
+def test_warm_resweep_monotone_h2d_collapse(rng):
+    """Three consecutive sweeps of the same volume: h2d bytes collapse
+    after the cold sweep and stay collapsed (the resident arenas persist
+    across map_blocks calls — the point of the process-wide pool)."""
+    vol = rng.random((24, 24, 24)).astype(np.float32)
+    _, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    h2d = []
+    for _ in range(3):
+        _, _, d = _sweep(vol, blocks, "sharded")
+        h2d.append(d["h2d_bytes"])
+    assert h2d[1] < h2d[0] and h2d[2] <= h2d[1]
+
+
+@pytest.mark.slow
+def test_forced_split_through_resident_pool_bit_identical(rng, inject,
+                                                          tmp_path):
+    """The PR-14 forced-split scenario THROUGH the resident pool: split
+    sub-batches stage against the arenas too, and the reassembled volume
+    stays bit-identical to the per-block fallback under the same faults."""
+    vol = rng.random((20, 20, 20)).astype(np.float32)
+    blocking, blocks = _grid_blocks(vol.shape, (8, 8, 8), (2, 2, 2))
+    split_ids = sorted(
+        blocking.grid_position_to_id(pos) for pos in np.ndindex(2, 2, 2)
+    )
+    cfg = {
+        "seed": 3,
+        "faults": [{"site": "load", "kind": "oom", "blocks": split_ids,
+                    "min_voxels": 1000, "fail_attempts": 10**6}],
+    }
+    split_kw = dict(splittable=True, split_halo=(2, 2, 2),
+                    min_block_shape=(2, 2, 2), degrade_wait_s=0.05)
+    inject(cfg)
+    out_pb, _, _ = _sweep(vol, blocks, "per_block", "off", n_devices=1,
+                          dev="off", fp=str(tmp_path / "f1.json"),
+                          **split_kw)
+    inject(cfg)
+    out_rg, _, d = _sweep(vol, blocks, "sharded",
+                          fp=str(tmp_path / "f2.json"), **split_kw)
+    assert np.array_equal(out_pb, out_rg)
+    assert d["device_batches_staged"] > 0

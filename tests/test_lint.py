@@ -75,6 +75,16 @@ def test_ct001_sharded_path_requires_sweep_mode_knob():
     assert any("['sweep_mode']" in m for m in msgs)
 
 
+def test_ct001_device_plane_requires_device_pool_knob():
+    """The HBM-resident page pool (device_pool) is enforced like
+    sweep_mode: a site plumbing everything else still fires, because a
+    call that cannot switch the pool off from config cannot reach the
+    host-staged twin when HBM is contended."""
+    findings, _ = lint_fixture("ct001_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT001"]
+    assert any("['device_pool']" in m for m in msgs)
+
+
 def test_ct001_sharded_solve_requires_knob_plumbing():
     """The sharded global solve (parallel/reduce_tree.py) is enforced like
     the executor paths: a solve_with_reduce_tree call site must plumb the
@@ -160,6 +170,11 @@ def test_ct007_all_violation_classes():
     assert any("misses spill wiring" in m for m in msgs)
     assert any("never passed to region_verifier" in m for m in msgs)
     assert any("not bound to a name" in m for m in msgs)
+    # device-rung publishes carry the contract too: both the bare call and
+    # the producer-only call fire (failures_path still unwired)
+    device = [m for m in msgs if "device handoff publish" in m]
+    assert any("'producer'" in m for m in device)
+    assert sum("'failures_path'" in m for m in device) == 2
     # kwarg-only call missing only `shape`: the required-kwarg slice must
     # not wrap negative and drop it
     assert any("['shape']" in m for m in msgs)
