@@ -83,7 +83,15 @@
 #                     per-tenant fairness into BENCH_r10.json; cpu
 #                     backend (a <10 s smoke twin runs inside tier1 via
 #                     tests/test_serve.py)
-#   bench-trajectory= aggregate the BENCH_r01..r12 headline numbers into
+#   bench-fleet     = fleet failover bench (docs/SERVING.md "Fleet"):
+#                     open-loop Poisson two-tenant traffic against a
+#                     2-member fleet with one member SIGKILLed mid-phase,
+#                     recording zero lost acknowledged requests, the
+#                     affinity hit rate (> 0.8), the kill-phase p99
+#                     (within 3x warm), and bit-identity into
+#                     BENCH_r13.json; cpu backend, <60 s (the chaos e2e
+#                     twin is tests/test_chaos.py -k fleet)
+#   bench-trajectory= aggregate the BENCH_r01..r13 headline numbers into
 #                     one table (stdout + rewritten into docs/PERFORMANCE.md
 #                     "Performance trajectory"), so the perf history is
 #                     readable without opening ten JSON files
@@ -107,7 +115,7 @@ TMP ?= /tmp/ctt_run
 
 .PHONY: test lint tier1 tier2 chaos chaos-resource failures-report progress \
 	bench-io bench-sweep bench-fuse bench-ragged bench-device bench-solve \
-	bench-serve \
+	bench-serve bench-fleet \
 	bench-trajectory serve-smoke scrub-smoke supervise-demo native clean
 
 test: lint tier1 tier2 chaos
@@ -158,6 +166,9 @@ bench-solve:
 
 bench-serve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve
+
+bench-fleet:
+	JAX_PLATFORMS=cpu $(PY) bench.py --fleet
 
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve.py -q \

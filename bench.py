@@ -1697,6 +1697,279 @@ def serve_bench(smoke=False):
     return rec
 
 
+def fleet_bench(smoke=False):
+    """Fleet failover bench (docs/SERVING.md "Fleet"): open-loop Poisson
+    two-tenant traffic against a 2-member fleet, ``kill -9`` one member
+    mid-phase.
+
+    - **warm**: after one cold request per tenant pins affinity, Poisson
+      arrivals of connected-components requests measure the fleet's warm
+      client-observed p50/p99 — this is the single-server-warm baseline
+      (each tenant's whole stream is served by its one affine member);
+    - **kill**: the same arrival pattern, with tenant alice's member
+      SIGKILLed after half the arrivals — the gateway detects the death,
+      a survivor adopts the journal under the exclusive claim, and every
+      acknowledged request completes with ZERO client resubmission (the
+      client only waits through the failover window);
+    - bars: zero lost acknowledged requests, affinity hit rate > 0.8,
+      kill-phase p99 within 3x the warm p99, bit-identical outputs,
+      drain rc 114.
+
+    ``make bench-fleet`` writes BENCH_r13.json; ``smoke=True`` shrinks
+    the request counts and skips the file write.  Emits exactly one JSON
+    line on stdout.
+    """
+    from __graft_entry__ import _force_cpu_platform
+
+    _force_cpu_platform(8)
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from cluster_tools_tpu.runtime.server import ServeClient
+    from cluster_tools_tpu.runtime.supervision import REQUEUE_EXIT_CODE
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.connected_components import (
+        ConnectedComponentsWorkflow,
+    )
+    from cluster_tools_tpu.utils import function_utils as fu
+    from cluster_tools_tpu.utils.volume_utils import file_reader
+
+    shape, block = (16, 16, 16), 8
+    n_warm = 6 if smoke else 12
+    n_kill = 6 if smoke else 12
+    mean_gap = 0.3 if smoke else 0.4
+    root = tempfile.mkdtemp(prefix="ctt_fleet_bench_")
+    log(f"fleet bench: 2 members, {n_warm} warm + {n_kill} kill-phase "
+        f"requests, open-loop poisson (mean gap {mean_gap}s)")
+
+    rng = np.random.default_rng(0)
+    vol = (rng.random(shape) > 0.5).astype("float32")
+    data = os.path.join(root, "data.zarr")
+    ds = file_reader(data).create_dataset(
+        "mask", shape=shape, chunks=(block,) * 3, dtype="float32")
+    ds[...] = vol
+
+    # -- solo batch reference (bit-identity oracle) ------------------------
+    ref_dir = os.path.join(root, "ref")
+    os.makedirs(os.path.join(ref_dir, "config"), exist_ok=True)
+    with open(os.path.join(ref_dir, "config", "global.config"), "w") as f:
+        json.dump({"block_shape": [block] * 3,
+                   "memory_handoffs": True}, f)
+    t0 = time.monotonic()
+    assert build([ConnectedComponentsWorkflow(
+        tmp_folder=os.path.join(ref_dir, "tmp"),
+        config_dir=os.path.join(ref_dir, "config"),
+        max_jobs=2, target="local",
+        input_path=data, input_key="mask",
+        output_path=data, output_key="ref_seg", threshold=0.5,
+    )])
+    solo_batch_s = round(time.monotonic() - t0, 4)
+    ref_seg = np.asarray(file_reader(data, "r")["ref_seg"][...])
+
+    # -- the fleet: gateway + 2 members, tight failure detection -----------
+    fleet_dir = os.path.join(root, "fleet")
+    cfg_path = os.path.join(root, "fleet.json")
+    with open(cfg_path, "w") as f:
+        json.dump({
+            "members": 2,
+            "gateway": {"health_interval_s": 0.2, "member_stale_s": 1.0},
+            "server": {"max_workers": 2},
+        }, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.fleet",
+         "--base-dir", fleet_dir, "--config", cfg_path],
+        env=env, cwd=repo, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+    def payload(tenant, rid, out_key):
+        return dict(
+            tenant=tenant, request_id=rid,
+            workflow="connected_components",
+            config=dict(
+                tmp_folder=os.path.join(root, "req_" + rid),
+                global_config={"block_shape": [block] * 3},
+                params=dict(input_path=data, input_key="mask",
+                            output_path=data, output_key=out_key,
+                            threshold=0.5),
+            ),
+        )
+
+    lats = {"warm": [], "kill": []}
+    states = {}
+    outputs = []
+    lock = threading.Lock()
+
+    def drive(phase, tenant, rid, key):
+        c = ServeClient.from_endpoint_file(fleet_dir)
+        t_start = time.monotonic()
+        c.submit(retry_s=120, **payload(tenant, rid, key))
+        rec = c.wait(rid, timeout_s=600, across_restarts=True)
+        lat = time.monotonic() - t_start
+        with lock:
+            lats[phase].append(lat)
+            states[rid] = rec.get("state")
+
+    drain_rc = None
+    try:
+        endpoint = os.path.join(fleet_dir, "server.json")
+        deadline = time.monotonic() + 180
+        while True:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"fleet died on startup rc={proc.returncode}:\n"
+                    f"{proc.stdout.read()[-4000:]}")
+            doc = fu.read_json_if_valid(endpoint) or {}
+            if doc.get("pid") == proc.pid and doc.get("role") == "gateway":
+                break
+            assert time.monotonic() < deadline, "gateway never bound"
+            time.sleep(0.05)
+        client = ServeClient.from_endpoint_file(fleet_dir)
+
+        # -- cold: one request per tenant pins affinity (not measured) -----
+        homes = {}
+        for tenant in ("alice", "bob"):
+            rid, key = f"{tenant}_cold", f"seg_{tenant}_cold"
+            doc = client.submit(retry_s=120, **payload(tenant, rid, key))
+            homes[tenant] = doc["member"]
+            outputs.append(key)
+            rec = client.wait(rid, timeout_s=600)
+            assert rec["state"] == "done", rec
+        victim = homes["alice"]
+        victim_dir = os.path.join(fleet_dir, "members", victim)
+        victim_pid = (fu.read_json_if_valid(
+            os.path.join(victim_dir, "server.json")) or {}).get("pid")
+        assert victim_pid and victim_pid != proc.pid
+
+        # -- warm phase: poisson arrivals, no failures ---------------------
+        arrival_rng = np.random.default_rng(42)
+        threads = []
+        for i, gap in enumerate(_poisson_gaps(arrival_rng, n_warm,
+                                              mean_gap)):
+            time.sleep(gap)
+            tenant = ("alice", "bob")[i % 2]
+            rid, key = f"{tenant}_w{i}", f"seg_{tenant}_w{i}"
+            outputs.append(key)
+            t = threading.Thread(target=drive,
+                                 args=("warm", tenant, rid, key))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=600)
+        warm_stats = _latency_stats(lats["warm"])
+        log(f"fleet warm phase: p50 {warm_stats['p50_s']}s, "
+            f"p99 {warm_stats['p99_s']}s")
+
+        # -- kill phase: SIGKILL alice's member after half the arrivals ----
+        threads = []
+        for i, gap in enumerate(_poisson_gaps(arrival_rng, n_kill,
+                                              mean_gap)):
+            time.sleep(gap)
+            if i == n_kill // 2:
+                log(f"fleet kill phase: SIGKILL member {victim} "
+                    f"(pid {victim_pid})")
+                os.kill(victim_pid, signal.SIGKILL)
+            tenant = ("alice", "bob")[i % 2]
+            rid, key = f"{tenant}_k{i}", f"seg_{tenant}_k{i}"
+            outputs.append(key)
+            t = threading.Thread(target=drive,
+                                 args=("kill", tenant, rid, key))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=600)
+        kill_stats = _latency_stats(lats["kill"])
+        log(f"fleet kill phase: p50 {kill_stats['p50_s']}s, "
+            f"p99 {kill_stats['p99_s']}s")
+
+        # every acknowledged request completed — zero resubmission
+        lost = [rid for rid, st in states.items() if st != "done"]
+
+        with open(os.path.join(fleet_dir, "fleet_state.json")) as f:
+            fstate = json.load(f)
+        aff = fstate["affinity"]
+        hit_rate = aff["hits"] / max(1, aff["hits"] + aff["misses"])
+        adoptions = fstate["adoptions"]
+
+        proc.send_signal(signal.SIGTERM)
+        drain_rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                pass
+        # a reaped gateway orphans its members — never leak a resident
+        # server past the bench
+        for name in ("m0", "m1"):
+            ep = os.path.join(fleet_dir, "members", name, "server.json")
+            mpid = (fu.read_json_if_valid(ep) or {}).get("pid")
+            if mpid:
+                try:
+                    os.kill(int(mpid), signal.SIGKILL)
+                except OSError:
+                    pass
+
+    # -- bit-identity sweep: every served output == the solo reference -----
+    out = file_reader(data, "r")
+    bit_identical = all(
+        np.array_equal(np.asarray(out[key][...]), ref_seg)
+        for key in outputs
+    )
+    p99_ratio = round(
+        kill_stats["p99_s"] / max(warm_stats["p99_s"], 1e-9), 2
+    )
+    rec = {
+        "metric": "fleet_failover_traffic",
+        "backend": "cpu",
+        "volume": list(shape),
+        "block_shape": [block] * 3,
+        "members": 2,
+        "tenants": 2,
+        "arrivals": {"process": "poisson", "mean_gap_s": mean_gap,
+                     "seed": 42},
+        "solo_batch_s": solo_batch_s,
+        "warm": warm_stats,
+        "kill_phase": kill_stats,
+        "kill_p99_over_warm_p99": p99_ratio,
+        "acked": len(states),
+        "lost_acked": lost,
+        "affinity": {
+            "hits": aff["hits"], "misses": aff["misses"],
+            "hit_rate": round(hit_rate, 4),
+        },
+        "adoptions": adoptions,
+        "victim": victim,
+        "bit_identical": bool(bit_identical),
+        "drain_rc": drain_rc,
+        "acceptance": {
+            "zero_lost_acked": not lost,
+            "affinity_hit_rate_gt_0_8": bool(hit_rate > 0.8),
+            "kill_p99_within_3x_warm": bool(p99_ratio <= 3.0),
+            "exactly_one_adoption": len(adoptions) == 1,
+            "bit_identical": bool(bit_identical),
+            "drain_rc_114": drain_rc == REQUEUE_EXIT_CODE,
+        },
+    }
+    shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(rec), flush=True)
+    if not smoke:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r13.json"
+        )
+        fu.atomic_write_json(path, rec)
+        log(f"fleet bench done -> {path}")
+    return rec
+
+
 def main():
     log(f"start; env JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}")
     probed = os.environ.get("CT_BENCH_ACCEL")
@@ -2676,6 +2949,8 @@ if __name__ == "__main__":
             solve_bench()
         elif "--serve" in sys.argv or os.environ.get("CT_BENCH_SERVE"):
             serve_bench(smoke="--smoke" in sys.argv)
+        elif "--fleet" in sys.argv or os.environ.get("CT_BENCH_FLEET"):
+            fleet_bench(smoke="--smoke" in sys.argv)
         elif os.environ.get("CT_BENCH_IMPL"):
             main()
         else:

@@ -1,0 +1,244 @@
+"""Fleet-mode CLI entry: a gateway fronting M pipeline servers.
+
+Usage (docs/SERVING.md "Fleet")::
+
+    python -m cluster_tools_tpu.fleet --base-dir /srv/fleet \\
+        [--members 2] [--port 0] [--config fleet.json] [--tpu]
+    python -m cluster_tools_tpu.fleet --status /srv/fleet
+    python -m cluster_tools_tpu.fleet --drain /srv/fleet [--member m0]
+
+Spawns ``--members`` pipeline-server subprocesses (each a standard
+``cluster_tools_tpu.serve`` process under ``<base_dir>/members/mN``) and a
+:class:`~cluster_tools_tpu.runtime.fleet.FleetGateway` routing to them:
+tenant-affinity placement with least-queue fallback, health checking, and
+journal-handoff failover — when a member dies, a surviving member adopts
+its journal under an exclusive claim and finishes every acknowledged
+request with zero client resubmission; with no survivor the gateway
+respawns the member on its own base dir and boot replay does the rest.
+
+``--config`` names a JSON document: ``{"members": N, "gateway":
+{affinity, health_interval_s, member_stale_s, max_member_queue, failover},
+"server": {...per-member cluster_tools_tpu.serve config...}}``.
+
+SIGTERM drains the whole fleet through the standard protocol: the gateway
+stops routing, every member is SIGTERMed and drains at its safe
+boundaries (each exits ``REQUEUE_EXIT_CODE``), and this process exits
+``REQUEUE_EXIT_CODE`` (114) so rolling restarts ride the same requeue
+protocol as every other preempted job.  ``--status`` prints the gateway's
+``/status`` document and exits with its ``rc`` (1 while a member is dead
+and unadopted).  ``--drain`` SIGTERMs the emptiest member (scale-down).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+
+def _load_fleet_config(path):
+    if not path:
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def cmd_status(base_dir: str) -> int:
+    from .runtime.server import ServeClient
+
+    client = ServeClient.from_endpoint_file(base_dir)
+    doc = client.status()
+    print(json.dumps(doc, indent=2))
+    return int(doc.get("rc") or 0)
+
+
+def cmd_drain(base_dir: str, member=None) -> int:
+    from .runtime.server import ServeClient
+
+    client = ServeClient.from_endpoint_file(base_dir)
+    status, doc = client._call(
+        "POST", "/drain", {"member": member} if member else {},
+    )
+    print(json.dumps(doc, indent=2))
+    return 0 if status == 200 else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="cluster_tools_tpu.fleet",
+        description="serving fleet: gateway + M pipeline servers "
+                    "(docs/SERVING.md \"Fleet\")",
+    )
+    p.add_argument("--base-dir", required=False,
+                   help="fleet scratch dir (gateway state + members/mN "
+                        "server dirs)")
+    p.add_argument("--members", type=int, default=None,
+                   help="number of member servers to spawn (default 2)")
+    p.add_argument("--port", type=int, default=0,
+                   help="gateway bind port (default 0 = ephemeral, see "
+                        "server.json)")
+    p.add_argument("--config", default=None,
+                   help="fleet config json: members/gateway/server keys")
+    p.add_argument("--tpu", action="store_true",
+                   help="skip the cpu platform pin on members (requests "
+                        "may target the accelerator)")
+    p.add_argument("--status", metavar="BASE_DIR", default=None,
+                   help="print a running gateway's /status and exit with "
+                        "its rc")
+    p.add_argument("--drain", metavar="BASE_DIR", default=None,
+                   help="SIGTERM the emptiest member of a running fleet "
+                        "(scale-down; rc 114 on the member)")
+    p.add_argument("--member", default=None,
+                   help="with --drain: the member to drain instead of "
+                        "the emptiest")
+    args = p.parse_args(argv)
+
+    if args.status:
+        return cmd_status(args.status)
+    if args.drain:
+        return cmd_drain(args.drain, member=args.member)
+    if not args.base_dir:
+        p.error("--base-dir is required (unless --status/--drain)")
+
+    from .runtime.fleet import FleetGateway
+    from .runtime.server import ENDPOINT_FILENAME
+    from .runtime.supervision import (
+        REQUEUE_EXIT_CODE,
+        DrainInterrupt,
+        install_drain_handler,
+    )
+    from .utils import function_utils as fu
+
+    cfg = _load_fleet_config(args.config)
+    n_members = int(
+        args.members if args.members is not None
+        else cfg.get("members", 2)
+    )
+    if n_members < 1:
+        p.error("--members must be >= 1")
+    base_dir = os.path.abspath(args.base_dir)
+    member_root = os.path.join(base_dir, "members")
+    member_dirs = [
+        os.path.join(member_root, f"m{i}") for i in range(n_members)
+    ]
+    for d in member_dirs:
+        os.makedirs(d, exist_ok=True)
+    server_cfg_path = None
+    if cfg.get("server"):
+        server_cfg_path = os.path.join(base_dir, "member_config.json")
+        fu.atomic_write_json(server_cfg_path, cfg["server"])
+
+    procs = {}
+    procs_lock = threading.Lock()
+
+    def spawn(name: str, mdir: str):
+        """Start (or restart) one member server subprocess; returns its
+        pid.  Used at boot AND as the gateway's no-survivor respawn
+        callback — the fresh server's own boot replay finishes the
+        journal it is booting on."""
+        cmd = [
+            sys.executable, "-m", "cluster_tools_tpu.serve",
+            "--base-dir", mdir,
+        ]
+        if server_cfg_path:
+            cmd += ["--config", server_cfg_path]
+        if args.tpu:
+            cmd += ["--tpu"]
+        proc = subprocess.Popen(cmd)
+        with procs_lock:
+            procs[name] = proc
+        return proc.pid
+
+    def reap_loop():
+        """Collect member exit statuses so dead members never zombie —
+        death detection itself is the gateway's (healthz + heartbeat +
+        pid liveness)."""
+        while not stop_reaping.is_set():
+            with procs_lock:
+                live = list(procs.values())
+            for proc in live:
+                proc.poll()
+            stop_reaping.wait(1.0)
+
+    for d in member_dirs:
+        spawn(os.path.basename(d), d)
+    # wait for each member's endpoint file to name its CURRENT pid (a
+    # stale file from a previous incarnation must not fake a live boot)
+    boot_deadline = time.monotonic() + 120.0
+    for d in member_dirs:
+        name = os.path.basename(d)
+        while True:
+            doc = fu.read_json_if_valid(
+                os.path.join(d, ENDPOINT_FILENAME)
+            )
+            with procs_lock:
+                proc = procs[name]
+            if doc and doc.get("pid") == proc.pid:
+                break
+            if proc.poll() is not None:
+                print(f"member {name} died during boot "
+                      f"(rc {proc.returncode})", file=sys.stderr)
+                return 1
+            if time.monotonic() > boot_deadline:
+                print(f"member {name} did not bind in time",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.1)
+
+    gw_cfg = dict(cfg.get("gateway") or {})
+    gateway = FleetGateway(
+        base_dir=base_dir,
+        member_dirs=member_dirs,
+        port=args.port,
+        affinity=bool(gw_cfg.get("affinity", True)),
+        health_interval_s=float(gw_cfg.get("health_interval_s", 1.0)),
+        member_stale_s=float(gw_cfg.get("member_stale_s", 6.0)),
+        max_member_queue=int(gw_cfg.get("max_member_queue", 64)),
+        failover=str(gw_cfg.get("failover", "adopt")),
+        spawn=spawn,
+    )
+    stop_reaping = threading.Event()
+    reaper = threading.Thread(target=reap_loop, name="fleet-reaper",
+                              daemon=True)
+    reaper.start()
+    install_drain_handler()
+    gateway.start()
+    print(
+        f"fleet gateway on {gateway.host}:{gateway.port} "
+        f"(base_dir={base_dir}, members={n_members}, "
+        f"failover={gateway.failover})",
+        flush=True,
+    )
+    try:
+        gateway.serve_until_drained()
+    except DrainInterrupt as e:
+        # CT006/CT012: a drained fleet is a requeue, not a crash — drain
+        # every member through the standard SIGTERM protocol (each exits
+        # REQUEUE_EXIT_CODE) and exit the same way ourselves
+        stop_reaping.set()
+        with procs_lock:
+            live = dict(procs)
+        for name, proc in live.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for name, proc in live.items():
+            try:
+                rc = proc.wait(timeout=60.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rc = proc.wait()
+            print(f"member {name} exited rc {rc}", flush=True)
+        print(
+            f"DRAINED ({e.reason}); exiting {REQUEUE_EXIT_CODE} for requeue",
+            flush=True,
+        )
+        return REQUEUE_EXIT_CODE
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

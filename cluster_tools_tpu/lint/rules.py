@@ -1,4 +1,4 @@
-"""The ctlint rule classes CT001-CT011 (docs/ANALYSIS.md).
+"""The ctlint rule classes CT001-CT012 (docs/ANALYSIS.md).
 
 Every rule is derived from a *real* invariant of this codebase — the
 docstring of each checker names the file/contract it guards.  Rules are
@@ -1579,6 +1579,182 @@ def ct011_verified_read_discipline(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT012 - fleet hygiene
+# =============================================================================
+
+#: the fleet layer (docs/SERVING.md "Fleet"): the gateway/router module
+#: (runtime/fleet.py) and the fleet CLI both answer to the name
+_CT012_SCOPE = ("fleet.py",)
+
+#: call segments that do a network round trip (the gateway's member-call
+#: helpers plus the stdlib HTTP client surface) — forbidden under the
+#: router's locks on top of CT009's blocking/IO sets: one slow member
+#: probed under the placement lock head-of-line blocks every submit
+_CT012_HTTP_CALLS = frozenset({
+    "HTTPConnection", "urlopen", "getresponse", "request",
+    "_member_call", "_call", "_call_once", "_probe_member", "healthz",
+    "submit",
+})
+
+#: the adoption-claim API (runtime/fleet.py) — the only sanctioned
+#: doorway to a peer's journal
+_CT012_CLAIM_API = frozenset({
+    "acquire_adoption_claim", "verify_adoption_claim",
+    "read_adoption_claim", "release_adoption_claim", "read_peer_journal",
+})
+
+#: read entry points into a peer's journal that must be claim-gated
+_CT012_JOURNAL_READS = frozenset({"scan", "recover", "journal_path"})
+
+
+def ct012_fleet_hygiene(module: LintModule) -> List[Finding]:
+    """Fleet-layer hygiene for the gateway/router (docs/SERVING.md
+    "Fleet").
+
+    (a) **Placement-lock discipline**: the router's locks guard pure
+    bookkeeping (member table, affinity map, route table, counters) —
+    no blocking calls, no storage IO, and, the fleet-specific extension,
+    no HTTP (member calls, health probes) while holding them.  Every
+    submit contends for the placement lock; one slow member probed under
+    it freezes the whole fleet's intake.
+
+    (b) **Journal adoption only through the claim API**: a peer's
+    journal may only be read via ``read_peer_journal`` /
+    ``verify_adoption_claim`` — no raw ``open()`` of a journal-named
+    path, and no ``journal.scan``/``recover``/``journal_path`` reach
+    into a peer outside a claim-holding scope.  Two servers replaying
+    one journal double-run acknowledged work; the O_CREAT|O_EXCL claim
+    file is the exactly-one-adopter proof, and this rule is what keeps
+    every code path behind it.
+
+    (c) **Drain protocol at the entry point**: any caller of
+    ``serve_until_drained()`` must map ``DrainInterrupt`` to
+    ``REQUEUE_EXIT_CODE`` (114) — a drained gateway that exits
+    nonzero-as-crash breaks the rolling-restart protocol, same contract
+    as CT009(c) for the single server.
+    """
+    is_fixture = "ct012" in module.name
+    if module.name not in _CT012_SCOPE and not is_fixture:
+        return []
+    out: List[Finding] = []
+
+    # -- (a) nothing slow under the router's bookkeeping locks -------------
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.With):
+            continue
+        keys = [
+            k for k in (
+                _lock_key(module, item.context_expr) for item in node.items
+            ) if k is not None
+        ]
+        if not keys:
+            continue
+        held = keys[-1]
+        for stmt in node.body:
+            for inner in _walk_inline(stmt):
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = dotted(inner.func)
+                seg = last_seg(name)
+                if seg is None:
+                    continue
+                if seg in _BLOCKING_CALLS or (name or "").startswith(
+                    "subprocess."
+                ):
+                    if seg == "join" and isinstance(
+                        inner.func, ast.Attribute
+                    ) and isinstance(inner.func.value, ast.Constant):
+                        continue  # "sep".join(...) is not a thread join
+                    out.append(Finding(
+                        "CT012", module.path, inner.lineno,
+                        inner.col_offset,
+                        f"blocking call '{name}' while holding router "
+                        f"lock '{held}': every submit contends for the "
+                        "placement lock — wait outside the critical "
+                        "section",
+                    ))
+                elif seg in _CT012_HTTP_CALLS:
+                    out.append(Finding(
+                        "CT012", module.path, inner.lineno,
+                        inner.col_offset,
+                        f"HTTP call '{name}' while holding router lock "
+                        f"'{held}': one slow member probed under the "
+                        "placement lock head-of-line blocks the whole "
+                        "fleet's intake — snapshot under the lock, call "
+                        "outside it",
+                    ))
+                elif seg in _CT009_IO_CALLS:
+                    out.append(Finding(
+                        "CT012", module.path, inner.lineno,
+                        inner.col_offset,
+                        f"storage IO '{name}' under router lock "
+                        f"'{held}': state/failure writes must happen "
+                        "after release — snapshot under the lock, write "
+                        "outside it",
+                    ))
+
+    # -- (b) peer journals only through the adoption-claim API -------------
+    def _claim_gated(call: ast.Call) -> bool:
+        scope: Optional[ast.AST] = module.enclosing_function(call)
+        while scope is not None:
+            for c in calls_in(scope):
+                if last_seg(dotted(c.func)) in _CT012_CLAIM_API:
+                    return True
+            scope = module.enclosing_function(scope)
+        return False
+
+    def _journal_arg(call: ast.Call) -> bool:
+        # walk arg subtrees: "journal.log" inside os.path.join(...) is
+        # still a journal path
+        return any(
+            _names_journal(dotted(n)) or _names_journal(str_const(n))
+            for a in call.args
+            for n in ast.walk(a)
+        )
+
+    for call in calls_in(module.tree):
+        name = dotted(call.func)
+        seg = last_seg(name)
+        if seg == "open" or name == "os.open":
+            if _journal_arg(call):
+                out.append(Finding(
+                    "CT012", module.path, call.lineno, call.col_offset,
+                    "raw open of a journal path in the fleet layer: a "
+                    "peer's journal may only be read via "
+                    "read_peer_journal under the exclusive adoption "
+                    "claim — two servers replaying one journal "
+                    "double-run acknowledged work",
+                ))
+            continue
+        if seg in _CT012_JOURNAL_READS:
+            journalish = _names_journal(name) or _journal_arg(call)
+            if journalish and not _claim_gated(call):
+                out.append(Finding(
+                    "CT012", module.path, call.lineno, call.col_offset,
+                    f"journal read '{name}' outside a claim-holding "
+                    "scope: adoption must verify the O_CREAT|O_EXCL "
+                    "claim file first (acquire_adoption_claim / "
+                    "verify_adoption_claim / read_peer_journal) — the "
+                    "claim is the exactly-one-adopter proof",
+                ))
+
+    # -- (c) fleet entry points speak the drain protocol -------------------
+    for call in calls_in(module.tree):
+        if last_seg(dotted(call.func)) != "serve_until_drained":
+            continue
+        if not ("DrainInterrupt" in module.source
+                and "REQUEUE_EXIT_CODE" in module.source):
+            out.append(Finding(
+                "CT012", module.path, call.lineno, call.col_offset,
+                "serve_until_drained() raises DrainInterrupt after the "
+                "drain, but this entry point never maps it to "
+                "REQUEUE_EXIT_CODE: a SIGTERM'd gateway exits as a "
+                "crash instead of a rolling-restart requeue",
+            ))
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -1594,4 +1770,5 @@ RULES = {
     "CT009": ct009_server_hygiene,
     "CT010": ct010_journal_discipline,
     "CT011": ct011_verified_read_discipline,
+    "CT012": ct012_fleet_hygiene,
 }

@@ -63,6 +63,12 @@ REJECT_DEADLINE = "rejected:deadline"
 REJECT_DRAINING = "rejected:draining"
 REJECT_FAULT = "rejected:fault"
 REJECT_DUPLICATE = "rejected:duplicate"
+#: gateway-layer backpressure (runtime/fleet.py, docs/SERVING.md "Fleet"):
+#: no placeable member at all (everyone dead/draining — the failover
+#: window, HTTP 503) vs. every member over its queue cap (transient
+#: fleet-wide pressure, HTTP 429).  Both are retry-with-backoff codes.
+REJECT_FLEET_NO_MEMBER = "rejected:fleet_no_member"
+REJECT_FLEET_BACKLOG = "rejected:fleet_backlog"
 
 #: one DRR credit buys this many bytes of request cost (requests without a
 #: size declaration cost exactly one credit)

@@ -1,0 +1,1005 @@
+"""Serving fleet: a gateway/router + journal-handoff failover
+(docs/SERVING.md "Fleet").
+
+PRs 12-15 made the serving story durable, fair, cached, and self-healing
+on exactly ONE box.  This module is the fleet layer (ROADMAP item 1):
+:class:`FleetGateway` is a lightweight stdlib-HTTP router fronting M
+:class:`~cluster_tools_tpu.runtime.server.PipelineServer` processes.
+
+**Placement** is tenant-affine: a tenant sticks to the member that served
+it last, so the member's compiled-program cache, decompressed-chunk cache,
+and resident device/handoff state keep paying (BENCH_r10's 5.38x
+cold-to-warm split is the prize).  When the affine member is dead,
+draining, or over its queue cap, placement falls back to the
+least-queue-depth member — safe because submission is idempotent per
+``(request_id, payload-fingerprint)`` on every member, so a client retry
+that lands on a different member can never double-run an acknowledged
+request.  When NO member is placeable the gateway answers with its own
+typed backpressure (:data:`~cluster_tools_tpu.runtime.admission.
+REJECT_FLEET_NO_MEMBER` → 503, :data:`~cluster_tools_tpu.runtime.
+admission.REJECT_FLEET_BACKLOG` → 429), attributed in the gateway's
+``failures.json`` like every member-side rejection.
+
+**Failover** is a journal handoff.  The gateway health-checks members
+(``/healthz`` + heartbeat freshness + pid liveness); when one dies, the
+PR-13 journal under its base dir is already a complete, fsync'd record of
+every acknowledged request — precisely the primitive that turns
+single-server crash-recovery into cross-server failover.  A surviving
+member *adopts* the dead member's journal: the gateway takes an exclusive
+**adoption claim** (an ``O_CREAT|O_EXCL`` claim file in the dead member's
+base dir, ``fu.file_lock`` style with a dead-pid stale-break — exactly one
+of N contenders can ever win), then POSTs ``/adopt`` to the adopter, which
+folds the peer's journal through the ordinary boot-replay machinery:
+completed requests become idempotently-answerable records, acknowledged-
+but-incomplete ones re-enter the adopter's queue with their original
+tenant/payload and finish bit-identically, with ZERO client resubmission.
+The claim file stays behind as the adoption record, so no second server
+can ever adopt the same journal (:func:`read_peer_journal` is the only
+sanctioned read of a peer's journal, and it refuses without the claim —
+ctlint CT012).  With no survivor, a ``spawn`` callback (the fleet CLI
+wires one) restarts a member on the dead base dir instead, and plain boot
+replay does the rest.
+
+**Lock discipline** (ctlint CT012): ``_placement_lock`` guards pure
+bookkeeping — the member table, the tenant-affinity map, the
+request-route table, counters.  Every HTTP call, health probe, journal
+read, and state-file write happens outside it; one slow member probed
+under the placement lock would head-of-line block every submit.
+
+**Scale hooks**: ``fleet_state.json`` (rendered by ``scripts/progress.py``)
+aggregates per-member queue depth / replay backlog / scrub pressure from
+each member's ``server_state.json``, and :meth:`FleetGateway.
+drain_emptiest` SIGTERMs the emptiest member (→ rc 114) for scale-down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import function_utils as fu
+from . import admission as admission_mod
+from . import journal as journal_mod
+from . import trace as trace_mod
+from .server import ENDPOINT_FILENAME, SERVER_UID, STATE_FILENAME
+from .supervision import (
+    DrainInterrupt,
+    HeartbeatWriter,
+    drain_reason,
+    drain_requested,
+    pid_alive,
+    read_heartbeat,
+)
+
+GATEWAY_UID = "gateway"
+
+#: the gateway's operator-facing state file (scripts/progress.py fleet view)
+FLEET_STATE_FILENAME = "fleet_state.json"
+
+#: the exclusive adoption claim in a dead member's base dir.  Present =
+#: this journal's failover fate is decided (an adopter finished it, or a
+#: respawn is booting on it); absent = the journal is still its owner's.
+CLAIM_FILENAME = "adoption.claim"
+
+#: failures.json resolution recorded for a completed journal adoption
+ADOPTION_RESOLUTION = "adopted:journal"
+
+#: adoption events kept in fleet_state.json (oldest dropped)
+_MAX_ADOPTION_EVENTS = 64
+
+#: request-id -> member routes kept in memory (oldest pruned; a pruned
+#: route degrades to the broadcast lookup, never to a lost answer)
+_MAX_ROUTES = 4096
+
+
+class AdoptionRefused(RuntimeError):
+    """A journal adoption that must not proceed: no claim, a claim held
+    by someone else, or a self-adoption.  Mapped to HTTP 409 by the
+    member's ``/adopt`` handler."""
+
+
+# -- the adoption claim protocol ----------------------------------------------
+#
+# Exactly-once semantics, not mutual exclusion: fu.file_lock waits and
+# eventually *steals* from a live holder (its callers guard best-effort
+# bookkeeping), but two servers replaying one journal would double-run
+# acknowledged work — so a live holder is NEVER stolen from here.  Only a
+# claim whose recorded holder pid is provably dead on this host is broken
+# (atomic rename first: one of N contenders wins the rename, so two can
+# never both break the same claim and then break each other's).
+
+
+def adoption_claim_path(base_dir: str) -> str:
+    return os.path.join(os.path.abspath(base_dir), CLAIM_FILENAME)
+
+
+def read_adoption_claim(base_dir: str) -> Optional[Dict[str, Any]]:
+    """The current claim document, or None (unclaimed / torn)."""
+    return fu.read_json_if_valid(adoption_claim_path(base_dir))
+
+
+def acquire_adoption_claim(base_dir: str, by: str,
+                           pid: int) -> Optional[Dict[str, Any]]:
+    """Try to claim ``base_dir``'s journal for adoption by ``(by, pid)``.
+
+    Returns the claim document on success, None when another holder has
+    it (no waiting, no stealing from the living — double adoption is a
+    correctness bug, not a liveness problem).  A claim whose holder pid
+    is dead on this host is stale-broken and re-contended.
+    """
+    path = adoption_claim_path(base_dir)
+    doc = {
+        "by": str(by),
+        "pid": int(pid),
+        "host": socket.gethostname(),
+        "time": trace_mod.walltime(),
+        "token": uuid.uuid4().hex,
+    }
+    payload = json.dumps(doc, sort_keys=True).encode()
+    for _ in range(16):  # bounded: each lap is a create attempt or a break
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            held = fu.read_json_if_valid(path)
+            if held is None:
+                # mid-write or torn: give the writer one beat, re-read;
+                # still unreadable -> err on the side of NOT adopting
+                time.sleep(0.01)
+                held = fu.read_json_if_valid(path)
+                if held is None and os.path.exists(path):
+                    return None
+                if held is None:
+                    continue  # holder released between exists and read
+            if (
+                held.get("host") == socket.gethostname()
+                and not pid_alive(held.get("pid", -1))
+            ):
+                # stale-break on a dead holder: rename first, so exactly
+                # one of N contenders wins the break (fu.file_lock idiom)
+                grave = f"{path}.stale.{os.getpid()}.{threading.get_ident()}"
+                try:
+                    os.rename(path, grave)
+                    os.unlink(grave)
+                except OSError:
+                    pass  # another contender broke it first; re-contend
+                continue
+            return None  # a live holder owns this journal's fate
+        try:
+            os.write(fd, payload)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        return doc
+    return None
+
+
+def release_adoption_claim(base_dir: str,
+                           doc: Optional[Dict[str, Any]]) -> None:
+    """Withdraw OUR claim (adoption attempt failed / respawn finished
+    booting).  Token-checked like ``fu.file_lock``'s release: a holder
+    whose stale claim was broken must not remove the new holder's claim.
+    A claim that *consummated* an adoption is never released — it stays
+    behind as the adoption record."""
+    path = adoption_claim_path(base_dir)
+    cur = fu.read_json_if_valid(path)
+    if cur is not None and doc is not None \
+            and cur.get("token") == doc.get("token"):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def verify_adoption_claim(peer_base_dir: str, pid: Optional[int] = None,
+                          by: Optional[str] = None) -> Dict[str, Any]:
+    """The adopter-side gate: raise :class:`AdoptionRefused` unless a
+    claim exists on ``peer_base_dir`` and (when given) names this
+    ``pid``/``by`` on this host.  Servers call this before touching a
+    peer's journal, so a stray ``/adopt`` (or a second would-be adopter
+    racing the winner) can never read a journal it does not own."""
+    doc = read_adoption_claim(peer_base_dir)
+    if doc is None:
+        raise AdoptionRefused(
+            f"no adoption claim under {peer_base_dir!r}; "
+            "acquire_adoption_claim first"
+        )
+    if pid is not None and (
+        int(doc.get("pid") or -1) != int(pid)
+        or doc.get("host") != socket.gethostname()
+    ):
+        raise AdoptionRefused(
+            f"adoption claim on {peer_base_dir!r} is held by "
+            f"{doc.get('by')!r} (pid {doc.get('pid')} on "
+            f"{doc.get('host')}), not pid {pid} on this host"
+        )
+    if by is not None and doc.get("by") != by:
+        raise AdoptionRefused(
+            f"adoption claim on {peer_base_dir!r} names "
+            f"{doc.get('by')!r}, not {by!r}"
+        )
+    return doc
+
+
+def read_peer_journal(peer_base_dir: str, pid: Optional[int] = None,
+                      by: Optional[str] = None) -> List[Dict[str, Any]]:
+    """The ONLY sanctioned read of a peer's journal (ctlint CT012):
+    verifies the adoption claim, then scans read-only.  Never
+    ``Journal.recover()`` on a peer — recover opens for append and
+    truncates torn tails, and the dead member's journal must stay
+    byte-identical for post-mortems; a torn tail was never acknowledged,
+    so ``scan``'s intact prefix is the whole promise."""
+    verify_adoption_claim(peer_base_dir, pid=pid, by=by)
+    records, _, _ = journal_mod.scan(journal_mod.journal_path(peer_base_dir))
+    return records
+
+
+# -- the gateway --------------------------------------------------------------
+
+
+class FleetGateway:
+    """The fleet's router: tenant-affinity placement with least-queue
+    fallback, member health tracking, journal-handoff failover, typed
+    gateway backpressure, and the ``fleet_state.json`` operator view.
+    See the module docstring and docs/SERVING.md "Fleet".
+
+    Knobs: ``affinity`` (tenant stickiness on/off), ``health_interval_s``
+    (probe cadence), ``member_stale_s`` (heartbeat age past which an
+    unreachable member is declared dead), ``max_member_queue`` (per-member
+    queued+inflight cap before placement skips it), ``failover``
+    (``"adopt"`` = surviving member adopts the journal; ``"respawn"`` =
+    always restart on the dead base dir via ``spawn``), ``spawn`` (the
+    no-survivor fallback: ``spawn(name, base_dir) -> pid|None``).
+    """
+
+    def __init__(
+        self,
+        base_dir: str,
+        member_dirs: List[str],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        affinity: bool = True,
+        health_interval_s: float = 1.0,
+        member_stale_s: float = 6.0,
+        max_member_queue: int = 64,
+        call_timeout_s: float = 10.0,
+        failover: str = "adopt",
+        spawn: Optional[Callable[[str, str], Optional[int]]] = None,
+    ):
+        self.base_dir = os.path.abspath(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.failures_path = fu.failures_path(self.base_dir)
+        self.host = host
+        self.port = int(port)
+        self.affinity = bool(affinity)
+        self.health_interval_s = max(0.05, float(health_interval_s))
+        self.member_stale_s = max(0.1, float(member_stale_s))
+        self.max_member_queue = max(1, int(max_member_queue))
+        self.call_timeout_s = float(call_timeout_s)
+        if failover not in ("adopt", "respawn"):
+            raise ValueError(f"unknown failover policy {failover!r}")
+        self.failover = failover
+        self._spawn = spawn
+        self.started_at = trace_mod.walltime()
+        #: pure-bookkeeping lock (ctlint CT012): member table, affinity
+        #: map, route table, counters — never any IO under it
+        self._placement_lock = threading.Lock()
+        self._members: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        for i, d in enumerate(member_dirs):
+            d = os.path.abspath(d)
+            name = os.path.basename(d.rstrip(os.sep)) or f"m{i}"
+            if name in self._members:
+                name = f"{name}-{i}"
+            self._members[name] = {
+                "name": name, "base_dir": d, "host": None, "port": 0,
+                "pid": None, "hostname": None, "alive": False,
+                "ever_alive": False, "dead": False, "draining": False,
+                "adopted_by": None, "queued": 0, "inflight": 0,
+                "replay_backlog": 0, "scrub": None, "heartbeat_age_s": None,
+            }
+        if not self._members:
+            raise ValueError("a fleet needs at least one member dir")
+        self._affinity_map: Dict[str, str] = {}
+        self._affinity_hits = 0
+        self._affinity_misses = 0
+        self._routes: "OrderedDict[str, str]" = OrderedDict()
+        self._rejections: Dict[str, int] = {}
+        self._adoptions: List[Dict[str, Any]] = []
+        self._adopting: set = set()
+        self._reject_seq = 0
+        self._draining = False
+        self._stop = threading.Event()
+        self._heartbeat: Optional[HeartbeatWriter] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FleetGateway":
+        """One synchronous member sweep (so the first submit already sees
+        live members), then bind, start the health loop + heartbeat, and
+        write the endpoint file — the same ``server.json`` contract as a
+        member, so ``ServeClient.from_endpoint_file(gateway_dir)`` routes
+        through the gateway unchanged."""
+        self._check_members()
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          _GatewayHandler)
+        self._httpd.gateway = self  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-http", daemon=True,
+        )
+        self._http_thread.start()
+        self._heartbeat = HeartbeatWriter(
+            self.base_dir, GATEWAY_UID, interval_s=2.0
+        ).start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="fleet-health", daemon=True,
+        )
+        self._health_thread.start()
+        fu.atomic_write_json(
+            os.path.join(self.base_dir, ENDPOINT_FILENAME),
+            {
+                "host": self.host,
+                "port": self.port,
+                "pid": os.getpid(),
+                "hostname": socket.gethostname(),
+                "time": trace_mod.walltime(),
+                "role": "gateway",
+            },
+        )
+        self._write_state()
+        return self
+
+    def serve_until_drained(self, poll_s: float = 0.2) -> None:
+        """Block until the drain latch flips (SIGTERM/SIGUSR1), then stop
+        routing and raise :class:`DrainInterrupt` for the entry point to
+        map to ``REQUEUE_EXIT_CODE`` — the fleet CLI drains the members
+        behind the same signal (docs/SERVING.md "Fleet")."""
+        while not drain_requested():
+            time.sleep(poll_s)
+        self._draining = True
+        self._write_state()
+        self._teardown()
+        raise DrainInterrupt(drain_reason() or "drain requested")
+
+    def stop(self) -> None:
+        """Cooperative shutdown for embedders/tests (no drain
+        semantics)."""
+        self._draining = True
+        self._write_state()
+        self._teardown()
+
+    def _teardown(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(
+                timeout=2 * self.health_interval_s + 5.0
+            )
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # -- member HTTP (never under the placement lock) ----------------------
+    def _member_call(self, member: Dict[str, Any], method: str, path: str,
+                     body: Optional[Dict[str, Any]] = None,
+                     timeout_s: Optional[float] = None) -> Tuple[int, Dict]:
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            member["host"], int(member["port"]),
+            timeout=float(timeout_s if timeout_s is not None
+                          else self.call_timeout_s),
+        )
+        try:
+            data = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if data else {}
+            conn.request(method, path, body=data, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    # -- health ------------------------------------------------------------
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self._check_members()
+            except Exception:
+                pass  # the health loop must outlive one flaky probe
+
+    def _check_members(self) -> None:
+        with self._placement_lock:
+            names = list(self._members)
+        newly_dead = []
+        for name in names:
+            with self._placement_lock:
+                snap = dict(self._members[name])
+            update = self._probe_member(snap)  # all IO outside the lock
+            with self._placement_lock:
+                m = self._members.get(name)
+                if m is None:
+                    continue
+                m.update(update)
+                if (
+                    m["dead"] and m.get("adopted_by") is None
+                    and name not in self._adopting
+                ):
+                    newly_dead.append(name)
+        for name in newly_dead:
+            self._failover(name)
+        self._write_state()
+
+    def _probe_member(self, m: Dict[str, Any]) -> Dict[str, Any]:
+        """One member's health snapshot: endpoint + /healthz + heartbeat
+        age + pid liveness + the queue/replay/scrub pressure from its
+        ``server_state.json``.  Dead = unreachable AND (pid provably dead,
+        or heartbeat stale past ``member_stale_s``) — a member that has
+        simply not booted yet (never seen alive) is "starting", not dead,
+        so a slow cold boot never triggers a spurious adoption."""
+        base = m["base_dir"]
+        ep = fu.read_json_if_valid(
+            os.path.join(base, ENDPOINT_FILENAME)
+        ) or {}
+        host = ep.get("host") or m.get("host")
+        port = int(ep.get("port") or m.get("port") or 0)
+        pid = ep.get("pid") or m.get("pid")
+        hostname = ep.get("hostname") or m.get("hostname")
+        ok, health = False, {}
+        if host and port:
+            try:
+                status, health = self._member_call(
+                    {"host": host, "port": port}, "GET", "/healthz",
+                    timeout_s=min(2.0, max(0.2, self.member_stale_s / 2)),
+                )
+                ok = status == 200
+            except (OSError, ValueError):
+                ok = False
+        hb = read_heartbeat(base, SERVER_UID) or {}
+        hb_age = None
+        if hb.get("time") is not None:
+            hb_age = max(0.0, trace_mod.walltime() - float(hb["time"]))
+        state = fu.read_json_if_valid(os.path.join(base, STATE_FILENAME))
+        state = state or {}
+        queued = inflight = 0
+        for t in (state.get("tenants") or {}).values():
+            queued += int(t.get("queued") or 0)
+            inflight += int(t.get("inflight") or 0)
+        journal = state.get("journal") or {}
+        sc = state.get("scrub") or {}
+        pid_dead = bool(
+            pid
+            and hostname == socket.gethostname()
+            and int(pid) != os.getpid()
+            and not pid_alive(pid)
+        )
+        hb_stale = hb_age is None or hb_age > self.member_stale_s
+        ever = bool(m.get("ever_alive")) or ok
+        return {
+            "host": host, "port": port, "pid": pid, "hostname": hostname,
+            "alive": ok,
+            "ever_alive": ever,
+            "dead": (not ok) and ever and (pid_dead or hb_stale),
+            "draining": (
+                bool(health.get("draining")) if ok else m.get("draining")
+            ),
+            "queued": queued,
+            "inflight": inflight,
+            "replay_backlog": int(journal.get("replay_backlog") or 0),
+            "scrub": (
+                {k: sc.get(k) for k in ("passes", "found_corrupt",
+                                        "repaired", "unrepairable")}
+                if sc else None
+            ),
+            "heartbeat_age_s": (
+                round(hb_age, 3) if hb_age is not None else None
+            ),
+        }
+
+    # -- failover ----------------------------------------------------------
+    def _failover(self, name: str) -> None:
+        """One dead member's journal handoff: claim exclusively, then let
+        the least-loaded survivor adopt (or respawn when there is none).
+        Re-entered by every health tick until the member is adopted, so a
+        failed attempt (adopter crashed mid-adopt, claim released) is
+        retried instead of abandoned."""
+        with self._placement_lock:
+            m = self._members.get(name)
+            if (
+                m is None or m.get("adopted_by") is not None
+                or name in self._adopting
+            ):
+                return
+            self._adopting.add(name)
+            dead = dict(m)
+            survivors = [
+                dict(x) for x in self._members.values()
+                if x["name"] != name and x["alive"] and not x["draining"]
+                and x.get("adopted_by") is None
+            ]
+        try:
+            if self.failover == "respawn" or not survivors:
+                self._respawn_failover(dead)
+                return
+            adopter = min(
+                survivors,
+                key=lambda x: (x["queued"] + x["inflight"], x["name"]),
+            )
+            claim = acquire_adoption_claim(
+                dead["base_dir"], by=adopter["name"], pid=adopter["pid"],
+            )
+            if claim is None:
+                # someone else (a racing gateway / a respawn) owns this
+                # journal's fate; never double-adopt
+                trace_mod.instant(
+                    "fleet.adopt_contended", member=name,
+                )
+                return
+            try:
+                status, doc = self._member_call(
+                    adopter, "POST", "/adopt",
+                    {"base_dir": dead["base_dir"]},
+                )
+            except (OSError, ValueError):
+                status, doc = 0, {}
+            if status != 200:
+                # adoption did not happen: withdraw so the next tick (or
+                # another contender) can retry against a clean slate
+                release_adoption_claim(dead["base_dir"], claim)
+                return
+            event = {
+                "time": trace_mod.walltime(),
+                "kind": "adopt",
+                "member": name,
+                "adopter": adopter["name"],
+                "completed": int(doc.get("completed") or 0),
+                "reenqueued": int(doc.get("reenqueued") or 0),
+                "quarantined": int(doc.get("quarantined") or 0),
+            }
+            with self._placement_lock:
+                dm = self._members.get(name)
+                if dm is not None:
+                    dm["adopted_by"] = adopter["name"]
+                for rid, owner in list(self._routes.items()):
+                    if owner == name:
+                        self._routes[rid] = adopter["name"]
+                for tenant, owner in list(self._affinity_map.items()):
+                    if owner == name:
+                        self._affinity_map[tenant] = adopter["name"]
+                self._adoptions.append(event)
+                del self._adoptions[:-_MAX_ADOPTION_EVENTS]
+                self._reject_seq += 1
+                seq = self._reject_seq
+            trace_mod.instant(
+                "fleet.adopt", member=name, adopter=adopter["name"],
+                reenqueued=event["reenqueued"], completed=event["completed"],
+            )
+            try:
+                fu.record_failures(
+                    self.failures_path,
+                    "fleet.failover",
+                    [{
+                        "block_id": f"adopt:{name}:{seq}",
+                        "sites": {"failover": 1},
+                        "error": (
+                            f"member {name} died; journal adopted by "
+                            f"{adopter['name']}"
+                        ),
+                        "quarantined": False,
+                        "resolved": True,
+                        "resolution": ADOPTION_RESOLUTION,
+                        "member": name,
+                        "adopter": adopter["name"],
+                    }],
+                )
+            except Exception:
+                pass  # attribution is best-effort; the adoption stands
+            self._write_state()
+        finally:
+            self._adopting.discard(name)
+
+    def _respawn_failover(self, dead: Dict[str, Any]) -> None:
+        """No survivor (or ``failover='respawn'``): restart a server on
+        the dead base dir — its own boot replay finishes the acknowledged
+        work.  The claim is held across the spawn so a late-arriving
+        survivor cannot adopt a journal a fresh server is booting on, and
+        released after (the new incarnation owns its journal again)."""
+        if self._spawn is None:
+            return
+        name = dead["name"]
+        claim = acquire_adoption_claim(
+            dead["base_dir"], by=f"respawn:{name}", pid=os.getpid(),
+        )
+        if claim is None:
+            return
+        try:
+            pid = self._spawn(name, dead["base_dir"])
+        finally:
+            release_adoption_claim(dead["base_dir"], claim)
+        if pid is None:
+            return
+        event = {
+            "time": trace_mod.walltime(),
+            "kind": "respawn",
+            "member": name,
+            "pid": int(pid),
+        }
+        with self._placement_lock:
+            m = self._members.get(name)
+            if m is not None:
+                m["pid"] = int(pid)
+                m["dead"] = False
+                m["ever_alive"] = False  # re-arm the cold-boot grace
+            self._adoptions.append(event)
+            del self._adoptions[:-_MAX_ADOPTION_EVENTS]
+        trace_mod.instant("fleet.respawn", member=name, pid=int(pid))
+        self._write_state()
+
+    # -- placement ---------------------------------------------------------
+    def _place(self, tenant: str, exclude=()) -> Tuple[
+            Optional[Dict[str, Any]], Optional[str], bool]:
+        """Pick a member for one submission: the tenant's affine member
+        when placeable (warm caches pay), else least queue depth (and the
+        affinity map follows — the tenant sticks to wherever it lands).
+        Returns ``(member, reject_code, affinity_hit)``.  Pure
+        bookkeeping under the placement lock (ctlint CT012)."""
+        with self._placement_lock:
+            usable = [
+                m for m in self._members.values()
+                if m["alive"] and not m["draining"]
+                and m.get("adopted_by") is None
+                and m["name"] not in exclude
+            ]
+            if not usable:
+                return None, admission_mod.REJECT_FLEET_NO_MEMBER, False
+            placeable = [
+                m for m in usable
+                if m["queued"] + m["inflight"] < self.max_member_queue
+            ]
+            if not placeable:
+                return None, admission_mod.REJECT_FLEET_BACKLOG, False
+            want = (
+                self._affinity_map.get(tenant) if self.affinity else None
+            )
+            target, hit = None, False
+            for m in placeable:
+                if m["name"] == want:
+                    target, hit = m, True
+                    break
+            if target is None:
+                target = min(
+                    placeable,
+                    key=lambda m: (m["queued"] + m["inflight"], m["name"]),
+                )
+            if self.affinity:
+                self._affinity_map[tenant] = target["name"]
+            if hit:
+                self._affinity_hits += 1
+            else:
+                self._affinity_misses += 1
+            return dict(target), None, hit
+
+    def submit(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """Route one submission: place, forward, record the route.  A
+        member that drops the connection mid-submit is marked suspect and
+        the next member tried (idempotency makes the ambiguous retry
+        safe); typed member rejections pass through verbatim; when no
+        member is placeable the gateway's own typed backpressure answers
+        (``rejected:fleet_*``)."""
+        tenant = str(payload.get("tenant") or "default")
+        if self._draining or drain_requested():
+            return self._reject(
+                tenant, admission_mod.REJECT_DRAINING, "gateway draining",
+            )
+        tried: set = set()
+        last_err = ""
+        with self._placement_lock:
+            n_members = len(self._members)
+        for _ in range(n_members):
+            member, code, _hit = self._place(tenant, exclude=tried)
+            if member is None:
+                return self._reject(tenant, code, last_err)
+            try:
+                status, doc = self._member_call(
+                    member, "POST", "/submit", payload,
+                )
+            except (OSError, ValueError) as e:
+                tried.add(member["name"])
+                last_err = f"{member['name']}: {e}"
+                with self._placement_lock:
+                    live = self._members.get(member["name"])
+                    if live is not None:
+                        live["alive"] = False  # suspect; health confirms
+                continue
+            if status == 200 and doc.get("request_id"):
+                rid = str(doc["request_id"])
+                with self._placement_lock:
+                    self._routes[rid] = member["name"]
+                    while len(self._routes) > _MAX_ROUTES:
+                        self._routes.popitem(last=False)
+                    live = self._members.get(member["name"])
+                    if live is not None:
+                        # provisional until the next probe refreshes it:
+                        # keeps least-queue placement honest in bursts
+                        live["queued"] += 1
+                doc = dict(doc)
+                doc["member"] = member["name"]
+                return status, doc
+            return status, doc  # the member's typed answer, verbatim
+        return self._reject(
+            tenant, admission_mod.REJECT_FLEET_NO_MEMBER,
+            f"every member unreachable; last: {last_err}",
+        )
+
+    def _reject(self, tenant: str, code: str,
+                detail: str = "") -> Tuple[int, Dict[str, Any]]:
+        """Typed gateway backpressure, attributed exactly like a member's
+        rejection (failures.json + trace instant), outside all locks."""
+        with self._placement_lock:
+            self._reject_seq += 1
+            seq = self._reject_seq
+            self._rejections[code] = self._rejections.get(code, 0) + 1
+        try:
+            fu.record_failures(
+                self.failures_path,
+                f"fleet.{tenant}",
+                [{
+                    "block_id": f"route:{tenant}:{os.getpid()}:{seq}",
+                    "sites": {"route": 1},
+                    "error": detail or None,
+                    "quarantined": False,
+                    "resolved": True,
+                    "resolution": code,
+                    "tenant": tenant,
+                }],
+            )
+        except Exception:
+            pass  # attribution is best-effort; the rejection stands
+        trace_mod.instant("fleet.reject", tenant=tenant, code=code)
+        self._write_state()
+        http = 503 if code in (
+            admission_mod.REJECT_DRAINING,
+            admission_mod.REJECT_FLEET_NO_MEMBER,
+        ) else 429
+        return http, {"error": code, "tenant": tenant, "detail": detail}
+
+    # -- lookup ------------------------------------------------------------
+    def lookup(self, request_id: str) -> Tuple[int, Dict[str, Any]]:
+        """Find a request's record: the routed owner first (post-failover
+        routes already point at the adopter), then every live member (a
+        gateway restart loses the route table, the broadcast does not
+        lose answers).  A known owner that nobody can answer for is the
+        failover window: a typed 503 the client's ``wait`` backs off on,
+        never a terminal-looking document."""
+        with self._placement_lock:
+            owner = self._routes.get(request_id)
+            members = [dict(m) for m in self._members.values()]
+        ordered = [m for m in members if m["name"] == owner]
+        ordered += [
+            m for m in members
+            if m["alive"] and m["name"] != owner
+        ]
+        seen_answer = False
+        for m in ordered:
+            if not (m["alive"] or m["name"] == owner):
+                continue
+            try:
+                status, doc = self._member_call(
+                    m, "GET", f"/request/{request_id}",
+                )
+            except (OSError, ValueError):
+                continue
+            if status == 200:
+                return 200, doc
+            seen_answer = True
+        if owner is not None and not seen_answer:
+            return 503, {
+                "error": admission_mod.REJECT_FLEET_NO_MEMBER,
+                "request_id": request_id,
+                "detail": (
+                    f"owner {owner} unreachable; journal adoption pending"
+                ),
+            }
+        return 404, {"error": "unknown_request"}
+
+    # -- drain policy ------------------------------------------------------
+    def drain_emptiest(
+        self, member: Optional[str] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """The scale-down hook: SIGTERM the emptiest live member (or the
+        named one) so it drains through the standard protocol — in-flight
+        work finishes, queued work stays journaled, the process exits
+        ``REQUEUE_EXIT_CODE`` (114).  Returns the chosen member, or None
+        when nothing is drainable."""
+        with self._placement_lock:
+            candidates = [
+                dict(m) for m in self._members.values()
+                if m["alive"] and not m["draining"]
+                and m.get("adopted_by") is None
+                and (member is None or m["name"] == member)
+            ]
+            if not candidates:
+                return None
+            target = min(
+                candidates,
+                key=lambda m: (m["queued"] + m["inflight"], m["name"]),
+            )
+            live = self._members.get(target["name"])
+            if live is not None:
+                live["draining"] = True
+        pid = target.get("pid")
+        delivered = False
+        if (
+            pid and int(pid) != os.getpid()
+            and target.get("hostname") == socket.gethostname()
+        ):
+            try:
+                os.kill(int(pid), signal.SIGTERM)
+                delivered = True
+            except OSError:
+                delivered = False
+        trace_mod.instant(
+            "fleet.drain", member=target["name"],
+            pid=int(pid) if pid else 0,
+        )
+        self._write_state()
+        return {
+            "member": target["name"],
+            "pid": pid,
+            "signalled": delivered,
+        }
+
+    # -- introspection -----------------------------------------------------
+    def _state_doc(self) -> Dict[str, Any]:
+        with self._placement_lock:
+            members = {
+                n: {
+                    k: m.get(k)
+                    for k in ("base_dir", "host", "port", "pid", "hostname",
+                              "alive", "ever_alive", "dead", "draining",
+                              "adopted_by", "queued", "inflight",
+                              "replay_backlog", "scrub", "heartbeat_age_s")
+                }
+                for n, m in self._members.items()
+            }
+            hits, misses = self._affinity_hits, self._affinity_misses
+            affinity_map = dict(self._affinity_map)
+            adoptions = list(self._adoptions)
+            rejections = dict(self._rejections)
+            n_routes = len(self._routes)
+        total = hits + misses
+        return {
+            "version": 1,
+            "role": "gateway",
+            "uid": GATEWAY_UID,
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "host": self.host,
+            "port": self.port,
+            "time": trace_mod.walltime(),
+            "started": self.started_at,
+            "draining": self._draining or drain_requested(),
+            "failover": self.failover,
+            "members": members,
+            "affinity": {
+                "enabled": self.affinity,
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": round(hits / total, 4) if total else None,
+                "map": affinity_map,
+            },
+            "routes": n_routes,
+            "rejections": rejections,
+            "adoptions": adoptions,
+            "dead_unadopted": sorted(
+                n for n, m in members.items()
+                if m.get("dead") and not m.get("adopted_by")
+            ),
+        }
+
+    def _write_state(self) -> None:
+        """Atomically refresh ``fleet_state.json`` — the file the
+        ``scripts/progress.py`` fleet view renders.  Best-effort; the
+        gateway must outlive a full disk."""
+        try:
+            fu.atomic_write_json(
+                os.path.join(self.base_dir, FLEET_STATE_FILENAME),
+                self._state_doc(),
+            )
+        except OSError:
+            pass
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/status`` document: the fleet state plus an ``rc`` that
+        preserves the operator contract — 1 when a member is dead and
+        unadopted (acknowledged requests are stranded until the failover
+        completes)."""
+        doc = self._state_doc()
+        return {"fleet": doc, "rc": 1 if doc["dead_unadopted"] else 0}
+
+    def healthz(self) -> Dict[str, Any]:
+        doc = self._state_doc()
+        return {
+            "ok": True,
+            "role": "gateway",
+            "draining": doc["draining"],
+            "members": {
+                n: {
+                    k: m.get(k)
+                    for k in ("alive", "dead", "draining", "adopted_by",
+                              "queued", "inflight", "replay_backlog")
+                }
+                for n, m in doc["members"].items()
+            },
+            "affinity": doc["affinity"],
+            "dead_unadopted": doc["dead_unadopted"],
+        }
+
+
+# -- HTTP plumbing ------------------------------------------------------------
+
+
+class _GatewayHandler(BaseHTTPRequestHandler):
+    """The gateway's JSON-over-HTTP surface, a superset-shape of the
+    member handler so existing clients work unchanged: POST /submit,
+    GET /status, GET /request/<id>, GET /healthz, plus the fleet-only
+    POST /drain (the scale-down hook)."""
+
+    server_version = "ctt-fleet/1"
+
+    @property
+    def gateway(self) -> FleetGateway:
+        return self.server.gateway  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # quiet: the state file is the log
+        pass
+
+    def _reply(self, code: int, doc: Dict[str, Any]) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.rstrip("/")
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, OSError) as e:
+            self._reply(400, {"error": "bad_request", "detail": str(e)})
+            return
+        if path == "/submit":
+            status, doc = self.gateway.submit(payload)
+            self._reply(status, doc)
+        elif path == "/drain":
+            doc = self.gateway.drain_emptiest(payload.get("member"))
+            if doc is None:
+                self._reply(409, {"error": "no_drainable_member"})
+            else:
+                self._reply(200, doc)
+        else:
+            self._reply(404, {"error": "not_found"})
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.rstrip("/")
+        if path == "/healthz":
+            self._reply(200, self.gateway.healthz())
+        elif path == "/status":
+            self._reply(200, self.gateway.status())
+        elif path.startswith("/request/"):
+            status, doc = self.gateway.lookup(path[len("/request/"):])
+            self._reply(status, doc)
+        else:
+            self._reply(404, {"error": "not_found"})

@@ -208,6 +208,9 @@ class PipelineServer:
         self._requests_lock = threading.Lock()
         self._reject_seq = 0
         self._order: List[str] = []  # insertion order, for pruning
+        #: journal adoptions this incarnation performed (fleet failover;
+        #: docs/SERVING.md "Fleet") — surfaced in server_state.json
+        self._adoptions: List[Dict[str, Any]] = []
         self._workers: List[threading.Thread] = []
         self._stop = threading.Event()
         self._heartbeat: Optional[HeartbeatWriter] = None
@@ -560,6 +563,130 @@ class PipelineServer:
             code=QUARANTINE_CRASH_LOOP,
         )
         self._replay_stats["quarantined"] += 1
+
+    # -- fleet failover ----------------------------------------------------
+    def adopt_journal(self, peer_base_dir: str) -> Dict[str, Any]:
+        """Journal handoff (docs/SERVING.md "Fleet"): fold a dead peer's
+        journal into this server through the ordinary replay machinery —
+        terminal requests become idempotently-answerable records,
+        acknowledged-but-incomplete ones re-enter this server's queue and
+        finish bit-identically, crash-loopers are quarantined.  Gated on
+        the exclusive adoption claim (``runtime/fleet.py``): the claim
+        file in the peer's base dir must name THIS pid, so exactly one of
+        N would-be adopters can ever get here (ctlint CT012).  Each
+        adopted lifecycle is re-journaled HERE before it is enqueued, so
+        the adopter crashing mid-adoption loses nothing — its own boot
+        replay finishes the inherited promises."""
+        from . import fleet as fleet_mod  # lazy: fleet imports server
+
+        peer = os.path.abspath(peer_base_dir)
+        if peer == self.base_dir:
+            raise fleet_mod.AdoptionRefused(
+                f"refusing self-adoption of {peer!r}"
+            )
+        records = fleet_mod.read_peer_journal(peer, pid=os.getpid())
+        folded = journal_mod.fold(records)
+        counts: Dict[str, Dict[str, int]] = {}
+        stats = {"peer": peer, "completed": 0, "reenqueued": 0,
+                 "quarantined": 0, "skipped": 0}
+        for rid, ent in folded.items():
+            tenant = ent["tenant"]
+            state = ent["state"]
+            with self._requests_lock:
+                known = rid in self._requests
+            if known or state == journal_mod.REJECTED:
+                # already ours (a client retry raced the failover onto
+                # this member) or terminal-and-replaceable: nothing to
+                # inherit — idempotency answers the former, the id stays
+                # free for the latter
+                stats["skipped"] += 1
+                continue
+            c = counts.setdefault(tenant, {
+                "submitted": 0, "dispatched": 0, "completed": 0,
+                "rejected": 0,
+            })
+            # durability first: the inherited lifecycle goes into OUR
+            # journal (never under a lock) before any in-memory state, so
+            # a crash mid-adoption replays to the same decision
+            if self._journal is not None:
+                for rec_doc in journal_mod.snapshot_records(ent):
+                    self._journal.append(rec_doc)
+            if state in (journal_mod.COMPLETED, journal_mod.FAILED,
+                         journal_mod.QUARANTINED):
+                c["submitted"] += 1
+                c["dispatched"] += ent["attempts"]
+                if state == journal_mod.COMPLETED:
+                    c["completed"] += 1
+                rec = dict(ent.get("record") or {})
+                rec.setdefault("request_id", rid)
+                rec.setdefault("tenant", tenant)
+                rec.setdefault("state", {
+                    journal_mod.COMPLETED: "done",
+                    journal_mod.FAILED: "failed",
+                    journal_mod.QUARANTINED: "quarantined",
+                }[state])
+                rec.setdefault("fingerprint", ent.get("fingerprint"))
+                rec["replayed"] = True
+                rec["adopted_from"] = peer
+                with self._requests_lock:
+                    self._requests[rid] = rec
+                    self._order.append(rid)
+                    self._prune_locked()
+                stats["completed"] += 1
+                continue
+            if ent["attempts"] >= self.max_replay_attempts:
+                c["submitted"] += 1
+                c["dispatched"] += ent["attempts"]
+                self._quarantine_crash_loop(ent)
+                stats["quarantined"] += 1
+                continue
+            c["dispatched"] += ent["attempts"]
+            self._reenqueue_replayed(ent)
+            with self._requests_lock:
+                rec = self._requests.get(rid)
+                if rec is not None:
+                    rec["adopted_from"] = peer
+            stats["reenqueued"] += 1
+        for tenant, c in counts.items():
+            if any(c.values()):
+                self.controller.restore_counts(tenant, **c)
+        event = {
+            "time": trace_mod.walltime(),
+            "peer": peer,
+            "completed": stats["completed"],
+            "reenqueued": stats["reenqueued"],
+            "quarantined": stats["quarantined"],
+            "skipped": stats["skipped"],
+        }
+        with self._requests_lock:
+            self._adoptions.append(event)
+            del self._adoptions[:-16]
+        try:
+            fu.record_failures(
+                self.failures_path,
+                "server.fleet",
+                [{
+                    "block_id": (
+                        f"adopt:{os.path.basename(peer.rstrip(os.sep))}"
+                        f":{os.getpid()}"
+                    ),
+                    "sites": {"adopt": 1},
+                    "error": f"adopted journal of dead peer {peer}",
+                    "quarantined": False,
+                    "resolved": True,
+                    "resolution": fleet_mod.ADOPTION_RESOLUTION,
+                    "peer": peer,
+                }],
+            )
+        except Exception:
+            pass  # attribution is best-effort; the adoption stands
+        trace_mod.instant(
+            "server.adopt", peer=peer, completed=stats["completed"],
+            reenqueued=stats["reenqueued"],
+            quarantined=stats["quarantined"],
+        )
+        self._write_state()
+        return stats
 
     # -- submission --------------------------------------------------------
     def _idempotent_doc(self, request_id: str,
@@ -948,6 +1075,7 @@ class PipelineServer:
                 }
                 for rid, rec in self._requests.items()
             }
+            adoptions = list(self._adoptions)
         return {
             "version": 1,
             "uid": SERVER_UID,
@@ -971,6 +1099,9 @@ class PipelineServer:
             # fsync freshness, journal growth, and what this incarnation's
             # replay recovered / re-enqueued / quarantined
             "journal": journal,
+            # fleet failover (docs/SERVING.md "Fleet"): dead peers whose
+            # journals this incarnation adopted
+            "adoptions": adoptions,
             # the server-scoped compiled-program cache (hits = repeat
             # requests that skipped a trace/compile; unkeyed = kernels
             # whose captured state could not be identity-frozen)
@@ -1084,7 +1215,8 @@ class _RequestHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
-        if self.path.rstrip("/") != "/submit":
+        path = self.path.rstrip("/")
+        if path not in ("/submit", "/adopt"):
             self._reply(404, {"error": "not_found"})
             return
         try:
@@ -1092,6 +1224,22 @@ class _RequestHandler(BaseHTTPRequestHandler):
             payload = json.loads(self.rfile.read(length) or b"{}")
         except (ValueError, OSError) as e:
             self._reply(400, {"error": "bad_request", "detail": str(e)})
+            return
+        if path == "/adopt":
+            # fleet failover (docs/SERVING.md "Fleet"): adopt a dead
+            # peer's journal — only with the exclusive claim in hand
+            from . import fleet as fleet_mod  # lazy: fleet imports server
+
+            try:
+                self._reply(200, self.pipeline.adopt_journal(
+                    str(payload.get("base_dir") or "")
+                ))
+            except fleet_mod.AdoptionRefused as e:
+                self._reply(409, {
+                    "error": "adoption_refused", "detail": str(e),
+                })
+            except (ValueError, KeyError, OSError) as e:
+                self._reply(400, {"error": "bad_request", "detail": str(e)})
             return
         try:
             self._reply(200, self.pipeline.submit(payload))
@@ -1154,12 +1302,17 @@ class ServeRejected(RuntimeError):
 
 
 #: rejection codes a durable client may retry with backoff: the restart
-#: window (503) and transient quota pressure.  byte_quota / duplicate /
-#: fault are NOT retryable-by-default — resubmitting them verbatim can
-#: never succeed (oversize, collision) or is the chaos seed's to count.
+#: window (503), transient quota pressure, and the gateway's fleet-level
+#: backpressure (no placeable member — the failover window — or every
+#: member over its queue cap; both clear on their own).  byte_quota /
+#: duplicate / fault are NOT retryable-by-default — resubmitting them
+#: verbatim can never succeed (oversize, collision) or is the chaos
+#: seed's to count.
 RETRYABLE_REJECTS = (
     admission_mod.REJECT_DRAINING,
     admission_mod.REJECT_QUEUE,
+    admission_mod.REJECT_FLEET_NO_MEMBER,
+    admission_mod.REJECT_FLEET_BACKLOG,
 )
 
 
@@ -1303,17 +1456,36 @@ class ServeClient:
         """Poll until the request reaches a terminal state; returns its
         record.  Raises TimeoutError when it stays live past
         ``timeout_s``.  With ``across_restarts`` (needs a ``base_dir``
-        endpoint file), polls ride out server restarts: connection
-        failures retry against the re-read endpoint until the deadline —
-        the journal's replay contract means an acknowledged request's
-        record WILL come back."""
+        endpoint file), polls ride out server restarts AND fleet
+        failovers: connection failures retry against the re-read endpoint
+        until the deadline, and a state-less answer — the gateway's typed
+        failover-window document (``rejected:fleet_no_member``: the
+        routed member is dead and its journal not yet adopted) — is
+        treated as transient with capped backoff, because the adoption
+        protocol (docs/SERVING.md "Fleet") means the record WILL come
+        back, served by a different member, with zero resubmission."""
         deadline = time.monotonic() + timeout_s
+        attempt = 0
         while True:
             remaining = deadline - time.monotonic()
             rec = self.request(
                 request_id,
                 retry_s=max(0.1, remaining) if across_restarts else None,
             )
+            if (
+                across_restarts
+                and rec is not None
+                and rec.get("state") is None
+            ):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"request {request_id} unresolved after "
+                        f"{timeout_s:g}s: {rec.get('error')!r}"
+                    )
+                time.sleep(fu.backoff_delay(attempt, poll_s, 1.0))
+                attempt += 1
+                self._refresh_endpoint()
+                continue
             if rec is not None and rec.get("state") not in (
                 "queued", "running",
             ):

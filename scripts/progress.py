@@ -33,6 +33,13 @@ heartbeat — queue depth, in-flight, completed/rejected counts, bytes in
 flight, and the request table — alongside the block-marker view of
 whatever requests keep their tmp folders underneath.  A stale server
 heartbeat (or a dead pid on this host) warns exactly like a stalled task.
+
+Fleet mode (docs/SERVING.md "Fleet"): pointed at a gateway's base dir,
+the same invocation renders the member table from ``fleet_state.json`` —
+alive/dead/draining/adopted per member, queue depth, replay backlog,
+affinity hit rate, and adoption events.  A member that is dead and NOT
+yet adopted means acknowledged requests are stranded until the journal
+handoff completes: rc 1, exactly like a stalled task.
 """
 
 from __future__ import annotations
@@ -189,6 +196,48 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
         heartbeats.pop("server", None)
         uids.discard("server")
 
+    # -- fleet mode: the gateway's member table (docs/SERVING.md "Fleet") --
+    fleet = None
+    fleet_state = _read_json(os.path.join(tmp_folder, "fleet_state.json"))
+    if fleet_state is not None:
+        hb = heartbeats.get("gateway")
+        hb_age = hb["age_s"] if hb else None
+        pid = fleet_state.get("pid")
+        pid_dead = bool(
+            pid is not None
+            and fleet_state.get("hostname") == socket.gethostname()
+            and not _pid_alive(pid)
+        )
+        members = fleet_state.get("members") or {}
+        dead_unadopted = fleet_state.get("dead_unadopted")
+        if dead_unadopted is None:
+            dead_unadopted = sorted(
+                n for n, m in members.items()
+                if m.get("dead") and not m.get("adopted_by")
+            )
+        fleet = {
+            "pid": pid,
+            "hostname": fleet_state.get("hostname"),
+            "port": fleet_state.get("port"),
+            "draining": bool(fleet_state.get("draining")),
+            "heartbeat_age_s": (
+                round(hb_age, 1) if hb_age is not None else None
+            ),
+            "stale": pid_dead or (
+                hb_age is not None and hb_age > stale_after_s
+            ),
+            "members": members,
+            "affinity": fleet_state.get("affinity") or {},
+            "rejections": fleet_state.get("rejections") or {},
+            "adoptions": fleet_state.get("adoptions") or [],
+            "routes": fleet_state.get("routes"),
+            # acknowledged requests stranded until the journal handoff
+            # completes — the operator page (rc 1)
+            "dead_unadopted": dead_unadopted,
+        }
+        heartbeats.pop("gateway", None)
+        uids.discard("gateway")
+
     # per-task sweep counters (io_metrics.json, written by the task
     # runtime next to failures.json): the dispatch-amortization pulse —
     # including the ragged paged-pool counters (docs/PERFORMANCE.md
@@ -273,6 +322,7 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
         "stale_after_s": float(stale_after_s),
         "tasks": tasks,
         "server": server,
+        "fleet": fleet,
         "traced": os.path.isdir(os.path.join(tmp_folder, "trace")),
     }
 
@@ -374,6 +424,75 @@ def _format_server(server) -> list:
     return lines
 
 
+def _format_fleet(fleet) -> list:
+    """The gateway's member table (docs/SERVING.md "Fleet"): one line per
+    member, then affinity / rejection / adoption tallies."""
+    state = "DRAINING" if fleet["draining"] else "routing"
+    if fleet["stale"]:
+        state += " (STALE?)"
+    where = f"{fleet.get('hostname') or '?'}:{fleet.get('port') or '?'}"
+    hb = (
+        f", heartbeat {fleet['heartbeat_age_s']:.1f}s ago"
+        if fleet.get("heartbeat_age_s") is not None else ""
+    )
+    lines = [f"  fleet gateway {where}  pid {fleet.get('pid')}  {state}{hb}"]
+    members = fleet.get("members") or {}
+    if members:
+        width = max(len(n) for n in members)
+        for name, m in sorted(members.items()):
+            if m.get("adopted_by"):
+                st = f"dead, adopted by {m['adopted_by']}"
+            elif m.get("dead"):
+                st = "DEAD (unadopted)"
+            elif m.get("draining"):
+                st = "draining"
+            elif m.get("alive"):
+                st = "alive"
+            else:
+                st = "starting"
+            bits = [
+                f"{m.get('queued', 0)} queued",
+                f"{m.get('inflight', 0)} in-flight",
+            ]
+            if m.get("replay_backlog"):
+                bits.append(f"replay backlog {m['replay_backlog']}")
+            if m.get("scrub_pressure"):
+                bits.append(f"scrub pressure {m['scrub_pressure']}")
+            if m.get("heartbeat_age_s") is not None:
+                bits.append(
+                    f"heartbeat {float(m['heartbeat_age_s']):.1f}s ago"
+                )
+            lines.append(
+                f"    member {name:<{width}}  [{st}]  " + ", ".join(bits)
+            )
+    else:
+        lines.append("    no members registered yet")
+    aff = fleet.get("affinity") or {}
+    if aff:
+        hits = aff.get("hits", 0)
+        misses = aff.get("misses", 0)
+        rate = hits / max(1, hits + misses)
+        lines.append(
+            f"    affinity: {'on' if aff.get('enabled', True) else 'off'}, "
+            f"{hits} hit(s), {misses} miss(es) (hit_rate {rate:.2f})"
+        )
+    rej = {k: v for k, v in (fleet.get("rejections") or {}).items() if v}
+    if rej:
+        lines.append(
+            "    rejections: "
+            + ", ".join(f"{n} {code}" for code, n in sorted(rej.items()))
+        )
+    for ev in (fleet.get("adoptions") or [])[-4:]:
+        lines.append(
+            f"    adoption: {ev.get('member') or ev.get('peer')} -> "
+            f"{ev.get('adopter') or ev.get('by')} "
+            f"({ev.get('completed', 0)} completed, "
+            f"{ev.get('reenqueued', 0)} re-enqueued, "
+            f"{ev.get('quarantined', 0)} quarantined)"
+        )
+    return lines
+
+
 def format_progress(doc) -> str:
     tasks = doc["tasks"]
     lines = [
@@ -400,6 +519,19 @@ def format_progress(doc) -> str:
                 "  WARNING: scrubber found corruption lineage could not "
                 "repair (quarantined:unrepairable) — the stored product "
                 "is damaged; see failures.json / make failures-report"
+            )
+    if doc.get("fleet") is not None:
+        lines.extend(_format_fleet(doc["fleet"]))
+        if doc["fleet"]["stale"]:
+            lines.append(
+                "  WARNING: fleet gateway looks dead (stale heartbeat or "
+                "dead pid) — nothing is routing; restart it"
+            )
+        for name in doc["fleet"].get("dead_unadopted") or []:
+            lines.append(
+                f"  WARNING: member {name} is dead and its journal is NOT "
+                "adopted — acknowledged requests are stranded until a "
+                "survivor adopts it (see docs/SERVING.md \"Fleet\")"
             )
     if not tasks:
         lines.append("  no tasks seen yet (no markers, manifests, "
@@ -475,6 +607,11 @@ def main(argv) -> int:
     if doc.get("server") is not None and (
         doc["server"]["stale"]
         or doc["server"].get("journal_backlog_stalled")
+    ):
+        bad = True
+    # a dead-and-unadopted fleet member strands acknowledged requests
+    if doc.get("fleet") is not None and (
+        doc["fleet"]["stale"] or doc["fleet"].get("dead_unadopted")
     ):
         bad = True
     return 1 if bad else 0

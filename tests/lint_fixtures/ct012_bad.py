@@ -1,0 +1,47 @@
+"""CT012 fixture: HTTP + blocking + storage IO under the placement lock,
+raw peer-journal reads outside the adoption-claim API, a deaf gateway
+entry point."""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+from cluster_tools_tpu.runtime import journal
+from cluster_tools_tpu.utils import function_utils as fu
+
+
+class Gateway:
+    def __init__(self):
+        self._placement_lock = threading.Lock()
+        self._members = {}
+        self._routes = {}
+
+    def place(self, tenant, member, path, doc):
+        with self._placement_lock:
+            time.sleep(0.1)  # blocking under the placement lock
+            conn = http.client.HTTPConnection("127.0.0.1", 80)  # HTTP...
+            conn.request("GET", "/healthz")  # ...round trips under it
+            self._member_call(member, "GET", "/healthz")  # helper too
+            with open(path, "w") as f:  # storage IO under the lock
+                json.dump(doc, f)
+            fu.atomic_write_json(path, doc)  # helper IO is still IO
+            self._routes[tenant] = member
+
+    def _member_call(self, member, method, path):
+        return 200, {}
+
+
+def steal_peer_journal(peer_base_dir):
+    # raw read of a peer's journal with no adoption claim: a second
+    # reader can double-run acknowledged work
+    with open(os.path.join(peer_base_dir, "journal.log"), "rb") as f:
+        raw = f.read()
+    records, _, _ = journal.scan(journal.journal_path(peer_base_dir))
+    return raw, records
+
+
+def main(gateway):
+    gateway.serve_until_drained()  # never mapped to the requeue exit
+    return 0

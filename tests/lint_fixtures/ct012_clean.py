@@ -1,0 +1,69 @@
+"""CT012 fixture: pure-bookkeeping placement-lock bodies, claim-gated
+peer-journal adoption, drain-correct gateway entry (clean)."""
+
+import sys
+import threading
+
+from cluster_tools_tpu.runtime import journal
+from cluster_tools_tpu.runtime.fleet import (
+    acquire_adoption_claim,
+    read_peer_journal,
+    release_adoption_claim,
+    verify_adoption_claim,
+)
+from cluster_tools_tpu.runtime.supervision import (
+    REQUEUE_EXIT_CODE,
+    DrainInterrupt,
+)
+from cluster_tools_tpu.utils import function_utils as fu
+
+
+class Gateway:
+    def __init__(self):
+        self._placement_lock = threading.Lock()
+        self._members = {}
+        self._routes = {}
+
+    def place(self, tenant, path, doc):
+        with self._placement_lock:
+            # bookkeeping only under the lock; HTTP/IO after release
+            member = min(self._members)
+            self._routes[tenant] = member
+            snapshot = dict(doc)
+        status, health = self._member_call(member, "GET", "/healthz")
+        fu.atomic_write_json(path, snapshot)
+        return status, health
+
+    def _member_call(self, member, method, path):
+        return 200, {}
+
+
+def adopt(peer_base_dir, by, pid):
+    claim = acquire_adoption_claim(peer_base_dir, by=by, pid=pid)
+    if claim is None:
+        return None
+    records = read_peer_journal(peer_base_dir, pid=pid)
+    return records
+
+
+def inspect(peer_base_dir, pid):
+    # a direct scan is fine INSIDE a claim-holding scope
+    verify_adoption_claim(peer_base_dir, pid=pid)
+    records, _, _ = journal.scan(journal.journal_path(peer_base_dir))
+    return records
+
+
+def withdraw(peer_base_dir, claim):
+    release_adoption_claim(peer_base_dir, claim)
+
+
+def main(gateway):
+    try:
+        gateway.serve_until_drained()
+    except DrainInterrupt:
+        return REQUEUE_EXIT_CODE
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(None))

@@ -1161,3 +1161,190 @@ def test_chaos_serve_self_healing_corruption(tmp_path):
     finally:
         reap_process(proc)
     assert stray_serve_pids() == []
+
+
+def test_chaos_fleet_kill_server_failover(tmp_path):
+    """ISSUE 17 acceptance: ``kill -9`` one member of a two-server fleet
+    under live two-tenant traffic — zero lost acknowledged requests.
+
+    - six requests (two tenants) are acknowledged through the gateway;
+      the member serving tenant alice is SIGKILLed with most of its
+      backlog still queued (acknowledged, not complete);
+    - the gateway detects the death, a surviving member takes the
+      exclusive adoption claim, adopts the dead member's journal, and
+      finishes EVERY acknowledged request — the client never resubmits,
+      it just keeps waiting through the failover window;
+    - every output is bit-identical to a solo batch reference;
+    - exactly one adoption happened, the claim file in the dead member's
+      dir names the adopter, and a concurrent claim attempt is refused;
+    - the failover is attributed in the gateway's failures.json
+      (``adopted:journal``) and the fleet drains to rc 114 on SIGTERM.
+    """
+    import signal
+    import time
+
+    from cluster_tools_tpu.runtime.fleet import (
+        FLEET_STATE_FILENAME,
+        acquire_adoption_claim,
+    )
+    from cluster_tools_tpu.runtime.server import ServeClient
+
+    root = str(tmp_path)
+    rng = np.random.default_rng(SEED)
+    vol = (rng.random((16, 16, 16)) > 0.5).astype("float32")
+    data = os.path.join(root, "data.zarr")
+    ds = file_reader(data).create_dataset(
+        "mask", shape=vol.shape, chunks=(8, 8, 8), dtype="float32")
+    ds[...] = vol
+
+    # -- solo reference (memory_handoffs on, the resident-owner default) ---
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.connected_components import (
+        ConnectedComponentsWorkflow,
+    )
+
+    ref_dir = os.path.join(root, "ref")
+    os.makedirs(os.path.join(ref_dir, "config"), exist_ok=True)
+    with open(os.path.join(ref_dir, "config", "global.config"), "w") as f:
+        json.dump({"block_shape": [8, 8, 8], "memory_handoffs": True}, f)
+    assert build([ConnectedComponentsWorkflow(
+        tmp_folder=os.path.join(ref_dir, "tmp"),
+        config_dir=os.path.join(ref_dir, "config"),
+        max_jobs=2, target="local",
+        input_path=data, input_key="mask",
+        output_path=data, output_key="ref_seg", threshold=0.5,
+    )])
+    ref_seg = np.asarray(file_reader(data, "r")["ref_seg"][...])
+
+    # -- the fleet: gateway + 2 members, tight failure detection -----------
+    fleet_dir = os.path.join(root, "fleet")
+    cfg_path = os.path.join(root, "fleet.json")
+    with open(cfg_path, "w") as f:
+        json.dump({
+            "members": 2,
+            "gateway": {"health_interval_s": 0.25, "member_stale_s": 1.5},
+            "server": {"max_workers": 1},
+        }, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.fleet",
+         "--base-dir", fleet_dir, "--config", cfg_path],
+        env=env, cwd=REPO_ROOT, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+    def payload(tenant, rid, out_key):
+        return dict(
+            tenant=tenant, request_id=rid,
+            workflow="connected_components",
+            config=dict(
+                tmp_folder=os.path.join(root, "req_" + rid),
+                global_config={"block_shape": [8, 8, 8]},
+                params=dict(input_path=data, input_key="mask",
+                            output_path=data, output_key=out_key,
+                            threshold=0.5),
+            ),
+        )
+
+    requests = [("alice", f"a{i}", f"seg_a{i}") for i in range(3)] \
+        + [("bob", f"b{i}", f"seg_b{i}") for i in range(3)]
+
+    try:
+        # gateway endpoint: same server.json contract, role "gateway"
+        endpoint = os.path.join(fleet_dir, "server.json")
+        deadline = time.monotonic() + 120
+        while True:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"fleet died on startup rc={proc.returncode}:\n"
+                    f"{proc.stdout.read()[-4000:]}")
+            try:
+                with open(endpoint) as f:
+                    doc = json.load(f)
+                if doc.get("pid") == proc.pid \
+                        and doc.get("role") == "gateway":
+                    break
+            except (OSError, ValueError):
+                pass
+            assert time.monotonic() < deadline, "gateway never bound"
+            time.sleep(0.05)
+        client = ServeClient.from_endpoint_file(fleet_dir)
+
+        # -- acknowledged two-tenant traffic -------------------------------
+        homes = {}
+        for tenant, rid, key in requests:
+            doc = client.submit(retry_s=60, **payload(tenant, rid, key))
+            homes[rid] = doc["member"]
+        # affinity: each tenant stays on one member
+        assert len({homes[f"a{i}"] for i in range(3)}) == 1
+        assert len({homes[f"b{i}"] for i in range(3)}) == 1
+
+        # -- kill -9 alice's member with its backlog still queued ----------
+        victim = homes["a0"]
+        victim_dir = os.path.join(fleet_dir, "members", victim)
+        with open(os.path.join(victim_dir, "server.json")) as f:
+            victim_pid = json.load(f)["pid"]
+        assert victim_pid != proc.pid
+        os.kill(victim_pid, signal.SIGKILL)
+
+        # -- zero lost acknowledged requests: every wait completes, the
+        # client NEVER resubmits — failover is invisible except as latency
+        for tenant, rid, key in requests:
+            rec = client.wait(rid, timeout_s=300, across_restarts=True)
+            assert rec["state"] == "done", (rid, rec)
+        out = file_reader(data, "r")
+        for _, _, key in requests:
+            np.testing.assert_array_equal(np.asarray(out[key][...]),
+                                          ref_seg)
+
+        # -- exactly one adoption, attributed and exclusive ----------------
+        with open(os.path.join(fleet_dir, FLEET_STATE_FILENAME)) as f:
+            state = json.load(f)
+        assert state["dead_unadopted"] == []
+        dead = state["members"][victim]
+        survivor = dead["adopted_by"]
+        assert survivor and survivor != victim
+        adoptions = state["adoptions"]
+        assert len(adoptions) == 1, adoptions
+        assert adoptions[0]["member"] == victim
+        assert adoptions[0]["adopter"] == survivor
+        # acked-but-incomplete work existed at kill time and was adopted
+        assert adoptions[0]["completed"] + adoptions[0]["reenqueued"] >= 1
+        # the consumed claim stays behind as the adoption record: a second
+        # adopter (or any concurrent contender) can never take it
+        claim_holder = acquire_adoption_claim(
+            victim_dir, by="attacker", pid=os.getpid())
+        assert claim_holder is None
+        with open(os.path.join(victim_dir, "adoption.claim")) as f:
+            claim = json.load(f)
+        assert claim["by"] == survivor
+        # attribution: the failover is a resolved record in failures.json
+        with open(os.path.join(fleet_dir, "failures.json")) as f:
+            recs = json.load(f)["records"]
+        fo = [r for r in recs if r["task"] == "fleet.failover"]
+        assert len(fo) == 1 and fo[0]["resolution"] == "adopted:journal"
+        assert fo[0]["resolved"] is True
+
+        # -- the whole fleet drains by the book ----------------------------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == REQUEUE_EXIT_CODE, (
+            f"fleet drain exited rc={rc}, wanted {REQUEUE_EXIT_CODE}:\n"
+            f"{proc.stdout.read()[-4000:]}")
+    finally:
+        reap_process(proc)
+        # a reaped gateway orphans its member subprocesses — kill any of
+        # THIS fleet's members that outlived it so a mid-test assertion
+        # never leaks resident servers into the rest of the suite
+        for name in ("m0", "m1"):
+            ep = os.path.join(fleet_dir, "members", name, "server.json")
+            try:
+                with open(ep) as f:
+                    mpid = json.load(f).get("pid")
+                if mpid and mpid in stray_serve_pids():
+                    os.kill(mpid, signal.SIGKILL)
+            except (OSError, ValueError):
+                pass
+    assert stray_serve_pids() == []

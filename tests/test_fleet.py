@@ -1,0 +1,499 @@
+"""Fleet layer (docs/SERVING.md "Fleet"): the adoption-claim protocol
+(exclusivity under contention, stale-break on dead pids, claim-gated
+peer-journal reads), tenant-affinity placement with typed fleet
+backpressure, the gateway end-to-end over in-process members, journal
+adoption into a live server, and the in-process failover path (the
+kill -9 version lives in test_chaos.py).  CPU-only, tier-1 fast."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cluster_tools_tpu.runtime import faults, handoff
+from cluster_tools_tpu.runtime import journal as journal_mod
+from cluster_tools_tpu.runtime.admission import (
+    REJECT_FLEET_BACKLOG,
+    REJECT_FLEET_NO_MEMBER,
+)
+from cluster_tools_tpu.runtime.fleet import (
+    CLAIM_FILENAME,
+    FLEET_STATE_FILENAME,
+    AdoptionRefused,
+    FleetGateway,
+    acquire_adoption_claim,
+    adoption_claim_path,
+    read_adoption_claim,
+    read_peer_journal,
+    release_adoption_claim,
+    verify_adoption_claim,
+)
+from cluster_tools_tpu.runtime.server import (
+    PipelineServer,
+    ServeClient,
+    _payload_fingerprint,
+)
+from cluster_tools_tpu.utils.volume_utils import file_reader
+
+from .helpers import stray_serve_pids as _stray_serve_pids
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_process_state():
+    handoff.reset()
+    faults.configure(None)
+    yield
+    handoff.reset()
+    faults.configure(None)
+
+
+def _dead_pid():
+    """A pid that is provably dead on this host."""
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    return proc.pid
+
+
+# -- the adoption-claim protocol ----------------------------------------------
+
+
+def test_adoption_claim_exclusive_under_contention(tmp_path):
+    """The double-adoption race: N concurrent contenders for one dead
+    member's journal — exactly ONE wins the O_CREAT|O_EXCL claim, and
+    the live winner is never stolen from."""
+    peer = str(tmp_path)
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def contend(i):
+        barrier.wait()
+        doc = acquire_adoption_claim(peer, by=f"srv{i}", pid=os.getpid())
+        if doc is not None:
+            wins.append((i, doc))
+
+    threads = [
+        threading.Thread(target=contend, args=(i,)) for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1, [w[0] for w in wins]
+    winner_i, winner_doc = wins[0]
+    held = read_adoption_claim(peer)
+    assert held["by"] == f"srv{winner_i}"
+    assert held["pid"] == os.getpid()
+    # re-contending against the live winner still loses
+    assert acquire_adoption_claim(peer, by="late", pid=os.getpid()) is None
+    # a release with the WRONG token is a no-op (fu.file_lock semantics)
+    release_adoption_claim(peer, {"token": "not-the-token"})
+    assert read_adoption_claim(peer) is not None
+    # the winner's release clears the claim
+    release_adoption_claim(peer, winner_doc)
+    assert read_adoption_claim(peer) is None
+
+
+def test_adoption_claim_stale_break_on_dead_pid(tmp_path):
+    """A claim whose recorded holder pid is dead on this host is broken
+    and re-contended — a crashed adopter must not wedge the failover."""
+    peer = str(tmp_path)
+    stale = acquire_adoption_claim(peer, by="crashed", pid=_dead_pid())
+    assert stale is not None
+    doc = acquire_adoption_claim(peer, by="srv1", pid=os.getpid())
+    assert doc is not None and doc["by"] == "srv1"
+    # the new claim is LIVE (our pid): a third contender loses
+    assert acquire_adoption_claim(peer, by="srv2", pid=os.getpid()) is None
+
+
+def test_claim_gates_peer_journal_reads(tmp_path):
+    """``read_peer_journal`` is the only doorway to a peer's journal:
+    no claim → refused; someone else's claim → refused; our claim →
+    the scanned records."""
+    peer = str(tmp_path)
+    j = journal_mod.Journal(journal_mod.journal_path(peer))
+    j.recover()
+    j.append_transition(
+        journal_mod.ACCEPTED, "r1", tenant="alice",
+        payload={"x": 1}, fingerprint="f1",
+    )
+    j.close()
+    with pytest.raises(AdoptionRefused):
+        verify_adoption_claim(peer, pid=os.getpid())
+    with pytest.raises(AdoptionRefused):
+        read_peer_journal(peer, pid=os.getpid())
+    claim = acquire_adoption_claim(peer, by="other", pid=_dead_pid())
+    assert claim is not None
+    with pytest.raises(AdoptionRefused):
+        read_peer_journal(peer, pid=os.getpid())
+    release_adoption_claim(peer, claim)
+    ours = acquire_adoption_claim(peer, by="me", pid=os.getpid())
+    records = read_peer_journal(peer, pid=os.getpid())
+    assert [r["request_id"] for r in records] == ["r1"]
+    assert os.path.basename(adoption_claim_path(peer)) == CLAIM_FILENAME
+    release_adoption_claim(peer, ours)
+
+
+# -- placement ----------------------------------------------------------------
+
+
+def _member(name, queued=0, alive=True, draining=False, adopted_by=None):
+    return {
+        "name": name, "base_dir": f"/tmp/{name}", "host": "127.0.0.1",
+        "port": 1, "pid": os.getpid(), "hostname": "h", "alive": alive,
+        "ever_alive": alive, "dead": False, "draining": draining,
+        "adopted_by": adopted_by, "queued": queued, "inflight": 0,
+        "replay_backlog": 0, "scrub": None, "heartbeat_age_s": 0.1,
+    }
+
+
+def _bare_gateway(tmp_path, members, **kw):
+    gw = FleetGateway(
+        base_dir=os.path.join(str(tmp_path), "gw"),
+        member_dirs=[m["base_dir"] for m in members],
+        **kw,
+    )
+    gw._members.clear()
+    for m in members:
+        gw._members[m["name"]] = dict(m)
+    return gw
+
+
+def test_placement_affinity_sticks_and_falls_back(tmp_path):
+    """A tenant sticks to the member that served it last (warm caches
+    pay); when that member is unplaceable, placement falls back to
+    least queue depth and the affinity map follows."""
+    gw = _bare_gateway(
+        tmp_path, [_member("m0", queued=3), _member("m1", queued=1)],
+        max_member_queue=8,
+    )
+    target, code, hit = gw._place("alice")
+    assert code is None and not hit
+    assert target["name"] == "m1"  # least-loaded first
+    target, code, hit = gw._place("alice")
+    assert hit and target["name"] == "m1"  # sticky thereafter
+    # the affine member leaves the placeable set -> least-queue fallback
+    gw._members["m1"]["draining"] = True
+    target, code, hit = gw._place("alice")
+    assert not hit and target["name"] == "m0"
+    target, code, hit = gw._place("alice")
+    assert hit and target["name"] == "m0"  # re-stuck to the new home
+
+
+def test_placement_typed_fleet_backpressure(tmp_path):
+    """No placeable member at all → ``rejected:fleet_no_member``; every
+    placeable member over its queue cap → ``rejected:fleet_backlog``."""
+    gw = _bare_gateway(
+        tmp_path, [_member("m0", queued=5), _member("m1", queued=5)],
+        max_member_queue=4,
+    )
+    target, code, _ = gw._place("alice")
+    assert target is None and code == REJECT_FLEET_BACKLOG
+    for m in gw._members.values():
+        m["alive"] = False
+    target, code, _ = gw._place("alice")
+    assert target is None and code == REJECT_FLEET_NO_MEMBER
+
+
+# -- the gateway end-to-end over in-process members ---------------------------
+
+
+def _serve_payload(base, data, tenant, rid, out_key, block=8):
+    return dict(
+        tenant=tenant,
+        request_id=rid,
+        workflow="connected_components",
+        config=dict(
+            tmp_folder=os.path.join(base, "req_" + rid),
+            global_config={"block_shape": [block] * 3},
+            params=dict(
+                input_path=data, input_key="mask",
+                output_path=data, output_key=out_key,
+                threshold=0.5,
+            ),
+        ),
+    )
+
+
+def _mk_input(base, shape=(16, 16, 16), seed=0):
+    rng = np.random.default_rng(seed)
+    vol = (rng.random(shape) > 0.5).astype("float32")
+    data = os.path.join(base, "data.zarr")
+    src = file_reader(data).create_dataset(
+        "mask", shape=vol.shape, chunks=(8, 8, 8), dtype="float32")
+    src[...] = vol
+    return data
+
+
+def _start_fleet(base, n=2, **gw_kw):
+    members = []
+    for i in range(n):
+        members.append(PipelineServer(
+            base_dir=os.path.join(base, "members", f"m{i}"),
+            max_workers=1,
+        ).start())
+    gw_kw.setdefault("health_interval_s", 0.2)
+    gw_kw.setdefault("member_stale_s", 1.0)
+    gateway = FleetGateway(
+        base_dir=os.path.join(base, "gw"),
+        member_dirs=[s.base_dir for s in members],
+        **gw_kw,
+    ).start()
+    client = ServeClient.from_endpoint_file(os.path.join(base, "gw"))
+    return gateway, members, client
+
+
+def _stop_all(gateway, members):
+    gateway.stop()
+    for s in members:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def test_gateway_routes_two_tenants_and_answers_idempotently(tmp_path):
+    """The fleet smoke: two tenants through the gateway, affinity keeps
+    each tenant warm on its member, duplicate resubmission through the
+    gateway answers idempotently, and the fleet state file carries the
+    member table + affinity hit rate."""
+    base = str(tmp_path)
+    data = _mk_input(base)
+    gateway, members, client = _start_fleet(base)
+    try:
+        doc_a = client.submit(**_serve_payload(base, data, "alice", "a1",
+                                               "seg_a"))
+        home_a = doc_a["member"]
+        doc_b = client.submit(**_serve_payload(base, data, "bob", "b1",
+                                               "seg_b"))
+        rec_a = client.wait("a1", timeout_s=120)
+        rec_b = client.wait("b1", timeout_s=120)
+        assert rec_a["state"] == "done", rec_a
+        assert rec_b["state"] == "done", rec_b
+        # a second request for alice lands on the SAME member (affinity)
+        doc_a2 = client.submit(**_serve_payload(base, data, "alice", "a2",
+                                                "seg_a2"))
+        assert doc_a2["member"] == home_a
+        assert client.wait("a2", timeout_s=120)["state"] == "done"
+        # duplicate resubmission THROUGH the gateway: same payload, same
+        # id -> the member's idempotent answer, not a re-run
+        dup = client.submit(**_serve_payload(base, data, "alice", "a1",
+                                             "seg_a"))
+        assert dup["state"] == "done"
+        # GET /request/<id> routes to the owning member
+        assert client.request("a1")["state"] == "done"
+        assert client.request("nope") is None
+        # outputs agree across members
+        seg_a = np.asarray(file_reader(data)["seg_a"][...])
+        seg_b = np.asarray(file_reader(data)["seg_b"][...])
+        np.testing.assert_array_equal(seg_a, seg_b)
+        # the state file is refreshed by the health tick — poll until the
+        # last submit's affinity hit is flushed
+        deadline = time.monotonic() + 10.0
+        while True:
+            state = json.load(open(os.path.join(base, "gw",
+                                                FLEET_STATE_FILENAME)))
+            if state["affinity"]["hits"] >= 2 or time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        assert set(state["members"]) == {"m0", "m1"}
+        assert state["affinity"]["hits"] >= 2  # a2 + the a1 duplicate
+        assert state["dead_unadopted"] == []
+        status = client.status()
+        assert status["rc"] == 0
+        assert status["fleet"]["routes"] >= 3
+    finally:
+        _stop_all(gateway, members)
+    assert _stray_serve_pids() == []
+
+
+def test_adopt_journal_reenqueues_and_completes(tmp_path):
+    """Journal handoff into a live server: an acknowledged-but-incomplete
+    request from a dead peer's journal re-enters the adopter's queue and
+    completes; adoption without the claim is refused; the consumed claim
+    stays behind, so a second adopter can never claim the same journal."""
+    base = str(tmp_path)
+    data = _mk_input(base)
+    peer = os.path.join(base, "dead-peer")
+    payload = _serve_payload(base, data, "alice", "r1", "seg_adopted")
+    j = journal_mod.Journal(journal_mod.journal_path(peer))
+    j.recover()
+    j.append_transition(
+        journal_mod.ACCEPTED, "r1", tenant="alice", payload=payload,
+        fingerprint=_payload_fingerprint(payload),
+    )
+    j.close()
+    server = PipelineServer(
+        base_dir=os.path.join(base, "srv"), max_workers=1,
+    ).start()
+    client = ServeClient(server.host, server.port)
+    try:
+        with pytest.raises(AdoptionRefused):
+            server.adopt_journal(peer)
+        claim = acquire_adoption_claim(
+            peer, by="srv", pid=os.getpid(),
+        )
+        assert claim is not None
+        stats = server.adopt_journal(peer)
+        assert stats["reenqueued"] == 1 and stats["completed"] == 0
+        rec = client.wait("r1", timeout_s=120)
+        assert rec["state"] == "done", rec
+        assert rec["adopted_from"] == os.path.abspath(peer)
+        # the adopted request's output is real
+        seg = np.asarray(file_reader(data)["seg_adopted"][...])
+        assert seg.shape == (16, 16, 16)
+        # the claim file REMAINS as the adoption record: nobody else can
+        # ever adopt this journal
+        assert read_adoption_claim(peer)["by"] == "srv"
+        assert acquire_adoption_claim(
+            peer, by="attacker", pid=os.getpid(),
+        ) is None
+        # the inherited lifecycle went into the adopter's OWN journal
+        own = journal_mod.fold(journal_mod.scan(
+            journal_mod.journal_path(server.base_dir))[0])
+        assert own["r1"]["state"] == journal_mod.COMPLETED
+        # adoption surfaced in server_state.json
+        state = json.load(open(os.path.join(server.base_dir,
+                                            "server_state.json")))
+        assert state["adoptions"][0]["reenqueued"] == 1
+    finally:
+        server.stop()
+    assert _stray_serve_pids() == []
+
+
+def test_gateway_failover_adopts_and_wait_survives(tmp_path):
+    """The in-process failover: kill a member under a routed tenant —
+    the gateway declares it dead (healthz unreachable + stale
+    heartbeat), the survivor adopts its journal over the real /adopt
+    endpoint, ``wait(across_restarts=True)`` rides the failover window
+    (the typed 503) to the answer now served by the OTHER member, and
+    new traffic for the tenant reroutes."""
+    base = str(tmp_path)
+    data = _mk_input(base)
+    gateway, members, client = _start_fleet(base)
+    by_name = {os.path.basename(s.base_dir): s for s in members}
+    try:
+        doc = client.submit(**_serve_payload(base, data, "alice", "a1",
+                                             "seg_a"))
+        home = doc["member"]
+        assert client.wait("a1", timeout_s=120)["state"] == "done"
+        # kill alice's member (in-process SIGKILL stand-in: endpoint and
+        # heartbeat go silent; test_chaos.py does the real kill -9)
+        by_name[home].stop()
+        survivor = next(n for n in by_name if n != home)
+        # wait survives the failover window: the gateway answers the
+        # typed 503 until the survivor adopts, then serves the record
+        # from the OTHER member — zero resubmission
+        rec = client.wait("a1", timeout_s=60, across_restarts=True)
+        assert rec["state"] == "done", rec
+        deadline = time.monotonic() + 30
+        state = {}
+        while time.monotonic() < deadline:
+            state = json.load(open(os.path.join(
+                base, "gw", FLEET_STATE_FILENAME)))
+            if state["members"][home].get("adopted_by"):
+                break
+            time.sleep(0.1)
+        assert state["members"][home]["adopted_by"] == survivor
+        assert state["dead_unadopted"] == []
+        adopt_events = [e for e in state["adoptions"]
+                        if e["kind"] == "adopt"]
+        assert adopt_events and adopt_events[0]["member"] == home
+        # the claim file in the dead member's dir names the survivor
+        claim = read_adoption_claim(by_name[home].base_dir)
+        assert claim is not None and claim["by"] == survivor
+        # new traffic for alice reroutes to the survivor
+        doc2 = client.submit(
+            retry_s=30.0,
+            **_serve_payload(base, data, "alice", "a2", "seg_a2"),
+        )
+        assert doc2["member"] == survivor
+        assert client.wait(
+            "a2", timeout_s=120, across_restarts=True,
+        )["state"] == "done"
+        # adoption attributed in the gateway's failures.json
+        fails = json.load(open(os.path.join(base, "gw", "failures.json")))
+        resolutions = [r.get("resolution") for r in fails["records"]]
+        assert "adopted:journal" in resolutions
+    finally:
+        _stop_all(gateway, members)
+    assert _stray_serve_pids() == []
+
+
+def test_gateway_drain_emptiest_picks_min_load(tmp_path):
+    """The scale-down hook: ``drain_emptiest`` marks the least-loaded
+    live member draining (the SIGTERM is skipped for our own pid — the
+    subprocess path is the chaos test's), and placement stops using
+    it."""
+    gw = _bare_gateway(
+        tmp_path, [_member("m0", queued=4), _member("m1", queued=1)],
+    )
+    picked = gw.drain_emptiest()
+    assert picked["member"] == "m1"
+    assert gw._members["m1"]["draining"]
+    target, code, _ = gw._place("alice")
+    assert target["name"] == "m0"  # the draining member left the pool
+    # nothing else drainable when the only live member is named
+    assert gw.drain_emptiest(member="m1") is None
+
+
+# -- the operator progress view -----------------------------------------------
+
+
+def _progress_mod():
+    spec = importlib.util.spec_from_file_location(
+        "ctt_progress", os.path.join(REPO_ROOT, "scripts", "progress.py"))
+    prog = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(prog)
+    return prog
+
+
+def test_progress_renders_fleet_view(tmp_path):
+    """Satellite: the progress tool renders the gateway's member table
+    from ``fleet_state.json`` — alive/dead/draining, queue depth, replay
+    backlog, adoption events — and exits 1 on a dead-and-unadopted
+    member."""
+    prog = _progress_mod()
+    base = str(tmp_path)
+    state = {
+        "version": 1, "role": "gateway", "pid": os.getpid(),
+        "time": time.time(), "draining": False,
+        "members": {
+            "m0": {"alive": True, "dead": False, "draining": False,
+                   "adopted_by": None, "queued": 2, "inflight": 1,
+                   "replay_backlog": 0, "heartbeat_age_s": 0.4},
+            "m1": {"alive": False, "dead": True, "draining": False,
+                   "adopted_by": "m0", "queued": 0, "inflight": 0,
+                   "replay_backlog": 3, "heartbeat_age_s": 9.1},
+        },
+        "affinity": {"enabled": True, "hits": 8, "misses": 2,
+                     "hit_rate": 0.8, "map": {"alice": "m0"}},
+        "routes": 4, "rejections": {"rejected:fleet_backlog": 1},
+        "adoptions": [{"time": time.time(), "kind": "adopt",
+                       "member": "m1", "adopter": "m0",
+                       "completed": 2, "reenqueued": 1,
+                       "quarantined": 0}],
+        "dead_unadopted": [],
+    }
+    import cluster_tools_tpu.utils.function_utils as fu
+    fu.atomic_write_json(
+        os.path.join(base, FLEET_STATE_FILENAME), state)
+    doc = prog.collect_progress(base)
+    assert doc["fleet"]["members"]["m1"]["adopted_by"] == "m0"
+    text = prog.format_progress(doc)
+    assert "fleet" in text and "m0" in text and "adopted" in text
+    assert "hit_rate" in text or "affinity" in text
+    assert prog.main(["progress.py", base]) == 0
+    # a dead-and-unadopted member is an operator page: rc 1
+    state["members"]["m1"]["adopted_by"] = None
+    state["dead_unadopted"] = ["m1"]
+    fu.atomic_write_json(
+        os.path.join(base, FLEET_STATE_FILENAME), state)
+    assert prog.main(["progress.py", base]) == 1

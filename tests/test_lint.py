@@ -255,6 +255,37 @@ def test_ct010_journal_surface_passes_unsuppressed():
         assert "ctlint: disable=CT010" not in open(path).read()
 
 
+def test_ct012_all_violation_classes():
+    """Fleet hygiene (docs/SERVING.md "Fleet"): blocking/HTTP/storage IO
+    under the placement lock, peer-journal reads outside the adoption
+    claim, and a gateway entry deaf to the drain protocol — each its own
+    violation class."""
+    findings, _ = lint_fixture("ct012_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT012"]
+    assert any("time.sleep" in m for m in msgs)
+    assert any("HTTP call 'http.client.HTTPConnection'" in m for m in msgs)
+    assert any("HTTP call 'self._member_call'" in m for m in msgs)
+    assert any("storage IO 'json.dump'" in m for m in msgs)
+    assert any("raw open of a journal path" in m for m in msgs)
+    assert any("outside a claim-holding scope" in m for m in msgs)
+    assert any("REQUEUE_EXIT_CODE" in m for m in msgs)
+
+
+def test_ct012_fleet_surface_passes_unsuppressed():
+    """The real fleet surface satisfies its own hygiene rule on merit:
+    pure-bookkeeping placement-lock bodies, claim-gated adoption, a
+    drain-mapped entry point — no opt-outs."""
+    paths = [
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "fleet.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "fleet.py"),
+    ]
+    for path in paths:
+        findings, _ = run_lint([path])
+        assert [f for f in findings if f.rule == "CT012"] == [], path
+        assert "ctlint: disable=CT012" not in open(path).read()
+
+
 # -- suppressions -------------------------------------------------------------
 
 
