@@ -83,15 +83,21 @@
 #                     per-tenant fairness into BENCH_r10.json; cpu
 #                     backend (a <10 s smoke twin runs inside tier1 via
 #                     tests/test_serve.py)
-#   bench-fleet     = fleet failover bench (docs/SERVING.md "Fleet"):
-#                     open-loop Poisson two-tenant traffic against a
-#                     2-member fleet with one member SIGKILLed mid-phase,
+#   bench-fleet     = fleet gray-failure bench (docs/SERVING.md "Gray
+#                     failures"): open-loop Poisson two-tenant traffic
+#                     against a 3-member fleet with one member SIGSTOPped
+#                     (wedge phase: breaker-open latency, hedge win rate,
+#                     fenced zombie exit) and one SIGKILLed (kill phase),
 #                     recording zero lost acknowledged requests, the
-#                     affinity hit rate (> 0.8), the kill-phase p99
-#                     (within 3x warm), and bit-identity into
-#                     BENCH_r13.json; cpu backend, <60 s (the chaos e2e
-#                     twin is tests/test_chaos.py -k fleet)
-#   bench-trajectory= aggregate the BENCH_r01..r13 headline numbers into
+#                     affinity hit rate (> 0.8), wedge/kill p99 (within
+#                     3x warm), and bit-identity into BENCH_r14.json; cpu
+#                     backend, <60 s (the chaos e2e twin is
+#                     tests/test_chaos.py -k fleet)
+#   chaos-wedge     = only the gray-failure chaos: SIGSTOP a fleet member
+#                     under live traffic — breaker opens, survivor adopts
+#                     + mints the fence epoch, SIGCONT'd zombie
+#                     self-drains rc 115 with zero double-execution
+#   bench-trajectory= aggregate the BENCH_r01..r14 headline numbers into
 #                     one table (stdout + rewritten into docs/PERFORMANCE.md
 #                     "Performance trajectory"), so the perf history is
 #                     readable without opening ten JSON files
@@ -113,7 +119,8 @@ PY ?= python
 CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
-.PHONY: test lint tier1 tier2 chaos chaos-resource failures-report progress \
+.PHONY: test lint tier1 tier2 chaos chaos-resource chaos-wedge \
+	failures-report progress \
 	bench-io bench-sweep bench-fuse bench-ragged bench-device bench-solve \
 	bench-serve bench-fleet \
 	bench-trajectory serve-smoke scrub-smoke supervise-demo native clean
@@ -139,6 +146,11 @@ chaos-resource:
 	JAX_PLATFORMS=cpu CTT_CHAOS_SEED=$(CTT_CHAOS_SEED) \
 		$(PY) -m pytest tests/test_chaos.py -q -m chaos \
 		-k resource -p no:cacheprovider
+
+chaos-wedge:
+	JAX_PLATFORMS=cpu CTT_CHAOS_SEED=$(CTT_CHAOS_SEED) \
+		$(PY) -m pytest tests/test_chaos.py -q -m chaos \
+		-k sigstop -p no:cacheprovider
 
 failures-report:
 	$(PY) scripts/failures_report.py $(TMP)

@@ -1698,24 +1698,31 @@ def serve_bench(smoke=False):
 
 
 def fleet_bench(smoke=False):
-    """Fleet failover bench (docs/SERVING.md "Fleet"): open-loop Poisson
-    two-tenant traffic against a 2-member fleet, ``kill -9`` one member
-    mid-phase.
+    """Fleet gray-failure bench (docs/SERVING.md "Gray failures"):
+    open-loop Poisson two-tenant traffic against a 3-member fleet, with a
+    **wedge** (SIGSTOP) phase and a **kill** (SIGKILL) phase.
 
     - **warm**: after one cold request per tenant pins affinity, Poisson
       arrivals of connected-components requests measure the fleet's warm
       client-observed p50/p99 — this is the single-server-warm baseline
       (each tenant's whole stream is served by its one affine member);
-    - **kill**: the same arrival pattern, with tenant alice's member
-      SIGKILLed after half the arrivals — the gateway detects the death,
-      a survivor adopts the journal under the exclusive claim, and every
-      acknowledged request completes with ZERO client resubmission (the
-      client only waits through the failover window);
+    - **wedge**: tenant alice's member is SIGSTOPped — alive pid,
+      accepting socket, total silence.  The per-member circuit breaker
+      opens within a couple of probe deadlines (breaker-open latency is
+      recorded), hedged submission re-routes alice's in-window arrivals,
+      a survivor adopts the journal and MINTS A FENCE EPOCH, and the
+      steady tenant (bob) rides through with bounded tail; then SIGCONT
+      wakes the zombie, whose next journal append hits the fence — it
+      self-drains rc 115 without acknowledging or appending a byte;
+    - **kill**: the same arrival pattern against alice's *new* home,
+      SIGKILLed after half the arrivals — the clean-crash failover from
+      BENCH_r13, proving the wedge machinery didn't regress it;
     - bars: zero lost acknowledged requests, affinity hit rate > 0.8,
-      kill-phase p99 within 3x the warm p99, bit-identical outputs,
-      drain rc 114.
+      steady-tenant wedge p99 AND kill-phase p99 within 3x the warm p99,
+      breaker opened, fenced zombie exit, exactly two adoptions,
+      bit-identical outputs, drain rc 114.
 
-    ``make bench-fleet`` writes BENCH_r13.json; ``smoke=True`` shrinks
+    ``make bench-fleet`` writes BENCH_r14.json; ``smoke=True`` shrinks
     the request counts and skips the file write.  Emits exactly one JSON
     line on stdout.
     """
@@ -1728,6 +1735,7 @@ def fleet_bench(smoke=False):
     import tempfile
     import threading
 
+    from cluster_tools_tpu.runtime import netio
     from cluster_tools_tpu.runtime.server import ServeClient
     from cluster_tools_tpu.runtime.supervision import REQUEUE_EXIT_CODE
     from cluster_tools_tpu.runtime.task import build
@@ -1739,11 +1747,13 @@ def fleet_bench(smoke=False):
 
     shape, block = (16, 16, 16), 8
     n_warm = 6 if smoke else 12
+    n_wedge = 6 if smoke else 12
     n_kill = 6 if smoke else 12
     mean_gap = 0.3 if smoke else 0.4
     root = tempfile.mkdtemp(prefix="ctt_fleet_bench_")
-    log(f"fleet bench: 2 members, {n_warm} warm + {n_kill} kill-phase "
-        f"requests, open-loop poisson (mean gap {mean_gap}s)")
+    log(f"fleet bench: 3 members, {n_warm} warm + {n_wedge} wedge + "
+        f"{n_kill} kill-phase requests, open-loop poisson "
+        f"(mean gap {mean_gap}s)")
 
     rng = np.random.default_rng(0)
     vol = (rng.random(shape) > 0.5).astype("float32")
@@ -1774,8 +1784,12 @@ def fleet_bench(smoke=False):
     cfg_path = os.path.join(root, "fleet.json")
     with open(cfg_path, "w") as f:
         json.dump({
-            "members": 2,
-            "gateway": {"health_interval_s": 0.2, "member_stale_s": 1.0},
+            "members": 3,
+            "gateway": {
+                "health_interval_s": 0.2, "member_stale_s": 1.0,
+                "call_timeout_s": 2.0, "breaker_threshold": 2,
+                "breaker_cooldown_s": 0.75, "hedge_max_delay_s": 0.4,
+            },
             "server": {"max_workers": 2},
         }, f)
     env = dict(os.environ)
@@ -1802,7 +1816,7 @@ def fleet_bench(smoke=False):
             ),
         )
 
-    lats = {"warm": [], "kill": []}
+    lats = {"warm": [], "wedge_alice": [], "wedge_bob": [], "kill": []}
     states = {}
     outputs = []
     lock = threading.Lock()
@@ -1842,11 +1856,6 @@ def fleet_bench(smoke=False):
             outputs.append(key)
             rec = client.wait(rid, timeout_s=600)
             assert rec["state"] == "done", rec
-        victim = homes["alice"]
-        victim_dir = os.path.join(fleet_dir, "members", victim)
-        victim_pid = (fu.read_json_if_valid(
-            os.path.join(victim_dir, "server.json")) or {}).get("pid")
-        assert victim_pid and victim_pid != proc.pid
 
         # -- warm phase: poisson arrivals, no failures ---------------------
         arrival_rng = np.random.default_rng(42)
@@ -1867,15 +1876,115 @@ def fleet_bench(smoke=False):
         log(f"fleet warm phase: p50 {warm_stats['p50_s']}s, "
             f"p99 {warm_stats['p99_s']}s")
 
-        # -- kill phase: SIGKILL alice's member after half the arrivals ----
+        # -- wedge phase: SIGSTOP alice's member — the gray failure --------
+        victim_w = homes["alice"]
+        victim_w_dir = os.path.join(fleet_dir, "members", victim_w)
+        victim_w_doc = fu.read_json_if_valid(
+            os.path.join(victim_w_dir, "server.json")) or {}
+        victim_w_pid = victim_w_doc.get("pid")
+        assert victim_w_pid and victim_w_pid != proc.pid
+        log(f"fleet wedge phase: SIGSTOP member {victim_w} "
+            f"(pid {victim_w_pid})")
+        breaker_open_s = [None]
+        t_stop = time.monotonic()
+        os.kill(victim_w_pid, signal.SIGSTOP)
+
+        def watch_breaker():
+            # breaker-open latency: SIGSTOP -> the gateway's healthz
+            # shows the victim's breaker OPEN (probe deadlines tripped it)
+            c = ServeClient.from_endpoint_file(fleet_dir)
+            while time.monotonic() - t_stop < 30:
+                try:
+                    hz = c.healthz()
+                except Exception:
+                    time.sleep(0.05)
+                    continue
+                br = ((hz.get("members") or {}).get(victim_w)
+                      or {}).get("breaker") or {}
+                if br.get("state") == "open":
+                    breaker_open_s[0] = round(
+                        time.monotonic() - t_stop, 3)
+                    return
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=watch_breaker)
+        watcher.start()
+        threads = []
+        for i, gap in enumerate(_poisson_gaps(arrival_rng, n_wedge,
+                                              mean_gap)):
+            time.sleep(gap)
+            tenant = ("alice", "bob")[i % 2]
+            rid, key = f"{tenant}_s{i}", f"seg_{tenant}_s{i}"
+            outputs.append(key)
+            t = threading.Thread(
+                target=drive, args=(f"wedge_{tenant}", tenant, rid, key))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=600)
+        watcher.join(timeout=60)
+
+        # the survivor adopted + fenced the wedged member while it stalled
+        adopter_w, fence_epoch = None, None
+        adopt_deadline = time.monotonic() + 60
+        while time.monotonic() < adopt_deadline:
+            fstate = fu.read_json_if_valid(
+                os.path.join(fleet_dir, "fleet_state.json")) or {}
+            for ev in fstate.get("adoptions") or []:
+                if ev.get("member") == victim_w:
+                    adopter_w = ev.get("adopter")
+                    fence_epoch = ev.get("fence_epoch")
+            if adopter_w:
+                break
+            time.sleep(0.1)
+        assert adopter_w, "wedged member was never adopted"
+        wedge_steady = _latency_stats(lats["wedge_bob"])
+        wedge_victim = _latency_stats(lats["wedge_alice"])
+        log(f"fleet wedge phase: steady-tenant p99 "
+            f"{wedge_steady['p99_s']}s, victim-tenant p99 "
+            f"{wedge_victim['p99_s']}s (breaker open after "
+            f"{breaker_open_s[0]}s; adopted by {adopter_w}, fence epoch "
+            f"{fence_epoch})")
+
+        # SIGCONT wakes the zombie; its next journal append hits the
+        # fence and it self-drains rc 115.  Poke a submit at its old
+        # endpoint so discovery is prompt even if it woke idle — the
+        # answer must be a typed refusal, NEVER an acknowledgement.
+        os.kill(victim_w_pid, signal.SIGCONT)
+        try:
+            st, _doc = netio.http_json_call(
+                victim_w_doc["host"], victim_w_doc["port"], "POST",
+                "/submit", payload("zombie", "z0", "seg_z0"),
+                timeout_s=30.0)
+            zombie_ack = (st == 200)
+        except OSError:
+            zombie_ack = False  # already self-fenced off resumed backlog
+        fenced_exit = False
+        z_deadline = time.monotonic() + 60
+        while time.monotonic() < z_deadline:
+            try:
+                os.kill(victim_w_pid, 0)
+            except ProcessLookupError:
+                fenced_exit = True
+                break
+            time.sleep(0.1)
+        log(f"fleet wedge phase: zombie fenced_exit={fenced_exit} "
+            f"acknowledged={zombie_ack}")
+
+        # -- kill phase: SIGKILL alice's NEW home after half the arrivals --
+        victim_k = adopter_w
+        victim_k_pid = (fu.read_json_if_valid(os.path.join(
+            fleet_dir, "members", victim_k, "server.json")) or {}
+        ).get("pid")
+        assert victim_k_pid and victim_k_pid != proc.pid
         threads = []
         for i, gap in enumerate(_poisson_gaps(arrival_rng, n_kill,
                                               mean_gap)):
             time.sleep(gap)
             if i == n_kill // 2:
-                log(f"fleet kill phase: SIGKILL member {victim} "
-                    f"(pid {victim_pid})")
-                os.kill(victim_pid, signal.SIGKILL)
+                log(f"fleet kill phase: SIGKILL member {victim_k} "
+                    f"(pid {victim_k_pid})")
+                os.kill(victim_k_pid, signal.SIGKILL)
             tenant = ("alice", "bob")[i % 2]
             rid, key = f"{tenant}_k{i}", f"seg_{tenant}_k{i}"
             outputs.append(key)
@@ -1897,6 +2006,7 @@ def fleet_bench(smoke=False):
         aff = fstate["affinity"]
         hit_rate = aff["hits"] / max(1, aff["hits"] + aff["misses"])
         adoptions = fstate["adoptions"]
+        hedge = dict(fstate.get("hedge") or {})
 
         proc.send_signal(signal.SIGTERM)
         drain_rc = proc.wait(timeout=120)
@@ -1909,7 +2019,7 @@ def fleet_bench(smoke=False):
                 pass
         # a reaped gateway orphans its members — never leak a resident
         # server past the bench
-        for name in ("m0", "m1"):
+        for name in ("m0", "m1", "m2"):
             ep = os.path.join(fleet_dir, "members", name, "server.json")
             mpid = (fu.read_json_if_valid(ep) or {}).get("pid")
             if mpid:
@@ -1927,17 +2037,39 @@ def fleet_bench(smoke=False):
     p99_ratio = round(
         kill_stats["p99_s"] / max(warm_stats["p99_s"], 1e-9), 2
     )
+    wedge_ratio = round(
+        wedge_steady["p99_s"] / max(warm_stats["p99_s"], 1e-9), 2
+    )
+    hedge_launched = int(hedge.get("launched") or 0)
+    hedge_win_rate = (
+        round(int(hedge.get("won_secondary") or 0) / hedge_launched, 4)
+        if hedge_launched else None
+    )
     rec = {
-        "metric": "fleet_failover_traffic",
+        "metric": "fleet_grayfail_traffic",
         "backend": "cpu",
         "volume": list(shape),
         "block_shape": [block] * 3,
-        "members": 2,
+        "members": 3,
         "tenants": 2,
         "arrivals": {"process": "poisson", "mean_gap_s": mean_gap,
                      "seed": 42},
         "solo_batch_s": solo_batch_s,
         "warm": warm_stats,
+        "wedge_phase": {
+            "steady_tenant": wedge_steady,
+            "victim_tenant": wedge_victim,
+            "breaker_open_latency_s": breaker_open_s[0],
+            "hedge": {**hedge, "win_rate": hedge_win_rate},
+            "zombie": {
+                "fenced_exit": bool(fenced_exit),
+                "acknowledged_after_fence": bool(zombie_ack),
+                "fence_epoch": fence_epoch,
+            },
+            "victim": victim_w,
+            "adopter": adopter_w,
+        },
+        "wedge_steady_p99_over_warm_p99": wedge_ratio,
         "kill_phase": kill_stats,
         "kill_p99_over_warm_p99": p99_ratio,
         "acked": len(states),
@@ -1947,14 +2079,17 @@ def fleet_bench(smoke=False):
             "hit_rate": round(hit_rate, 4),
         },
         "adoptions": adoptions,
-        "victim": victim,
+        "victim": victim_k,
         "bit_identical": bool(bit_identical),
         "drain_rc": drain_rc,
         "acceptance": {
             "zero_lost_acked": not lost,
             "affinity_hit_rate_gt_0_8": bool(hit_rate > 0.8),
+            "wedge_steady_p99_within_3x_warm": bool(wedge_ratio <= 3.0),
             "kill_p99_within_3x_warm": bool(p99_ratio <= 3.0),
-            "exactly_one_adoption": len(adoptions) == 1,
+            "breaker_opened_during_wedge": breaker_open_s[0] is not None,
+            "fenced_zombie_exit": bool(fenced_exit and not zombie_ack),
+            "exactly_two_adoptions": len(adoptions) == 2,
             "bit_identical": bool(bit_identical),
             "drain_rc_114": drain_rc == REQUEUE_EXIT_CODE,
         },
@@ -1963,7 +2098,7 @@ def fleet_bench(smoke=False):
     print(json.dumps(rec), flush=True)
     if not smoke:
         path = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "BENCH_r13.json"
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r14.json"
         )
         fu.atomic_write_json(path, rec)
         log(f"fleet bench done -> {path}")

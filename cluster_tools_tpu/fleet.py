@@ -107,6 +107,7 @@ def main(argv=None) -> int:
     from .runtime.fleet import FleetGateway
     from .runtime.server import ENDPOINT_FILENAME
     from .runtime.supervision import (
+        FENCED_EXIT_CODE,
         REQUEUE_EXIT_CODE,
         DrainInterrupt,
         install_drain_handler,
@@ -153,15 +154,26 @@ def main(argv=None) -> int:
             procs[name] = proc
         return proc.pid
 
+    fenced_seen = set()
+
     def reap_loop():
         """Collect member exit statuses so dead members never zombie —
         death detection itself is the gateway's (healthz + heartbeat +
-        pid liveness)."""
+        pid liveness).  A FENCED exit (rc 115) is surfaced distinctly:
+        that member's journal was adopted by a survivor while it was
+        wedged, and it must NOT be respawned onto the same base dir."""
         while not stop_reaping.is_set():
             with procs_lock:
-                live = list(procs.values())
-            for proc in live:
-                proc.poll()
+                live = list(procs.items())
+            for name, proc in live:
+                rc = proc.poll()
+                if rc == FENCED_EXIT_CODE and name not in fenced_seen:
+                    fenced_seen.add(name)
+                    print(
+                        f"member {name} exited FENCED (rc {rc}): journal "
+                        "adopted by a survivor; not respawning",
+                        flush=True,
+                    )
             stop_reaping.wait(1.0)
 
     for d in member_dirs:
@@ -198,8 +210,15 @@ def main(argv=None) -> int:
         health_interval_s=float(gw_cfg.get("health_interval_s", 1.0)),
         member_stale_s=float(gw_cfg.get("member_stale_s", 6.0)),
         max_member_queue=int(gw_cfg.get("max_member_queue", 64)),
+        call_timeout_s=float(gw_cfg.get("call_timeout_s", 10.0)),
         failover=str(gw_cfg.get("failover", "adopt")),
         spawn=spawn,
+        # gray-failure knobs (docs/SERVING.md "Gray failures")
+        breaker_threshold=int(gw_cfg.get("breaker_threshold", 2)),
+        breaker_cooldown_s=float(gw_cfg.get("breaker_cooldown_s", 2.0)),
+        hedge=bool(gw_cfg.get("hedge", True)),
+        hedge_min_delay_s=float(gw_cfg.get("hedge_min_delay_s", 0.05)),
+        hedge_max_delay_s=float(gw_cfg.get("hedge_max_delay_s", 2.0)),
     )
     stop_reaping = threading.Event()
     reaper = threading.Thread(target=reap_loop, name="fleet-reaper",

@@ -1,4 +1,4 @@
-"""The ctlint rule classes CT001-CT012 (docs/ANALYSIS.md).
+"""The ctlint rule classes CT001-CT013 (docs/ANALYSIS.md).
 
 Every rule is derived from a *real* invariant of this codebase — the
 docstring of each checker names the file/contract it guards.  Rules are
@@ -464,10 +464,12 @@ _DEFAULT_SITES = frozenset({
     "load", "store", "io_read", "io_write", "submit", "task",
     "block_done", "task_done", "compute", "kernel", "admit",
     "journal", "journal_append", "journal_replay",
+    "net_member", "net_probe", "net_client",
 })
 _DEFAULT_KINDS = frozenset({
     "error", "oom", "enospc", "hang", "corrupt", "nan",
     "job_loss", "kill", "preempt", "spill", "reject", "torn",
+    "net_delay", "net_drop", "net_wedge",
 })
 
 #: hook callables whose first positional arg is a site name
@@ -1755,6 +1757,110 @@ def ct012_fleet_hygiene(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT013 - gray-failure hygiene
+# =============================================================================
+
+#: outbound-connection constructors that, without an explicit deadline,
+#: hang forever on a wedged peer (SYN-acked socket that never answers) —
+#: the gray failure the breaker/hedging stack exists to bound
+_CT013_NET_CALLS = frozenset({
+    "HTTPConnection", "HTTPSConnection", "urlopen", "create_connection",
+})
+
+#: write paths that move acknowledged bytes to durable/visible places and
+#: must therefore be fence-gated in server code: journal transitions and
+#: handoff publishes.  A zombie server that was adopted away and still
+#: reaches one of these double-writes acknowledged work.
+_CT013_FENCED_WRITES = frozenset({"append_transition", "flush_namespace"})
+
+#: the modules whose writes are fence-gated (the member server surface)
+_CT013_FENCE_SCOPE = ("server.py",)
+
+
+def ct013_grayfail_hygiene(module: LintModule) -> List[Finding]:
+    """Gray-failure hygiene (docs/SERVING.md "Gray failures").
+
+    (a) **Every outbound connection carries an explicit deadline**: an
+    ``HTTPConnection``/``urlopen``/``create_connection`` without a
+    ``timeout`` kwarg blocks forever on a wedged peer — the caller's
+    thread is gone, no breaker ever trips, and the fleet degrades
+    silently instead of failing over.  All serve-plane HTTP is supposed
+    to go through ``runtime/netio.py`` (which always passes one); a raw
+    deadline-less call is a hole in the gray-failure defense.
+
+    (b) **Acknowledged writes in server code are fence-gated**: a
+    ``journal.append_transition`` / ``handoff.flush_namespace`` call
+    site in the member server whose enclosing scope shows no fencing
+    evidence — neither a ``fence_guard.check()`` call nor a
+    ``Fenced``-handling except — is a path a zombie can still write
+    through after a survivor adopted its journal.  The fence epoch makes
+    zombie double-writes structurally impossible only if every such
+    write path re-validates the epoch first.
+    """
+    is_fixture = "ct013" in module.name
+    out: List[Finding] = []
+
+    # -- (a) no deadline-less outbound connections -------------------------
+    for call in calls_in(module.tree):
+        seg = last_seg(dotted(call.func))
+        if seg not in _CT013_NET_CALLS:
+            continue
+        names, splat = kw_names(call)
+        if "timeout" in names or splat:
+            continue
+        out.append(Finding(
+            "CT013", module.path, call.lineno, call.col_offset,
+            f"outbound connection '{seg}' without an explicit timeout "
+            "kwarg: a wedged peer (accepted connection that never "
+            "answers) blocks this caller forever and no circuit breaker "
+            "ever trips — route serve-plane HTTP through "
+            "runtime/netio.http_json_call, or pass timeout=",
+        ))
+
+    # -- (b) fence-gated acknowledged writes in the member server ----------
+    if module.name not in _CT013_FENCE_SCOPE and not is_fixture:
+        return out
+
+    def _fence_guarded(call: ast.Call) -> bool:
+        """Fencing evidence anywhere in the enclosing function chain: a
+        ``*fence*.check()`` call, or an ``except ...Fenced`` handler
+        (the append path itself re-validates under the journal lock and
+        surfaces the verdict as the exception)."""
+        scope: Optional[ast.AST] = module.enclosing_function(call)
+        while scope is not None:
+            for c in calls_in(scope):
+                name = dotted(c.func) or ""
+                if last_seg(name) == "check" and "fence" in name.lower():
+                    return True
+            for node in ast.walk(scope):
+                if (isinstance(node, ast.ExceptHandler)
+                        and node.type is not None):
+                    if any(
+                        "Fenced" in (dotted(n) or "")
+                        for n in ast.walk(node.type)
+                    ):
+                        return True
+            scope = module.enclosing_function(scope)
+        return False
+
+    for call in calls_in(module.tree):
+        seg = last_seg(dotted(call.func))
+        if seg not in _CT013_FENCED_WRITES:
+            continue
+        if _fence_guarded(call):
+            continue
+        out.append(Finding(
+            "CT013", module.path, call.lineno, call.col_offset,
+            f"acknowledged write '{seg}' with no fencing evidence in "
+            "scope (no fence_guard.check() and no Fenced handler): a "
+            "zombie server adopted away while wedged can still write "
+            "through this path, double-running acknowledged work — "
+            "re-validate the fence epoch before bytes move",
+        ))
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -1771,4 +1877,5 @@ RULES = {
     "CT010": ct010_journal_discipline,
     "CT011": ct011_verified_read_discipline,
     "CT012": ct012_fleet_hygiene,
+    "CT013": ct013_grayfail_hygiene,
 }

@@ -69,6 +69,11 @@ REJECT_DUPLICATE = "rejected:duplicate"
 #: fleet-wide pressure, HTTP 429).  Both are retry-with-backoff codes.
 REJECT_FLEET_NO_MEMBER = "rejected:fleet_no_member"
 REJECT_FLEET_BACKLOG = "rejected:fleet_backlog"
+#: every otherwise-placeable member sits behind an OPEN circuit breaker
+#: (consecutive timeouts/resets — a wedged member, not a dead one).  HTTP
+#: 503, retry-with-backoff: the breaker half-opens after its cooldown
+#: (docs/SERVING.md "Gray failures").
+REJECT_FLEET_BREAKER = "rejected:fleet_breaker_open"
 
 #: one DRR credit buys this many bytes of request cost (requests without a
 #: size declaration cost exactly one credit)

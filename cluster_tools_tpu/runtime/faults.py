@@ -48,6 +48,18 @@ task runtime, and container IO layer call at their failure-relevant sites:
   chaos can prove rejected requests are attributed in ``failures.json``
   and leave no partial markers, manifests, or handoff entries behind.
   Targeted by tenant name (``"tenants": [...]``) instead of block,
+- :meth:`FaultInjector.net_fault` — degrade an outbound serve-plane HTTP
+  exchange (sites ``net_member`` / ``net_probe`` / ``net_client``; the
+  shim in ``runtime/netio.py`` is the single call-through).  Three kinds
+  model the gray-failure spectrum (docs/SERVING.md "Gray failures"):
+  ``net_delay`` sleeps ``seconds`` before the exchange (congestion, a GC
+  pause on the far side), ``net_drop`` raises ``ConnectionResetError``
+  mid-exchange (refused/reset connections), and ``net_wedge`` holds the
+  accepted connection open without ever answering — the caller's
+  *explicit deadline* is the only thing that can save it, which is
+  exactly what the gateway's circuit breaker and the CT013 timeout
+  audit exist to prove.  Targetable per member/tenant via the
+  ``"members"`` spec key,
 - :meth:`FaultInjector.torn_append` — tear a submission-journal append
   (``kind='torn'``, site ``journal``; docs/SERVING.md "Durability"): a
   strict prefix of the frame reaches the disk and the process hard-exits
@@ -133,7 +145,18 @@ Config schema::
         # durable journal: the 3rd journal append is torn — half the frame
         # lands, the process dies; replay must truncate-and-warn
         {"site": "journal", "kind": "torn", "after": 3,
-         "keep_fraction": 0.5}
+         "keep_fraction": 0.5},
+        # gray failure: member m1 wedges — the gateway's first 4 calls to
+        # it are accepted but never answered (the request deadline fires,
+        # the breaker opens within one timeout)
+        {"site": "net_member", "kind": "net_wedge", "members": ["m1"],
+         "fail_attempts": 4, "seconds": 30.0},
+        # flaky network: 20% of client submissions see a connection reset
+        {"site": "net_client", "kind": "net_drop", "rate": 0.2,
+         "fail_attempts": 1000000},
+        # slow path: every health probe of m0 is delayed 0.5 s
+        {"site": "net_probe", "kind": "net_delay", "members": ["m0"],
+         "seconds": 0.5, "fail_attempts": 1000000}
       ]
     }
 
@@ -232,6 +255,14 @@ _SPILL_SITES = ("publish",)
 #: *tenant* (the ``tenants`` spec key), not block — admission has no
 #: blocks.
 _REJECT_SITES = ("admit",)
+#: serve-plane network sites (runtime/netio.py, docs/SERVING.md "Gray
+#: failures"): ``net_member`` is the gateway's data-path call to a member
+#: (submit/lookup/adopt), ``net_probe`` the health loop's /healthz probe,
+#: ``net_client`` the ServeClient's call to a server or gateway.  The
+#: net_* kinds fire here: ``net_delay`` (latency), ``net_drop``
+#: (reset/refused), ``net_wedge`` (accepted, never answered — only an
+#: explicit deadline notices).
+_NET_SITES = ("net_member", "net_probe", "net_client")
 #: maybe_fail kinds: all raise at the same hook, with their own exception
 #: types so the executor's *typed* classification is what gets exercised
 _FAIL_KINDS = ("error", "oom", "enospc")
@@ -424,6 +455,12 @@ class FaultInjector:
                         f"corrupt fault mode must be one of {_CORRUPT_MODES},"
                         f" got {spec.get('mode')!r}"
                     )
+            elif kind in ("net_delay", "net_drop", "net_wedge"):
+                if site not in _NET_SITES:
+                    raise ValueError(
+                        f"{kind} fault site must be one of {_NET_SITES}, "
+                        f"got {site!r}"
+                    )
             elif kind == "job_loss":
                 if site != "submit":
                     raise ValueError(
@@ -587,6 +624,43 @@ class FaultInjector:
                 continue
             return True
         return False
+
+    def net_fault(
+        self, site: str, member: Optional[str] = None
+    ) -> Optional[tuple]:
+        """``(kind, seconds)`` if a net fault fires for this outbound HTTP
+        exchange (sites ``net_member`` / ``net_probe`` / ``net_client``),
+        else None.  The ``members`` spec key gates on the far side's name
+        (a fleet member or, for ``net_client``, a tenant; no key: every
+        exchange at the site); attempts count per ``(site, member)``, so
+        ``fail_attempts`` bounds how many exchanges degrade and ``rate``
+        draws a seeded per-attempt coin.  The shim (``runtime/netio.py``)
+        acts on the verdict: ``net_delay`` sleeps ``seconds`` then
+        proceeds, ``net_drop`` raises ``ConnectionResetError``,
+        ``net_wedge`` blocks until the caller's deadline fires."""
+        if not self.enabled:
+            return None
+        for idx, spec in enumerate(self.specs):
+            kind = spec.get("kind")
+            if kind not in ("net_delay", "net_drop", "net_wedge") \
+                    or spec.get("site") != site:
+                continue
+            members = spec.get("members")
+            if members is not None:
+                if member is None or str(member) not in {
+                    str(m) for m in members
+                }:
+                    continue
+            attempt = self._next_attempt(site, member, idx)
+            if attempt > int(spec.get("fail_attempts", 1)):
+                continue
+            rate = spec.get("rate")
+            if rate is not None and self._unit(
+                site, member, attempt
+            ) >= float(rate):
+                continue
+            return (kind, float(spec.get("seconds", 1.0)))
+        return None
 
     def torn_append(self) -> Optional[float]:
         """Fraction of the current journal frame to keep if a ``torn``

@@ -40,6 +40,25 @@ ctlint CT012).  With no survivor, a ``spawn`` callback (the fleet CLI
 wires one) restarts a member on the dead base dir instead, and plain boot
 replay does the rest.
 
+**Gray-failure defense** (docs/SERVING.md "Gray failures"): the dead-member
+story above only covers members that are *gone*.  A member that is
+alive-but-wedged (SIGSTOP, GC pause, wedged disk) answers nothing yet
+trips no pid-death check, and a member *falsely* declared dead can wake
+after a survivor adopted its journal.  Three layers close that class:
+every outbound HTTP exchange goes through :mod:`.netio` with an explicit
+deadline (and the ``net_delay``/``net_drop``/``net_wedge`` fault shim); a
+per-member :class:`CircuitBreaker` counts consecutive connection-level
+failures and shifts traffic off a wedged member within ~one request
+deadline (typed :data:`~cluster_tools_tpu.runtime.admission.
+REJECT_FLEET_BREAKER` while open, half-open trial after the cooldown),
+with **hedged submission** re-routing an idempotent request to a second
+member after a p99-derived delay; and every adoption **mints a fence
+epoch** (:func:`~cluster_tools_tpu.runtime.journal.mint_fence`, under the
+exclusive claim, *before* the journal scan) so a SIGCONT'd zombie's next
+journal append or handoff flush raises
+:class:`~cluster_tools_tpu.runtime.journal.Fenced` instead of forking the
+truth — split-brain is structurally impossible, not merely improbable.
+
 **Lock discipline** (ctlint CT012): ``_placement_lock`` guards pure
 bookkeeping — the member table, the tenant-affinity map, the
 request-route table, counters.  Every HTTP call, health probe, journal
@@ -61,13 +80,14 @@ import socket
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils import function_utils as fu
 from . import admission as admission_mod
 from . import journal as journal_mod
+from . import netio
 from . import trace as trace_mod
 from .server import ENDPOINT_FILENAME, SERVER_UID, STATE_FILENAME
 from .supervision import (
@@ -241,6 +261,93 @@ def read_peer_journal(peer_base_dir: str, pid: Optional[int] = None,
     return records
 
 
+# -- circuit breaker ----------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Per-member circuit breaker (docs/SERVING.md "Gray failures").
+
+    Counts CONSECUTIVE connection-level failures — timeouts, resets,
+    refusals, from data calls and health probes alike — and opens at
+    ``threshold``, taking the member out of placement within roughly one
+    request deadline (heartbeat staleness needs ``member_stale_s``; a
+    wedged-but-alive member never goes pid-dead at all).  After
+    ``cooldown_s`` the breaker half-opens: exactly ONE trial call is
+    admitted; its success closes the breaker, its failure re-opens and
+    restarts the cooldown.  Any success anywhere (including a health
+    probe) closes — the member is demonstrably answering again.
+
+    Bookkeeping only, under its own tiny lock; the caller does the IO and
+    reports outcomes via :meth:`record` (CT012: never IO under a lock).
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, threshold: int = 2, cooldown_s: float = 2.0):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = max(0.05, float(cooldown_s))
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.last_transition = time.monotonic()
+        self.opened_total = 0
+        self._trial_inflight = False
+
+    def _transition(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            self.last_transition = time.monotonic()
+            if state == self.OPEN:
+                self.opened_total += 1
+
+    def allow(self) -> bool:
+        """Data-path gate: True in CLOSED; past the cooldown the caller
+        takes the single half-open trial slot (and MUST then
+        :meth:`record` the outcome to free it)."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                if time.monotonic() - self.last_transition \
+                        < self.cooldown_s:
+                    return False
+                self._transition(self.HALF_OPEN)
+                self._trial_inflight = True
+                return True
+            # HALF_OPEN: one trial at a time
+            if self._trial_inflight:
+                return False
+            self._trial_inflight = True
+            return True
+
+    def record(self, ok: bool) -> None:
+        """Report one call's connection-level outcome (HTTP answers of
+        any status count as ``ok`` — the member is responsive)."""
+        with self._lock:
+            self._trial_inflight = False
+            if ok:
+                self.consecutive_failures = 0
+                self._transition(self.CLOSED)
+            else:
+                self.consecutive_failures += 1
+                if self.state == self.HALF_OPEN \
+                        or self.consecutive_failures >= self.threshold:
+                    self._transition(self.OPEN)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": int(self.consecutive_failures),
+                "since_transition_s": round(
+                    time.monotonic() - self.last_transition, 3
+                ),
+                "opened_total": int(self.opened_total),
+            }
+
+
 # -- the gateway --------------------------------------------------------------
 
 
@@ -256,7 +363,12 @@ class FleetGateway:
     queued+inflight cap before placement skips it), ``failover``
     (``"adopt"`` = surviving member adopts the journal; ``"respawn"`` =
     always restart on the dead base dir via ``spawn``), ``spawn`` (the
-    no-survivor fallback: ``spawn(name, base_dir) -> pid|None``).
+    no-survivor fallback: ``spawn(name, base_dir) -> pid|None``),
+    ``breaker_threshold`` / ``breaker_cooldown_s`` (consecutive
+    connection failures before a member's circuit opens / seconds before
+    the half-open trial), ``hedge`` + ``hedge_min_delay_s`` /
+    ``hedge_max_delay_s`` (idempotent-submit hedging and the clamp on
+    its p99-derived trigger delay).
     """
 
     def __init__(
@@ -272,6 +384,11 @@ class FleetGateway:
         call_timeout_s: float = 10.0,
         failover: str = "adopt",
         spawn: Optional[Callable[[str, str], Optional[int]]] = None,
+        breaker_threshold: int = 2,
+        breaker_cooldown_s: float = 2.0,
+        hedge: bool = True,
+        hedge_min_delay_s: float = 0.05,
+        hedge_max_delay_s: float = 2.0,
     ):
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
@@ -287,6 +404,13 @@ class FleetGateway:
             raise ValueError(f"unknown failover policy {failover!r}")
         self.failover = failover
         self._spawn = spawn
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_cooldown_s = max(0.05, float(breaker_cooldown_s))
+        self.hedge = bool(hedge)
+        self.hedge_min_delay_s = max(0.0, float(hedge_min_delay_s))
+        self.hedge_max_delay_s = max(
+            self.hedge_min_delay_s, float(hedge_max_delay_s)
+        )
         self.started_at = trace_mod.walltime()
         #: pure-bookkeeping lock (ctlint CT012): member table, affinity
         #: map, route table, counters — never any IO under it
@@ -306,6 +430,17 @@ class FleetGateway:
             }
         if not self._members:
             raise ValueError("a fleet needs at least one member dir")
+        self._breakers: Dict[str, CircuitBreaker] = {
+            n: CircuitBreaker(self.breaker_threshold,
+                              self.breaker_cooldown_s)
+            for n in self._members
+        }
+        #: recent successful submit latencies (s) — the hedge delay is
+        #: their p99, clamped to [hedge_min_delay_s, hedge_max_delay_s]
+        self._submit_latencies: deque = deque(maxlen=128)
+        self._hedge_stats = {
+            "launched": 0, "won_primary": 0, "won_secondary": 0,
+        }
         self._affinity_map: Dict[str, str] = {}
         self._affinity_hits = 0
         self._affinity_misses = 0
@@ -391,24 +526,42 @@ class FleetGateway:
             self._httpd.server_close()
 
     # -- member HTTP (never under the placement lock) ----------------------
+    def _breaker_for(self, name: Optional[str]) -> Optional[CircuitBreaker]:
+        if not name:
+            return None
+        with self._placement_lock:
+            br = self._breakers.get(name)
+            if br is None:
+                br = self._breakers[name] = CircuitBreaker(
+                    self.breaker_threshold, self.breaker_cooldown_s
+                )
+        return br
+
     def _member_call(self, member: Dict[str, Any], method: str, path: str,
                      body: Optional[Dict[str, Any]] = None,
-                     timeout_s: Optional[float] = None) -> Tuple[int, Dict]:
-        import http.client
-
-        conn = http.client.HTTPConnection(
-            member["host"], int(member["port"]),
-            timeout=float(timeout_s if timeout_s is not None
-                          else self.call_timeout_s),
-        )
+                     timeout_s: Optional[float] = None,
+                     site: str = "net_member") -> Tuple[int, Dict]:
+        """One deadline-bounded exchange with a member via :mod:`.netio`
+        (fault sites ``net_member`` / ``net_probe``), reporting the
+        connection-level outcome to the member's circuit breaker — any
+        HTTP answer counts as responsive, only timeouts/resets/refusals
+        count against it."""
+        name = member.get("name")
+        br = self._breaker_for(name)
         try:
-            data = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if data else {}
-            conn.request(method, path, body=data, headers=headers)
-            resp = conn.getresponse()
-            return resp.status, json.loads(resp.read() or b"{}")
-        finally:
-            conn.close()
+            status, doc = netio.http_json_call(
+                member["host"], int(member["port"]), method, path, body,
+                timeout_s=float(timeout_s if timeout_s is not None
+                                else self.call_timeout_s),
+                site=site, member=name,
+            )
+        except (OSError, ValueError):
+            if br is not None:
+                br.record(False)
+            raise
+        if br is not None:
+            br.record(True)
+        return status, doc
 
     # -- health ------------------------------------------------------------
     def _health_loop(self) -> None:
@@ -459,8 +612,10 @@ class FleetGateway:
         if host and port:
             try:
                 status, health = self._member_call(
-                    {"host": host, "port": port}, "GET", "/healthz",
+                    {"name": m.get("name"), "host": host, "port": port},
+                    "GET", "/healthz",
                     timeout_s=min(2.0, max(0.2, self.member_stale_s / 2)),
+                    site="net_probe",
                 )
                 ok = status == 200
             except (OSError, ValueError):
@@ -545,6 +700,17 @@ class FleetGateway:
                     "fleet.adopt_contended", member=name,
                 )
                 return
+            # fence FIRST, scan after: minting a higher epoch under the
+            # exclusive claim means the old incarnation — even one merely
+            # wedged, not dead — can never append another journal byte or
+            # flush another store (Journal.append and the server's flush
+            # path re-check the epoch and raise Fenced).  The adopter's
+            # journal scan below therefore reads the complete, FINAL
+            # record of the member's promises: split-brain is closed
+            # before any peer byte is read.
+            fence_epoch = journal_mod.mint_fence(
+                dead["base_dir"], by=f"adopt:{adopter['name']}",
+            )
             try:
                 status, doc = self._member_call(
                     adopter, "POST", "/adopt",
@@ -562,6 +728,7 @@ class FleetGateway:
                 "kind": "adopt",
                 "member": name,
                 "adopter": adopter["name"],
+                "fence_epoch": fence_epoch,
                 "completed": int(doc.get("completed") or 0),
                 "reenqueued": int(doc.get("reenqueued") or 0),
                 "quarantined": int(doc.get("quarantined") or 0),
@@ -583,6 +750,7 @@ class FleetGateway:
             trace_mod.instant(
                 "fleet.adopt", member=name, adopter=adopter["name"],
                 reenqueued=event["reenqueued"], completed=event["completed"],
+                fence_epoch=fence_epoch,
             )
             try:
                 fu.record_failures(
@@ -622,6 +790,13 @@ class FleetGateway:
         )
         if claim is None:
             return
+        # fence the old incarnation before the new one boots: a wedged
+        # predecessor waking mid-respawn must not interleave appends with
+        # its successor.  The fresh server reads the bumped epoch at boot
+        # and owns the journal under it.
+        fence_epoch = journal_mod.mint_fence(
+            dead["base_dir"], by=f"respawn:{name}",
+        )
         try:
             pid = self._spawn(name, dead["base_dir"])
         finally:
@@ -633,6 +808,7 @@ class FleetGateway:
             "kind": "respawn",
             "member": name,
             "pid": int(pid),
+            "fence_epoch": fence_epoch,
         }
         with self._placement_lock:
             m = self._members.get(name)
@@ -689,30 +865,148 @@ class FleetGateway:
                 self._affinity_misses += 1
             return dict(target), None, hit
 
+    def _hedge_delay(self) -> float:
+        """The hedge trigger: p99 of recent successful submit latencies,
+        clamped to [hedge_min_delay_s, hedge_max_delay_s] — too few
+        samples and the max applies (hedge rarely until the tail is
+        known)."""
+        with self._placement_lock:
+            lats = sorted(self._submit_latencies)
+        if len(lats) >= 8:
+            delay = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+        else:
+            delay = self.hedge_max_delay_s
+        return min(self.hedge_max_delay_s,
+                   max(self.hedge_min_delay_s, delay))
+
+    def _submit_hedged(
+        self, member: Dict[str, Any], payload: Dict[str, Any],
+        tenant: str, tried: set,
+    ) -> Tuple[int, Dict[str, Any], str]:
+        """One placement's submit with a hedge: the primary call runs in
+        a helper thread; past the p99-derived delay with no answer, the
+        same request is re-routed to a second member, and the first 200
+        wins.  Safe ONLY for requests carrying an explicit ``request_id``
+        (the caller gates on that): every member dedupes on
+        ``(request_id, payload-fingerprint)``, and an adopted journal
+        skips already-known ids, so the loser is answered idempotently,
+        never double-run.  Returns ``(status, doc, via_member_name)`` or
+        raises the connection error when neither side answered."""
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def call_primary() -> None:
+            try:
+                box["res"] = self._member_call(
+                    member, "POST", "/submit", payload,
+                )
+            except (OSError, ValueError) as e:
+                box["err"] = e
+            finally:
+                done.set()
+
+        threading.Thread(
+            target=call_primary, name="fleet-hedge-primary", daemon=True,
+        ).start()
+        if done.wait(self._hedge_delay()):
+            if "res" in box:
+                st, doc = box["res"]
+                return st, doc, member["name"]
+            raise box["err"]
+        # the primary is past p99 with no answer — the wedge signature.
+        second, _code, _hit = self._place(
+            tenant, exclude=set(tried) | {member["name"]},
+        )
+        if second is not None:
+            br = self._breaker_for(second["name"])
+            if br is not None and not br.allow():
+                second = None
+        if second is None:
+            # nowhere to hedge: wait out the primary's own deadline
+            done.wait(self.call_timeout_s + 1.0)
+            if "res" in box:
+                st, doc = box["res"]
+                return st, doc, member["name"]
+            raise box.get("err") or TimeoutError(
+                f"{member['name']}: no answer within the deadline"
+            )
+        with self._placement_lock:
+            self._hedge_stats["launched"] += 1
+        trace_mod.instant(
+            "fleet.hedge", tenant=tenant, primary=member["name"],
+            secondary=second["name"],
+        )
+        try:
+            st2, doc2 = self._member_call(
+                second, "POST", "/submit", payload,
+            )
+        except (OSError, ValueError):
+            st2, doc2 = None, None
+        if st2 == 200:
+            with self._placement_lock:
+                self._hedge_stats["won_secondary"] += 1
+            return st2, doc2, second["name"]
+        # the secondary could not win either — fall back to the primary
+        done.wait(self.call_timeout_s + 1.0)
+        if "res" in box:
+            st, doc = box["res"]
+            with self._placement_lock:
+                self._hedge_stats["won_primary"] += 1
+            return st, doc, member["name"]
+        if st2 is not None:
+            return st2, doc2, second["name"]  # the typed answer we have
+        raise box.get("err") or TimeoutError(
+            f"{member['name']}: no answer within the deadline"
+        )
+
     def submit(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         """Route one submission: place, forward, record the route.  A
-        member that drops the connection mid-submit is marked suspect and
-        the next member tried (idempotency makes the ambiguous retry
-        safe); typed member rejections pass through verbatim; when no
-        member is placeable the gateway's own typed backpressure answers
-        (``rejected:fleet_*``)."""
+        member behind an OPEN circuit breaker is skipped without a call
+        (all skipped → typed ``rejected:fleet_breaker_open``); a member
+        that drops the connection mid-submit is marked suspect and the
+        next member tried (idempotency makes the ambiguous retry safe);
+        a request with an explicit ``request_id`` is hedged to a second
+        member past the p99 delay; typed member rejections pass through
+        verbatim; when no member is placeable the gateway's own typed
+        backpressure answers (``rejected:fleet_*``)."""
         tenant = str(payload.get("tenant") or "default")
         if self._draining or drain_requested():
             return self._reject(
                 tenant, admission_mod.REJECT_DRAINING, "gateway draining",
             )
+        hedgeable = bool(self.hedge and payload.get("request_id"))
         tried: set = set()
         last_err = ""
+        breaker_blocked = False
         with self._placement_lock:
             n_members = len(self._members)
         for _ in range(n_members):
             member, code, _hit = self._place(tenant, exclude=tried)
             if member is None:
-                return self._reject(tenant, code, last_err)
-            try:
-                status, doc = self._member_call(
-                    member, "POST", "/submit", payload,
+                if breaker_blocked \
+                        and code == admission_mod.REJECT_FLEET_NO_MEMBER:
+                    code = admission_mod.REJECT_FLEET_BREAKER
+                return self._reject(
+                    tenant, code,
+                    last_err or ("circuit breaker open"
+                                 if breaker_blocked else ""),
                 )
+            br = self._breaker_for(member["name"])
+            if br is not None and not br.allow():
+                tried.add(member["name"])
+                breaker_blocked = True
+                continue
+            t0 = time.monotonic()
+            try:
+                if hedgeable:
+                    status, doc, via = self._submit_hedged(
+                        member, payload, tenant, tried,
+                    )
+                else:
+                    status, doc = self._member_call(
+                        member, "POST", "/submit", payload,
+                    )
+                    via = member["name"]
             except (OSError, ValueError) as e:
                 tried.add(member["name"])
                 last_err = f"{member['name']}: {e}"
@@ -724,18 +1018,24 @@ class FleetGateway:
             if status == 200 and doc.get("request_id"):
                 rid = str(doc["request_id"])
                 with self._placement_lock:
-                    self._routes[rid] = member["name"]
+                    self._submit_latencies.append(time.monotonic() - t0)
+                    self._routes[rid] = via
                     while len(self._routes) > _MAX_ROUTES:
                         self._routes.popitem(last=False)
-                    live = self._members.get(member["name"])
+                    live = self._members.get(via)
                     if live is not None:
                         # provisional until the next probe refreshes it:
                         # keeps least-queue placement honest in bursts
                         live["queued"] += 1
                 doc = dict(doc)
-                doc["member"] = member["name"]
+                doc["member"] = via
                 return status, doc
             return status, doc  # the member's typed answer, verbatim
+        if breaker_blocked and not last_err:
+            return self._reject(
+                tenant, admission_mod.REJECT_FLEET_BREAKER,
+                "every placeable member behind an open breaker",
+            )
         return self._reject(
             tenant, admission_mod.REJECT_FLEET_NO_MEMBER,
             f"every member unreachable; last: {last_err}",
@@ -770,6 +1070,7 @@ class FleetGateway:
         http = 503 if code in (
             admission_mod.REJECT_DRAINING,
             admission_mod.REJECT_FLEET_NO_MEMBER,
+            admission_mod.REJECT_FLEET_BREAKER,
         ) else 429
         return http, {"error": code, "tenant": tenant, "detail": detail}
 
@@ -877,6 +1178,16 @@ class FleetGateway:
             adoptions = list(self._adoptions)
             rejections = dict(self._rejections)
             n_routes = len(self._routes)
+            breakers = dict(self._breakers)
+            hedge_stats = dict(self._hedge_stats)
+        # breaker snapshots + fence epochs OUTSIDE the placement lock:
+        # each breaker has its own lock, and the fence read is file IO
+        for n, m in members.items():
+            br = breakers.get(n)
+            m["breaker"] = br.snapshot() if br is not None else None
+            m["fence_epoch"] = int(
+                journal_mod.read_fence(m["base_dir"])["epoch"]
+            )
         total = hits + misses
         return {
             "version": 1,
@@ -901,6 +1212,11 @@ class FleetGateway:
             "routes": n_routes,
             "rejections": rejections,
             "adoptions": adoptions,
+            "hedge": {
+                "enabled": self.hedge,
+                "delay_s": round(self._hedge_delay(), 4),
+                **{k: int(v) for k, v in hedge_stats.items()},
+            },
             "dead_unadopted": sorted(
                 n for n, m in members.items()
                 if m.get("dead") and not m.get("adopted_by")
@@ -937,7 +1253,8 @@ class FleetGateway:
                 n: {
                     k: m.get(k)
                     for k in ("alive", "dead", "draining", "adopted_by",
-                              "queued", "inflight", "replay_backlog")
+                              "queued", "inflight", "replay_backlog",
+                              "breaker", "fence_epoch")
                 }
                 for n, m in doc["members"].items()
             },

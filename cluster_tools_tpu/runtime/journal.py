@@ -66,6 +66,14 @@ from . import trace as trace_mod
 #: the journal file, next to ``server_state.json`` / ``failures.json``
 JOURNAL_FILENAME = "journal.log"
 
+#: the fence-epoch file, next to the journal it guards (docs/SERVING.md
+#: "Gray failures").  Minted (monotonically bumped) by whoever wins the
+#: adoption claim for this member's journal; the member itself re-checks
+#: it before every journal append and handoff flush, so a falsely-
+#: declared-dead zombie that wakes after adoption can never fork the
+#: truth a survivor now owns.
+FENCE_FILENAME = "fence.json"
+
 MAGIC = b"CTJ1"
 _HEADER = struct.Struct("<4sII")  # magic, payload length, crc32(payload)
 
@@ -104,6 +112,154 @@ def rotate_bytes_default() -> int:
 
 def journal_path(base_dir: str) -> str:
     return os.path.join(base_dir, JOURNAL_FILENAME)
+
+
+# -- fencing epochs (docs/SERVING.md "Gray failures") -------------------------
+#
+# The adoption claim (runtime/fleet.py) proves at most one ADOPTER; fencing
+# proves the ADOPTED member can no longer write.  Protocol:
+#
+#   1. the survivor wins ``adoption.claim`` (O_CREAT|O_EXCL),
+#   2. it MINTS a new fence epoch next to the victim's journal
+#      (:func:`mint_fence` — read-bump-atomic-replace, strictly monotonic
+#      because the replace is atomic and minting happens only under the
+#      exclusive claim),
+#   3. only THEN does it scan the journal (``read_peer_journal``) and adopt.
+#
+# Every member boots owning the epoch it finds (:func:`read_fence`) and
+# re-validates through a :class:`FenceGuard` — one ``os.stat`` per check,
+# re-reading the JSON only when (mtime_ns, size, ino) moved — immediately
+# before each journal append (inside :meth:`Journal.append`, under the
+# journal lock) and each handoff flush.  A SIGSTOP'd zombie is frozen for
+# the whole mint-then-scan window, so its first instruction after SIGCONT
+# that could touch the journal re-checks the (changed) fence file, sees the
+# higher epoch, and raises :class:`Fenced` — structurally before any byte
+# of the old epoch reaches a journal the survivor owns.
+
+class Fenced(RuntimeError):
+    """This process's fence epoch has been superseded: a survivor holds
+    the adoption claim and owns the journal now.  The only safe move is
+    to stop writing and self-drain (``fenced:adopted_away``)."""
+
+    def __init__(self, own_epoch: int, current_epoch: int,
+                 minted_by: Optional[str] = None):
+        self.own_epoch = int(own_epoch)
+        self.current_epoch = int(current_epoch)
+        self.minted_by = minted_by
+        super().__init__(
+            f"fenced: epoch {self.own_epoch} superseded by "
+            f"{self.current_epoch}"
+            + (f" (minted by {minted_by})" if minted_by else "")
+        )
+
+
+def fence_path(base_dir: str) -> str:
+    return os.path.join(base_dir, FENCE_FILENAME)
+
+
+def read_fence(base_dir: str) -> Dict[str, Any]:
+    """The current fence doc: ``{"epoch", "minted_by", "time"}``.  A
+    missing or unparseable file reads as epoch 0 — safe because the file
+    is only ever installed by atomic replace, so a torn final file cannot
+    arise from a crash (the property test crashes the mint at every byte
+    offset to prove the epoch never regresses)."""
+    try:
+        with open(fence_path(base_dir), "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {"epoch": 0, "minted_by": None, "time": None}
+    if not isinstance(doc, dict):
+        return {"epoch": 0, "minted_by": None, "time": None}
+    try:
+        epoch = int(doc.get("epoch") or 0)
+    except (TypeError, ValueError):
+        epoch = 0
+    return {"epoch": epoch, "minted_by": doc.get("minted_by"),
+            "time": doc.get("time")}
+
+
+def mint_fence(base_dir: str, by: Optional[str] = None) -> int:
+    """Bump the fence epoch by one and return the new value.
+
+    Write discipline mirrors every manifest in the repo (CT002): full doc
+    to a tmp file, flush + fsync, then ONE ``os.replace`` — a crash at any
+    byte offset of the tmp write leaves the old fence intact, so epochs
+    are strictly monotonic across arbitrary adopt/respawn/re-adopt
+    interleavings.  Monotonicity across *concurrent* minters is the
+    adoption claim's job: mint only while holding ``adoption.claim``.
+    """
+    new_epoch = int(read_fence(base_dir)["epoch"]) + 1
+    doc = {"epoch": new_epoch, "minted_by": by,
+           "time": trace_mod.walltime()}
+    path = fence_path(base_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # dir-entry durability is best-effort
+    return new_epoch
+
+
+class FenceGuard:
+    """Cheap membership re-validation: holds the epoch this process booted
+    with and raises :class:`Fenced` the moment a higher one appears.
+
+    ``check()`` is one ``os.stat`` on the hot path; the JSON is re-read
+    only when the file's (mtime_ns, size, ino) signature moves — i.e.
+    exactly once per adoption, however many appends happen between.
+    """
+
+    def __init__(self, base_dir: str, own_epoch: Optional[int] = None):
+        self.base_dir = base_dir
+        self.path = fence_path(base_dir)
+        self.own_epoch = int(
+            read_fence(base_dir)["epoch"] if own_epoch is None else own_epoch
+        )
+        self._lock = threading.Lock()
+        self._cached_sig: Optional[Tuple[int, int, int]] = None
+        self._cached_epoch = self.own_epoch
+        self._cached_by: Optional[str] = None
+        self.checks = 0
+        self.rereads = 0
+
+    def current(self) -> int:
+        """The last epoch observed (refreshing the cache), without
+        raising — the state-doc / progress view uses this."""
+        try:
+            self.check()
+        except Fenced as exc:
+            return exc.current_epoch
+        return self._cached_epoch
+
+    def check(self) -> None:
+        """Raise :class:`Fenced` iff a higher epoch has been minted."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return  # never minted: nobody has ever adopted this journal
+        sig = (st.st_mtime_ns, st.st_size, st.st_ino)
+        with self._lock:
+            self.checks += 1
+            if sig != self._cached_sig:
+                doc = read_fence(self.base_dir)
+                self._cached_sig = sig
+                self._cached_epoch = max(
+                    int(doc["epoch"]), self._cached_epoch
+                )
+                self._cached_by = doc.get("minted_by")
+                self.rereads += 1
+            epoch, by = self._cached_epoch, self._cached_by
+        if epoch > self.own_epoch:
+            raise Fenced(self.own_epoch, epoch, by)
 
 
 def _frame(record: Dict[str, Any]) -> bytes:
@@ -260,6 +416,11 @@ class Journal:
         self.path = path
         self._lock = threading.Lock()
         self._fh = None
+        #: optional :class:`FenceGuard` — when set, every append re-checks
+        #: the fence epoch under the journal lock, immediately before the
+        #: write, and raises :class:`Fenced` instead of forking a journal
+        #: a survivor owns (docs/SERVING.md "Gray failures")
+        self.fence_guard: Optional[FenceGuard] = None
         # stats for /healthz + server_state.json (docs/SERVING.md)
         self.appended = 0
         self.bytes = 0
@@ -381,6 +542,11 @@ class Journal:
         with self._lock:
             if self._fh is None:  # pragma: no cover - misuse guard
                 raise RuntimeError("journal.append before recover()")
+            if self.fence_guard is not None:
+                # last possible instant before bytes move: a zombie that
+                # was adopted away raises Fenced here, with the frame
+                # still un-written
+                self.fence_guard.check()
             keep = inj.torn_append()
             if keep is not None:
                 # the injected torn write (kind='torn', site='journal'):

@@ -82,6 +82,7 @@ from . import executor as executor_mod
 from . import faults as faults_mod
 from . import handoff as handoff_mod
 from . import journal as journal_mod
+from . import netio
 from . import scrub as scrub_mod
 from . import trace as trace_mod
 from .supervision import (
@@ -98,6 +99,12 @@ ENDPOINT_FILENAME = "server.json"
 #: the crash-loop quarantine resolution recorded in failures.json when a
 #: replayed request has crashed the server ``max_replay_attempts`` times
 QUARANTINE_CRASH_LOOP = "quarantined:crash_loop"
+
+#: failures.json resolution recorded when this member discovers it was
+#: fenced — declared dead and adopted away while wedged (docs/SERVING.md
+#: "Gray failures").  The member self-drains and exits
+#: ``FENCED_EXIT_CODE`` without another journal byte or store write.
+FENCED_RESOLUTION = "fenced:adopted_away"
 
 #: completed/terminal request records kept in memory (oldest pruned)
 _MAX_RECORDS = 512
@@ -206,6 +213,11 @@ class PipelineServer:
         )
         self._requests: "Dict[str, Dict[str, Any]]" = {}
         self._requests_lock = threading.Lock()
+        #: fencing (docs/SERVING.md "Gray failures"): armed in start(),
+        #: re-validated before every journal append + handoff flush; set
+        #: once a higher epoch is discovered — the self-drain trigger
+        self._fence_guard: Optional[journal_mod.FenceGuard] = None
+        self._fenced_exc: Optional[journal_mod.Fenced] = None
         self._reject_seq = 0
         self._order: List[str] = []  # insertion order, for pruning
         #: journal adoptions this incarnation performed (fleet failover;
@@ -252,6 +264,14 @@ class PipelineServer:
             trace_mod.set_trace_dir(
                 os.path.join(self.base_dir, trace_mod.TRACE_DIRNAME)
             )
+        # fence ownership (docs/SERVING.md "Gray failures"): boot owning
+        # whatever epoch is current — a respawned member adopts the epoch
+        # its respawn minted.  From here every journal append and handoff
+        # flush re-validates the epoch (one cached stat); a higher one
+        # means a survivor adopted this journal and we must self-drain.
+        self._fence_guard = journal_mod.FenceGuard(self.base_dir)
+        if self._journal is not None:
+            self._journal.fence_guard = self._fence_guard
         self._recover_journal()
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), _RequestHandler
@@ -315,6 +335,20 @@ class PipelineServer:
         (:func:`~cluster_tools_tpu.runtime.supervision.
         install_drain_handler`)."""
         while not drain_requested():
+            if self._fenced_exc is not None:
+                # adopted away while wedged (docs/SERVING.md "Gray
+                # failures"): stop answering, bounded-join the workers
+                # (an in-flight request hits the fence at its next
+                # journal append or flush and unwinds), and exit
+                # FENCED_EXIT_CODE — never rc 114: a supervisor must not
+                # respawn us onto a journal a survivor now owns
+                self._stop.set()
+                self.controller.begin_drain()
+                for t in self._workers:
+                    t.join(timeout=10.0)
+                self._write_state()
+                self._teardown()
+                raise self._fenced_exc
             time.sleep(poll_s)
         self.controller.begin_drain()
         for t in self._workers:
@@ -355,9 +389,63 @@ class PipelineServer:
                         **fields: Any) -> None:
         """One lifecycle transition into the journal (fsync'd; a no-op
         with the journal off).  Never called under the admission/request
-        locks — an fsync is a disk round trip (ctlint CT010)."""
+        locks — an fsync is a disk round trip (ctlint CT010).  Raises
+        :class:`~cluster_tools_tpu.runtime.journal.Fenced` — with the
+        record UNWRITTEN and the self-drain armed — when a survivor has
+        adopted this journal (fence check under the journal lock)."""
         if self._journal is not None:
-            self._journal.append_transition(typ, request_id, **fields)
+            try:
+                self._journal.append_transition(typ, request_id, **fields)
+            except journal_mod.Fenced as e:
+                self._note_fenced(e)
+                raise
+
+    def _note_fenced(self, exc: journal_mod.Fenced) -> None:
+        """First fence discovery wins: record ``fenced:adopted_away`` in
+        failures.json, stop admission, and arm the self-drain (the serve
+        loop exits ``FENCED_EXIT_CODE``).  Idempotent — every later
+        fenced append re-raises without re-recording."""
+        with self._requests_lock:
+            if self._fenced_exc is not None:
+                return
+            self._fenced_exc = exc
+        fu.log(
+            f"server {self.base_dir}: FENCED — epoch {exc.own_epoch} "
+            f"superseded by {exc.current_epoch} "
+            f"({exc.minted_by or 'unknown'}); self-draining without "
+            "another journal byte or store write"
+        )
+        try:
+            fu.record_failures(
+                self.failures_path,
+                "server.fleet",
+                [{
+                    "block_id": f"fenced:{os.getpid()}",
+                    "sites": {"fence": 1},
+                    "error": str(exc),
+                    "quarantined": False,
+                    # resolved on the quarantine precedent: the fence DID
+                    # its job — the record is the operator's pointer to
+                    # the zombie incarnation, not an open problem
+                    "resolved": True,
+                    "resolution": FENCED_RESOLUTION,
+                    "own_epoch": exc.own_epoch,
+                    "fence_epoch": exc.current_epoch,
+                    "minted_by": exc.minted_by,
+                }],
+            )
+        except Exception:
+            pass  # attribution is best-effort; the fence stands
+        trace_mod.instant(
+            "server.fenced", own_epoch=exc.own_epoch,
+            fence_epoch=exc.current_epoch, by=exc.minted_by or "",
+        )
+        self.controller.begin_drain()
+        self._write_state()
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced_exc is not None
 
     def journal_health(self) -> Optional[Dict[str, Any]]:
         """The journal block of ``/healthz`` / ``server_state.json``:
@@ -967,6 +1055,12 @@ class PipelineServer:
                     wf = self._instantiate(payload, rid)
                     ok = build([wf], rerun=bool(payload.get("rerun")))
                     if ok:
+                        # fence gate on the OTHER write plane: a fenced
+                        # member must not store another byte either —
+                        # the adopter re-runs this request and flushes
+                        # its own bit-identical copy (ctlint CT013)
+                        if self._fence_guard is not None:
+                            self._fence_guard.check()
                         # client-visible durability: live dataset handoffs
                         # are written back before the request reports done
                         handoff_mod.flush_namespace(rid)
@@ -975,6 +1069,11 @@ class PipelineServer:
             # graceful preemption mid-request: markers/manifests are
             # flushed — the resubmitted request resumes at block grain
             state, error = "drained", str(e)
+        except journal_mod.Fenced as e:
+            # adopted away mid-run: the survivor re-runs this request
+            # from its adopted journal copy — record NOTHING here
+            self._note_fenced(e)
+            state, error = "fenced", str(e)
         except Exception:
             error = fu.cap_traceback(traceback.format_exc())
         finally:
@@ -1000,11 +1099,20 @@ class PipelineServer:
         # terminal transition journaled BEFORE the state flip becomes
         # observable: done -> the idempotent-answer record a restart
         # serves; drained -> re-enqueued on replay (the drain protocol's
-        # queued-work-survives contract now holds server-side)
-        self._journal_append(
-            _JOURNAL_TERMINAL.get(state, journal_mod.FAILED), rid,
-            tenant=request.tenant, record=terminal,
-        )
+        # queued-work-survives contract now holds server-side).  A fenced
+        # request journals NOTHING — the adopter owns its lifecycle now —
+        # and a fence discovered AT this append likewise unwinds with the
+        # record unwritten (Journal.append checks under its lock).
+        if state != "fenced":
+            try:
+                self._journal_append(
+                    _JOURNAL_TERMINAL.get(state, journal_mod.FAILED), rid,
+                    tenant=request.tenant, record=terminal,
+                )
+            except journal_mod.Fenced as e:
+                state, error = "fenced", str(e)
+                terminal["state"] = state
+                terminal["error"] = error
         with self._requests_lock:
             rec.update(
                 {k: v for k, v in terminal.items() if k != "request_id"}
@@ -1064,6 +1172,15 @@ class PipelineServer:
 
     def _state_doc(self) -> Dict[str, Any]:
         journal = self.journal_health()
+        # fence pulse (docs/SERVING.md "Gray failures") — outside the
+        # request lock: current() may stat/re-read the fence file
+        fence = None
+        if self._fence_guard is not None:
+            fence = {
+                "own_epoch": self._fence_guard.own_epoch,
+                "current_epoch": self._fence_guard.current(),
+                "fenced": self.fenced,
+            }
         with self._requests_lock:
             requests = {
                 rid: {
@@ -1102,6 +1219,10 @@ class PipelineServer:
             # fleet failover (docs/SERVING.md "Fleet"): dead peers whose
             # journals this incarnation adopted
             "adoptions": adoptions,
+            # fencing (docs/SERVING.md "Gray failures"): the epoch this
+            # incarnation owns vs. the minted one; fenced=true means a
+            # survivor adopted this journal and we are self-draining
+            "fence": fence,
             # the server-scoped compiled-program cache (hits = repeat
             # requests that skipped a trace/compile; unkeyed = kernels
             # whose captured state could not be identity-frozen)
@@ -1243,6 +1364,13 @@ class _RequestHandler(BaseHTTPRequestHandler):
             return
         try:
             self._reply(200, self.pipeline.submit(payload))
+        except journal_mod.Fenced as e:
+            # the acceptance was NOT journaled (and so never promised):
+            # typed 503 — the client retries and the gateway, which has
+            # already routed traffic off this member, places it elsewhere
+            self._reply(503, {
+                "error": FENCED_RESOLUTION, "detail": str(e),
+            })
         except admission_mod.AdmissionError as e:
             http = 503 if e.code == admission_mod.REJECT_DRAINING else 429
             self._reply(http, {
@@ -1258,6 +1386,9 @@ class _RequestHandler(BaseHTTPRequestHandler):
                 "ok": True,
                 "draining": self.pipeline.controller.draining()
                 or drain_requested(),
+                # fenced = a survivor owns this journal; the member is
+                # exiting and must not be routed to
+                "fenced": self.pipeline.fenced,
                 # journal health (docs/SERVING.md "Durability"): last
                 # fsync age, journal bytes, and the replay backlog — a
                 # liveness probe that can also see the ack contract rot
@@ -1313,6 +1444,13 @@ RETRYABLE_REJECTS = (
     admission_mod.REJECT_QUEUE,
     admission_mod.REJECT_FLEET_NO_MEMBER,
     admission_mod.REJECT_FLEET_BACKLOG,
+    # every placeable member behind an open circuit breaker — clears on
+    # the half-open probe (docs/SERVING.md "Gray failures")
+    admission_mod.REJECT_FLEET_BREAKER,
+    # fenced member answered directly (never through the gateway, which
+    # routes off it): the acceptance was not journaled, resubmit lands
+    # on the survivor
+    FENCED_RESOLUTION,
 )
 
 
@@ -1363,43 +1501,31 @@ class ServeClient:
             self.port = int(doc["port"])
 
     def _call_once(self, method: str, path: str,
-                   body: Optional[Dict[str, Any]] = None) -> tuple:
-        import http.client
-
-        conn = http.client.HTTPConnection(
-            self.host, self.port, timeout=self.timeout_s
+                   body: Optional[Dict[str, Any]] = None,
+                   member: Optional[str] = None) -> tuple:
+        # one deadline-bounded exchange through the shared serve-plane
+        # doorway (fault site net_client; ``member`` carries the tenant
+        # for targeted client-side faults)
+        return netio.http_json_call(
+            self.host, self.port, method, path, body,
+            timeout_s=self.timeout_s, site="net_client", member=member,
         )
-        try:
-            data = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if data else {}
-            conn.request(method, path, body=data, headers=headers)
-            resp = conn.getresponse()
-            doc = json.loads(resp.read() or b"{}")
-            return resp.status, doc
-        finally:
-            conn.close()
 
     def _call(self, method: str, path: str,
               body: Optional[Dict[str, Any]] = None,
-              retry_s: Optional[float] = None) -> tuple:
+              retry_s: Optional[float] = None,
+              member: Optional[str] = None) -> tuple:
         """One HTTP call; with a ``retry_s`` budget, connection-level
         failures (refused / reset / timed out — the restart window) are
         retried with capped backoff, re-reading the endpoint file between
-        attempts.  HTTP-level answers are never retried here — the typed
+        attempts (:func:`netio.retry_connection` — the loop the gateway
+        shares).  HTTP-level answers are never retried here — the typed
         rejection codes are the caller's protocol."""
-        deadline = (
-            None if not retry_s else time.monotonic() + float(retry_s)
+        return netio.retry_connection(
+            lambda: self._call_once(method, path, body, member=member),
+            retry_s,
+            on_retry=self._refresh_endpoint,
         )
-        attempt = 0
-        while True:
-            try:
-                return self._call_once(method, path, body)
-            except (OSError, ConnectionError) as e:
-                if deadline is None or time.monotonic() >= deadline:
-                    raise
-                time.sleep(fu.backoff_delay(attempt, 0.05, 1.0))
-                attempt += 1
-                self._refresh_endpoint()
 
     def submit(self, retry_s: Optional[float] = None,
                **payload) -> Dict[str, Any]:
@@ -1421,8 +1547,10 @@ class ServeClient:
                 None if deadline is None
                 else max(0.1, deadline - time.monotonic())
             )
-            status, doc = self._call("POST", "/submit", payload,
-                                     retry_s=remaining)
+            status, doc = self._call(
+                "POST", "/submit", payload, retry_s=remaining,
+                member=str(payload.get("tenant") or "") or None,
+            )
             if status == 200:
                 return doc
             code = str(doc.get("error"))

@@ -56,6 +56,13 @@ HEARTBEAT_DIRNAME = "heartbeats"
 #: both a crash (1) and an injected hard kill (``faults.KILL_EXIT_CODE``).
 REQUEUE_EXIT_CODE = 114
 
+#: Exit code a serve member exits with after discovering it was FENCED —
+#: declared dead and adopted away while wedged (SIGSTOP, GC pause), then
+#: woken.  Distinct from a drain (114): the supervisor must NOT requeue
+#: it onto the same member dir — a survivor owns that journal now
+#: (docs/SERVING.md "Gray failures").
+FENCED_EXIT_CODE = 115
+
 
 # -- preemption-aware draining ------------------------------------------------
 

@@ -86,8 +86,10 @@ def main(argv=None) -> int:
         except Exception:
             pass
 
+    from .runtime.journal import Fenced
     from .runtime.server import PipelineServer
     from .runtime.supervision import (
+        FENCED_EXIT_CODE,
         REQUEUE_EXIT_CODE,
         DrainInterrupt,
         install_drain_handler,
@@ -127,6 +129,17 @@ def main(argv=None) -> int:
     )
     try:
         server.serve_until_drained()
+    except Fenced as e:
+        # gray-failure defense (docs/SERVING.md "Gray failures"): this
+        # member was declared dead and its journal adopted while it was
+        # wedged.  NOT a requeue — a survivor owns the journal; the
+        # supervisor must not respawn onto this base dir.
+        print(
+            f"FENCED ({e}); exiting {FENCED_EXIT_CODE} — journal "
+            "adopted away, do not requeue",
+            flush=True,
+        )
+        return FENCED_EXIT_CODE
     except DrainInterrupt as e:
         # CT006/CT009: a drained server is a requeue, not a crash — the
         # supervisor restarts it and clients resubmit their queued work

@@ -37,9 +37,12 @@ heartbeat (or a dead pid on this host) warns exactly like a stalled task.
 Fleet mode (docs/SERVING.md "Fleet"): pointed at a gateway's base dir,
 the same invocation renders the member table from ``fleet_state.json`` —
 alive/dead/draining/adopted per member, queue depth, replay backlog,
-affinity hit rate, and adoption events.  A member that is dead and NOT
-yet adopted means acknowledged requests are stranded until the journal
-handoff completes: rc 1, exactly like a stalled task.
+affinity hit rate, circuit-breaker state, fence epochs, hedging stats,
+and adoption events.  A member that is dead and NOT yet adopted means
+acknowledged requests are stranded until the journal handoff completes:
+rc 1, exactly like a stalled task.  A member that was FENCED (journal
+adopted away, docs/SERVING.md "Gray failures") but whose pid is still
+alive is a zombie that must be killed: rc 1 too.
 """
 
 from __future__ import annotations
@@ -215,6 +218,34 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
                 n for n, m in members.items()
                 if m.get("dead") and not m.get("adopted_by")
             )
+        # gray-failure view (docs/SERVING.md "Gray failures"): a member
+        # whose on-disk fence epoch moved past the epoch it booted with
+        # was adopted away — if its pid is STILL alive on this host it is
+        # a zombie that must be killed before it wakes up and tries to
+        # write (the fence makes the write impossible, but the process is
+        # wasted capacity and operator confusion)
+        fenced_alive = []
+        for name, m in sorted(members.items()):
+            fe = m.get("fence_epoch")
+            base = m.get("base_dir")
+            if fe is None or not base:
+                continue
+            mstate = _read_json(
+                os.path.join(base, "server_state.json")
+            ) or {}
+            fence = mstate.get("fence") or {}
+            own = fence.get("own_epoch")
+            fenced = bool(fence.get("fenced")) or (
+                own is not None and int(fe) > int(own)
+            )
+            pid = m.get("pid")
+            alive_here = bool(
+                pid
+                and m.get("hostname") == socket.gethostname()
+                and _pid_alive(pid)
+            )
+            if fenced and alive_here:
+                fenced_alive.append(name)
         fleet = {
             "pid": pid,
             "hostname": fleet_state.get("hostname"),
@@ -234,6 +265,10 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
             # acknowledged requests stranded until the journal handoff
             # completes — the operator page (rc 1)
             "dead_unadopted": dead_unadopted,
+            # hedged-submission pulse (docs/SERVING.md "Gray failures")
+            "hedge": fleet_state.get("hedge") or {},
+            # fenced-but-still-alive zombies — the operator page (rc 1)
+            "fenced_alive": fenced_alive,
         }
         heartbeats.pop("gateway", None)
         uids.discard("gateway")
@@ -462,6 +497,16 @@ def _format_fleet(fleet) -> list:
                 bits.append(
                     f"heartbeat {float(m['heartbeat_age_s']):.1f}s ago"
                 )
+            br = m.get("breaker") or {}
+            if br.get("state"):
+                b = f"breaker {br['state']}"
+                if br.get("consecutive_failures"):
+                    b += f" ({br['consecutive_failures']} fail(s))"
+                if br.get("since_transition_s") is not None:
+                    b += f" for {float(br['since_transition_s']):.1f}s"
+                bits.append(b)
+            if m.get("fence_epoch"):
+                bits.append(f"fence epoch {m['fence_epoch']}")
             lines.append(
                 f"    member {name:<{width}}  [{st}]  " + ", ".join(bits)
             )
@@ -475,6 +520,14 @@ def _format_fleet(fleet) -> list:
         lines.append(
             f"    affinity: {'on' if aff.get('enabled', True) else 'off'}, "
             f"{hits} hit(s), {misses} miss(es) (hit_rate {rate:.2f})"
+        )
+    hedge = fleet.get("hedge") or {}
+    if hedge.get("launched"):
+        lines.append(
+            f"    hedges: {hedge['launched']} launched "
+            f"(delay {hedge.get('delay_s', 0)}s, "
+            f"{hedge.get('won_secondary', 0)} won by the hedge, "
+            f"{hedge.get('won_primary', 0)} by the primary)"
         )
     rej = {k: v for k, v in (fleet.get("rejections") or {}).items() if v}
     if rej:
@@ -532,6 +585,13 @@ def format_progress(doc) -> str:
                 f"  WARNING: member {name} is dead and its journal is NOT "
                 "adopted — acknowledged requests are stranded until a "
                 "survivor adopts it (see docs/SERVING.md \"Fleet\")"
+            )
+        for name in doc["fleet"].get("fenced_alive") or []:
+            lines.append(
+                f"  WARNING: member {name} is FENCED (journal adopted "
+                "away) but its pid is still alive — a zombie; the fence "
+                "blocks its writes, but kill it (docs/SERVING.md "
+                "\"Gray failures\")"
             )
     if not tasks:
         lines.append("  no tasks seen yet (no markers, manifests, "
@@ -609,9 +669,12 @@ def main(argv) -> int:
         or doc["server"].get("journal_backlog_stalled")
     ):
         bad = True
-    # a dead-and-unadopted fleet member strands acknowledged requests
+    # a dead-and-unadopted fleet member strands acknowledged requests;
+    # a fenced-but-still-alive member is a zombie that must be killed
     if doc.get("fleet") is not None and (
-        doc["fleet"]["stale"] or doc["fleet"].get("dead_unadopted")
+        doc["fleet"]["stale"]
+        or doc["fleet"].get("dead_unadopted")
+        or doc["fleet"].get("fenced_alive")
     ):
         bad = True
     return 1 if bad else 0

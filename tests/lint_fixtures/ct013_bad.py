@@ -1,0 +1,35 @@
+"""CT013 fixture: deadline-less outbound connections, and acknowledged
+server writes (journal transition, handoff publish) with no fencing
+evidence in scope."""
+
+import http.client
+import socket
+import urllib.request
+
+from cluster_tools_tpu.runtime import handoff as handoff_mod
+
+
+def probe(host, port):
+    # no timeout kwarg: a wedged peer blocks this thread forever and no
+    # breaker ever trips
+    conn = http.client.HTTPConnection(host, port)
+    conn.request("GET", "/healthz")
+    return conn.getresponse().status
+
+
+def fetch(url):
+    return urllib.request.urlopen(url).read()  # deadline-less too
+
+
+def raw_connect(host, port):
+    return socket.create_connection((host, port))  # and again
+
+
+class Server:
+    def _journal_append(self, typ, request_id, **fields):
+        # no fence_guard.check() and no Fenced handler anywhere in
+        # scope: a zombie adopted away while wedged writes right through
+        self._journal.append_transition(typ, request_id, **fields)
+
+    def _execute(self, rid):
+        handoff_mod.flush_namespace(rid)  # publish with no fence gate

@@ -1,0 +1,40 @@
+"""CT013 fixture: every outbound connection carries a deadline; every
+acknowledged server write shows fencing evidence (clean)."""
+
+import http.client
+import socket
+import urllib.request
+
+from cluster_tools_tpu.runtime import handoff as handoff_mod
+from cluster_tools_tpu.runtime import journal as journal_mod
+
+
+def probe(host, port, timeout_s):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    conn.request("GET", "/healthz")
+    return conn.getresponse().status
+
+
+def fetch(url, timeout_s):
+    return urllib.request.urlopen(url, timeout=timeout_s).read()
+
+
+def raw_connect(host, port, timeout_s):
+    return socket.create_connection((host, port), timeout=timeout_s)
+
+
+class Server:
+    def _journal_append(self, typ, request_id, **fields):
+        # the append path re-validates the fence epoch under the journal
+        # lock; the Fenced handler is the evidence that this call site
+        # rides the gate
+        try:
+            self._journal.append_transition(typ, request_id, **fields)
+        except journal_mod.Fenced as e:
+            self._note_fenced(e)
+            raise
+
+    def _execute(self, rid):
+        # explicit re-validation immediately before the publish
+        self._fence_guard.check()
+        handoff_mod.flush_namespace(rid)

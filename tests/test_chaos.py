@@ -1348,3 +1348,239 @@ def test_chaos_fleet_kill_server_failover(tmp_path):
             except (OSError, ValueError):
                 pass
     assert stray_serve_pids() == []
+
+
+def test_chaos_fleet_sigstop_zombie_fenced(tmp_path):
+    """ISSUE 18 acceptance: SIGSTOP (not kill) one member of a two-server
+    fleet under live two-tenant traffic — a *gray* failure: the pid stays
+    alive, the socket still accepts, nothing ever answers.
+
+    - six requests (two tenants) are acknowledged through the gateway;
+      the member serving tenant alice is SIGSTOPped with most of its
+      backlog still queued;
+    - the gateway's probe deadline trips the member's circuit breaker
+      open, heartbeat staleness declares the member dead despite the
+      live pid, a survivor takes the adoption claim, MINTS A FENCE
+      EPOCH, then adopts the journal and finishes every acknowledged
+      request — the client never resubmits;
+    - every output is bit-identical to a solo batch reference;
+    - then SIGCONT wakes the zombie: its next journal append hits the
+      fence and it self-drains with ``FENCED_EXIT_CODE`` (115) having
+      appended ZERO further journal bytes — a post-wake submit poked
+      straight at its old endpoint is refused ``fenced:adopted_away``,
+      never acknowledged;
+    - the fence discovery is attributed in the zombie's own
+      failures.json, the fleet supervisor surfaces the FENCED exit
+      without respawning, and the fleet drains to rc 114 on SIGTERM.
+    """
+    import signal
+    import time
+
+    from cluster_tools_tpu.runtime import journal as journal_mod
+    from cluster_tools_tpu.runtime import netio
+    from cluster_tools_tpu.runtime.fleet import FLEET_STATE_FILENAME
+    from cluster_tools_tpu.runtime.server import (
+        FENCED_RESOLUTION,
+        ServeClient,
+    )
+    from cluster_tools_tpu.runtime.supervision import FENCED_EXIT_CODE
+
+    root = str(tmp_path)
+    rng = np.random.default_rng(SEED)
+    vol = (rng.random((16, 16, 16)) > 0.5).astype("float32")
+    data = os.path.join(root, "data.zarr")
+    ds = file_reader(data).create_dataset(
+        "mask", shape=vol.shape, chunks=(8, 8, 8), dtype="float32")
+    ds[...] = vol
+
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.connected_components import (
+        ConnectedComponentsWorkflow,
+    )
+
+    ref_dir = os.path.join(root, "ref")
+    os.makedirs(os.path.join(ref_dir, "config"), exist_ok=True)
+    with open(os.path.join(ref_dir, "config", "global.config"), "w") as f:
+        json.dump({"block_shape": [8, 8, 8], "memory_handoffs": True}, f)
+    assert build([ConnectedComponentsWorkflow(
+        tmp_folder=os.path.join(ref_dir, "tmp"),
+        config_dir=os.path.join(ref_dir, "config"),
+        max_jobs=2, target="local",
+        input_path=data, input_key="mask",
+        output_path=data, output_key="ref_seg", threshold=0.5,
+    )])
+    ref_seg = np.asarray(file_reader(data, "r")["ref_seg"][...])
+
+    # -- the fleet: tight gray-failure knobs so the wedge is detected in
+    # seconds — short call deadlines, a 2-strike breaker, fast staleness
+    fleet_dir = os.path.join(root, "fleet")
+    cfg_path = os.path.join(root, "fleet.json")
+    with open(cfg_path, "w") as f:
+        json.dump({
+            "members": 2,
+            "gateway": {
+                "health_interval_s": 0.25, "member_stale_s": 1.5,
+                "call_timeout_s": 2.0, "breaker_threshold": 2,
+                "breaker_cooldown_s": 1.0, "hedge_max_delay_s": 0.5,
+            },
+            "server": {"max_workers": 1},
+        }, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.fleet",
+         "--base-dir", fleet_dir, "--config", cfg_path],
+        env=env, cwd=REPO_ROOT, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+    def payload(tenant, rid, out_key):
+        return dict(
+            tenant=tenant, request_id=rid,
+            workflow="connected_components",
+            config=dict(
+                tmp_folder=os.path.join(root, "req_" + rid),
+                global_config={"block_shape": [8, 8, 8]},
+                params=dict(input_path=data, input_key="mask",
+                            output_path=data, output_key=out_key,
+                            threshold=0.5),
+            ),
+        )
+
+    requests = [("alice", f"a{i}", f"seg_a{i}") for i in range(3)] \
+        + [("bob", f"b{i}", f"seg_b{i}") for i in range(3)]
+
+    try:
+        endpoint = os.path.join(fleet_dir, "server.json")
+        deadline = time.monotonic() + 120
+        while True:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"fleet died on startup rc={proc.returncode}:\n"
+                    f"{proc.stdout.read()[-4000:]}")
+            try:
+                with open(endpoint) as f:
+                    doc = json.load(f)
+                if doc.get("pid") == proc.pid \
+                        and doc.get("role") == "gateway":
+                    break
+            except (OSError, ValueError):
+                pass
+            assert time.monotonic() < deadline, "gateway never bound"
+            time.sleep(0.05)
+        client = ServeClient.from_endpoint_file(fleet_dir)
+
+        homes = {}
+        for tenant, rid, key in requests:
+            doc = client.submit(retry_s=60, **payload(tenant, rid, key))
+            homes[rid] = doc["member"]
+        assert len({homes[f"a{i}"] for i in range(3)}) == 1
+        assert len({homes[f"b{i}"] for i in range(3)}) == 1
+
+        # -- SIGSTOP alice's member: alive pid, accepting socket, total
+        # silence — the pure gray failure
+        victim = homes["a0"]
+        victim_dir = os.path.join(fleet_dir, "members", victim)
+        with open(os.path.join(victim_dir, "server.json")) as f:
+            victim_doc = json.load(f)
+        victim_pid = victim_doc["pid"]
+        assert victim_pid != proc.pid
+        os.kill(victim_pid, signal.SIGSTOP)
+
+        # zero lost acknowledged requests through the wedge + failover
+        for tenant, rid, key in requests:
+            rec = client.wait(rid, timeout_s=300, across_restarts=True)
+            assert rec["state"] == "done", (rid, rec)
+        out = file_reader(data, "r")
+        for _, _, key in requests:
+            np.testing.assert_array_equal(np.asarray(out[key][...]),
+                                          ref_seg)
+
+        # -- breaker opened, exactly one adoption, fence minted ------------
+        with open(os.path.join(fleet_dir, FLEET_STATE_FILENAME)) as f:
+            state = json.load(f)
+        assert state["dead_unadopted"] == []
+        dead = state["members"][victim]
+        survivor = dead["adopted_by"]
+        assert survivor and survivor != victim
+        breaker = dead.get("breaker") or {}
+        assert breaker.get("opened_total", 0) >= 1, breaker
+        adoptions = state["adoptions"]
+        assert len(adoptions) == 1, adoptions
+        assert adoptions[0]["member"] == victim
+        assert adoptions[0]["adopter"] == survivor
+        assert adoptions[0]["completed"] + adoptions[0]["reenqueued"] >= 1
+        fence_epoch = adoptions[0]["fence_epoch"]
+        assert fence_epoch >= 1
+        fence = journal_mod.read_fence(victim_dir)
+        assert fence["epoch"] == fence_epoch
+        assert fence["minted_by"] == f"adopt:{survivor}"
+
+        # the victim is still a live (stopped) pid — a true zombie-to-be
+        os.kill(victim_pid, 0)
+        journal_file = os.path.join(
+            victim_dir, journal_mod.JOURNAL_FILENAME)
+        journal_size = os.path.getsize(journal_file)
+
+        # -- wake the zombie; its next append hits the fence ---------------
+        os.kill(victim_pid, signal.SIGCONT)
+        # poke a submit straight at the old endpoint: the zombie must
+        # refuse it typed — NEVER acknowledge.  (Connection errors mean
+        # it already self-fenced off resumed backlog; equally fine.)
+        try:
+            st, doc = netio.http_json_call(
+                victim_doc["host"], victim_doc["port"], "POST", "/submit",
+                payload("zombie", "z0", "seg_z0"), timeout_s=30.0)
+            assert st == 503 and doc.get("error") == FENCED_RESOLUTION, (
+                st, doc)
+        except OSError:
+            pass
+        # the zombie self-drains and the supervisor reaps rc 115
+        zombie_deadline = time.monotonic() + 120
+        while True:
+            try:
+                os.kill(victim_pid, 0)
+            except ProcessLookupError:
+                break
+            assert time.monotonic() < zombie_deadline, \
+                "SIGCONT'd zombie never exited FENCED"
+            time.sleep(0.2)
+
+        # ZERO journal bytes appended after the fence, discovery is
+        # attributed in the zombie's own failures.json, and no output
+        # was corrupted by the wake (bit-identical re-check)
+        assert os.path.getsize(journal_file) == journal_size
+        with open(os.path.join(victim_dir, "failures.json")) as f:
+            recs = json.load(f)["records"]
+        fenced = [r for r in recs
+                  if r.get("resolution") == FENCED_RESOLUTION]
+        assert len(fenced) == 1, recs
+        assert fenced[0]["resolved"] is True
+        assert fenced[0]["fence_epoch"] == fence_epoch
+        out = file_reader(data, "r")
+        for _, _, key in requests:
+            np.testing.assert_array_equal(np.asarray(out[key][...]),
+                                          ref_seg)
+
+        # -- drain by the book; the FENCED exit was surfaced, once ---------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        stdout_tail = proc.stdout.read()
+        assert rc == REQUEUE_EXIT_CODE, (
+            f"fleet drain exited rc={rc}, wanted {REQUEUE_EXIT_CODE}:\n"
+            f"{stdout_tail[-4000:]}")
+        assert stdout_tail.count(
+            f"member {victim} exited FENCED (rc {FENCED_EXIT_CODE})") == 1
+    finally:
+        reap_process(proc)
+        for name in ("m0", "m1"):
+            ep = os.path.join(fleet_dir, "members", name, "server.json")
+            try:
+                with open(ep) as f:
+                    mpid = json.load(f).get("pid")
+                if mpid and mpid in stray_serve_pids():
+                    os.kill(mpid, signal.SIGKILL)
+            except (OSError, ValueError):
+                pass
+    assert stray_serve_pids() == []

@@ -279,6 +279,9 @@ def test_fused_and_blockwise_cc_agree(workspace, rng):
     assert_labels_equivalent(r["cc_fused"][...], r["cc_block"][...])
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~20 s of XLA compiles; the split
+# execution variant — fused segmentation stays tier-1 via _task_vs_scipy
+# and _grid_decomposition.
 def test_fused_segmentation_split_execution(workspace, rng):
     """execution='split': the staged four-program chain through the task
     API writes the same labels the fused monolith does."""

@@ -1,0 +1,605 @@
+"""Gray-failure defense (docs/SERVING.md "Gray failures"): net-fault
+injection verdicts, the netio shim's delay/drop/wedge semantics, fence
+epochs (mint monotonicity under crash at every byte offset, the
+FenceGuard, Fenced journal appends that write nothing), the per-member
+circuit breaker state machine, breaker-typed gateway backpressure,
+hedged submission winning past a wedged primary with exactly-one
+execution, the fenced member's 503 + self-drain, and the operator views
+(fleet_state.json + scripts/progress.py).  CPU-only, tier-1 fast; the
+SIGSTOP zombie end-to-end lives in test_chaos.py."""
+
+import http.server
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from cluster_tools_tpu.runtime import faults, handoff, netio
+from cluster_tools_tpu.runtime import journal as journal_mod
+from cluster_tools_tpu.runtime.faults import KILL_EXIT_CODE
+from cluster_tools_tpu.runtime.admission import (
+    REJECT_FLEET_BREAKER,
+    REJECT_FLEET_NO_MEMBER,
+)
+from cluster_tools_tpu.runtime.fleet import CircuitBreaker, FleetGateway
+from cluster_tools_tpu.runtime.server import (
+    FENCED_RESOLUTION,
+    RETRYABLE_REJECTS,
+    PipelineServer,
+)
+from cluster_tools_tpu.runtime.supervision import (
+    FENCED_EXIT_CODE,
+    REQUEUE_EXIT_CODE,
+)
+
+from .test_fleet import (
+    _bare_gateway,
+    _member,
+    _mk_input,
+    _serve_payload,
+    _start_fleet,
+    _stop_all,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_process_state():
+    handoff.reset()
+    faults.configure(None)
+    yield
+    handoff.reset()
+    faults.configure(None)
+
+
+# -- net-fault injection (runtime/faults.py) ----------------------------------
+
+
+def test_net_fault_site_vocabulary_is_validated():
+    """A net fault at a non-net site is a config error, not a hook that
+    silently never fires (the CT004 contract)."""
+    with pytest.raises(ValueError):
+        faults.configure({"faults": [
+            {"kind": "net_wedge", "site": "journal"},
+        ]})
+    for site in ("net_member", "net_probe", "net_client"):
+        inj = faults.configure({"faults": [
+            {"kind": "net_drop", "site": site},
+        ]})
+        assert inj.net_fault(site) == ("net_drop", 1.0)
+
+
+def test_net_fault_targets_members_and_bounds_attempts():
+    """``members`` gates on the far side's name; ``fail_attempts`` bounds
+    how many exchanges degrade (per (site, member) attempt counter)."""
+    inj = faults.configure({"faults": [
+        {"kind": "net_wedge", "site": "net_member", "members": ["m1"],
+         "seconds": 7.5, "fail_attempts": 2},
+    ]})
+    assert inj.net_fault("net_member", member="m0") is None
+    assert inj.net_fault("net_probe", member="m1") is None  # wrong site
+    assert inj.net_fault("net_member", member="m1") == ("net_wedge", 7.5)
+    assert inj.net_fault("net_member", member="m1") == ("net_wedge", 7.5)
+    assert inj.net_fault("net_member", member="m1") is None  # budget spent
+
+
+def test_net_fault_rate_draws_a_seeded_coin():
+    inj = faults.configure({"faults": [
+        {"kind": "net_drop", "site": "net_client", "rate": 1.0,
+         "fail_attempts": 99},
+    ]})
+    assert inj.net_fault("net_client") is not None
+    inj = faults.configure({"faults": [
+        {"kind": "net_drop", "site": "net_client", "rate": 0.0,
+         "fail_attempts": 99},
+    ]})
+    assert all(inj.net_fault("net_client") is None for _ in range(20))
+
+
+# -- the netio shim -----------------------------------------------------------
+
+
+def test_netio_drop_raises_connection_reset():
+    """net_drop surfaces as the same exception class a real reset gives
+    — callers classify with ``except (OSError, ValueError)`` unchanged."""
+    faults.configure({"faults": [
+        {"kind": "net_drop", "site": "net_client"},
+    ]})
+    with pytest.raises(ConnectionResetError):
+        netio.http_json_call("127.0.0.1", 1, "GET", "/healthz",
+                             timeout_s=1.0, site="net_client")
+
+
+def test_netio_wedge_blocks_until_the_callers_deadline():
+    """net_wedge models the accepted-but-never-answers connection: the
+    caller's own deadline bounds the stall (never the wedge's length)."""
+    faults.configure({"faults": [
+        {"kind": "net_wedge", "site": "net_client", "seconds": 30.0},
+    ]})
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        netio.http_json_call("127.0.0.1", 1, "GET", "/healthz",
+                             timeout_s=0.1, site="net_client")
+    assert time.monotonic() - t0 < 2.0  # bounded by timeout_s, not 30s
+
+
+class _JsonHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        body = json.dumps({"ok": True}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+def test_netio_delay_then_proceeds():
+    """net_delay is pure added latency: the exchange still completes."""
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _JsonHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        faults.configure({"faults": [
+            {"kind": "net_delay", "site": "net_client", "seconds": 0.05},
+        ]})
+        t0 = time.monotonic()
+        status, doc = netio.http_json_call(
+            "127.0.0.1", httpd.server_address[1], "GET", "/healthz",
+            timeout_s=5.0, site="net_client",
+        )
+        assert status == 200 and doc == {"ok": True}
+        assert time.monotonic() - t0 >= 0.05
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_retry_connection_backoff_and_give_up():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionRefusedError("binding")
+        return "answer"
+
+    retries = []
+    assert netio.retry_connection(
+        flaky, retry_s=5.0, on_retry=lambda: retries.append(1),
+        base_s=0.01, cap_s=0.02,
+    ) == "answer"
+    assert calls["n"] == 3 and len(retries) == 2
+
+    # no retry budget: the first connection failure is the caller's
+    def always_refused():
+        raise ConnectionRefusedError("nobody home")
+
+    with pytest.raises(ConnectionRefusedError):
+        netio.retry_connection(always_refused, retry_s=0)
+
+
+# -- fence epochs (runtime/journal.py) ----------------------------------------
+
+
+def test_fence_mint_is_strictly_monotonic(tmp_path):
+    base = str(tmp_path)
+    assert journal_mod.read_fence(base)["epoch"] == 0
+    assert journal_mod.mint_fence(base, by="adopt:m1") == 1
+    assert journal_mod.mint_fence(base, by="respawn:m0") == 2
+    assert journal_mod.mint_fence(base, by="adopt:m1") == 3
+    doc = journal_mod.read_fence(base)
+    assert doc["epoch"] == 3 and doc["minted_by"] == "adopt:m1"
+
+
+def test_fence_epoch_survives_crash_at_every_byte_offset(tmp_path):
+    """The PR-13 torn-tail discipline applied to the fence: a minter that
+    dies after writing any prefix of its tmp file leaves the installed
+    fence untouched (the tmp is never the fence until os.replace), so a
+    later re-mint continues strictly upward — epochs never regress or
+    fork across arbitrary adopt/respawn/re-adopt interleavings."""
+    base = str(tmp_path)
+    journal_mod.mint_fence(base, by="adopt:m1")
+    journal_mod.mint_fence(base, by="respawn:m0")  # epoch 2 installed
+    path = journal_mod.fence_path(base)
+    with open(path, "rb") as f:
+        final = f.read()
+    # a would-be epoch-3 mint dies after i bytes of its tmp write
+    doomed = json.dumps(
+        {"epoch": 3, "minted_by": "adopt:crashed", "time": 0.0},
+        sort_keys=True,
+    ).encode()
+    for i in range(len(doomed) + 1):
+        tmp = f"{path}.tmp.99999"
+        with open(tmp, "wb") as f:
+            f.write(doomed[:i])
+        assert journal_mod.read_fence(base)["epoch"] == 2, i
+        with open(path, "rb") as f:
+            assert f.read() == final, i  # installed fence untouched
+        os.unlink(tmp)
+    # the next real mint (the re-adopter) continues strictly upward
+    assert journal_mod.mint_fence(base, by="adopt:m1") == 3
+    assert journal_mod.read_fence(base)["epoch"] == 3
+
+
+def test_fence_guard_stat_caching_and_fenced(tmp_path):
+    """check() is one os.stat on the hot path: the JSON re-read happens
+    exactly once per mint, however many appends run between."""
+    base = str(tmp_path)
+    journal_mod.mint_fence(base, by="boot")
+    guard = journal_mod.FenceGuard(base)  # boots owning epoch 1
+    assert guard.own_epoch == 1
+    for _ in range(5):
+        guard.check()  # no raise: we own the current epoch
+    assert guard.checks == 5 and guard.rereads == 1
+    journal_mod.mint_fence(base, by="adopt:m1")
+    with pytest.raises(journal_mod.Fenced) as ei:
+        guard.check()
+    assert ei.value.own_epoch == 1 and ei.value.current_epoch == 2
+    assert ei.value.minted_by == "adopt:m1"
+    assert guard.rereads == 2
+    assert guard.current() == 2  # the non-raising observability read
+    # a guard on a never-fenced dir never raises (epoch never minted)
+    journal_mod.FenceGuard(str(tmp_path / "fresh")).check()
+
+
+def test_journal_append_raises_fenced_with_zero_bytes_written(tmp_path):
+    """The structural no-double-write proof at the unit level: a fenced
+    append raises BEFORE any frame byte moves, so the zombie's journal is
+    bit-identical to what the survivor adopted."""
+    base = str(tmp_path)
+    j = journal_mod.Journal(journal_mod.journal_path(base))
+    j.recover()
+    j.fence_guard = journal_mod.FenceGuard(base)  # owns epoch 0
+    j.append_transition("ACCEPTED", "r1", tenant="alice")
+    size_before = os.path.getsize(j.path)
+    journal_mod.mint_fence(base, by="adopt:m1")
+    with pytest.raises(journal_mod.Fenced):
+        j.append_transition("DISPATCHED", "r1")
+    with pytest.raises(journal_mod.Fenced):
+        j.append_transition("ACCEPTED", "r2", tenant="alice")
+    j.close()
+    assert os.path.getsize(j.path) == size_before
+    records, _, torn = journal_mod.scan(j.path)
+    assert torn == 0
+    assert [(r["type"], r["request_id"]) for r in records] \
+        == [("ACCEPTED", "r1")]
+
+
+def test_fenced_exit_code_is_distinct():
+    """rc 115 is its own verdict: a supervisor must requeue 114 and must
+    NOT respawn 115 onto the same base dir."""
+    assert FENCED_EXIT_CODE == 115
+    assert len({FENCED_EXIT_CODE, REQUEUE_EXIT_CODE, KILL_EXIT_CODE}) == 3
+    assert FENCED_RESOLUTION in RETRYABLE_REJECTS
+    assert REJECT_FLEET_BREAKER in RETRYABLE_REJECTS
+
+
+# -- the circuit breaker state machine ----------------------------------------
+
+
+def test_breaker_opens_on_consecutive_failures_only():
+    br = CircuitBreaker(threshold=2, cooldown_s=60.0)
+    assert br.allow() and br.state == br.CLOSED
+    br.record(False)
+    br.record(True)  # success resets the consecutive count
+    br.record(False)
+    assert br.state == br.CLOSED and br.allow()
+    br.record(False)  # second CONSECUTIVE failure
+    assert br.state == br.OPEN and not br.allow()
+    snap = br.snapshot()
+    assert snap["state"] == "open" and snap["opened_total"] == 1
+    assert snap["consecutive_failures"] == 2
+
+
+def test_breaker_half_open_single_trial_then_close_or_reopen():
+    br = CircuitBreaker(threshold=1, cooldown_s=0.05)
+    br.record(False)
+    assert br.state == br.OPEN and not br.allow()
+    time.sleep(0.06)
+    assert br.allow()  # past the cooldown: the single half-open trial
+    assert br.state == br.HALF_OPEN
+    assert not br.allow()  # the trial slot is taken
+    br.record(False)  # trial failed -> re-open, cooldown restarts
+    assert br.state == br.OPEN and br.snapshot()["opened_total"] == 2
+    time.sleep(0.06)
+    assert br.allow()
+    br.record(True)  # trial succeeded -> closed, fully admitting
+    assert br.state == br.CLOSED and br.allow() and br.allow()
+
+
+def test_member_call_reports_outcomes_to_the_breaker(tmp_path):
+    """``_member_call`` is the breaker's only informant: a refused
+    connection counts against the member, and any successful exchange —
+    a health probe included — closes the breaker again."""
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _JsonHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        good = _member("m0")
+        good["port"] = httpd.server_address[1]
+        gw = _bare_gateway(
+            tmp_path, [good], breaker_threshold=1,
+            breaker_cooldown_s=0.05,
+        )
+        bad = dict(good, port=1)  # nobody listens on port 1
+        with pytest.raises(OSError):
+            gw._member_call(bad, "GET", "/healthz", timeout_s=0.5)
+        br = gw._breaker_for("m0")
+        assert br.state == br.OPEN
+        time.sleep(0.06)  # cooldown: the next call is the trial
+        status, doc = gw._member_call(
+            good, "GET", "/healthz", timeout_s=2.0, site="net_probe")
+        assert status == 200
+        assert br.state == br.CLOSED  # the probe's success closed it
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# -- gateway: breaker-typed backpressure + hedging ----------------------------
+
+
+def test_submit_skips_open_breaker_and_types_the_reject(tmp_path):
+    """Every placeable member behind an open breaker → one typed 503,
+    ``rejected:fleet_breaker_open`` — retryable backpressure, not a
+    member timeout per submit."""
+    gw = _bare_gateway(
+        tmp_path, [_member("m0"), _member("m1")],
+        breaker_threshold=1, breaker_cooldown_s=60.0,
+    )
+    gw._breaker_for("m0").record(False)
+    gw._breaker_for("m1").record(False)
+    status, doc = gw.submit({"tenant": "alice", "workflow": "x",
+                             "request_id": "r1"})
+    assert status == 503
+    assert doc["error"] == REJECT_FLEET_BREAKER
+    assert gw._rejections[REJECT_FLEET_BREAKER] == 1
+    # no member at all is still the no-member code, not the breaker's
+    gw._members.clear()
+    status, doc = gw.submit({"tenant": "alice", "workflow": "x"})
+    assert status == 503 and doc["error"] == REJECT_FLEET_NO_MEMBER
+
+
+def test_submit_routes_around_open_breaker(tmp_path, monkeypatch):
+    """One open breaker is a detour, not an outage: placement skips the
+    broken member without a call and the healthy one answers."""
+    gw = _bare_gateway(
+        tmp_path, [_member("m0", queued=0), _member("m1", queued=5)],
+        max_member_queue=64, breaker_threshold=1, breaker_cooldown_s=60.0,
+        hedge=False,
+    )
+    gw._breaker_for("m0").record(False)  # the least-loaded member is out
+    called = []
+
+    def fake_call(member, method, path, body=None, **kw):
+        called.append(member["name"])
+        return 200, {"request_id": body["request_id"], "state": "queued"}
+
+    monkeypatch.setattr(gw, "_member_call", fake_call)
+    status, doc = gw.submit({"tenant": "alice", "workflow": "x",
+                             "request_id": "r1"})
+    assert status == 200 and called == ["m1"]
+    assert doc["member"] == "m1"
+
+
+def test_hedge_delay_tracks_p99_within_clamp(tmp_path):
+    gw = _bare_gateway(
+        tmp_path, [_member("m0")],
+        hedge_min_delay_s=0.05, hedge_max_delay_s=2.0,
+    )
+    # too few samples: hedge at the max (rarely) until the tail is known
+    assert gw._hedge_delay() == 2.0
+    gw._submit_latencies.extend([0.01] * 99 + [1.5])
+    delay = gw._hedge_delay()
+    assert 0.05 <= delay <= 2.0 and delay >= 1.0  # the p99, not the p50
+    gw._submit_latencies.clear()
+    gw._submit_latencies.extend([0.001] * 50)
+    assert gw._hedge_delay() == 0.05  # clamped up to the floor
+
+
+def test_hedged_submit_wins_on_wedged_primary_exactly_once(tmp_path):
+    """The tentpole's hedging proof, in process: the tenant's affine
+    member wedges (accepts, never answers — alive by every health
+    signal), the hedge fires past the delay, the second member answers
+    200, and the wedged member never even RECEIVES the request (the
+    exactly-one-execution guarantee is structural, not probabilistic)."""
+    base = str(tmp_path)
+    data = _mk_input(base)
+    gateway, members, client = _start_fleet(
+        base, call_timeout_s=3.0, hedge_max_delay_s=0.3,
+        breaker_threshold=2, breaker_cooldown_s=0.5,
+    )
+    try:
+        doc1 = client.submit(**_serve_payload(base, data, "alice", "a1",
+                                              "seg_a"))
+        home = doc1["member"]
+        other = next(
+            os.path.basename(s.base_dir) for s in members
+            if os.path.basename(s.base_dir) != home
+        )
+        # wedge every gateway data call to the affine member; probes
+        # (site net_probe) stay clean, so the member reads as alive —
+        # the definition of a gray failure
+        faults.configure({"faults": [
+            {"kind": "net_wedge", "site": "net_member",
+             "members": [home], "seconds": 30.0, "fail_attempts": 99},
+        ]})
+        t0 = time.monotonic()
+        status, doc2 = gateway.submit(
+            _serve_payload(base, data, "alice", "a2", "seg_a2"))
+        elapsed = time.monotonic() - t0
+        assert status == 200 and doc2["member"] == other
+        assert elapsed < 3.0  # the hedge answered, not the deadline
+        assert gateway._hedge_stats["launched"] == 1
+        assert gateway._hedge_stats["won_secondary"] == 1
+        # the wedge raised in the shim before a byte reached the
+        # primary: the request exists ONLY on the hedge target
+        home_server = next(
+            s for s in members if os.path.basename(s.base_dir) == home
+        )
+        assert "a2" not in home_server._requests
+        faults.configure(None)
+        done = client.wait("a2", timeout_s=120.0)
+        assert done["state"] == "done"
+    finally:
+        faults.configure(None)
+        _stop_all(gateway, members)
+
+
+# -- the fenced member: 503, no journal bytes, self-drain ---------------------
+
+
+def test_fenced_member_rejects_submits_and_self_drains(tmp_path):
+    """A member whose journal was adopted away answers 503
+    ``fenced:adopted_away`` (the acceptance was never journaled, so the
+    resubmit lands on the survivor), appends nothing, flags itself in
+    /healthz + state + failures.json, and its serve loop raises Fenced
+    for the entry point to map to rc 115."""
+    base = str(tmp_path)
+    server = PipelineServer(base_dir=base, max_workers=1).start()
+    torn_down = False
+    try:
+        journal_size = os.path.getsize(journal_mod.journal_path(base))
+        # a survivor adopts this journal while we are "wedged"
+        journal_mod.mint_fence(base, by="adopt:m1")
+        status, doc = netio.http_json_call(
+            server.host, server.port, "POST", "/submit",
+            {"tenant": "alice", "request_id": "r1",
+             "workflow": "connected_components", "config": {}},
+            timeout_s=10.0,
+        )
+        assert status == 503 and doc["error"] == FENCED_RESOLUTION
+        assert server.fenced
+        # structurally nothing journaled: bit-identical to adoption time
+        assert os.path.getsize(journal_mod.journal_path(base)) \
+            == journal_size
+        status, health = netio.http_json_call(
+            server.host, server.port, "GET", "/healthz", timeout_s=10.0)
+        assert health["fenced"] is True
+        state = server._state_doc()
+        assert state["fence"]["fenced"] is True
+        assert state["fence"]["own_epoch"] == 0
+        assert state["fence"]["current_epoch"] == 1
+        fails = json.load(open(os.path.join(base, "failures.json")))
+        fenced_recs = [
+            r for r in fails["records"]
+            if r.get("resolution") == FENCED_RESOLUTION
+        ]
+        assert len(fenced_recs) == 1
+        assert fenced_recs[0]["fence_epoch"] == 1
+        # the serve loop exits via Fenced (rc 115 at the entry point)
+        box = []
+
+        def run():
+            try:
+                server.serve_until_drained(poll_s=0.05)
+            except BaseException as e:  # noqa: BLE001 - capture verdict
+                box.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        assert isinstance(box[0], journal_mod.Fenced)
+        torn_down = True  # serve_until_drained tore the server down
+    finally:
+        if not torn_down:
+            server.stop()
+
+
+# -- operator views -----------------------------------------------------------
+
+
+def test_state_doc_carries_breaker_fence_and_hedge(tmp_path):
+    m0 = _member("m0")
+    m0["base_dir"] = str(tmp_path / "m0")
+    os.makedirs(m0["base_dir"])
+    journal_mod.mint_fence(m0["base_dir"], by="adopt:m1")
+    gw = _bare_gateway(tmp_path, [m0], breaker_threshold=2)
+    gw._breaker_for("m0").record(False)
+    doc = gw._state_doc()
+    assert doc["members"]["m0"]["fence_epoch"] == 1
+    br = doc["members"]["m0"]["breaker"]
+    assert br["state"] == "closed" and br["consecutive_failures"] == 1
+    assert doc["hedge"]["enabled"] is True
+    assert set(doc["hedge"]) >= {"delay_s", "launched", "won_primary",
+                                 "won_secondary"}
+    hz = gw.healthz()
+    assert hz["members"]["m0"]["fence_epoch"] == 1
+    assert hz["members"]["m0"]["breaker"]["state"] == "closed"
+
+
+def _progress_mod():
+    spec = importlib.util.spec_from_file_location(
+        "ctt_progress_grayfail",
+        os.path.join(REPO_ROOT, "scripts", "progress.py"))
+    prog = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(prog)
+    return prog
+
+
+def test_progress_renders_breakers_fences_and_zombie_warning(tmp_path):
+    """Satellite: the operator view shows per-member breaker state and
+    fence epochs, the hedge tally, and pages (rc 1) on a member that was
+    fenced but whose pid is still alive — a zombie to kill."""
+    import cluster_tools_tpu.utils.function_utils as fu
+    prog = _progress_mod()
+    base = str(tmp_path)
+    m0_dir = os.path.join(base, "members", "m0")
+    os.makedirs(m0_dir)
+    # the zombie: fence epoch 2 on disk, booted owning epoch 1, and its
+    # pid (ours, for the test) is demonstrably alive on this host
+    fu.atomic_write_json(os.path.join(m0_dir, "server_state.json"), {
+        "fence": {"own_epoch": 1, "current_epoch": 1, "fenced": False},
+    })
+    state = {
+        "version": 1, "role": "gateway", "pid": os.getpid(),
+        "hostname": socket.gethostname(), "time": time.time(),
+        "draining": False,
+        "members": {
+            "m0": {"base_dir": m0_dir, "alive": True, "dead": False,
+                   "draining": False, "adopted_by": "m1", "queued": 0,
+                   "inflight": 0, "replay_backlog": 0,
+                   "heartbeat_age_s": 0.2, "pid": os.getpid(),
+                   "hostname": socket.gethostname(), "fence_epoch": 2,
+                   "breaker": {"state": "open",
+                               "consecutive_failures": 3,
+                               "since_transition_s": 1.25,
+                               "opened_total": 1}},
+            "m1": {"base_dir": os.path.join(base, "members", "m1"),
+                   "alive": True, "dead": False, "draining": False,
+                   "adopted_by": None, "queued": 1, "inflight": 0,
+                   "replay_backlog": 0, "heartbeat_age_s": 0.1,
+                   "fence_epoch": 0,
+                   "breaker": {"state": "closed",
+                               "consecutive_failures": 0,
+                               "since_transition_s": 9.0,
+                               "opened_total": 0}},
+        },
+        "affinity": {"enabled": True, "hits": 3, "misses": 1},
+        "rejections": {"rejected:fleet_breaker_open": 2},
+        "adoptions": [], "dead_unadopted": [],
+        "hedge": {"enabled": True, "delay_s": 0.21, "launched": 4,
+                  "won_primary": 1, "won_secondary": 3},
+    }
+    fu.atomic_write_json(os.path.join(base, "fleet_state.json"), state)
+    doc = prog.collect_progress(base)
+    assert doc["fleet"]["fenced_alive"] == ["m0"]
+    text = prog.format_progress(doc)
+    assert "breaker open (3 fail(s))" in text
+    assert "fence epoch 2" in text
+    assert "hedges: 4 launched" in text
+    assert "rejected:fleet_breaker_open" in text
+    assert "FENCED" in text and "still alive" in text
+    assert prog.main(["progress.py", base]) == 1  # the zombie pages
+    # kill the zombie (a provably-dead pid) and the page clears
+    state["members"]["m0"]["pid"] = 2 ** 22 + 12345
+    fu.atomic_write_json(os.path.join(base, "fleet_state.json"), state)
+    doc = prog.collect_progress(base)
+    assert doc["fleet"]["fenced_alive"] == []

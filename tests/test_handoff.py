@@ -580,6 +580,9 @@ def _run_workflow(tmp_path, name, vol, memory_handoffs):
     return base, path
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~28 s of XLA compiles; the fused
+# multicut e2e — handoff mechanics stay tier-1 via the unit tests above
+# and test_fuse_bench_smoke.
 def test_workflow_fusion_zero_intermediate_writes_bit_identical(tmp_path):
     """The ISSUE 8 acceptance shape, in-process: the full multicut
     workflow with handoffs on writes NO intermediate storage (no ws

@@ -185,6 +185,9 @@ def _write_minimal_ilp(path, label_blocks, feature_ids, scales, matrix):
             ds.attrs["blockSlice"] = bs
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~24 s of XLA compiles; ingestion
+# stays tier-1 via test_ilp_rejects_unsupported_and_unlabeled and
+# test_ilp_trained_forest_end_to_end.
 def test_ilp_project_ingestion(workspace, rng):
     """r2 VERDICT #7: consume an existing ilastik .ilp (feature selections +
     annotations) and run it through the prediction task."""

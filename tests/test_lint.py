@@ -286,6 +286,40 @@ def test_ct012_fleet_surface_passes_unsuppressed():
         assert "ctlint: disable=CT012" not in open(path).read()
 
 
+def test_ct013_all_violation_classes():
+    """Gray-failure hygiene (docs/SERVING.md "Gray failures"):
+    deadline-less outbound connections and un-fenced acknowledged writes
+    — each call form its own violation."""
+    findings, _ = lint_fixture("ct013_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT013"]
+    assert any("'HTTPConnection'" in m for m in msgs)
+    assert any("'urlopen'" in m for m in msgs)
+    assert any("'create_connection'" in m for m in msgs)
+    assert any("'append_transition'" in m for m in msgs)
+    assert any("'flush_namespace'" in m for m in msgs)
+
+
+def test_ct013_grayfail_surface_passes_unsuppressed():
+    """The real gray-failure surface satisfies its own rule on merit:
+    netio always passes a deadline, and every journal/handoff write in
+    the member server rides a fence gate — no opt-outs."""
+    paths = [
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "netio.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "server.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "fleet.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "journal.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "fleet.py"),
+    ]
+    for path in paths:
+        findings, _ = run_lint([path])
+        assert [f for f in findings if f.rule == "CT013"] == [], path
+        assert "ctlint: disable=CT013" not in open(path).read()
+
+
 # -- suppressions -------------------------------------------------------------
 
 

@@ -447,6 +447,8 @@ def test_checkpointed_kl_matches_plain_kl_quality(rng):
     assert e_stepped <= e_plain + 1e-6
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~25 s; the 1e5-edge scale variant —
+# the ordering property stays tier-1 via _rag_derived and _random.
 def test_solver_energy_ordering_rag_scale_1e5(rng):
     """VERDICT r3 #5: energy-ordering regression (fusion <= KL <= GAEC) on
     a RAG-DERIVED problem with >= 1e5 edges — solver evidence at realistic

@@ -235,6 +235,8 @@ def test_sharded_ccl_overflow_flag():
     assert bool(overflow)
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~23 s of XLA compiles; shape/dtype
+# variant of the ws_ccl step — _stitched_fragments keeps the path tier-1.
 def test_ws_ccl_step_shapes_and_consistency(rng):
     mesh = _mesh(("dp", "sp"))
     sizes = mesh_axis_sizes(mesh)
@@ -300,6 +302,8 @@ def test_graft_entry_single_chip():
     assert int(jnp.max(out)) > 0  # produced some labels
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~25 s; full-graph compile smoke of
+# the driver entry (also exercised by the verify drive).
 def test_graft_entry_dryrun():
     import __graft_entry__ as g
 

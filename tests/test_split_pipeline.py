@@ -59,6 +59,8 @@ def test_split_matches_fused_dp_sp(rng):
         assert_labels_equivalent(cc[i], expected)
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~21 s of XLA compiles; parity
+# variant — split-vs-fused stays tier-1 via _dp_sp.
 def test_split_matches_fused_stitch_compaction(rng):
     mesh = _mesh(("dp", "sp"))
     sizes = mesh_axis_sizes(mesh)
@@ -72,6 +74,8 @@ def test_split_matches_fused_stitch_compaction(rng):
     assert not bool(f[3])
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~20 s of XLA compiles; parity
+# variant — split-vs-fused stays tier-1 via _dp_sp.
 def test_split_matches_fused_two_axis_exact_edt(rng):
     mesh = _mesh(("dp", "spz", "spy"))
     sizes = mesh_axis_sizes(mesh)

@@ -144,6 +144,8 @@ def test_multicut_on_synthetic_em_2d_mode(workspace):
     assert measures["adapted_rand_error"] < 0.25, measures
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~18 s of XLA compiles; the fused
+# fast path on synthetic EM — the 2d_mode variant stays tier-1.
 def test_multicut_on_fused_fragments(workspace):
     """The fused fast path composes with the flagship chain: stitched fused
     watershed fragments feed MulticutSegmentationWorkflow(skip_ws=True) and

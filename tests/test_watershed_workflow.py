@@ -93,6 +93,9 @@ def test_two_pass_stitches_across_faces(rng, workspace):
     assert split / len(uniq) < 0.05, f"{split}/{len(uniq)} labels fragmented"
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~23 s of XLA compiles; resume
+# idempotency is covered tier-1 by test_cc_workflow_resume — the two-pass
+# stitching property itself stays tier-1 via _stitches_across_faces.
 def test_two_pass_resume_is_idempotent(rng, workspace):
     vol = _boundary_volume(rng)
     labels1 = _run_ws(workspace, vol, two_pass=True)
