@@ -83,21 +83,31 @@
 #                     per-tenant fairness into BENCH_r10.json; cpu
 #                     backend (a <10 s smoke twin runs inside tier1 via
 #                     tests/test_serve.py)
-#   bench-fleet     = fleet gray-failure bench (docs/SERVING.md "Gray
-#                     failures"): open-loop Poisson two-tenant traffic
-#                     against a 3-member fleet with one member SIGSTOPped
-#                     (wedge phase: breaker-open latency, hedge win rate,
-#                     fenced zombie exit) and one SIGKILLed (kill phase),
-#                     recording zero lost acknowledged requests, the
-#                     affinity hit rate (> 0.8), wedge/kill p99 (within
-#                     3x warm), and bit-identity into BENCH_r14.json; cpu
-#                     backend, <60 s (the chaos e2e twin is
+#   bench-fleet     = fleet supervised-traffic bench (docs/SERVING.md
+#                     "Supervision"): open-loop Poisson two-tenant traffic
+#                     against a supervised 3-member fleet with the GATEWAY
+#                     child SIGKILLed mid-arrivals (restarted as
+#                     incarnation 2 on the same port, routing view rebuilt
+#                     cold from disk) and one member SIGKILLed (adopted by
+#                     a survivor AND respawned on a fresh dir, serving
+#                     again before the run ends), recording zero lost
+#                     acknowledged requests (of >= 30 acked), gateway/
+#                     member-kill p99 (within 3x the failover floor:
+#                     warm p99 + one restart / detection window), and
+#                     bit-identity into BENCH_r15.json; cpu backend,
+#                     <90 s (the chaos e2e twin is
 #                     tests/test_chaos.py -k fleet)
 #   chaos-wedge     = only the gray-failure chaos: SIGSTOP a fleet member
 #                     under live traffic — breaker opens, survivor adopts
 #                     + mints the fence epoch, SIGCONT'd zombie
 #                     self-drains rc 115 with zero double-execution
-#   bench-trajectory= aggregate the BENCH_r01..r14 headline numbers into
+#   chaos-gateway   = only the supervisor chaos: SIGKILL the gateway child
+#                     AND a member under live two-tenant traffic — the
+#                     supervisor restarts the gateway as incarnation 2,
+#                     every acked request completes with zero client
+#                     resubmission, the dead member is adopted AND
+#                     respawned on a fresh dir before the drain (rc 114)
+#   bench-trajectory= aggregate the BENCH_r01..r15 headline numbers into
 #                     one table (stdout + rewritten into docs/PERFORMANCE.md
 #                     "Performance trajectory"), so the perf history is
 #                     readable without opening ten JSON files
@@ -120,6 +130,7 @@ CTT_CHAOS_SEED ?= 7
 TMP ?= /tmp/ctt_run
 
 .PHONY: test lint tier1 tier2 chaos chaos-resource chaos-wedge \
+	chaos-gateway \
 	failures-report progress \
 	bench-io bench-sweep bench-fuse bench-ragged bench-device bench-solve \
 	bench-serve bench-fleet \
@@ -151,6 +162,11 @@ chaos-wedge:
 	JAX_PLATFORMS=cpu CTT_CHAOS_SEED=$(CTT_CHAOS_SEED) \
 		$(PY) -m pytest tests/test_chaos.py -q -m chaos \
 		-k sigstop -p no:cacheprovider
+
+chaos-gateway:
+	JAX_PLATFORMS=cpu CTT_CHAOS_SEED=$(CTT_CHAOS_SEED) \
+		$(PY) -m pytest tests/test_chaos.py -q -m chaos \
+		-k gateway -p no:cacheprovider
 
 failures-report:
 	$(PY) scripts/failures_report.py $(TMP)

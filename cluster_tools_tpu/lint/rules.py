@@ -1,4 +1,4 @@
-"""The ctlint rule classes CT001-CT013 (docs/ANALYSIS.md).
+"""The ctlint rule classes CT001-CT014 (docs/ANALYSIS.md).
 
 Every rule is derived from a *real* invariant of this codebase — the
 docstring of each checker names the file/contract it guards.  Rules are
@@ -1861,6 +1861,154 @@ def ct013_grayfail_hygiene(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT014 - supervisor hygiene
+# =============================================================================
+
+#: the supervisor surface: the fleet CLI (now the supervisor process) and
+#: the gateway/router module whose failover/scale-down helpers are
+#: lifecycle decisions too
+_CT014_SCOPE = ("fleet.py",)
+
+#: journal-plane evidence for a lifecycle decision: a typed ledger record
+#: or a durable failure-surface record in scope
+_CT014_JOURNAL_EVIDENCE = frozenset({"append_transition", "record_failures"})
+
+#: trace-plane evidence: the decision lands on the timeline
+_CT014_TRACE_EVIDENCE = frozenset({"instant"})
+
+
+def ct014_supervisor_hygiene(module: LintModule) -> List[Finding]:
+    """Supervisor hygiene for the fleet's control plane (docs/SERVING.md
+    "Supervision").
+
+    (a) **Every lifecycle decision is journaled AND traced**: a call
+    site that spawns/respawns a process (``*spawn*``, ``Popen``) or
+    scales the fleet down (``drain_emptiest``) must show journal-plane
+    evidence (``append_transition``/``record_failures`` or a
+    ``*journal_decision*`` helper) and trace-plane evidence
+    (``trace.instant`` or the same helper) — in the enclosing function
+    chain or directly in the same-module definition of the called
+    helper.  An unjournaled respawn/scale decision makes a healed fleet
+    unauditable: nobody can replay WHY capacity changed, which is the
+    difference between a control loop and a haunted house.
+
+    (b) **No process spawn or blocking wait under a lock**: extending
+    CT012(a), a ``subprocess.Popen``/``subprocess.*`` call or a blocking
+    wait (``sleep``/``wait``/``join``/``result``) while holding any
+    ``*lock*``-named context serializes fork+exec (or a child's whole
+    lifetime) behind bookkeeping every submit contends for.  The
+    supervisor is single-threaded by design; anything lock-shaped in
+    this layer must stay pure bookkeeping.
+    """
+    is_fixture = "ct014" in module.name
+    if module.name not in _CT014_SCOPE and not is_fixture:
+        return []
+    out: List[Finding] = []
+
+    defs_by_name: Dict[str, ast.AST] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+
+    def _evidence_in(scope: ast.AST) -> Tuple[bool, bool]:
+        journaled = traced = False
+        for c in calls_in(scope):
+            seg = last_seg(dotted(c.func)) or ""
+            if "journal_decision" in seg:
+                # the canonical helper writes both planes at once
+                journaled = traced = True
+            if seg in _CT014_JOURNAL_EVIDENCE:
+                journaled = True
+            if seg in _CT014_TRACE_EVIDENCE:
+                traced = True
+        return journaled, traced
+
+    def _decision_evidence(call: ast.Call,
+                           callee_seg: str) -> Tuple[bool, bool]:
+        journaled = traced = False
+        scope: Optional[ast.AST] = module.enclosing_function(call)
+        while scope is not None:
+            j, t = _evidence_in(scope)
+            journaled, traced = journaled or j, traced or t
+            scope = module.enclosing_function(scope)
+        # one level into the called helper: a spawn wrapper that
+        # journals inside its own body covers all its call sites
+        target = defs_by_name.get(callee_seg)
+        if target is not None:
+            j, t = _evidence_in(target)
+            journaled, traced = journaled or j, traced or t
+        return journaled, traced
+
+    # -- (a) spawn/scale decisions carry journal + trace evidence ----------
+    for call in calls_in(module.tree):
+        seg = last_seg(dotted(call.func))
+        if seg is None:
+            continue
+        low = seg.lower()
+        if "journal_decision" in low:
+            continue  # the evidence helper is not itself a decision
+        if not (seg == "Popen" or "spawn" in low
+                or seg == "drain_emptiest"):
+            continue
+        journaled, traced = _decision_evidence(call, seg)
+        if not journaled:
+            out.append(Finding(
+                "CT014", module.path, call.lineno, call.col_offset,
+                f"lifecycle decision '{seg}' with no journal-plane "
+                "evidence in scope (append_transition / record_failures "
+                "/ a *journal_decision* helper): an unjournaled "
+                "respawn/scale decision cannot be replayed or "
+                "attributed after the fleet heals itself",
+            ))
+        if not traced:
+            out.append(Finding(
+                "CT014", module.path, call.lineno, call.col_offset,
+                f"lifecycle decision '{seg}' with no trace-plane "
+                "evidence in scope (trace.instant / a *journal_decision* "
+                "helper): supervisor decisions must land on the trace "
+                "timeline next to the work they moved",
+            ))
+
+    # -- (b) no fork+exec or blocking wait under a lock --------------------
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.With):
+            continue
+        keys = [
+            k for k in (
+                _lock_key(module, item.context_expr) for item in node.items
+            ) if k is not None
+        ]
+        if not keys:
+            continue
+        held = keys[-1]
+        for stmt in node.body:
+            for inner in _walk_inline(stmt):
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = dotted(inner.func)
+                seg = last_seg(name)
+                if seg is None:
+                    continue
+                if seg == "join" and isinstance(
+                    inner.func, ast.Attribute
+                ) and isinstance(inner.func.value, ast.Constant):
+                    continue  # "sep".join(...) is not a thread join
+                if (seg == "Popen"
+                        or (name or "").startswith("subprocess.")
+                        or seg in _BLOCKING_CALLS):
+                    out.append(Finding(
+                        "CT014", module.path, inner.lineno,
+                        inner.col_offset,
+                        f"process spawn / blocking wait '{name}' while "
+                        f"holding lock '{held}': fork+exec (or a "
+                        "child's lifetime) serialized behind supervisor "
+                        "bookkeeping — decide under the lock, spawn "
+                        "outside it",
+                    ))
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -1878,4 +2026,5 @@ RULES = {
     "CT011": ct011_verified_read_discipline,
     "CT012": ct012_fleet_hygiene,
     "CT013": ct013_grayfail_hygiene,
+    "CT014": ct014_supervisor_hygiene,
 }

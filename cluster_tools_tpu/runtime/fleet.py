@@ -389,6 +389,7 @@ class FleetGateway:
         hedge: bool = True,
         hedge_min_delay_s: float = 0.05,
         hedge_max_delay_s: float = 2.0,
+        incarnation: int = 1,
     ):
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
@@ -411,6 +412,10 @@ class FleetGateway:
         self.hedge_max_delay_s = max(
             self.hedge_min_delay_s, float(hedge_max_delay_s)
         )
+        #: which gateway life this is — the supervisor bumps it on every
+        #: restart, so "incarnation increments exactly once per kill" is
+        #: externally checkable from fleet_state.json
+        self.incarnation = max(1, int(incarnation))
         self.started_at = trace_mod.walltime()
         #: pure-bookkeeping lock (ctlint CT012): member table, affinity
         #: map, route table, counters — never any IO under it
@@ -462,7 +467,14 @@ class FleetGateway:
         live members), then bind, start the health loop + heartbeat, and
         write the endpoint file — the same ``server.json`` contract as a
         member, so ``ServeClient.from_endpoint_file(gateway_dir)`` routes
-        through the gateway unchanged."""
+        through the gateway unchanged.
+
+        A restarted gateway (the supervisor's crash-only contract) calls
+        :meth:`_rebuild_from_disk` first: routes, affinity, adoption
+        bookkeeping, and the dead-member grace all come back from what is
+        durably on disk, so incarnation N+1 serves exactly what N
+        acknowledged."""
+        self._rebuild_from_disk()
         self._check_members()
         self._httpd = ThreadingHTTPServer((self.host, self.port),
                                           _GatewayHandler)
@@ -489,10 +501,154 @@ class FleetGateway:
                 "hostname": socket.gethostname(),
                 "time": trace_mod.walltime(),
                 "role": "gateway",
+                "incarnation": self.incarnation,
             },
         )
         self._write_state()
         return self
+
+    def _rebuild_from_disk(self) -> None:
+        """Cold-start state rebuild (docs/SERVING.md "Supervision"): the
+        gateway is crash-only, so everything it routes by must be
+        recoverable from member truth — endpoint files, each member's
+        ``server_state.json``, and the adoption claims.  The previous
+        incarnation's ``fleet_state.json`` is a HINT at most (it breaks
+        affinity ties); a stale or torn copy is never trusted over what
+        the members themselves say.
+
+        Rebuilt here: ``ever_alive`` (a member with an endpoint file has
+        booted once, so its death is detectable — without this a
+        restarted gateway would wait out the cold-boot grace and never
+        adopt an already-dead member), ``adopted_by`` (consumed adoption
+        claims whose ``by`` names a peer, not a ``respawn:`` holder),
+        the tenant-affinity map, and the request route table."""
+        with self._placement_lock:
+            snaps = [(n, m["base_dir"]) for n, m in self._members.items()]
+        names = {n for n, _ in snaps}
+        hint = fu.read_json_if_valid(
+            os.path.join(self.base_dir, FLEET_STATE_FILENAME)
+        ) or {}
+        hint_aff = dict(((hint.get("affinity") or {}).get("map") or {}))
+        # all file IO outside the placement lock (ctlint CT012)
+        ever: set = set()
+        adopted: Dict[str, str] = {}
+        tenant_seen: Dict[str, List[Tuple[int, str]]] = {}
+        routes_terminal: List[Tuple[str, str]] = []
+        routes_open: List[Tuple[str, str]] = []
+        for name, base in snaps:
+            if fu.read_json_if_valid(
+                os.path.join(base, ENDPOINT_FILENAME)
+            ) is not None:
+                ever.add(name)
+            claim = read_adoption_claim(base)
+            by = str((claim or {}).get("by") or "")
+            if by and not by.startswith("respawn:") and by != name:
+                adopted[name] = by
+            state = fu.read_json_if_valid(
+                os.path.join(base, STATE_FILENAME)
+            ) or {}
+            for tenant, t in (state.get("tenants") or {}).items():
+                if int(t.get("submitted") or 0) > 0:
+                    tenant_seen.setdefault(tenant, []).append(
+                        (int(t["submitted"]), name)
+                    )
+            for rid, rec in (state.get("requests") or {}).items():
+                if rec.get("state") in journal_mod.TERMINAL_TYPES or (
+                    rec.get("state") == journal_mod.DRAINED
+                ):
+                    routes_terminal.append((rid, name))
+                else:
+                    routes_open.append((rid, name))
+
+        def owner(name: str) -> str:
+            # follow the adoption chain so rebuilt routes/affinity point
+            # at whoever holds the journal now
+            hops = 0
+            while name in adopted and hops < len(names) + 1:
+                name = adopted[name]
+                hops += 1
+            return name
+
+        affinity: Dict[str, str] = {}
+        for tenant, cands in tenant_seen.items():
+            hinted = hint_aff.get(tenant)
+            if hinted in {owner(n) for _, n in cands}:
+                affinity[tenant] = hinted  # hint breaks the tie, no more
+            else:
+                cands.sort(key=lambda c: (-c[0], c[1]))
+                affinity[tenant] = owner(cands[0][1])
+        with self._placement_lock:
+            for name in names:
+                m = self._members.get(name)
+                if m is None:
+                    continue
+                if name in ever:
+                    m["ever_alive"] = True
+                if name in adopted:
+                    m["adopted_by"] = adopted[name]
+            for tenant, name in affinity.items():
+                if name in self._members:
+                    self._affinity_map.setdefault(tenant, name)
+            # terminal routes first: the FIFO route-table trim evicts
+            # oldest-inserted, so open requests survive the cap
+            for rid, name in routes_terminal + routes_open:
+                name = owner(name)
+                if name in self._members:
+                    self._routes[rid] = name
+            while len(self._routes) > _MAX_ROUTES:
+                self._routes.popitem(last=False)
+
+    # -- membership (the supervisor's scale/respawn hooks) -----------------
+    def add_member(self, name: str, base_dir: str) -> Optional[Dict]:
+        """Register a new member (scale-up, or respawned capacity on a
+        fresh dir).  The dir may be empty — the member is "starting"
+        until its first healthy probe, so registration never trips a
+        spurious adoption.  Returns the member doc, or None when the
+        name is taken."""
+        base_dir = os.path.abspath(base_dir)
+        os.makedirs(base_dir, exist_ok=True)
+        with self._placement_lock:
+            if name in self._members:
+                return None
+            self._members[name] = {
+                "name": name, "base_dir": base_dir, "host": None,
+                "port": 0, "pid": None, "hostname": None, "alive": False,
+                "ever_alive": False, "dead": False, "draining": False,
+                "adopted_by": None, "queued": 0, "inflight": 0,
+                "replay_backlog": 0, "scrub": None, "heartbeat_age_s": None,
+            }
+            self._breakers[name] = CircuitBreaker(
+                self.breaker_threshold, self.breaker_cooldown_s
+            )
+            doc = dict(self._members[name])
+        trace_mod.instant("fleet.member_added", member=name)
+        self._write_state()
+        return doc
+
+    def retire_member(self, name: str) -> bool:
+        """Drop a member from the table: scale-down after its drain, or
+        an adopted-away dir whose capacity respawned elsewhere.  Refused
+        for a live, unadopted, undraining member — capacity never
+        vanishes silently.  The tenant re-places on next submit; routes
+        to an adopted journal were already remapped at adoption time."""
+        with self._placement_lock:
+            m = self._members.get(name)
+            if m is None:
+                return False
+            if m["alive"] and not m["draining"] and not m.get("adopted_by"):
+                return False
+            del self._members[name]
+            self._breakers.pop(name, None)
+            self._adopting.discard(name)
+            for tenant, o in list(self._affinity_map.items()):
+                if o == name:
+                    del self._affinity_map[tenant]
+            for rid, o in list(self._routes.items()):
+                if o == name:
+                    del self._routes[rid]
+        trace_mod.instant("fleet.member_retired", member=name)
+        self._write_state()
+        return True
 
     def serve_until_drained(self, poll_s: float = 0.2) -> None:
         """Block until the drain latch flips (SIGTERM/SIGUSR1), then stop
@@ -818,7 +974,28 @@ class FleetGateway:
                 m["ever_alive"] = False  # re-arm the cold-boot grace
             self._adoptions.append(event)
             del self._adoptions[:-_MAX_ADOPTION_EVENTS]
+            self._reject_seq += 1
+            seq = self._reject_seq
         trace_mod.instant("fleet.respawn", member=name, pid=int(pid))
+        try:
+            fu.record_failures(
+                self.failures_path,
+                "fleet.respawn",
+                [{
+                    "block_id": f"respawn:{name}:{seq}",
+                    "sites": {"failover": 1},
+                    "error": (
+                        f"member {name} died with no adoptable survivor; "
+                        f"respawned on its own dir as pid {int(pid)}"
+                    ),
+                    "quarantined": False,
+                    "resolved": True,
+                    "resolution": "respawned:own_journal",
+                    "member": name,
+                }],
+            )
+        except Exception:
+            pass  # attribution is best-effort; the respawn stands
         self._write_state()
 
     # -- placement ---------------------------------------------------------
@@ -1153,6 +1330,24 @@ class FleetGateway:
             "fleet.drain", member=target["name"],
             pid=int(pid) if pid else 0,
         )
+        try:
+            fu.record_failures(
+                self.failures_path,
+                "fleet.drain",
+                [{
+                    "block_id": f"drain:{target['name']}",
+                    "sites": {},
+                    "error": (
+                        f"member {target['name']} drained (scale-down)"
+                    ),
+                    "quarantined": False,
+                    "resolved": True,
+                    "resolution": "drained:scale_down",
+                    "member": target["name"],
+                }],
+            )
+        except Exception:
+            pass  # attribution is best-effort; the drain stands
         self._write_state()
         return {
             "member": target["name"],
@@ -1193,6 +1388,7 @@ class FleetGateway:
             "version": 1,
             "role": "gateway",
             "uid": GATEWAY_UID,
+            "incarnation": self.incarnation,
             "pid": os.getpid(),
             "hostname": socket.gethostname(),
             "host": self.host,
@@ -1248,6 +1444,7 @@ class FleetGateway:
         return {
             "ok": True,
             "role": "gateway",
+            "incarnation": doc["incarnation"],
             "draining": doc["draining"],
             "members": {
                 n: {
@@ -1270,7 +1467,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     """The gateway's JSON-over-HTTP surface, a superset-shape of the
     member handler so existing clients work unchanged: POST /submit,
     GET /status, GET /request/<id>, GET /healthz, plus the fleet-only
-    POST /drain (the scale-down hook)."""
+    POST /drain (the scale-down hook) and POST /members (the
+    supervisor's add/retire membership hooks)."""
 
     server_version = "ctt-fleet/1"
 
@@ -1306,6 +1504,26 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 self._reply(409, {"error": "no_drainable_member"})
             else:
                 self._reply(200, doc)
+        elif path == "/members":
+            # the supervisor's membership hooks: register respawned /
+            # scaled-up capacity, retire drained or adopted-away dirs
+            op = payload.get("op")
+            name = str(payload.get("name") or "")
+            if op == "add" and name and payload.get("base_dir"):
+                doc = self.gateway.add_member(
+                    name, str(payload["base_dir"])
+                )
+                if doc is None:
+                    self._reply(409, {"error": "member_exists"})
+                else:
+                    self._reply(200, {"member": name, "added": True})
+            elif op == "retire" and name:
+                if self.gateway.retire_member(name):
+                    self._reply(200, {"member": name, "retired": True})
+                else:
+                    self._reply(409, {"error": "not_retirable"})
+            else:
+                self._reply(400, {"error": "bad_member_op"})
         else:
             self._reply(404, {"error": "not_found"})
 

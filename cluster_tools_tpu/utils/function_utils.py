@@ -94,20 +94,69 @@ def failures_path(tmp_folder: str) -> str:
     return os.path.join(tmp_folder, "failures.json")
 
 
+def _hostname() -> str:
+    global _HOSTNAME
+    if _HOSTNAME is None:
+        import socket
+
+        _HOSTNAME = socket.gethostname()
+    return _HOSTNAME
+
+
+_HOSTNAME: Optional[str] = None
+
+
+def _lock_holder_dead(lock: str) -> bool:
+    """True when ``lock``'s token names a pid on THIS host that no longer
+    exists — a SIGKILLed holder whose lock would otherwise pin every
+    waiter for the full ``timeout_s``.  A token from another host (shared
+    filesystem), an unparsable/torn token, or a live-or-unprobeable pid
+    all answer False: the stale/timeout ladder handles those — pid reuse
+    can only make a dead holder look alive (conservative), never a live
+    holder look dead."""
+    try:
+        with open(lock) as f:
+            token = f.read()
+    except OSError:
+        return False
+    parts = token.split(":")
+    if len(parts) != 4 or parts[0] != _hostname():
+        return False
+    try:
+        pid = int(parts[1])
+    except ValueError:
+        return False
+    if pid == os.getpid():
+        return False  # another thread of this process: alive by definition
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        pass
+    return False
+
+
 @contextlib.contextmanager
 def file_lock(path: str, timeout_s: float = 30.0, stale_s: float = 60.0):
     """Advisory cross-process lock via an ``O_CREAT|O_EXCL`` lock file
     (works on the shared filesystems cluster jobs coordinate over, where
-    ``fcntl`` locks are unreliable).  A lock older than ``stale_s`` is
-    broken (its holder died between create and unlink); after ``timeout_s``
-    the lock is stolen rather than raising — the callers guard best-effort
-    bookkeeping on failure paths, where blocking forever or raising would
-    mask the real error."""
+    ``fcntl`` locks are unreliable).  A lock whose same-host holder pid is
+    dead is broken immediately (:func:`_lock_holder_dead` — a SIGKILLed
+    holder must not make its adopter wait out the full timeout); a lock
+    older than ``stale_s`` is broken (its cross-host holder died between
+    create and unlink); after ``timeout_s`` the lock is stolen rather than
+    raising — the callers guard best-effort bookkeeping on failure paths,
+    where blocking forever or raising would mask the real error."""
     lock = path + ".lock"
     # unique ownership token: release must only unlink OUR lock file — a
     # holder whose lock was stolen (timeout/stale break) must not remove
-    # the thief's lock and cascade the loss of mutual exclusion
-    token = f"{os.getpid()}:{threading.get_ident()}:{random.random()}"
+    # the thief's lock and cascade the loss of mutual exclusion.  The
+    # host:pid prefix is what the dead-holder probe parses.
+    token = (
+        f"{_hostname()}:{os.getpid()}:{threading.get_ident()}"
+        f":{random.random()}"
+    )
     deadline = time.time() + float(timeout_s)
     while True:
         try:
@@ -120,7 +169,7 @@ def file_lock(path: str, timeout_s: float = 30.0, stale_s: float = 60.0):
                 stale = time.time() - os.path.getmtime(lock) > float(stale_s)
             except OSError:
                 continue  # holder released between exists-check and stat
-            if stale or time.time() > deadline:
+            if stale or time.time() > deadline or _lock_holder_dead(lock):
                 # atomic steal: rename first — exactly one of N waiters
                 # wins the rename, so two waiters can never both break the
                 # same lock and then break each other's fresh locks

@@ -43,6 +43,14 @@ acknowledged requests are stranded until the journal handoff completes:
 rc 1, exactly like a stalled task.  A member that was FENCED (journal
 adopted away, docs/SERVING.md "Gray failures") but whose pid is still
 alive is a zombie that must be killed: rc 1 too.
+
+Supervisor mode (docs/SERVING.md "Supervision"): when the same base dir
+carries ``supervisor_state.json``, the control-plane view is rendered
+too — gateway incarnation + aliveness + restart count, per-member
+respawn counts and backoff state, the last scale decision with its
+reason, and crash-loop quarantines.  A crash-looped gateway or member
+(respawn budget exhausted) means the fleet stopped healing itself:
+rc 1, exactly like a quarantined task.
 """
 
 from __future__ import annotations
@@ -273,6 +281,45 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
         heartbeats.pop("gateway", None)
         uids.discard("gateway")
 
+    # -- supervisor mode: the fleet's control plane (docs/SERVING.md
+    # "Supervision"): gateway incarnation + aliveness, per-member respawn
+    # counts/backoff, the last scale decision, crash-loop quarantines --
+    supervisor = None
+    sup_state = _read_json(
+        os.path.join(tmp_folder, "supervisor_state.json")
+    )
+    if sup_state is not None:
+        pid = sup_state.get("pid")
+        pid_dead = bool(
+            pid is not None
+            and sup_state.get("hostname") == socket.gethostname()
+            and not _pid_alive(pid)
+        )
+        hb = heartbeats.get("supervisor")
+        hb_age = hb["age_s"] if hb else None
+        supervisor = {
+            "pid": pid,
+            "hostname": sup_state.get("hostname"),
+            "stale": pid_dead or (
+                hb_age is not None and hb_age > stale_after_s
+            ),
+            "heartbeat_age_s": (
+                round(hb_age, 1) if hb_age is not None else None
+            ),
+            "gateway": sup_state.get("gateway") or {},
+            "members": sup_state.get("members") or {},
+            "scale": sup_state.get("scale") or {},
+            # lineages that exhausted their respawn budget — operator
+            # page (rc 1, exactly like a quarantined task)
+            "crash_loops": list(sup_state.get("crash_loops") or []),
+            "gateway_crash_loop": bool(
+                sup_state.get("gateway_crash_loop")
+                or (sup_state.get("gateway") or {}).get("quarantined")
+            ),
+        }
+        heartbeats.pop("supervisor", None)
+        uids.discard("supervisor")
+
     # per-task sweep counters (io_metrics.json, written by the task
     # runtime next to failures.json): the dispatch-amortization pulse —
     # including the ragged paged-pool counters (docs/PERFORMANCE.md
@@ -358,6 +405,7 @@ def collect_progress(tmp_folder: str, stale_after_s: float = STALE_AFTER_S,
         "tasks": tasks,
         "server": server,
         "fleet": fleet,
+        "supervisor": supervisor,
         "traced": os.path.isdir(os.path.join(tmp_folder, "trace")),
     }
 
@@ -546,6 +594,56 @@ def _format_fleet(fleet) -> list:
     return lines
 
 
+def _format_supervisor(sup) -> list:
+    """The control-plane view (docs/SERVING.md "Supervision"): gateway
+    incarnation + aliveness, per-member respawn/backoff state, and the
+    last scale decision with its reason."""
+    state = "supervising"
+    if sup["stale"]:
+        state += " (STALE?)"
+    hb = (
+        f", heartbeat {sup['heartbeat_age_s']:.1f}s ago"
+        if sup.get("heartbeat_age_s") is not None else ""
+    )
+    lines = [f"  fleet supervisor  pid {sup.get('pid')}  {state}{hb}"]
+    gw = sup.get("gateway") or {}
+    gw_bits = [
+        "alive" if gw.get("alive") else "DEAD",
+        "booted" if gw.get("booted") else "booting",
+        f"{int(gw.get('restarts') or 0)} restart(s)",
+    ]
+    if gw.get("heartbeat_age_s") is not None:
+        gw_bits.append(f"heartbeat {float(gw['heartbeat_age_s']):.1f}s ago")
+    if gw.get("quarantined"):
+        gw_bits.append("QUARANTINED (crash loop)")
+    lines.append(
+        f"    gateway incarnation {int(gw.get('incarnation') or 0)}  "
+        f"pid {gw.get('pid')}  " + ", ".join(gw_bits)
+    )
+    members = sup.get("members") or {}
+    if members:
+        width = max(len(n) for n in members)
+        for name, m in sorted(members.items()):
+            bits = [f"{int(m.get('respawns') or 0)} respawn(s)"]
+            if m.get("backoff_remaining_s") is not None:
+                bits.append(
+                    f"respawn in {float(m['backoff_remaining_s']):.1f}s"
+                )
+            if m.get("last_rc") is not None:
+                bits.append(f"last rc {m['last_rc']}")
+            lines.append(
+                f"    {name:<{width}}  {str(m.get('state')):<11}  "
+                + ", ".join(bits)
+            )
+    scale = sup.get("scale") or {}
+    if scale:
+        lines.append(
+            f"    last scale decision: {scale.get('decision')} "
+            f"({scale.get('reason')})"
+        )
+    return lines
+
+
 def format_progress(doc) -> str:
     tasks = doc["tasks"]
     lines = [
@@ -592,6 +690,25 @@ def format_progress(doc) -> str:
                 "away) but its pid is still alive — a zombie; the fence "
                 "blocks its writes, but kill it (docs/SERVING.md "
                 "\"Gray failures\")"
+            )
+    if doc.get("supervisor") is not None:
+        lines.extend(_format_supervisor(doc["supervisor"]))
+        sup = doc["supervisor"]
+        if sup["stale"]:
+            lines.append(
+                "  WARNING: fleet supervisor looks dead (stale heartbeat "
+                "or dead pid) — nothing heals the fleet; restart it"
+            )
+        if sup.get("gateway_crash_loop"):
+            lines.append(
+                "  WARNING: gateway is in a crash loop (restart budget "
+                "exhausted) — the fleet is quarantined; see lifecycle.log"
+            )
+        for name in sup.get("crash_loops") or []:
+            lines.append(
+                f"  WARNING: member {name} quarantined after exhausting "
+                "its respawn budget (quarantined:member_crash_loop) — "
+                "see failures.json / lifecycle.log"
             )
     if not tasks:
         lines.append("  no tasks seen yet (no markers, manifests, "
@@ -675,6 +792,14 @@ def main(argv) -> int:
         doc["fleet"]["stale"]
         or doc["fleet"].get("dead_unadopted")
         or doc["fleet"].get("fenced_alive")
+    ):
+        bad = True
+    # a crash-looped gateway or member means the fleet stopped healing
+    # itself — same rc semantics as a quarantined task
+    if doc.get("supervisor") is not None and (
+        doc["supervisor"]["stale"]
+        or doc["supervisor"].get("gateway_crash_loop")
+        or doc["supervisor"].get("crash_loops")
     ):
         bad = True
     return 1 if bad else 0

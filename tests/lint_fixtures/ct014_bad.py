@@ -1,0 +1,35 @@
+"""CT014 fixture: unjournaled/untraced lifecycle decisions and a
+process spawn + blocking wait under the placement lock."""
+
+import subprocess
+import sys
+import threading
+import time
+
+
+class Supervisor:
+    def __init__(self):
+        self._placement_lock = threading.Lock()
+        self.members = {}
+
+    def respawn_member(self, name, mdir):
+        # decision with NO journal record and NO trace instant in scope
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_tools_tpu.serve",
+             "--base-dir", mdir]
+        )
+        self.members[name] = proc
+        return proc
+
+    def scale_down(self, gateway):
+        # scale decision: neither plane shows evidence
+        return gateway.drain_emptiest()
+
+    def spawn_under_lock(self, name, mdir):
+        with self._placement_lock:
+            # fork+exec serialized behind supervisor bookkeeping
+            proc = subprocess.Popen([sys.executable, "-c", "pass"])
+            proc.wait()  # a child's whole lifetime under the lock
+            time.sleep(0.1)
+            self.members[name] = proc
+        return proc

@@ -1,0 +1,52 @@
+"""CT014 fixture: every lifecycle decision journaled + traced (directly
+or via a *journal_decision* helper), all spawning outside locks (clean)."""
+
+import subprocess
+import sys
+import threading
+
+from cluster_tools_tpu.runtime import journal as journal_mod
+from cluster_tools_tpu.runtime import trace as trace_mod
+from cluster_tools_tpu.utils import function_utils as fu
+
+
+class Supervisor:
+    def __init__(self, ledger_path, failures_path):
+        self._placement_lock = threading.Lock()
+        self._ledger = journal_mod.Journal(ledger_path)
+        self.failures_path = failures_path
+        self.members = {}
+
+    def _journal_decision(self, typ, member, **fields):
+        # the canonical helper: one typed ledger record + one instant
+        self._ledger.append_transition(typ, member, **fields)
+        trace_mod.instant(f"fleet.{typ}", member=member, **fields)
+
+    def _spawn_member(self, name, mdir):
+        # the spawn wrapper journals inside its own body, covering
+        # every call site
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_tools_tpu.serve",
+             "--base-dir", mdir]
+        )
+        self.members[name] = proc
+        self._journal_decision("member_spawn", name, pid=proc.pid)
+        return proc
+
+    def respawn_pending(self, name, mdir):
+        self._journal_decision("member_respawn", name, fresh_dir=True)
+        return self._spawn_member(name, mdir)
+
+    def scale_down(self, gateway, live):
+        # direct evidence: ledger record + trace instant at the site
+        target = gateway.drain_emptiest()
+        self._ledger.append_transition("scale_down", target, live=live)
+        trace_mod.instant("fleet.scale_down", member=target)
+        fu.record_failures(self.failures_path, "fleet.scale", [])
+        return target
+
+    def bookkeeping_only(self, name, proc):
+        with self._placement_lock:
+            # pure bookkeeping under the lock; the spawn happened outside
+            self.members[name] = proc
+        return proc

@@ -1163,6 +1163,59 @@ def test_chaos_serve_self_healing_corruption(tmp_path):
     assert stray_serve_pids() == []
 
 
+def _wait_fleet_ready(fleet_dir, proc, timeout=180):
+    """Supervised-fleet boot barrier: the supervisor must name a BOOTED
+    gateway child whose endpoint file is live — the gateway is its own
+    process now, so ``server.json``'s pid is the child's, never the
+    supervisor's.  Returns the gateway child pid."""
+    import time
+
+    endpoint = os.path.join(fleet_dir, "server.json")
+    sup_path = os.path.join(fleet_dir, "supervisor_state.json")
+    deadline = time.monotonic() + timeout
+    while True:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"fleet died on startup rc={proc.returncode}:\n"
+                f"{proc.stdout.read()[-4000:]}")
+        try:
+            with open(sup_path) as f:
+                sup = json.load(f)
+            gw = sup.get("gateway") or {}
+            with open(endpoint) as f:
+                doc = json.load(f)
+            if (sup.get("pid") == proc.pid and gw.get("booted")
+                    and doc.get("role") == "gateway"
+                    and doc.get("pid") == gw.get("pid")):
+                return gw["pid"]
+        except (OSError, ValueError):
+            pass
+        assert time.monotonic() < deadline, "gateway never bound"
+        time.sleep(0.05)
+
+
+def _reap_fleet_members(fleet_dir):
+    """Kill any of THIS fleet's member servers that outlived the
+    supervisor (a mid-test assertion must not leak resident servers) —
+    including fresh-dir respawns, whose names are not the boot roster."""
+    import signal
+
+    members_root = os.path.join(fleet_dir, "members")
+    try:
+        names = os.listdir(members_root)
+    except OSError:
+        names = []
+    for name in names:
+        ep = os.path.join(members_root, name, "server.json")
+        try:
+            with open(ep) as f:
+                mpid = json.load(f).get("pid")
+            if mpid and mpid in stray_serve_pids():
+                os.kill(mpid, signal.SIGKILL)
+        except (OSError, ValueError):
+            pass
+
+
 def test_chaos_fleet_kill_server_failover(tmp_path):
     """ISSUE 17 acceptance: ``kill -9`` one member of a two-server fleet
     under live two-tenant traffic — zero lost acknowledged requests.
@@ -1252,24 +1305,9 @@ def test_chaos_fleet_kill_server_failover(tmp_path):
         + [("bob", f"b{i}", f"seg_b{i}") for i in range(3)]
 
     try:
-        # gateway endpoint: same server.json contract, role "gateway"
-        endpoint = os.path.join(fleet_dir, "server.json")
-        deadline = time.monotonic() + 120
-        while True:
-            if proc.poll() is not None:
-                raise AssertionError(
-                    f"fleet died on startup rc={proc.returncode}:\n"
-                    f"{proc.stdout.read()[-4000:]}")
-            try:
-                with open(endpoint) as f:
-                    doc = json.load(f)
-                if doc.get("pid") == proc.pid \
-                        and doc.get("role") == "gateway":
-                    break
-            except (OSError, ValueError):
-                pass
-            assert time.monotonic() < deadline, "gateway never bound"
-            time.sleep(0.05)
+        # gateway endpoint: same server.json contract, role "gateway" —
+        # the pid belongs to the supervisor's gateway CHILD
+        gw_pid = _wait_fleet_ready(fleet_dir, proc)
         client = ServeClient.from_endpoint_file(fleet_dir)
 
         # -- acknowledged two-tenant traffic -------------------------------
@@ -1286,7 +1324,7 @@ def test_chaos_fleet_kill_server_failover(tmp_path):
         victim_dir = os.path.join(fleet_dir, "members", victim)
         with open(os.path.join(victim_dir, "server.json")) as f:
             victim_pid = json.load(f)["pid"]
-        assert victim_pid != proc.pid
+        assert victim_pid not in (proc.pid, gw_pid)
         os.kill(victim_pid, signal.SIGKILL)
 
         # -- zero lost acknowledged requests: every wait completes, the
@@ -1335,18 +1373,10 @@ def test_chaos_fleet_kill_server_failover(tmp_path):
             f"{proc.stdout.read()[-4000:]}")
     finally:
         reap_process(proc)
-        # a reaped gateway orphans its member subprocesses — kill any of
+        # a reaped supervisor orphans its subprocesses — kill any of
         # THIS fleet's members that outlived it so a mid-test assertion
         # never leaks resident servers into the rest of the suite
-        for name in ("m0", "m1"):
-            ep = os.path.join(fleet_dir, "members", name, "server.json")
-            try:
-                with open(ep) as f:
-                    mpid = json.load(f).get("pid")
-                if mpid and mpid in stray_serve_pids():
-                    os.kill(mpid, signal.SIGKILL)
-            except (OSError, ValueError):
-                pass
+        _reap_fleet_members(fleet_dir)
     assert stray_serve_pids() == []
 
 
@@ -1370,8 +1400,10 @@ def test_chaos_fleet_sigstop_zombie_fenced(tmp_path):
       straight at its old endpoint is refused ``fenced:adopted_away``,
       never acknowledged;
     - the fence discovery is attributed in the zombie's own
-      failures.json, the fleet supervisor surfaces the FENCED exit
-      without respawning, and the fleet drains to rc 114 on SIGTERM.
+      failures.json, the fleet supervisor surfaces the FENCED exit and
+      respawns the lost capacity on a FRESH dir (the old dir is the
+      adoption record; rc 115 never reuses it), and the fleet drains to
+      rc 114 on SIGTERM.
     """
     import signal
     import time
@@ -1452,23 +1484,7 @@ def test_chaos_fleet_sigstop_zombie_fenced(tmp_path):
         + [("bob", f"b{i}", f"seg_b{i}") for i in range(3)]
 
     try:
-        endpoint = os.path.join(fleet_dir, "server.json")
-        deadline = time.monotonic() + 120
-        while True:
-            if proc.poll() is not None:
-                raise AssertionError(
-                    f"fleet died on startup rc={proc.returncode}:\n"
-                    f"{proc.stdout.read()[-4000:]}")
-            try:
-                with open(endpoint) as f:
-                    doc = json.load(f)
-                if doc.get("pid") == proc.pid \
-                        and doc.get("role") == "gateway":
-                    break
-            except (OSError, ValueError):
-                pass
-            assert time.monotonic() < deadline, "gateway never bound"
-            time.sleep(0.05)
+        gw_pid = _wait_fleet_ready(fleet_dir, proc)
         client = ServeClient.from_endpoint_file(fleet_dir)
 
         homes = {}
@@ -1485,7 +1501,7 @@ def test_chaos_fleet_sigstop_zombie_fenced(tmp_path):
         with open(os.path.join(victim_dir, "server.json")) as f:
             victim_doc = json.load(f)
         victim_pid = victim_doc["pid"]
-        assert victim_pid != proc.pid
+        assert victim_pid not in (proc.pid, gw_pid)
         os.kill(victim_pid, signal.SIGSTOP)
 
         # zero lost acknowledged requests through the wedge + failover
@@ -1563,6 +1579,27 @@ def test_chaos_fleet_sigstop_zombie_fenced(tmp_path):
             np.testing.assert_array_equal(np.asarray(out[key][...]),
                                           ref_seg)
 
+        # -- the supervisor reaps rc 115 as FENCED and heals capacity on
+        # a FRESH dir — the old dir stays behind as the adoption record
+        sup_path = os.path.join(fleet_dir, "supervisor_state.json")
+        reap_deadline = time.monotonic() + 120
+        while True:
+            with open(sup_path) as f:
+                sup = json.load(f)
+            vm = (sup.get("members") or {}).get(victim) or {}
+            if vm.get("state") == "fenced":
+                break
+            assert time.monotonic() < reap_deadline, \
+                "supervisor never reaped the FENCED exit"
+            time.sleep(0.2)
+        assert vm["last_rc"] == FENCED_EXIT_CODE
+        replacements = [
+            n for n in sup["members"] if n.startswith(victim + "-r")
+        ]
+        assert replacements, sup["members"]
+        repl = sup["members"][replacements[0]]
+        assert repl["base_dir"] != victim_dir  # rc 115 never reuses it
+
         # -- drain by the book; the FENCED exit was surfaced, once ---------
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=120)
@@ -1574,13 +1611,209 @@ def test_chaos_fleet_sigstop_zombie_fenced(tmp_path):
             f"member {victim} exited FENCED (rc {FENCED_EXIT_CODE})") == 1
     finally:
         reap_process(proc)
-        for name in ("m0", "m1"):
-            ep = os.path.join(fleet_dir, "members", name, "server.json")
-            try:
-                with open(ep) as f:
-                    mpid = json.load(f).get("pid")
-                if mpid and mpid in stray_serve_pids():
-                    os.kill(mpid, signal.SIGKILL)
-            except (OSError, ValueError):
-                pass
+        _reap_fleet_members(fleet_dir)
+    assert stray_serve_pids() == []
+
+
+def test_chaos_fleet_kill_gateway_and_member(tmp_path):
+    """ISSUE 19 acceptance: SIGKILL the GATEWAY mid-traffic — and a
+    member in the same run — under live two-tenant load.  The supervisor
+    restarts both planes and no acknowledged request is ever lost.
+
+    - six requests (two tenants) are acknowledged through the gateway;
+      then the gateway child is SIGKILLed AND the member serving tenant
+      alice is SIGKILLed with most of its backlog still queued;
+    - the supervisor restarts the gateway (incarnation bumps exactly
+      once); the new incarnation rebuilds routes/affinity/adoption state
+      cold from disk and re-binds the same port; clients riding
+      ``wait(across_restarts=True)`` never resubmit — every acknowledged
+      request completes bit-identical to a solo batch reference;
+    - the killed member's journal is adopted by the survivor (exactly
+      one adoption) and its capacity respawns on a FRESH dir, registered
+      with the new gateway and alive before the fleet drains;
+    - every lifecycle decision is a typed record in ``lifecycle.log``;
+    - the fleet drains to rc 114 on SIGTERM, no strays.
+    """
+    import signal
+    import time
+
+    from cluster_tools_tpu.runtime import journal as journal_mod
+    from cluster_tools_tpu.runtime.fleet import FLEET_STATE_FILENAME
+    from cluster_tools_tpu.runtime.server import ServeClient
+
+    root = str(tmp_path)
+    rng = np.random.default_rng(SEED)
+    vol = (rng.random((16, 16, 16)) > 0.5).astype("float32")
+    data = os.path.join(root, "data.zarr")
+    ds = file_reader(data).create_dataset(
+        "mask", shape=vol.shape, chunks=(8, 8, 8), dtype="float32")
+    ds[...] = vol
+
+    from cluster_tools_tpu.runtime.task import build
+    from cluster_tools_tpu.tasks.connected_components import (
+        ConnectedComponentsWorkflow,
+    )
+
+    ref_dir = os.path.join(root, "ref")
+    os.makedirs(os.path.join(ref_dir, "config"), exist_ok=True)
+    with open(os.path.join(ref_dir, "config", "global.config"), "w") as f:
+        json.dump({"block_shape": [8, 8, 8], "memory_handoffs": True}, f)
+    assert build([ConnectedComponentsWorkflow(
+        tmp_folder=os.path.join(ref_dir, "tmp"),
+        config_dir=os.path.join(ref_dir, "config"),
+        max_jobs=2, target="local",
+        input_path=data, input_key="mask",
+        output_path=data, output_key="ref_seg", threshold=0.5,
+    )])
+    ref_seg = np.asarray(file_reader(data, "r")["ref_seg"][...])
+
+    # -- the fleet: tight detection on BOTH planes — members (gateway
+    # health ticks) and the gateway itself (supervisor poll + staleness)
+    fleet_dir = os.path.join(root, "fleet")
+    cfg_path = os.path.join(root, "fleet.json")
+    with open(cfg_path, "w") as f:
+        json.dump({
+            "members": 2,
+            "gateway": {"health_interval_s": 0.25, "member_stale_s": 1.5},
+            "server": {"max_workers": 1},
+            "supervisor": {"poll_s": 0.2, "gateway_stale_s": 4.0},
+        }, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cluster_tools_tpu.fleet",
+         "--base-dir", fleet_dir, "--config", cfg_path],
+        env=env, cwd=REPO_ROOT, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+    def payload(tenant, rid, out_key):
+        return dict(
+            tenant=tenant, request_id=rid,
+            workflow="connected_components",
+            config=dict(
+                tmp_folder=os.path.join(root, "req_" + rid),
+                global_config={"block_shape": [8, 8, 8]},
+                params=dict(input_path=data, input_key="mask",
+                            output_path=data, output_key=out_key,
+                            threshold=0.5),
+            ),
+        )
+
+    requests = [("alice", f"a{i}", f"seg_a{i}") for i in range(3)] \
+        + [("bob", f"b{i}", f"seg_b{i}") for i in range(3)]
+
+    sup_path = os.path.join(fleet_dir, "supervisor_state.json")
+    try:
+        gw_pid1 = _wait_fleet_ready(fleet_dir, proc)
+        with open(sup_path) as f:
+            assert json.load(f)["gateway"]["incarnation"] == 1
+        client = ServeClient.from_endpoint_file(fleet_dir)
+
+        # -- acknowledged two-tenant traffic -------------------------------
+        homes = {}
+        for tenant, rid, key in requests:
+            doc = client.submit(retry_s=60, **payload(tenant, rid, key))
+            homes[rid] = doc["member"]
+        assert len({homes[f"a{i}"] for i in range(3)}) == 1
+        assert len({homes[f"b{i}"] for i in range(3)}) == 1
+
+        # -- SIGKILL the gateway AND alice's member in the same run --------
+        victim = homes["a0"]
+        victim_dir = os.path.join(fleet_dir, "members", victim)
+        with open(os.path.join(victim_dir, "server.json")) as f:
+            victim_pid = json.load(f)["pid"]
+        assert victim_pid not in (proc.pid, gw_pid1)
+        os.kill(gw_pid1, signal.SIGKILL)
+        os.kill(victim_pid, signal.SIGKILL)
+
+        # -- ZERO lost acknowledged requests, ZERO resubmission: the
+        # client only ever WAITS — riding endpoint refreshes across the
+        # gateway restart and journal adoption on the member plane
+        for tenant, rid, key in requests:
+            rec = client.wait(rid, timeout_s=300, across_restarts=True)
+            assert rec["state"] == "done", (rid, rec)
+        out = file_reader(data, "r")
+        for _, _, key in requests:
+            np.testing.assert_array_equal(np.asarray(out[key][...]),
+                                          ref_seg)
+
+        # -- gateway incarnation incremented exactly once ------------------
+        with open(sup_path) as f:
+            sup = json.load(f)
+        assert sup["gateway"]["incarnation"] == 2, sup["gateway"]
+        assert sup["gateway"]["restarts"] == 1
+        assert sup["gateway"]["alive"] and sup["gateway"]["booted"]
+        gw_pid2 = sup["gateway"]["pid"]
+        assert gw_pid2 != gw_pid1
+        with open(os.path.join(fleet_dir, FLEET_STATE_FILENAME)) as f:
+            state = json.load(f)
+        assert state["incarnation"] == 2
+
+        # -- the killed member was adopted (exactly once) by a survivor,
+        # by the RESTARTED gateway's failover, with nothing stranded
+        assert state["dead_unadopted"] == []
+        survivor = state["members"][victim]["adopted_by"]
+        assert survivor and survivor != victim
+        adoptions = state["adoptions"]
+        assert len(adoptions) == 1, adoptions
+        assert adoptions[0]["member"] == victim
+        assert adoptions[0]["adopter"] == survivor
+
+        # -- capacity healed: a fresh-dir replacement registered with the
+        # new gateway and ALIVE before the fleet drains
+        heal_deadline = time.monotonic() + 120
+        while True:
+            with open(sup_path) as f:
+                sup = json.load(f)
+            repl_names = [
+                n for n in sup.get("members") or {}
+                if n.startswith(victim + "-r")
+            ]
+            with open(os.path.join(fleet_dir, FLEET_STATE_FILENAME)) as f:
+                state = json.load(f)
+            if repl_names and any(
+                (state["members"].get(n) or {}).get("alive")
+                for n in repl_names
+            ):
+                break
+            assert time.monotonic() < heal_deadline, (
+                "fresh-dir respawn never served", sup.get("members"))
+            time.sleep(0.2)
+        repl = repl_names[0]
+        assert sup["members"][repl]["state"] == "running"
+        assert sup["members"][repl]["base_dir"] != victim_dir
+        # the old dir remains the adoption record
+        with open(os.path.join(victim_dir, "adoption.claim")) as f:
+            assert json.load(f)["by"] == survivor
+
+        # -- every decision is a typed record in the lifecycle ledger ------
+        records, _, torn = journal_mod.scan(
+            os.path.join(fleet_dir, "lifecycle.log"))
+        assert torn == 0
+        types = [r["type"] for r in records]
+        assert types.count("gateway_start") == 1
+        assert types.count("gateway_restart") == 1
+        assert types.count("member_spawn") >= 2
+        assert "member_crashed" in types
+        assert "member_adopted" in types
+        assert "member_respawn" in types
+        restart_rec = next(r for r in records
+                           if r["type"] == "gateway_restart")
+        assert restart_rec["incarnation"] == 2
+        respawn_rec = next(r for r in records
+                           if r["type"] == "member_respawn")
+        assert respawn_rec["request_id"] == repl
+        assert respawn_rec["fresh_dir"] is True
+
+        # -- the whole fleet drains by the book ----------------------------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == REQUEUE_EXIT_CODE, (
+            f"fleet drain exited rc={rc}, wanted {REQUEUE_EXIT_CODE}:\n"
+            f"{proc.stdout.read()[-4000:]}")
+    finally:
+        reap_process(proc)
+        _reap_fleet_members(fleet_dir)
     assert stray_serve_pids() == []

@@ -8,6 +8,7 @@ kill -9 version lives in test_chaos.py).  CPU-only, tier-1 fast."""
 import importlib.util
 import json
 import os
+import socket
 import subprocess
 import sys
 import threading
@@ -34,10 +35,20 @@ from cluster_tools_tpu.runtime.fleet import (
     release_adoption_claim,
     verify_adoption_claim,
 )
+from cluster_tools_tpu.fleet import (
+    classify_member_exit,
+    fresh_member_name,
+    split_generation,
+)
 from cluster_tools_tpu.runtime.server import (
+    ENDPOINT_FILENAME,
     PipelineServer,
     ServeClient,
     _payload_fingerprint,
+)
+from cluster_tools_tpu.runtime.supervision import (
+    FENCED_EXIT_CODE,
+    REQUEUE_EXIT_CODE,
 )
 from cluster_tools_tpu.utils.volume_utils import file_reader
 
@@ -497,3 +508,181 @@ def test_progress_renders_fleet_view(tmp_path):
     fu.atomic_write_json(
         os.path.join(base, FLEET_STATE_FILENAME), state)
     assert prog.main(["progress.py", base]) == 1
+
+
+def test_progress_renders_supervisor_view(tmp_path):
+    """Satellite: the progress tool renders the control-plane view from
+    ``supervisor_state.json`` — gateway incarnation + aliveness +
+    restarts, per-member respawn/backoff state, the last scale decision
+    — and exits 1 on crash-loop quarantines (member or gateway)."""
+    prog = _progress_mod()
+    base = str(tmp_path)
+    import cluster_tools_tpu.utils.function_utils as fu
+    state = {
+        "version": 1, "role": "supervisor", "pid": os.getpid(),
+        "hostname": socket.gethostname(), "time": time.time(),
+        "base_dir": base,
+        "gateway": {"pid": os.getpid(), "incarnation": 2, "alive": True,
+                    "booted": True, "restarts": 1, "port": 8931,
+                    "heartbeat_age_s": 0.3, "quarantined": False},
+        "members": {
+            "m0": {"base_dir": os.path.join(base, "members", "m0"),
+                   "pid": os.getpid(), "state": "running", "respawns": 0,
+                   "last_rc": None, "backoff_remaining_s": None,
+                   "quarantined": False},
+            "m1": {"base_dir": os.path.join(base, "members", "m1"),
+                   "pid": None, "state": "backoff", "respawns": 2,
+                   "last_rc": 1, "backoff_remaining_s": 3.2,
+                   "quarantined": False},
+        },
+        "scale": {"decision": "hold", "reason": "steady",
+                  "time": time.time()},
+        "crash_loops": [], "gateway_crash_loop": False,
+    }
+    sup_path = os.path.join(base, "supervisor_state.json")
+    fu.atomic_write_json(sup_path, state)
+    doc = prog.collect_progress(base)
+    assert doc["supervisor"]["gateway"]["incarnation"] == 2
+    assert doc["supervisor"]["members"]["m1"]["respawns"] == 2
+    text = prog.format_progress(doc)
+    assert "incarnation 2" in text
+    assert "1 restart(s)" in text
+    assert "2 respawn(s)" in text
+    assert "respawn in 3.2s" in text
+    assert "last scale decision: hold (steady)" in text
+    assert prog.main(["progress.py", base]) == 0
+    # a member that exhausted its respawn budget is an operator page
+    state["members"]["m1"]["state"] = "quarantined"
+    state["members"]["m1"]["quarantined"] = True
+    state["crash_loops"] = ["m1"]
+    fu.atomic_write_json(sup_path, state)
+    assert prog.main(["progress.py", base]) == 1
+    assert "member_crash_loop" in prog.format_progress(
+        prog.collect_progress(base))
+    # ... and so is a crash-looped (quarantined) gateway
+    state["crash_loops"] = []
+    state["members"]["m1"]["state"] = "backoff"
+    state["gateway"]["quarantined"] = True
+    fu.atomic_write_json(sup_path, state)
+    assert prog.main(["progress.py", base]) == 1
+
+
+# -- the supervisor's reaper decision table -----------------------------------
+
+
+def test_reaper_decision_table():
+    """Satellite: the fleet CLI reaper distinguishes rc 114 (drained —
+    expected, retire) / rc 115 (fenced — fresh-dir respawn) / everything
+    else (crash — backoff respawn), instead of the old surface-once
+    behavior."""
+    assert classify_member_exit(REQUEUE_EXIT_CODE) == "drained"
+    assert classify_member_exit(FENCED_EXIT_CODE) == "fenced"
+    # crashes: clean-zero is still a crash for a server that should only
+    # ever exit via the drain protocol, and so are signals
+    for rc in (0, 1, 2, -9, -15, 134, 137):
+        assert classify_member_exit(rc) == "crashed", rc
+
+
+def test_fresh_dir_lineage_names():
+    """rc 115 never reuses a dir: the lineage continues on fresh names
+    (m0 -> m0-r1 -> m0-r2) and the generation parser round-trips so the
+    crash budget follows the lineage."""
+    assert fresh_member_name("m0") == "m0-r1"
+    assert fresh_member_name("m0-r1") == "m0-r2"
+    assert fresh_member_name("m0-r9") == "m0-r10"
+    assert split_generation("m0") == ("m0", 0)
+    assert split_generation("m0-r3") == ("m0", 3)
+    # names that merely LOOK like generations stay intact
+    assert split_generation("m-rx") == ("m-rx", 0)
+    assert split_generation("s1") == ("s1", 0)
+    assert fresh_member_name("s1") == "s1-r1"
+
+
+# -- gateway state rebuild (the crash-only property) --------------------------
+
+
+def test_gateway_rebuild_from_disk_property(tmp_path):
+    """Tentpole property: a restarted gateway rebuilds member table,
+    affinity, routes, and adoption view cold from member truth on disk —
+    a torn ``fleet_state.json`` is never trusted, and a valid-but-lying
+    one can only break ties, never override what members actually saw."""
+    base = str(tmp_path)
+    data = _mk_input(base)
+    gw_dir = os.path.join(base, "gw")
+    gateway, members, client = _start_fleet(base)
+    state_path = os.path.join(gw_dir, FLEET_STATE_FILENAME)
+    try:
+        home_a = client.submit(
+            **_serve_payload(base, data, "alice", "a1", "seg_a")
+        )["member"]
+        home_b = client.submit(
+            **_serve_payload(base, data, "bob", "b1", "seg_b")
+        )["member"]
+        assert client.wait("a1", timeout_s=120)["state"] == "done"
+        assert client.wait("b1", timeout_s=120)["state"] == "done"
+        gateway.stop()
+        # a torn state file (half a write at kill time) must never be
+        # trusted: the rebuild works from server_state/journal/claims
+        with open(state_path, "w") as f:
+            f.write('{"version": 1, "members": {"m0": {"al')
+        # a dead never-routed peer with a consumed adoption claim: the
+        # rebuilt view must show it adopted, not dead_unadopted
+        peer = os.path.join(base, "members", "m2")
+        os.makedirs(peer, exist_ok=True)
+        fu = pytest.importorskip("cluster_tools_tpu.utils.function_utils")
+        fu.atomic_write_json(os.path.join(peer, ENDPOINT_FILENAME), {
+            "pid": _dead_pid(), "host": "127.0.0.1", "port": 1,
+            "role": "server", "uid": "server",
+        })
+        claim = acquire_adoption_claim(peer, by="m0", pid=os.getpid())
+        assert claim is not None  # consumed claim = the adoption record
+        gw2 = FleetGateway(
+            base_dir=gw_dir,
+            member_dirs=[s.base_dir for s in members] + [peer],
+            health_interval_s=0.2, member_stale_s=1.0,
+            incarnation=2,
+        ).start()
+        try:
+            client2 = ServeClient.from_endpoint_file(gw_dir)
+            # routes rebuilt: the pre-kill request is answerable by id
+            assert client2.request("a1")["state"] == "done"
+            # affinity rebuilt from member truth: alice stays home
+            assert client2.submit(
+                **_serve_payload(base, data, "alice", "a2", "seg_a2")
+            )["member"] == home_a
+            assert client2.wait("a2", timeout_s=120)["state"] == "done"
+            # the adoption record was rebuilt, so m2 is not a page
+            st = gw2._state_doc()
+            assert st["incarnation"] == 2
+            assert st["members"]["m2"]["adopted_by"] == "m0"
+            assert "m2" not in st["dead_unadopted"]
+            assert set(st["members"]) == {"m0", "m1", "m2"}
+        finally:
+            gw2.stop()
+        # a VALID but lying state file: affinity pointing at the wrong
+        # member can only break ties among true candidates — member
+        # truth (who actually served alice) wins
+        lying = {
+            "version": 1, "incarnation": 99,
+            "affinity": {"map": {"alice": home_b, "bob": home_b}},
+            "members": {},
+        }
+        fu.atomic_write_json(state_path, lying)
+        gw3 = FleetGateway(
+            base_dir=gw_dir,
+            member_dirs=[s.base_dir for s in members],
+            health_interval_s=0.2, member_stale_s=1.0,
+            incarnation=3,
+        ).start()
+        try:
+            client3 = ServeClient.from_endpoint_file(gw_dir)
+            assert client3.submit(
+                **_serve_payload(base, data, "alice", "a3", "seg_a3")
+            )["member"] == home_a
+            assert client3.wait("a3", timeout_s=120)["state"] == "done"
+            assert gw3._state_doc()["incarnation"] == 3
+        finally:
+            gw3.stop()
+    finally:
+        _stop_all(gateway, members)
+    assert _stray_serve_pids() == []

@@ -320,6 +320,38 @@ def test_ct013_grayfail_surface_passes_unsuppressed():
         assert "ctlint: disable=CT013" not in open(path).read()
 
 
+def test_ct014_all_violation_classes():
+    """Supervisor hygiene (docs/SERVING.md "Supervision"): unjournaled
+    and untraced lifecycle decisions (spawn, scale-down) and fork+exec /
+    blocking waits under a lock — each its own violation class."""
+    findings, _ = lint_fixture("ct014_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT014"]
+    assert any("'Popen' with no journal-plane" in m for m in msgs)
+    assert any("'Popen' with no trace-plane" in m for m in msgs)
+    assert any("'drain_emptiest' with no journal-plane" in m for m in msgs)
+    assert any("'drain_emptiest' with no trace-plane" in m for m in msgs)
+    assert any("process spawn / blocking wait 'subprocess.Popen'" in m
+               for m in msgs)
+    assert any("'proc.wait'" in m for m in msgs)
+    assert any("'time.sleep'" in m for m in msgs)
+
+
+def test_ct014_supervisor_surface_passes_unsuppressed():
+    """The real supervisor surface satisfies its own rule on merit:
+    every spawn/respawn/scale decision rides ``_journal_decision`` (or
+    direct ledger + instant evidence) and nothing forks or waits under
+    a lock — no opt-outs."""
+    paths = [
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "fleet.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "runtime",
+                     "fleet.py"),
+    ]
+    for path in paths:
+        findings, _ = run_lint([path])
+        assert [f for f in findings if f.rule == "CT014"] == [], path
+        assert "ctlint: disable=CT014" not in open(path).read()
+
+
 # -- suppressions -------------------------------------------------------------
 
 

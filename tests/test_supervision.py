@@ -498,6 +498,46 @@ def test_file_lock_breaks_stale_lock(tmp_path):
     assert not os.path.exists(lock)
 
 
+def test_file_lock_breaks_dead_holder_immediately(tmp_path):
+    """A SIGKILLed same-host holder leaves a FRESH lock file; its waiter
+    must break it via the dead-pid probe, not sit out timeout_s (the
+    adopter re-running a mid-run-killed request hits exactly this on
+    io_metrics.json)."""
+    import socket
+    import sys
+
+    path = str(tmp_path / "f.json")
+    lock = path + ".lock"
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()  # a real, definitely-dead pid of ours to stamp
+    with open(lock, "w") as f:
+        f.write(f"{socket.gethostname()}:{proc.pid}:1:0.5")
+    t0 = time.monotonic()
+    with fu.file_lock(path, timeout_s=30.0, stale_s=60.0):
+        pass
+    assert time.monotonic() - t0 < 5.0
+    assert not os.path.exists(lock)
+
+
+def test_file_lock_dead_holder_probe_is_conservative(tmp_path):
+    """Tokens the probe cannot vouch for — our own pid (a sibling thread),
+    another host's pid, torn/garbage tokens — must NOT be broken early;
+    they stay on the stale/timeout ladder."""
+    import socket
+
+    lock = str(tmp_path / "f.json.lock")
+    host = socket.gethostname()
+    for token in (
+        f"{host}:{os.getpid()}:1:0.1",   # this process: alive by definition
+        f"not-{host}:424242:1:0.1",      # cross-host: unprobeable
+        "garbage",                        # torn token
+        f"{host}:notanint:1:0.1",        # unparsable pid
+    ):
+        with open(lock, "w") as f:
+            f.write(token)
+        assert not fu._lock_holder_dead(lock)
+
+
 # -- multihost timeout collection ---------------------------------------------
 
 
