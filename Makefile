@@ -75,6 +75,16 @@
 #                     determinism, and bit-identity into BENCH_r09.json;
 #                     cpu backend, <30 s (a <10 s smoke twin runs inside
 #                     tier1 via tests/test_reduce_tree.py)
+#   bench-reduce    = collective-reduce-plane bench (docs/PERFORMANCE.md
+#                     "Collective reduce plane"): the >=100k-edge instance
+#                     solved on the host level engine, the 2-worker
+#                     filesystem packet plane, the collective plane (one
+#                     jitted program + one all_gather hop per tree level;
+#                     >=2x fewer dispatches/level, zero packet files), and
+#                     the force-disabled fallback arm (degraded:
+#                     packet_plane attributed, bit-identical) into
+#                     BENCH_r16.json; cpu backend (a <10 s smoke twin
+#                     runs inside tier1 via tests/test_reduce_plane.py)
 #   bench-serve     = traffic-shaped service bench (docs/SERVING.md): an
 #                     open-loop load generator (Poisson arrivals, mixed
 #                     request classes, 2 tenants + an aggressor phase)
@@ -107,7 +117,7 @@
 #                     every acked request completes with zero client
 #                     resubmission, the dead member is adopted AND
 #                     respawned on a fresh dir before the drain (rc 114)
-#   bench-trajectory= aggregate the BENCH_r01..r15 headline numbers into
+#   bench-trajectory= aggregate the BENCH_r01..r16 headline numbers into
 #                     one table (stdout + rewritten into docs/PERFORMANCE.md
 #                     "Performance trajectory"), so the perf history is
 #                     readable without opening ten JSON files
@@ -133,7 +143,7 @@ TMP ?= /tmp/ctt_run
 	chaos-gateway \
 	failures-report progress \
 	bench-io bench-sweep bench-fuse bench-ragged bench-device bench-solve \
-	bench-serve bench-fleet \
+	bench-reduce bench-serve bench-fleet \
 	bench-trajectory serve-smoke scrub-smoke supervise-demo native clean
 
 test: lint tier1 tier2 chaos
@@ -191,6 +201,9 @@ bench-device:
 
 bench-solve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --solve
+
+bench-reduce:
+	JAX_PLATFORMS=cpu $(PY) bench.py --reduce-plane
 
 bench-serve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve

@@ -1247,6 +1247,179 @@ def solve_bench(smoke=False):
     return rec
 
 
+def reduce_plane_bench(smoke=False):
+    """Collective reduce plane vs the filesystem packet plane
+    (docs/PERFORMANCE.md "Collective reduce plane") on the >=100k-edge
+    solver-scale instance (``grid_rag(g=33)``), four arms:
+
+    1. **host arm** (``reduce_plane="packet"`` in-process): the per-round
+       host dispatch baseline — ``contraction_dispatches`` counts one
+       dispatch per contraction round per group,
+    2. **worker packet arm** (2-process ``solve_over_workers``): the
+       filesystem packet plane proper; counts the ``packet_*.npz`` hops
+       it writes,
+    3. **collective arm** (``reduce_plane="collective"``): one jitted
+       shard_map program + one all_gather hop per tree level
+       (``collective_hops == levels``, ``contraction_dispatches ==
+       levels``, zero packet files by construction),
+    4. **fallback arm** (``CT_COLLECTIVES_DISABLED=1`` + demanded
+       collective): the degrade ladder — bit-identical labels with
+       ``degraded:packet_plane`` attributed in failures.json.
+
+    Acceptance: >=2x fewer host dispatches per tree level on the
+    collective arm, ``packet_fallbacks == 0`` on the happy path, and all
+    arms bit-identical.  ``smoke=True`` is the <10 s tier-1 variant
+    (g=12, no worker arm, no file output); the full run writes
+    BENCH_r16.json next to this script.  Emits one JSON line on stdout.
+    """
+    import glob as glob_mod
+    import tempfile
+
+    # the collective plane needs a multi-device mesh: force the virtual
+    # 8-device CPU platform (same as tests/conftest.py) BEFORE the jax
+    # backend initializes — on one device the plane refuses and every
+    # arm would silently measure the host path
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from cluster_tools_tpu.parallel import reduce_tree as rt
+    from cluster_tools_tpu.utils import function_utils as fu
+    from cluster_tools_tpu.utils.synthetic import grid_rag
+
+    g = 12 if smoke else 33
+    shards = 4 if smoke else 8
+    fanout = 2
+    n, edges, costs = grid_rag(g=g, seed=0)
+    pos = np.stack(np.unravel_index(np.arange(n), (g, g, g)), axis=1)
+    node_shard = rt.morton_node_shards(pos, shards)
+    log(
+        f"reduce-plane bench: grid_rag g={g} ({len(edges)} edges, {n} "
+        f"nodes), {shards} shards, fanout {fanout}"
+    )
+
+    def solve(plane, **kw):
+        snap = rt.solve_snapshot()
+        t0 = time.perf_counter()
+        labels, info = rt.sharded_solve(
+            n, edges, costs, node_shard, fanout=fanout,
+            reduce_plane=plane, **kw,
+        )
+        return labels, info, time.perf_counter() - t0, rt.solve_delta(snap)
+
+    # 1. host arm: the per-round dispatch baseline
+    lab_h, info_h, t_host, d_host = solve("packet", max_workers=4)
+    levels = len(info_h["levels"])
+
+    # 2. worker packet arm: the filesystem plane, hops counted as files
+    packet_files = None
+    t_workers = None
+    workers_identical = None
+    if not smoke:
+        scratch = tempfile.mkdtemp(prefix="ctt_reduce_plane_")
+        t0 = time.perf_counter()
+        lab_w, _ = rt.solve_over_workers(
+            n, edges, costs, node_shard, fanout=fanout, n_workers=2,
+            scratch_dir=scratch, reduce_plane="packet",
+        )
+        t_workers = time.perf_counter() - t0
+        packet_files = len(
+            glob_mod.glob(os.path.join(scratch, "packet_*.npz"))
+        )
+        workers_identical = bool(np.array_equal(lab_w, lab_h))
+
+    # 3. collective arm: one program + one hop per level
+    lab_c, info_c, t_coll, d_coll = solve("collective")
+    collective_identical = bool(np.array_equal(lab_c, lab_h))
+
+    # 4. fallback arm: force-disabled collectives ride the degrade ladder
+    fail_dir = tempfile.mkdtemp(prefix="ctt_reduce_fallback_")
+    failures_path = os.path.join(fail_dir, "failures.json")
+    os.environ["CT_COLLECTIVES_DISABLED"] = "1"
+    try:
+        lab_f, info_f, t_fb, d_fb = solve(
+            "collective", max_workers=4,
+            failures_path=failures_path, task_name="reduce_plane_bench",
+        )
+    finally:
+        del os.environ["CT_COLLECTIVES_DISABLED"]
+    fallback_identical = bool(np.array_equal(lab_f, lab_h))
+    with open(failures_path) as f:
+        fb_records = [
+            r["resolution"] for r in json.load(f)["records"]
+            if r["task"] == "reduce_plane_bench"
+        ]
+
+    host_per_level = d_host["contraction_dispatches"] / max(1, levels)
+    coll_per_level = d_coll["contraction_dispatches"] / max(1, levels)
+    dispatch_ratio = host_per_level / max(1e-9, coll_per_level)
+    log(
+        f"reduce-plane bench: host {t_host:.3f}s "
+        f"({host_per_level:.1f} dispatches/level) | collective "
+        f"{t_coll:.3f}s ({coll_per_level:.1f}/level, "
+        f"{d_coll['collective_hops']} hops, "
+        f"{d_coll['bytes_over_interconnect']} B over interconnect) | "
+        f"fallback {t_fb:.3f}s ({fb_records or 'no record'}) | "
+        f"bit-identical c={collective_identical} f={fallback_identical}"
+    )
+
+    rec = {
+        "metric": "collective_reduce_plane",
+        "backend": "cpu",
+        "smoke": bool(smoke),
+        "n_nodes": int(n),
+        "n_edges": int(len(edges)),
+        "solver_shards": int(shards),
+        "tree_levels": int(levels),
+        "host_arm": {
+            "seconds": round(t_host, 4),
+            "contraction_dispatches": int(d_host["contraction_dispatches"]),
+            "dispatches_per_level": round(host_per_level, 2),
+        },
+        "packet_worker_arm": None if smoke else {
+            "workers": 2,
+            "seconds": round(t_workers, 4),
+            "packet_files_written": int(packet_files),
+            "bit_identical_to_host": workers_identical,
+        },
+        "collective_arm": {
+            "seconds": round(t_coll, 4),
+            "contraction_dispatches": int(d_coll["contraction_dispatches"]),
+            "dispatches_per_level": round(coll_per_level, 2),
+            "collective_hops": int(d_coll["collective_hops"]),
+            "bytes_over_interconnect": int(d_coll["bytes_over_interconnect"]),
+            "packet_fallbacks": int(d_coll["packet_fallbacks"]),
+            "packet_files_written": 0,  # never touches the filesystem
+            "bit_identical_to_host": collective_identical,
+        },
+        "fallback_arm": {
+            "seconds": round(t_fb, 4),
+            "packet_fallbacks": int(d_fb["packet_fallbacks"]),
+            "resolutions": fb_records,
+            "bit_identical_to_host": fallback_identical,
+        },
+        "dispatch_ratio_host_over_collective": round(dispatch_ratio, 2),
+        "accepted": bool(
+            dispatch_ratio >= 2.0
+            and d_coll["collective_hops"] == levels
+            and d_coll["packet_fallbacks"] == 0
+            and collective_identical
+            and fallback_identical
+            and "degraded:packet_plane" in fb_records
+        ),
+    }
+    print(json.dumps(rec), flush=True)
+    if not smoke:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r16.json"
+        )
+        fu.atomic_write_json(path, rec)
+        log(f"reduce-plane bench done -> {path}")
+    return rec
+
+
 def _latency_stats(samples):
     """p50/p95/p99/mean seconds over a list of latencies (None-safe)."""
     if not samples:
@@ -2138,6 +2311,9 @@ def fleet_bench(smoke=False):
         "lost_acked": lost,
         "affinity": {
             "hits": aff["hits"], "misses": aff["misses"],
+            # first-touch pins (probe tenants) — excluded from hit_rate
+            # since r16: counting them as misses was the r13→r15 "drop"
+            "cold_pins": aff.get("cold_pins", 0),
             "hit_rate": round(hit_rate, 4),
         },
         "adoptions": adoptions,
@@ -3150,6 +3326,9 @@ if __name__ == "__main__":
             fuse_bench()
         elif "--solve" in sys.argv or os.environ.get("CT_BENCH_SOLVE"):
             solve_bench()
+        elif "--reduce-plane" in sys.argv \
+                or os.environ.get("CT_BENCH_REDUCE"):
+            reduce_plane_bench(smoke="--smoke" in sys.argv)
         elif "--serve" in sys.argv or os.environ.get("CT_BENCH_SERVE"):
             serve_bench(smoke="--smoke" in sys.argv)
         elif "--fleet" in sys.argv or os.environ.get("CT_BENCH_FLEET"):

@@ -1,4 +1,4 @@
-"""The ctlint rule classes CT001-CT014 (docs/ANALYSIS.md).
+"""The ctlint rule classes CT001-CT015 (docs/ANALYSIS.md).
 
 Every rule is derived from a *real* invariant of this codebase — the
 docstring of each checker names the file/contract it guards.  Rules are
@@ -133,6 +133,10 @@ SOLVE_KNOBS = frozenset({
     "fanout",
     "failures_path",
     "task_name",
+    # the collective reduce plane must be switchable from config: a site
+    # that cannot force `packet` cannot drill the degrade ladder, and one
+    # that cannot force `collective` cannot prove the fast path
+    "reduce_plane",
 })
 
 #: files that *define* the executor/solve surface (call sites only are
@@ -2009,6 +2013,109 @@ def ct014_supervisor_hygiene(module: LintModule) -> List[Finding]:
 
 
 # =============================================================================
+# CT015 - reduce-plane discipline
+# =============================================================================
+
+#: the reduce-plane surface: the tree driver (both planes) and the
+#: multihost wiring that probes collective support
+_CT015_SCOPE = ("reduce_tree.py", "multihost.py")
+
+#: waits on the reduce plane and the patience evidence each must carry:
+#: ``callee -> (min_positional_args_that_satisfy, accepted_kwargs)``.
+#: ``_wait_npz(path, wait_s)`` satisfies positionally; the collective
+#: level dispatch and the support probe must name their deadline.
+_CT015_WAITS: Dict[str, Tuple[Optional[int], frozenset]] = {
+    "_wait_npz": (2, frozenset({"wait_s", "deadline"})),
+    "solve_level": (None, frozenset({"deadline_s", "hop_deadline_s"})),
+    "collectives_supported": (1, frozenset({"deadline_s", "timeout"})),
+}
+
+
+def ct015_reduce_plane_discipline(module: LintModule) -> List[Finding]:
+    """Reduce-plane discipline (docs/PERFORMANCE.md "Collective reduce
+    plane").
+
+    (a) **No unbounded waits on the reduce plane**: every collective hop
+    (``solve_level`` dispatch, ``collectives_supported`` probe) and every
+    packet poll (``_wait_npz``) must carry an explicit deadline/patience
+    argument.  A deadline-less hop turns one dead worker into a wedged
+    worker *group*: siblings block forever on a packet or a collective
+    that is never coming, and the driver's own timeout is the only thing
+    left to notice — minutes instead of one patience window.
+
+    (b) **Every ``degraded:packet_plane`` fallback site writes a failures
+    record**: a function whose body mentions the resolution string must
+    show a ``record_failures`` call — in its own body or one level into a
+    same-module helper it calls (the CT014 evidence walk).  A silent
+    degradation leaves io_metrics claiming collectives ran while every
+    level quietly went through the filesystem; the failures record is
+    what makes the ladder auditable.
+    """
+    is_fixture = "ct015" in module.name
+    if module.name not in _CT015_SCOPE and not is_fixture:
+        return []
+    out: List[Finding] = []
+
+    # -- (a) every hop/poll carries patience -------------------------------
+    for call in calls_in(module.tree):
+        seg = last_seg(dotted(call.func))
+        if seg not in _CT015_WAITS:
+            continue
+        min_pos, accepted = _CT015_WAITS[seg]
+        names, splat = kw_names(call)
+        if splat or (names & accepted):
+            continue
+        if min_pos is not None and len(call.args) >= min_pos:
+            continue
+        out.append(Finding(
+            "CT015", module.path, call.lineno, call.col_offset,
+            f"reduce-plane wait '{seg}' without an explicit "
+            f"deadline/patience argument ({sorted(accepted)}): an "
+            "unbounded hop lets one dead worker wedge the whole group — "
+            "every packet poll and collective dispatch must be able to "
+            "declare the hop lost",
+        ))
+
+    # -- (b) degraded:packet_plane sites write a failures record -----------
+    defs_by_name: Dict[str, ast.AST] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+
+    def _writes_failures(scope: ast.AST, depth: int = 1) -> bool:
+        for c in calls_in(scope):
+            seg = last_seg(dotted(c.func))
+            if seg == "record_failures":
+                return True
+            if depth and seg in defs_by_name and _writes_failures(
+                defs_by_name[seg], depth - 1
+            ):
+                return True
+        return False
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mentions = any(
+            "degraded:packet_plane" in (str_const(n) or "")
+            for n in ast.walk(node)
+        )
+        if not mentions:
+            continue
+        if _writes_failures(node):
+            continue
+        out.append(Finding(
+            "CT015", module.path, node.lineno, node.col_offset,
+            f"'{node.name}' degrades to the packet plane "
+            "(degraded:packet_plane) without failures-record evidence "
+            "(record_failures in its body or a same-module helper it "
+            "calls): silent degradation makes the collective/packet "
+            "ladder unauditable",
+        ))
+    return out
+
+
+# =============================================================================
 # registry
 # =============================================================================
 
@@ -2027,4 +2134,5 @@ RULES = {
     "CT012": ct012_fleet_hygiene,
     "CT013": ct013_grayfail_hygiene,
     "CT014": ct014_supervisor_hygiene,
+    "CT015": ct015_reduce_plane_discipline,
 }

@@ -354,6 +354,158 @@ def _device_contract(u, v, pay, threshold, n_nodes, mode, k):
 
 
 # ---------------------------------------------------------------------------
+# per-lane frontier rounds: the reduce tree's fused level program
+# ---------------------------------------------------------------------------
+
+
+def lane_frontier_rounds(u, v, pay, f_node, f_ghost, f_pay, threshold,
+                         *, n_pad, mode, k):
+    """One reduce-tree group as a device computation: canonical
+    aggregation + mutual-best contraction rounds with frontier abstention,
+    the exact :func:`..parallel.reduce_tree.frontier_contraction` scheme
+    in f64/int64 on device.  ``vmap`` this over the padded lanes of a tree
+    level and wrap it in a ``shard_map`` + ``all_gather`` to get the
+    collective reduce plane's one-dispatch-per-level program
+    (docs/PERFORMANCE.md "Collective reduce plane").
+
+    Bit-identity contract (property-tested in tests/test_reduce_plane.py):
+    every float op mirrors the numpy reference — f64 payloads (run under
+    ``jax.experimental.enable_x64``), stable sorts whose equal-key order
+    matches ``sum_by_key``'s stable argsort, and sequential scatter-adds
+    whose per-segment accumulation order equals ``np.bincount``'s
+    original-index order, so parallel-edge and frontier re-aggregation
+    round identically and the mutual-best float comparisons see the same
+    bits.  Ties break toward the smallest edge id, where ids are the
+    canonical sorted rank — the same documented order as the host rungs.
+
+    Inputs are fixed-capacity lanes (the ragged-pool marshalling idiom):
+    ``u``/``v`` ``[We]`` int64 endpoints with ``n_pad`` as the padding
+    sentinel, ``pay`` ``[We, k]`` f64, frontier ``f_node``/``f_ghost``/
+    ``f_pay`` ``[Wf]``/``[Wf, k]`` with the same sentinel on ``f_node``.
+    Static: ``n_pad`` (node capacity), ``mode``, ``k``.  Returns
+    ``(labels [n_pad] raw roots, rounds)`` — the caller crops to the real
+    member count and applies the consecutive relabel on host.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    We = u.shape[0]
+    Wf = f_node.shape[0]
+    n = n_pad
+    sign = 1.0 if mode == "max" else -1.0
+    thr = sign * threshold
+    NEG = -jnp.inf
+    SENT = jnp.int64(n)
+    BIGK = jnp.int64(2 ** 62)
+
+    def prio_of(p):
+        if k == 1:
+            return sign * p[:, 0]
+        return sign * (p[:, 0] / jnp.maximum(p[:, 1], 1e-300))
+
+    def agg_edges(u, v, pay):
+        # _canonical_edges on device: lo<hi canonicalization, self/pad
+        # edges to the sentinel, stable 2-key sort (== the host's single
+        # lo*n+hi key), segment compaction so the surviving edge ids are
+        # the sorted ranks, and in-order scatter-adds for the payload sums
+        lo = jnp.minimum(u, v)
+        hi = jnp.maximum(u, v)
+        dead = (lo == hi) | (u == SENT)
+        lo = jnp.where(dead, SENT, lo)
+        hi = jnp.where(dead, SENT, hi)
+        ops = lax.sort((lo, hi) + tuple(pay[:, c] for c in range(k)),
+                       num_keys=2, is_stable=True)
+        lo, hi = ops[0], ops[1]
+        cols = ops[2:]
+        valid = lo != SENT
+        is_first = valid & (
+            (lo != jnp.concatenate([SENT[None], lo[:-1]]))
+            | (hi != jnp.concatenate([SENT[None], hi[:-1]]))
+        )
+        seg = jnp.cumsum(is_first.astype(jnp.int64)) - 1
+        sid = jnp.where(valid, seg, We)
+        new_u = jnp.full((We + 1,), SENT, jnp.int64).at[sid].min(
+            jnp.where(valid, lo, SENT), mode="drop")[:We]
+        new_v = jnp.full((We + 1,), SENT, jnp.int64).at[sid].min(
+            jnp.where(valid, hi, SENT), mode="drop")[:We]
+        new_pay = jnp.stack(
+            [jnp.zeros((We + 1,)).at[sid].add(
+                jnp.where(valid, c, 0.0), mode="drop")[:We]
+             for c in cols], axis=1)
+        return new_u, new_v, new_pay
+
+    def agg_frontier(fn, fg, fpay):
+        # _aggregate_frontier on device: the same fn*mult+fg key (mult
+        # recomputed per call over the live entries, like the host) and
+        # the same stable-sort + in-order summation
+        valid = fn != SENT
+        mult = jnp.maximum(jnp.max(jnp.where(valid, fg, -1)) + 1, 1)
+        key = jnp.where(valid, fn * mult + fg, BIGK)
+        ops = lax.sort((key,) + tuple(fpay[:, c] for c in range(k)),
+                       num_keys=1, is_stable=True)
+        key = ops[0]
+        cols = ops[1:]
+        valid = key != BIGK
+        is_first = valid & (key != jnp.concatenate([BIGK[None], key[:-1]]))
+        seg = jnp.cumsum(is_first.astype(jnp.int64)) - 1
+        sid = jnp.where(valid, seg, Wf)
+        key_seg = jnp.full((Wf + 1,), BIGK, jnp.int64).at[sid].min(
+            jnp.where(valid, key, BIGK), mode="drop")[:Wf]
+        live = key_seg != BIGK
+        new_fn = jnp.where(live, key_seg // mult, SENT)
+        new_fg = jnp.where(live, key_seg % mult, jnp.int64(0))
+        new_fpay = jnp.stack(
+            [jnp.zeros((Wf + 1,)).at[sid].add(
+                jnp.where(valid, c, 0.0), mode="drop")[:Wf]
+             for c in cols], axis=1)
+        return new_fn, new_fg, new_fpay
+
+    u, v, pay = agg_edges(u, v, pay)
+    f_node, f_ghost, f_pay = agg_frontier(f_node, f_ghost, f_pay)
+
+    def cond(state):
+        return state[-1]
+
+    def body(state):
+        u, v, pay, fn, fg, fpay, labels, rounds, _ = state
+        active = u != SENT
+        prio = jnp.where(active, prio_of(pay), NEG)
+        elig = active & (prio > thr)
+        eid = jnp.arange(We, dtype=jnp.int64)
+        best_p = jnp.full((n + 1,), NEG).at[
+            jnp.where(elig, u, SENT)].max(prio, mode="drop")
+        best_p = best_p.at[jnp.where(elig, v, SENT)].max(prio, mode="drop")
+        # external competition: the frontier raises best_p but never
+        # places a candidate edge id — the node abstains if it wins
+        factive = fn != SENT
+        fprio = jnp.where(factive, prio_of(fpay), NEG)
+        felig = factive & (fprio > thr)
+        best_p = best_p.at[jnp.where(felig, fn, SENT)].max(
+            fprio, mode="drop")
+        cand_u = jnp.where(elig & (prio == best_p[u]), u, SENT)
+        cand_v = jnp.where(elig & (prio == best_p[v]), v, SENT)
+        best_e = jnp.full((n + 1,), We, jnp.int64).at[cand_u].min(
+            eid, mode="drop")
+        best_e = best_e.at[cand_v].min(eid, mode="drop")
+        mutual = elig & (best_e[u] == eid) & (best_e[v] == eid)
+        progressed = jnp.any(mutual)
+        root = jnp.arange(n + 1, dtype=jnp.int64).at[
+            jnp.where(mutual, v, SENT)].set(
+            jnp.where(mutual, u, SENT), mode="drop")
+        labels = root[labels]
+        u2, v2, pay2 = agg_edges(root[u], root[v], pay)
+        fn2, fg2, fpay2 = agg_frontier(root[fn], fg, fpay)
+        return (u2, v2, pay2, fn2, fg2, fpay2, labels,
+                rounds + progressed.astype(jnp.int64), progressed)
+
+    labels0 = jnp.arange(n + 1, dtype=jnp.int64)
+    state = (u, v, pay, f_node, f_ghost, f_pay, labels0, jnp.int64(0),
+             jnp.bool_(True))
+    state = lax.while_loop(cond, body, state)
+    return state[6][:n], state[7]
+
+
+# ---------------------------------------------------------------------------
 # dispatch + public entry points
 # ---------------------------------------------------------------------------
 

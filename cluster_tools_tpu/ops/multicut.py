@@ -608,6 +608,21 @@ def lifted_multicut_energy(
     return e
 
 
+def lifted_frontier_capable() -> bool:
+    """Whether the lifted objective has a frontier-abstention formulation.
+
+    It does not: a lifted edge contributes to a cluster pair's priority
+    only while the pair stays *graph-connected*, a property of the whole
+    partition that a shard cannot decide from its boundary frontier alone
+    (``lifted_greedy_additive`` re-checks connectivity on every merge).
+    The frontier trick — abstain when an unseen cross-shard edge could
+    outbid the local best — therefore has no sound lifted analogue, and
+    the collective reduce plane (like ``frontier_contraction``) refuses
+    lifted problems; they stay on the host GAEC path.
+    """
+    return False
+
+
 def lifted_greedy_additive(
     n_nodes: int,
     edges: np.ndarray,

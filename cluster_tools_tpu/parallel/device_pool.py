@@ -42,6 +42,14 @@ runtime snapshots around each task and merges the delta into
 executor's d2h copies and the handoff registry's device rung
 (:mod:`~cluster_tools_tpu.runtime.handoff`) — one counter plane for the
 whole device-resident data path.
+
+The collective reduce plane
+(:class:`~cluster_tools_tpu.parallel.reduce_tree.CollectiveReducePlane`,
+docs/PERFORMANCE.md "Collective reduce plane") is a second consumer of
+this pool: each tree level's boundary-edge lanes marshal as one-page
+``RaggedBatch`` pools and stage through :meth:`DevicePagePool.stage`, so
+a warm re-solve of the same problem (same edge bytes → same crc32 slots)
+pays zero h2d before its per-level dispatch.
 """
 
 from __future__ import annotations

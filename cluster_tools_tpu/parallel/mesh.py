@@ -98,3 +98,19 @@ def make_mesh(
 
 def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+#: the reduce tree's sibling axis: tree groups of one level are dealt over
+#: this 1-D mesh and their labels exchanged with an in-program all_gather
+#: (docs/PERFORMANCE.md "Collective reduce plane")
+SIBLING_AXIS = "sib"
+
+
+def sibling_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over every visible device, axis :data:`SIBLING_AXIS` — the
+    collective reduce plane's hop fabric.  In-process this spans the local
+    (possibly ``xla_force_host_platform_device_count`` virtual) devices; in
+    a ``jax.distributed`` pod it spans the global device list, so the same
+    level program moves the boundary packets over ICI/DCN instead of the
+    filesystem."""
+    return make_mesh(n_devices=n_devices, axis_names=(SIBLING_AXIS,))

@@ -93,6 +93,64 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def collectives_supported(deadline_s: float = 30.0) -> Tuple[bool, str]:
+    """Probe whether this runtime can execute a cross-process collective.
+
+    The collective reduce plane must know *before* committing to device
+    hops: old jaxlib CPU backends accept ``jax.distributed.initialize``
+    but abort the first multi-process computation with "Multiprocess
+    computations aren't implemented on the CPU backend" (the env the
+    test_multihost skips document).  The probe runs one tiny jitted
+    ``psum`` over a 1-D pod mesh — the exact op class the reduce plane
+    dispatches — with ``deadline_s`` of patience (a deadline, per ctlint
+    CT015: a wedged probe must degrade, not hang the solve).  Returns
+    ``(supported, reason)``; single-process runtimes are trivially
+    supported (in-process collectives over the local mesh always work).
+
+    Deterministic across the worker group: every process probes the same
+    op on the same backend, so all workers pick the same reduce plane.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return True, "single-process runtime"
+    import threading
+
+    import numpy as np
+
+    out: Dict[str, object] = {}
+
+    def _probe():
+        try:
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .mesh import SIBLING_AXIS, sibling_mesh
+
+            mesh = sibling_mesh()
+            sharding = NamedSharding(mesh, P(SIBLING_AXIS))
+            n = int(mesh.devices.size)
+            x = jax.make_array_from_callback(
+                (n,), sharding,
+                lambda idx: jnp.ones(np.zeros(n)[idx].shape, jnp.float32),
+            )
+            total = jax.jit(
+                lambda a: a.sum(),
+                out_shardings=NamedSharding(mesh, P()),
+            )(x)
+            ok = float(np.asarray(total)) == float(n)
+            out["result"] = (ok, "ok" if ok else "probe sum mismatch")
+        except Exception as e:  # the documented old-jaxlib abort lands here
+            out["result"] = (False, f"{type(e).__name__}: {e}"[:200])
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(timeout=max(1.0, float(deadline_s)))
+    if t.is_alive():
+        return False, f"collective probe exceeded {deadline_s:g}s deadline"
+    return out.get("result", (False, "probe thread died"))
+
+
 def launch_workers(
     num_processes: int,
     target: str,

@@ -124,6 +124,11 @@ _SOLVE_COUNTERS = {
     "tree_merge_s": 0.0,        # wall time relabeling/merging boundary edges
     "boundary_edges_in": 0,     # edges entering the reduce tree (leaf level)
     "boundary_edges_out": 0,    # edges surviving to the root solve
+    # -- collective reduce plane (docs/PERFORMANCE.md) --
+    "collective_hops": 0,          # per-level all_gather exchanges
+    "packet_fallbacks": 0,         # degraded:packet_plane degradations
+    "bytes_over_interconnect": 0,  # bytes the collective hops moved
+    "contraction_dispatches": 0,   # host round dispatches + level programs
 }
 
 
@@ -339,7 +344,9 @@ def frontier_contraction(
             f_node, f_ghost, f_payload = _aggregate_frontier(
                 root[f_node], f_ghost, f_payload
             )
-    _record_solve_metrics(tree_rounds=rounds)
+    # one host-driven dispatch per mutual-best round — the figure the
+    # collective plane's one-dispatch-per-level program is measured against
+    _record_solve_metrics(tree_rounds=rounds, contraction_dispatches=rounds)
     _, out = np.unique(labels, return_inverse=True)
     return out.astype(np.int64)
 
@@ -403,21 +410,22 @@ def _aggregate(n_new: int, edges: np.ndarray, payload: np.ndarray):
     return np.stack([u, v], axis=1), pay
 
 
-def _solve_group(
+def _group_problem(
     state: _TreeState,
     children: Tuple[int, ...],
-    solver: Callable,
-) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Solve one merge group: ``(members, sub_labels, n_internal_edges)``.
-
-    ``members`` are the group's supernodes (ascending — the deterministic
-    local index), ``sub_labels`` their contraction labels 0..k-1.  The
-    group's *frontier* — edges with exactly one endpoint inside the span,
-    keyed by the remote supernode id — is handed to the solver so it can
-    defer boundary-best nodes (:func:`frontier_contraction`)."""
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Optional[tuple],
+           Optional[np.ndarray], Optional[np.ndarray], int]:
+    """Extract one merge group's subproblem from the level state:
+    ``(members, sub_edges, sub_payload, frontier, sub_le, sub_lp,
+    n_internal)``.  ``members`` are the group's supernodes (ascending —
+    the deterministic local index); ``frontier`` is the ``(f_node,
+    f_ghost, f_payload)`` still-external edge context or None.  Shared by
+    the host solver path (:func:`_solve_group`) and the collective
+    plane's lane marshalling — both rungs see byte-identical problems."""
     members = np.flatnonzero(np.isin(state.owner, children))
     if len(members) == 0:
-        return members, np.zeros(0, np.int64), 0
+        return (members, np.zeros((0, 2), np.int64),
+                np.zeros((0, state.payload.shape[-1])), None, None, None, 0)
 
     def side_masks(edges):
         in_u = np.isin(state.owner[edges[:, 0]], children)
@@ -447,6 +455,25 @@ def _solve_group(
         l_mask = lin_u & lin_v
         sub_le = np.searchsorted(members, state.ledges[l_mask])
         sub_lp = state.lpayload[l_mask]
+    return (members, sub_edges, sub_payload, frontier, sub_le, sub_lp,
+            int(e_mask.sum()))
+
+
+def _solve_group(
+    state: _TreeState,
+    children: Tuple[int, ...],
+    solver: Callable,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Solve one merge group: ``(members, sub_labels, n_internal_edges)``.
+
+    The group's *frontier* — edges with exactly one endpoint inside the
+    span, keyed by the remote supernode id — is handed to the solver so it
+    can defer boundary-best nodes (:func:`frontier_contraction`)."""
+    members, sub_edges, sub_payload, frontier, sub_le, sub_lp, n_int = (
+        _group_problem(state, children)
+    )
+    if len(members) == 0:
+        return members, np.zeros(0, np.int64), 0
     labels = np.asarray(
         solver(len(members), sub_edges, sub_payload, frontier, sub_le, sub_lp),
         dtype=np.int64,
@@ -456,7 +483,7 @@ def _solve_group(
             f"group solver returned {len(labels)} labels for "
             f"{len(members)} supernodes"
         )
-    return members, labels, int(e_mask.sum())
+    return members, labels, n_int
 
 
 def _apply_level(
@@ -496,6 +523,401 @@ def _final_labels(state: _TreeState) -> np.ndarray:
     return labels.astype(np.int64)
 
 
+# -- collective reduce plane --------------------------------------------------
+# Boundary-edge packets as device collectives (ROADMAP item 2d, the thesis
+# of "Near-Optimal Wafer-Scale Reduce" and "Large Scale Distributed Linear
+# Algebra With TPUs", PAPERS.md): a tree level's merge groups are dealt as
+# padded lanes over the 1-D sibling mesh, each device contracts its lanes
+# with the fused on-device round program (ops/contraction.py
+# lane_frontier_rounds — convergence predicate inside lax.while_loop, so a
+# level costs ONE dispatch instead of one per mutual-best round), and one
+# in-program all_gather over the sibling axis replaces the npz packet
+# exchange.  Ragged group problems marshal to fixed lanes through the
+# PR-14/16 page-table + valid-extent descriptors and stage through the
+# resident device pool, so a warm re-solve of the same problem pays zero
+# h2d.  Bit-identical to the host rungs by construction (the kernel's
+# documented contract); any failure degrades to the filesystem packet
+# plane, attributed ``degraded:packet_plane``.
+
+#: plane selection: operator env overrides the task knob
+#: (``auto`` | ``collective`` | ``packet``)
+_ENV_PLANE = "CT_REDUCE_PLANE"
+#: force-disable switch — plane init refuses, exercising the attributed
+#: init-failure rung (the bench's fallback arm, chaos drills)
+_ENV_COLLECTIVES_OFF = "CT_COLLECTIVES_DISABLED"
+#: wall-clock budget for one level's collective program (dispatch + the
+#: all_gather hop); a level that exceeds it degrades to the packet plane
+_ENV_HOP_DEADLINE = "CT_HOP_DEADLINE_S"
+DEFAULT_HOP_DEADLINE_S = 60.0
+#: ``reduce_plane='auto'`` floor: below this many live edges the jit
+#: compile + d2h overhead outweighs the dispatch savings, stay on host
+_ENV_AUTO_MIN_EDGES = "CT_REDUCE_PLANE_MIN_EDGES"
+_AUTO_MIN_EDGES = 20_000
+
+#: lane-capacity floors — capacities quantize to powers of two above
+#: these so the compiled-program population stays bounded (the same
+#: policy as the ragged pool's ``_quantize_pages``)
+_MIN_LANE_NODES = 64
+_MIN_LANE_EDGES = 128
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    cap = int(floor)
+    while cap < int(n):
+        cap *= 2
+    return cap
+
+
+def _hop_deadline_s(explicit: Optional[float] = None) -> float:
+    if explicit is not None:
+        return float(explicit)
+    return float(os.environ.get(_ENV_HOP_DEADLINE, DEFAULT_HOP_DEADLINE_S))
+
+
+def _record_packet_degrade(
+    failures_path: Optional[str], task_name: str, err: BaseException,
+    record: bool = True,
+) -> None:
+    """Attribute one collective→packet degradation: the
+    ``packet_fallbacks`` counter (→ io_metrics via the task's solve
+    delta), a trace instant, and — when ``record`` — a resolved
+    failures.json record at the ``hop`` site.  ctlint CT015 enforces that
+    every ``degraded:packet_plane`` site routes through a
+    ``record_failures`` writer; this helper is that one site.  ``record``
+    is False only for ``reduce_plane='auto'`` picking the supported rung
+    up front (not a runtime failure, counter-only)."""
+    _record_solve_metrics(packet_fallbacks=1)
+    trace_mod.instant(
+        "degraded:packet_plane", task=task_name,
+        error=f"{type(err).__name__}: {err}"[:200],
+    )
+    if not record or not failures_path:
+        return
+    try:
+        fu.record_failures(failures_path, task_name, [{
+            "block_id": None,
+            "sites": {"hop": 1},
+            "error": fu.cap_traceback(f"{type(err).__name__}: {err}"),
+            "quarantined": False,
+            "resolved": True,
+            "resolution": "degraded:packet_plane",
+        }])
+    except Exception:
+        pass  # attribution is best effort; the solve must still land
+
+
+class CollectiveReducePlane:
+    """One tree level as one collective device program.
+
+    Construction is the degrade ladder's first rung: it raises (→ packet
+    plane) when collectives are force-disabled, the ``hop`` fault site
+    fires, fewer than two devices are visible, or the payload shape has
+    no device kernel.  ``solve_level`` marshals every group of a level
+    into fixed-capacity lanes, stages them through the resident device
+    pool, and runs the jitted ``shard_map`` program under a wall-clock
+    hop deadline; its failures are the ladder's second rung.
+
+    Everything numeric runs under the thread-local
+    ``jax.experimental.enable_x64`` context — staging included: without
+    it ``device_put``/``jnp.zeros`` silently downcast f64→f32 and the
+    bit-identity contract breaks.
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        threshold: float,
+        k: int,
+        *,
+        hop_deadline_s: Optional[float] = None,
+        n_devices: Optional[int] = None,
+    ):
+        from ..runtime import faults as faults_mod
+
+        if os.environ.get(_ENV_COLLECTIVES_OFF):
+            raise ShardedSolveError(
+                f"collectives force-disabled ({_ENV_COLLECTIVES_OFF})"
+            )
+        # init-failure injection rung: a `hop` error fault here models
+        # jax.distributed refusing to wire the plane up
+        faults_mod.get_injector().maybe_fail("hop")
+        if mode not in ("max", "min"):
+            raise ShardedSolveError(f"unsupported mode {mode!r}")
+        if int(k) not in (1, 2):
+            raise ShardedSolveError(
+                f"no device kernel for payload width {k} (expected 1 or 2)"
+            )
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .mesh import sibling_mesh
+
+        self.mode = mode
+        self.threshold = float(threshold)
+        self.k = int(k)
+        self.hop_deadline_s = _hop_deadline_s(hop_deadline_s)
+        self.mesh = sibling_mesh(n_devices)
+        self.ndev = int(self.mesh.devices.size)
+        if self.ndev < 2:
+            raise ShardedSolveError(
+                "collective plane needs >= 2 devices on the sibling mesh"
+            )
+        self._replicated = NamedSharding(self.mesh, PartitionSpec())
+        from .mesh import SIBLING_AXIS
+
+        self._lane_sharded = NamedSharding(
+            self.mesh, PartitionSpec(SIBLING_AXIS)
+        )
+        self._dev_key = tuple(
+            d.id for d in self.mesh.devices.reshape(-1)
+        )
+        self._programs: Dict[tuple, Callable] = {}
+
+    # -- the per-level program (cached per node capacity) -------------------
+
+    def _program(self, Wn: int) -> Callable:
+        prog = self._programs.get((Wn,))
+        if prog is not None:
+            return prog
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.contraction import lane_frontier_rounds
+        from .mesh import SIBLING_AXIS
+
+        mode, k = self.mode, self.k
+
+        def per_device(up, vp, pp, fnp, fgp, fpp, tabs, thr):
+            # tabs [local_lanes, 6]: this device's lanes' page slots, one
+            # per pool — the ragged page-table indirection on device
+            def one_lane(t):
+                return lane_frontier_rounds(
+                    up[t[0]], vp[t[1]], pp[t[2]],
+                    fnp[t[3]], fgp[t[4]], fpp[t[5]],
+                    thr, n_pad=Wn, mode=mode, k=k,
+                )
+
+            labels, rounds = jax.vmap(one_lane)(tabs)
+            # THE reduce hop: every sibling's lane labels in one gather
+            # over the interconnect — the packet exchange, minus the
+            # filesystem
+            labels = lax.all_gather(labels, SIBLING_AXIS, tiled=True)
+            rounds = lax.all_gather(rounds, SIBLING_AXIS, tiled=True)
+            return labels, rounds
+
+        prog = jax.jit(shard_map(
+            per_device, self.mesh,
+            in_specs=(P(),) * 6 + (P(SIBLING_AXIS), P()),
+            out_specs=(P(), P()),
+            check_rep=False,
+        ))
+        self._programs[(Wn,)] = prog
+        return prog
+
+    # -- lane marshalling ---------------------------------------------------
+
+    def _marshal(self, probs: List[tuple]):
+        """Pack the level's group problems into one 6-spec ragged batch:
+        fixed ``(We,)``/``(We,k)``/``(Wf,)``/``(Wf,k)`` pages, one page
+        per lane, lane count padded to a multiple of the device count —
+        page-table + valid-extent descriptors exactly like the executor's
+        ragged sweeps, so the device pool's content-addressed staging
+        dedupes warm re-solves to zero h2d."""
+        from .block_pool import RaggedArgSpec, RaggedBatch, _quantize_pages
+
+        Wn = _pow2_at_least(
+            max(len(m) for _, m, _, _, _ in probs), _MIN_LANE_NODES
+        )
+        We = _pow2_at_least(
+            max(max((len(e) for _, _, e, _, _ in probs), default=0), 1),
+            _MIN_LANE_EDGES,
+        )
+        Wf = _pow2_at_least(
+            max(max((len(f[0]) for _, _, _, _, f in probs
+                     if f is not None), default=0), 1),
+            _MIN_LANE_EDGES,
+        )
+        lanes = -(-len(probs) // self.ndev) * self.ndev
+        k = self.k
+        # (page_shape, dtype, fill) per pool: u, v, pay, f_node, f_ghost,
+        # f_pay.  Wn is the kernel's padding sentinel for endpoints.
+        layout = [
+            ((We,), np.int64, Wn), ((We,), np.int64, Wn),
+            ((We, k), np.float64, 0.0),
+            ((Wf,), np.int64, Wn), ((Wf,), np.int64, 0),
+            ((Wf, k), np.float64, 0.0),
+        ]
+        specs, pools, tables, valids = [], [], [], []
+        for shape, dtype, fill in layout:
+            cap = _quantize_pages(1 + len(probs))
+            pool = np.full((cap,) + shape, fill, dtype)
+            specs.append(RaggedArgSpec(
+                (1,) * len(shape), shape, np.dtype(dtype).name,
+                fill if isinstance(fill, float) else int(fill), cap,
+            ))
+            pools.append(pool)
+            tables.append(np.zeros((lanes, 1), np.int32))
+            valids.append(np.zeros((lanes, len(shape)), np.int32))
+        for li, (gi, members, sub_edges, sub_payload, frontier) in enumerate(
+            probs
+        ):
+            m = len(sub_edges)
+            pools[0][1 + li, :m] = sub_edges[:, 0] if m else 0
+            pools[1][1 + li, :m] = sub_edges[:, 1] if m else 0
+            pools[2][1 + li, :m] = sub_payload
+            rows = [(m,), (m,), (m, k)]
+            if frontier is not None:
+                f_node, f_ghost, f_pay = frontier
+                fm = len(f_node)
+                pools[3][1 + li, :fm] = f_node
+                pools[4][1 + li, :fm] = f_ghost
+                pools[5][1 + li, :fm] = np.asarray(f_pay, np.float64)
+                rows += [(fm,), (fm,), (fm, k)]
+            else:
+                rows += [(0,), (0,), (0, k)]
+            for a, extent in enumerate(rows):
+                tables[a][li, 0] = 1 + li
+                valids[a][li] = extent
+        rb = RaggedBatch(
+            specs, pools, tables, valids, n_lanes=len(probs), width=lanes,
+            pages_in_use=6 * len(probs),
+        )
+        return rb, Wn
+
+    # -- one level, one dispatch, one hop -----------------------------------
+
+    def solve_level(
+        self,
+        state: _TreeState,
+        groups: List[Tuple[int, ...]],
+        *,
+        level: int,
+        deadline_s: Optional[float] = None,
+    ) -> Tuple[Dict[int, Tuple[np.ndarray, np.ndarray]], int]:
+        """Solve every group of one tree level collectively; returns the
+        ``{group: (members, labels)}`` results for :func:`_apply_level`
+        plus the level's internal-edge total.  ``deadline_s`` caps the
+        whole dispatch+hop (default: the plane's hop deadline) — a level
+        that cannot make the deadline raises :class:`ShardedSolveError`
+        and the caller degrades to the packet plane."""
+        deadline = self.hop_deadline_s if deadline_s is None else float(
+            deadline_s
+        )
+        results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        probs: List[tuple] = []
+        internal_total = 0
+        for gi, children in enumerate(groups):
+            (members, sub_edges, sub_payload, frontier, sub_le, sub_lp,
+             n_int) = _group_problem(state, children)
+            if sub_le is not None and len(sub_le):
+                from ..ops.multicut import lifted_frontier_capable
+
+                if not lifted_frontier_capable():
+                    raise ShardedSolveError(
+                        "lifted edges have no frontier formulation — "
+                        "collective plane refuses the group"
+                    )
+            internal_total += n_int
+            if len(members) == 0:
+                results[gi] = (members, np.zeros(0, np.int64))
+                continue
+            probs.append((gi, members, sub_edges, sub_payload, frontier))
+        if not probs:
+            return results, internal_total
+        raw = self._dispatch(probs, level, deadline)
+        for li, (gi, members, _, _, _) in enumerate(probs):
+            lane = raw[li, : len(members)]
+            # the kernel returns raw union roots; the consecutive relabel
+            # is the same np.unique the host rung applies
+            _, labels = np.unique(lane, return_inverse=True)
+            results[gi] = (members, labels.astype(np.int64))
+        return results, internal_total
+
+    def _dispatch(
+        self, probs: List[tuple], level: int, deadline: float
+    ) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        from ..runtime import faults as faults_mod
+        from .device_pool import get_device_pool
+
+        rb, Wn = self._marshal(probs)
+        injector = faults_mod.get_injector()
+        box: Dict[str, object] = {}
+
+        def run():
+            try:
+                # thread-local x64: staging AND the call must both see it,
+                # and this worker thread is where both happen
+                with enable_x64():
+                    # hop chaos: a hang here is a wedged interconnect the
+                    # deadline must notice; an error a failed collective
+                    injector.maybe_hang("hop", block_id=level)
+                    injector.maybe_fail("hop", block_id=level)
+                    sb = get_device_pool().stage(
+                        rb, self._dev_key, self._replicated, block_id=level
+                    )
+                    tabs = jax.device_put(
+                        np.concatenate(sb.tables, axis=1).astype(np.int32),
+                        self._lane_sharded,
+                    )
+                    thr = jnp.float64(self.threshold)
+                    prog = self._program(Wn)
+                    labels, rounds = prog(*sb.pools, tabs, thr)
+                    box["labels"] = np.asarray(jax.device_get(labels))
+                    box["rounds"] = np.asarray(jax.device_get(rounds))
+                    box["staged"] = sb.staged_bytes
+            # marshalled across the thread boundary: the caller re-raises
+            # non-Exception BaseExceptions (DrainInterrupt) verbatim below
+            except BaseException as e:  # ctlint: disable=CT006
+                box["error"] = e
+
+        t = threading.Thread(
+            target=run, name=f"collective-hop-l{level}", daemon=True
+        )
+        with trace_mod.span(
+            "solve.collective_level", level=level, groups=len(probs),
+            devices=self.ndev,
+        ):
+            t.start()
+            t.join(timeout=deadline)
+        if t.is_alive():
+            raise ShardedSolveError(
+                f"collective hop deadline: level {level} program exceeded "
+                f"{deadline:g}s"
+            )
+        if "error" in box:
+            err = box["error"]
+            if isinstance(err, BaseException) and not isinstance(
+                err, Exception
+            ):
+                raise err  # DrainInterrupt etc. pass through
+            raise ShardedSolveError(
+                f"collective level {level} failed: "
+                f"{type(err).__name__}: {err}"
+            ) from err
+        out = box["labels"]
+        rounds = box["rounds"]
+        # interconnect accounting: the all_gather hands every device all
+        # other devices' shard — (ndev-1)/ndev of the gathered bytes moved
+        # over the fabric
+        moved = int(
+            (out.nbytes + np.asarray(rounds).nbytes)
+            * (self.ndev - 1) // self.ndev
+        )
+        _record_solve_metrics(
+            collective_hops=1,
+            contraction_dispatches=1,
+            bytes_over_interconnect=moved,
+            tree_rounds=int(np.asarray(rounds).sum()),
+        )
+        return out
+
+
 # -- in-process driver --------------------------------------------------------
 
 
@@ -512,6 +934,10 @@ def sharded_solve(
     lifted_edges: Optional[np.ndarray] = None,
     lifted_payload: Optional[np.ndarray] = None,
     max_workers: int = 1,
+    reduce_plane: str = "auto",
+    hop_deadline_s: Optional[float] = None,
+    failures_path: Optional[str] = None,
+    task_name: str = "sharded_solve",
 ) -> Tuple[np.ndarray, Dict]:
     """Shard-contract-merge in one process.  Returns ``(labels, info)``:
     int64 labels 0..k-1 over the original nodes and the per-level stats
@@ -523,6 +949,18 @@ def sharded_solve(
     f_payload)`` still-external edge context, or None).  Group solves
     within a level are independent and fan out on a thread pool
     (``max_workers``); the result is invariant to their completion order.
+
+    ``reduce_plane`` picks the level engine (``CT_REDUCE_PLANE``
+    overrides): ``collective`` demands the
+    :class:`CollectiveReducePlane` (one device program + one all_gather
+    hop per level) and attributes ``degraded:packet_plane`` if it cannot
+    run; ``auto`` uses it when it is eligible (≥ 2 devices, ≥
+    ``CT_REDUCE_PLANE_MIN_EDGES`` live edges, default solver, no lifted
+    edges) and otherwise stays on the host path silently; ``packet``
+    never touches devices.  Either way the labels are bit-identical —
+    the plane choice is pure performance.  ``hop_deadline_s`` caps each
+    level's collective dispatch (``CT_HOP_DEADLINE_S``, default
+    :data:`DEFAULT_HOP_DEADLINE_S`).
     """
     n_nodes = int(n_nodes)
     edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
@@ -532,6 +970,7 @@ def sharded_solve(
         raise ValueError(
             f"node_shard has {len(node_shard)} entries for {n_nodes} nodes"
         )
+    custom_solver = solver is not None
     if solver is None:
         solver = default_tree_solver(mode, threshold)
     ledges = (
@@ -546,10 +985,45 @@ def sharded_solve(
     n_shards = int(node_shard.max()) + 1 if n_nodes else 1
     levels = reduce_tree_levels(n_shards, fanout)
     state = _TreeState(n_nodes, edges, payload, ledges, lpayload, node_shard)
+
+    plane_req = os.environ.get(_ENV_PLANE) or (reduce_plane or "auto")
+    if plane_req not in ("auto", "collective", "packet"):
+        raise ValueError(
+            f"reduce_plane must be auto|collective|packet, got {plane_req!r}"
+        )
+    hop_deadline = _hop_deadline_s(hop_deadline_s)
+    plane: Optional[CollectiveReducePlane] = None
+    if plane_req != "packet":
+        has_lifted = ledges is not None and len(ledges) > 0
+        auto_floor = int(
+            os.environ.get(_ENV_AUTO_MIN_EDGES, _AUTO_MIN_EDGES)
+        )
+        if plane_req == "collective" or (
+            not custom_solver and not has_lifted and len(edges) >= auto_floor
+        ):
+            try:
+                if custom_solver or has_lifted:
+                    raise ShardedSolveError(
+                        "collective plane needs the default solver and "
+                        "no lifted edges"
+                    )
+                plane = CollectiveReducePlane(
+                    mode, threshold, payload.shape[1],
+                    hop_deadline_s=hop_deadline,
+                )
+            except Exception as e:
+                # init-failure rung: attributed when the plane was
+                # demanded, counter-only when auto was probing
+                _record_packet_degrade(
+                    failures_path, task_name, e,
+                    record=(plane_req == "collective"),
+                )
+
     info: Dict = {
         "sharded": True,
         "shards": n_shards,
         "fanout": int(fanout),
+        "reduce_plane": "collective" if plane is not None else "host",
         "levels": [],
     }
     _record_solve_metrics(
@@ -576,21 +1050,44 @@ def sharded_solve(
             "solve.level_solve", level=li, groups=len(groups),
             edges_in=int(edges_in),
         )
-
-        def run_group(gi, _groups=groups, _li=li):
-            with trace_mod.span("solve.group", level=_li, group=gi):
-                members, labels, n_int = _solve_group(
-                    state, _groups[gi], solver
+        level_plane = "host"
+        if plane is not None:
+            try:
+                results, internal_total = plane.solve_level(
+                    state, groups, level=li, deadline_s=hop_deadline
                 )
-            with merge_lock:
-                results[gi] = (members, labels)
-            return n_int
+                level_plane = "collective"
+            except Exception as e:
+                # runtime rung of the degrade ladder (hop deadline, a
+                # failed collective, pool exhaustion): this and every
+                # remaining level re-solve on the host path — the plane
+                # was live, so the degradation is always attributed
+                _record_packet_degrade(failures_path, task_name, e)
+                info["degraded_plane"] = f"{type(e).__name__}: {e}"[:200]
+                info["reduce_plane"] = "host"
+                plane = None
+                results = {}
+                internal_total = 0
+        if level_plane == "host":
 
-        if max_workers > 1 and len(groups) > 1:
-            with ThreadPoolExecutor(max_workers=int(max_workers)) as pool:
-                internal_total = sum(pool.map(run_group, range(len(groups))))
-        else:
-            internal_total = sum(run_group(gi) for gi in range(len(groups)))
+            def run_group(gi, _groups=groups, _li=li):
+                with trace_mod.span("solve.group", level=_li, group=gi):
+                    members, labels, n_int = _solve_group(
+                        state, _groups[gi], solver
+                    )
+                with merge_lock:
+                    results[gi] = (members, labels)
+                return n_int
+
+            if max_workers > 1 and len(groups) > 1:
+                with ThreadPoolExecutor(max_workers=int(max_workers)) as pool:
+                    internal_total = sum(
+                        pool.map(run_group, range(len(groups)))
+                    )
+            else:
+                internal_total = sum(
+                    run_group(gi) for gi in range(len(groups))
+                )
         t_solve = solve_span.end()
 
         merge_span = trace_mod.begin("solve.level_merge", level=li)
@@ -599,6 +1096,7 @@ def sharded_solve(
         info["levels"].append({
             "level": li,
             "groups": len(groups),
+            "plane": level_plane,
             "edges_in": int(edges_in),
             "internal_edges": int(internal_total),
             "edges_out": int(len(state.edges)),
@@ -631,12 +1129,47 @@ def _publish_npz(path: str, **arrays) -> None:
     os.replace(tmp, path)
 
 
-def _wait_npz(path: str, wait_s: float) -> Dict[str, np.ndarray]:
-    """Poll for a sibling's packet with ``wait_s`` of patience — per hop,
+def _worker_pid_path(scratch: str, worker: int) -> str:
+    return os.path.join(scratch, f"worker_{int(worker)}.json")
+
+
+def _read_worker_os_pid(pid_path: str) -> Optional[int]:
+    """The OS pid a reduce worker advertised at boot, or None while the
+    file has not landed yet (the worker may still be initializing)."""
+    try:
+        with open(pid_path) as f:
+            return int(json.load(f)["os_pid"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _wait_npz(
+    path: str,
+    wait_s: float,
+    *,
+    deadline: Optional[float] = None,
+    owner_pid_path: Optional[str] = None,
+) -> Dict[str, np.ndarray]:
+    """Poll for a sibling's packet with ``wait_s`` of patience per hop —
     re-armed for every packet, so a worker whose own (possibly long) solve
-    consumed wall time still grants its siblings the full window; only a
-    packet that makes NO progress for ``wait_s`` is a lost hop."""
-    deadline = time.monotonic() + wait_s
+    consumed wall time still grants its siblings the full window.
+
+    Two fast-fail guards bound the worst case (a worker dying *between*
+    publishing level L and reading level L+1 used to burn the full
+    patience window per remaining hop — levels × patience):
+
+    - ``deadline`` (absolute ``time.monotonic()``) caps the TOTAL wait of
+      the enclosing level: however many packets are still missing, the
+      level fails in one window.
+    - ``owner_pid_path`` points at the publishing worker's boot-time pid
+      record; a ~4/s same-host liveness probe (``os.kill(pid, 0)``, the
+      PR-19 file_lock fast-break idiom) surfaces a dead publisher in a
+      quarter second, naming the pid instead of "worker death?".
+    """
+    hop_deadline = time.monotonic() + wait_s
+    if deadline is not None:
+        hop_deadline = min(hop_deadline, float(deadline))
+    next_probe = time.monotonic() + 0.25
     while True:
         if os.path.exists(path):
             try:
@@ -646,11 +1179,29 @@ def _wait_npz(path: str, wait_s: float) -> Dict[str, np.ndarray]:
                 # packets publish via os.replace, so a torn file here is
                 # real corruption, not a mid-write read
                 raise ShardedSolveError(f"unreadable packet {path}: {e}")
-        if time.monotonic() > deadline:
+        now = time.monotonic()
+        if now > hop_deadline:
             raise ShardedSolveError(
                 f"reduce hop lost: packet {os.path.basename(path)} did not "
-                f"arrive within {wait_s:g}s (worker death?)"
+                f"arrive within {wait_s:g}s"
+                + (" (level deadline)" if deadline is not None
+                   and hop_deadline == float(deadline) else "")
+                + " (worker death?)"
             )
+        if owner_pid_path is not None and now >= next_probe:
+            next_probe = now + 0.25
+            owner_pid = _read_worker_os_pid(owner_pid_path)
+            if owner_pid is not None:
+                try:
+                    os.kill(owner_pid, 0)
+                except ProcessLookupError:
+                    raise ShardedSolveError(
+                        f"reduce hop lost: worker owning "
+                        f"{os.path.basename(path)} (os pid {owner_pid}) "
+                        f"is dead"
+                    )
+                except (PermissionError, OSError):
+                    pass  # alive but unprobeable — keep the deadlines
         time.sleep(0.02)
 
 
@@ -703,6 +1254,11 @@ def _reduce_worker_body() -> None:
     pid = int(os.environ[multihost._ENV_PID])
     n_workers = int(os.environ[multihost._ENV_NPROC])
     hop_wait_s = float(os.environ.get(_ENV_WAIT, DEFAULT_HOP_WAIT_S))
+    # boot-time pid record: siblings probe it to fast-fail on this
+    # worker's death instead of burning their hop patience (_wait_npz)
+    fu.atomic_write_json(
+        _worker_pid_path(scratch, pid), {"os_pid": os.getpid()}
+    )
     # solver-worker lifetime span (docs/OBSERVABILITY.md): tracing is on
     # only when the driver exported CTT_TRACE=<dir>, pointing this process
     # at the submitter's shard directory
@@ -741,7 +1297,54 @@ def _reduce_worker_body() -> None:
     levels = reduce_tree_levels(int(meta["n_shards"]), int(meta["fanout"]))
     state = _TreeState(n_nodes, edges, payload, ledges, lpayload, node_shard)
 
+    # plane choice is made ONCE, deterministically, before the levels:
+    # every worker runs the same probe on the same backend, so the group
+    # either all takes the collective path (SPMD level programs over the
+    # pod mesh, no packets) or all exchanges filesystem packets.  A
+    # worker cannot switch rungs mid-solve — its siblings would wait on
+    # packets that are never coming.
+    plane: Optional[CollectiveReducePlane] = None
+    plane_reason = "packet plane requested"
+    plane_req = str(meta.get("reduce_plane", "packet"))
+    hop_deadline = _hop_deadline_s(meta.get("hop_deadline_s"))
+    if plane_req in ("auto", "collective"):
+        supported, reason = multihost.collectives_supported(
+            deadline_s=hop_deadline
+        )
+        if not supported:
+            # the known old-jaxlib CPU backends take initialize() but
+            # abort the first multi-process collective — degrade here,
+            # before any level committed to device hops
+            plane_reason = f"collectives unsupported: {reason}"
+        elif ledges is not None and len(ledges):
+            plane_reason = "lifted edges have no frontier formulation"
+        else:
+            try:
+                plane = CollectiveReducePlane(
+                    meta["mode"], float(meta["threshold"]),
+                    payload.shape[1] if payload.ndim > 1 else 1,
+                    hop_deadline_s=hop_deadline,
+                )
+                plane_reason = "collective"
+            except Exception as e:
+                plane_reason = f"plane init failed: {e}"[:200]
+
     for li, groups in enumerate(levels):
+        if plane is not None:
+            # the collective rung: ONE SPMD program solves every group of
+            # the level on the pod mesh and the in-program all_gather IS
+            # the reduce hop — no packets, no polling.  Any failure here
+            # is a worker failure (SIGKILL via reduce_worker_main); the
+            # driver retries the whole solve on the packet plane.
+            results, _ = plane.solve_level(
+                state, groups, level=li, deadline_s=hop_deadline
+            )
+            _apply_level(state, groups, results)
+            try:
+                trace_mod.flush()
+            except Exception:
+                pass
+            continue
         # solve + publish the groups dealt to this worker
         for gi in range(len(groups)):
             if _group_owner(li, gi, n_workers) != pid:
@@ -757,8 +1360,12 @@ def _reduce_worker_body() -> None:
                 members=members, labels=labels,
                 n_internal=np.int64(n_int),
             )
-        # collect every group's packet (the reduce hop) and fold the level
-        results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        # collect every group's packet (the reduce hop) and fold the
+        # level.  The level deadline is armed AFTER this worker's own
+        # solves: however many siblings' packets are still missing, a
+        # dead group fails within ONE patience window, not one per hop.
+        level_deadline = time.monotonic() + hop_wait_s
+        results = {}
         for gi in range(len(groups)):
             # the hop wait is the inter-host latency PAPERS.md's wafer-
             # scale-reduce analysis says must be measured per hop — one
@@ -766,7 +1373,13 @@ def _reduce_worker_body() -> None:
             with trace_mod.span(
                 "solve.hop_wait", level=li, group=gi, worker=pid
             ):
-                pkt = _wait_npz(_packet_path(scratch, li, gi), hop_wait_s)
+                pkt = _wait_npz(
+                    _packet_path(scratch, li, gi), hop_wait_s,
+                    deadline=level_deadline,
+                    owner_pid_path=_worker_pid_path(
+                        scratch, _group_owner(li, gi, n_workers)
+                    ),
+                )
             results[gi] = (
                 pkt["members"].astype(np.int64),
                 pkt["labels"].astype(np.int64),
@@ -787,6 +1400,11 @@ def _reduce_worker_body() -> None:
             # root residual for the driver's observability counters (its
             # own snapshot cannot see this process's state)
             boundary_edges_root=np.int64(len(state.edges)),
+            # which rung actually ran, for the driver's attribution
+            plane_used=np.str_(
+                "collective" if plane is not None else "packet"
+            ),
+            plane_reason=np.str_(plane_reason),
         )
     worker_span.end()
     try:
@@ -812,6 +1430,8 @@ def solve_over_workers(
     timeout: Optional[float] = None,
     hop_wait_s: Optional[float] = None,
     impl: Optional[str] = None,
+    reduce_plane: str = "packet",
+    hop_deadline_s: Optional[float] = None,
 ) -> Tuple[np.ndarray, Dict]:
     """Run the reduce tree over a :func:`multihost.launch_workers` group.
 
@@ -820,6 +1440,15 @@ def solve_over_workers(
     boundary-edge packets between levels are the inter-host hops.  Raises
     :class:`ShardedSolveError` on any worker failure or lost packet — the
     caller's cue to degrade to the single-host solve.
+
+    ``reduce_plane`` ∈ ``packet|auto|collective``: with ``auto`` or
+    ``collective`` the workers probe
+    :func:`multihost.collectives_supported` once at boot and — where the
+    backend can run multi-process collectives — replace the packet
+    exchange with SPMD level programs over the pod mesh
+    (:class:`CollectiveReducePlane`); otherwise all workers
+    deterministically stay on packets.  ``info["reduce_plane"]`` reports
+    the rung that actually ran, ``info["plane_reason"]`` why.
     """
     from .multihost import launch_workers
 
@@ -829,7 +1458,7 @@ def solve_over_workers(
     n_shards = int(node_shard.max()) + 1 if int(n_nodes) else 1
     os.makedirs(scratch_dir, exist_ok=True)
     for stale in os.listdir(scratch_dir):
-        if stale.startswith(("packet_", "result")):
+        if stale.startswith(("packet_", "result", "worker_")):
             try:
                 os.unlink(os.path.join(scratch_dir, stale))
             except OSError:
@@ -850,6 +1479,8 @@ def solve_over_workers(
             "mode": mode,
             "threshold": float(threshold),
             "impl": impl or "host",
+            "reduce_plane": str(reduce_plane),
+            "hop_deadline_s": _hop_deadline_s(hop_deadline_s),
         },
     )
 
@@ -906,6 +1537,10 @@ def solve_over_workers(
         labels = f["labels"].astype(np.int64)
         root_edges = int(f["boundary_edges_root"]) \
             if "boundary_edges_root" in f.files else 0
+        plane_used = str(f["plane_used"]) if "plane_used" in f.files \
+            else "packet"
+        plane_reason = str(f["plane_reason"]) if "plane_reason" in f.files \
+            else ""
     wall = group_span.end()
     levels = reduce_tree_levels(n_shards, fanout)
     info = {
@@ -913,6 +1548,8 @@ def solve_over_workers(
         "shards": n_shards,
         "fanout": int(fanout),
         "workers": int(n_workers),
+        "reduce_plane": plane_used,
+        "plane_reason": plane_reason,
         "levels": [{"level": i, "groups": len(g)} for i, g in enumerate(levels)],
         "wall_s": round(wall, 4),
         "boundary_edges_root": root_edges,
@@ -952,6 +1589,8 @@ def solve_with_reduce_tree(
     scratch_dir: Optional[str] = None,
     worker_timeout: Optional[float] = None,
     max_workers: int = 1,
+    reduce_plane: str = "auto",
+    hop_deadline_s: Optional[float] = None,
 ) -> Tuple[np.ndarray, Dict]:
     """Sharded solve with the single-host path as the degenerate case AND
     the degrade fallback.  Returns ``(labels, info)``.
@@ -974,6 +1613,12 @@ def solve_with_reduce_tree(
     computed (docs/ROBUSTNESS.md "Graceful degradation").
     ``DrainInterrupt`` is a BaseException and passes through: a preemption
     mid-solve drains, it does not burn a fallback.
+
+    ``reduce_plane``/``hop_deadline_s`` pick the level engine (see
+    :func:`sharded_solve`): ``collective`` rides the degrade ladder
+    collective → packet plane → unsharded, each rung attributed
+    (``degraded:packet_plane`` / ``degraded:unsharded_solve``); ``auto``
+    takes the best supported rung; ``packet`` never touches devices.
     """
     shards = int(solver_shards or 1)
     if shards <= 1 or node_shard is None or int(n_nodes) == 0 \
@@ -994,23 +1639,54 @@ def solve_with_reduce_tree(
                 # single-host, but not a failure worth attributing
                 no_partition = True
                 raise ShardedSolveError("no block geometry to shard by")
+        plane_req = os.environ.get(_ENV_PLANE) or (reduce_plane or "auto")
         if int(workers) > 1:
             if scratch_dir is None:
                 raise ShardedSolveError(
                     "worker-group solve needs a scratch_dir for the hops"
                 )
-            return solve_over_workers(
-                n_nodes, edges, payload, node_shard,
-                fanout=fanout, mode=mode, threshold=threshold,
-                lifted_edges=lifted_edges, lifted_payload=lifted_payload,
-                n_workers=int(workers), scratch_dir=scratch_dir,
-                timeout=worker_timeout,
-            )
+
+            def worker_solve(rp):
+                return solve_over_workers(
+                    n_nodes, edges, payload, node_shard,
+                    fanout=fanout, mode=mode, threshold=threshold,
+                    lifted_edges=lifted_edges, lifted_payload=lifted_payload,
+                    n_workers=int(workers), scratch_dir=scratch_dir,
+                    timeout=worker_timeout, reduce_plane=rp,
+                    hop_deadline_s=hop_deadline_s,
+                )
+
+            if plane_req != "collective":
+                return worker_solve(plane_req)
+            # demanded collective: one retry rung on the packet plane
+            # before the unsharded ladder below — a mid-solve collective
+            # failure (hop deadline, failed gather → worker SIGKILL)
+            # re-runs the whole group on packets, bit-identically
+            try:
+                labels, winfo = worker_solve("collective")
+            except ShardedSolveError as hop_err:
+                _record_packet_degrade(failures_path, task_name, hop_err)
+                labels, winfo = worker_solve("packet")
+                winfo["degraded_plane"] = str(hop_err)[:200]
+                return labels, winfo
+            if winfo.get("reduce_plane") != "collective":
+                # the workers degraded up front (unsupported backend /
+                # init failure) — attribute it here, once, driver-side
+                _record_packet_degrade(
+                    failures_path, task_name,
+                    ShardedSolveError(
+                        winfo.get("plane_reason") or "collective plane "
+                        "unavailable in the worker group"
+                    ),
+                )
+            return labels, winfo
         return sharded_solve(
             n_nodes, edges, payload, node_shard,
             fanout=fanout, solver=solver, mode=mode, threshold=threshold,
             lifted_edges=lifted_edges, lifted_payload=lifted_payload,
-            max_workers=max_workers,
+            max_workers=max_workers, reduce_plane=plane_req,
+            hop_deadline_s=hop_deadline_s, failures_path=failures_path,
+            task_name=task_name,
         )
     except Exception as e:
         if no_partition:

@@ -201,8 +201,15 @@ ENV_VAR = "CTT_FAULTS"
 #: "degraded:unsharded_solve").  Inside a reduce-tree worker the same hook
 #: (block-targeted by worker id) escalates to a real SIGKILL, so chaos can
 #: kill one worker of the group and prove the driver's fallback.
+#: "hop" is the collective reduce plane's exchange site
+#: (parallel/reduce_tree.py, docs/PERFORMANCE.md "Collective reduce
+#: plane"): an error there models a failed device collective (init
+#: refused, a peer dropping out of the gather), a hang a wedged
+#: interconnect hop — either must degrade the level to the filesystem
+#: packet plane (resolution "degraded:packet_plane") with bit-identical
+#: labels.
 _ERROR_SITES = ("load", "store", "io_read", "io_write", "submit", "task",
-                "solve")
+                "solve", "hop")
 #: "journal_append" / "journal_replay" are the durable-journal boundaries
 #: (runtime/journal.py, docs/SERVING.md "Durability"): a kill at the
 #: former models dying after the fsync'd ack record but before the
@@ -223,7 +230,9 @@ _TORN_SITES = ("journal",)
 #: Ragged paged batches (docs/PERFORMANCE.md "Ragged sweeps") — mixed-shape
 #: main batches AND the degrade ladder's sub-block batches — dispatch
 #: through the same site, so the same faults prove their fallback.
-_HANG_SITES = ("load", "store", "io_read", "io_write", "dispatch")
+#: "hop" hangs model a wedged collective on the reduce plane — the hop
+#: deadline must fire and degrade the solve to the packet plane.
+_HANG_SITES = ("load", "store", "io_read", "io_write", "dispatch", "hop")
 #: silent-corruption sites (kind='corrupt'): at ``io_write`` the flip lands
 #: after the write's sidecar is recorded; at ``io_read`` the stored bytes
 #: rot just before the read returns (at-rest damage surfacing at the read

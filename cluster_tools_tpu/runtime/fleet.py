@@ -449,6 +449,7 @@ class FleetGateway:
         self._affinity_map: Dict[str, str] = {}
         self._affinity_hits = 0
         self._affinity_misses = 0
+        self._affinity_cold = 0
         self._routes: "OrderedDict[str, str]" = OrderedDict()
         self._rejections: Dict[str, int] = {}
         self._adoptions: List[Dict[str, Any]] = []
@@ -1038,6 +1039,13 @@ class FleetGateway:
                 self._affinity_map[tenant] = target["name"]
             if hit:
                 self._affinity_hits += 1
+            elif want is None:
+                # a first-touch tenant has no affine member to hit — that
+                # is a cold pin, not a miss.  Counting it as a miss let
+                # new-tenant probe bursts (the bench's heal phase) drag
+                # hit_rate down without any affinity ever being broken
+                # (the BENCH_r13 0.89 → r15 0.75 investigation).
+                self._affinity_cold += 1
             else:
                 self._affinity_misses += 1
             return dict(target), None, hit
@@ -1369,6 +1377,7 @@ class FleetGateway:
                 for n, m in self._members.items()
             }
             hits, misses = self._affinity_hits, self._affinity_misses
+            cold_pins = self._affinity_cold
             affinity_map = dict(self._affinity_map)
             adoptions = list(self._adoptions)
             rejections = dict(self._rejections)
@@ -1402,6 +1411,10 @@ class FleetGateway:
                 "enabled": self.affinity,
                 "hits": hits,
                 "misses": misses,
+                # first-ever placements: no affinity existed to hit or
+                # break, reported separately so probe-tenant bursts don't
+                # pollute hit_rate's denominator
+                "cold_pins": cold_pins,
                 "hit_rate": round(hits / total, 4) if total else None,
                 "map": affinity_map,
             },

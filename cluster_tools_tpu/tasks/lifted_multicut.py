@@ -246,6 +246,11 @@ class SolveLiftedGlobalBase(BaseTask):
                 ),
                 solver_shards=shards,
                 fanout=int(cfg.get("reduce_fanout", 2) or 2),
+                # lifted edges have no frontier formulation
+                # (ops.multicut.lifted_frontier_capable) — the plane
+                # degrades itself, but the knob stays config-reachable
+                reduce_plane=str(cfg.get("reduce_plane", "auto") or "auto"),
+                hop_deadline_s=cfg.get("hop_deadline_s"),
                 failures_path=self.failures_path,
                 task_name=self.uid,
                 unsharded=unsharded,

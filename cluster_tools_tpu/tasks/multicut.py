@@ -406,6 +406,11 @@ class SolveGlobalBase(BaseTask):
                 workers=int(cfg.get("solver_workers", 1) or 1),
                 scratch_dir=os.path.join(mc_dir(self.tmp_folder), "reduce_tree"),
                 max_workers=max(1, self.max_jobs),
+                # collective reduce plane knobs (docs/PERFORMANCE.md):
+                # auto rides device collectives when eligible, collective
+                # demands them (degrades attributed), packet never does
+                reduce_plane=str(cfg.get("reduce_plane", "auto") or "auto"),
+                hop_deadline_s=cfg.get("hop_deadline_s"),
             )
         else:
             labels = unsharded()
@@ -477,6 +482,8 @@ class MulticutWorkflow(WorkflowBase):
                 "solver_shards",
                 "reduce_fanout",
                 "solver_workers",
+                "reduce_plane",
+                "hop_deadline_s",
             )
             if k in p
         }

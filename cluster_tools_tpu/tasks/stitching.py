@@ -269,6 +269,10 @@ class MergeStitchAssignmentsBase(BaseTask):
                         ),
                         solver_shards=shards,
                         fanout=int(cfg.get("reduce_fanout", 2) or 2),
+                        reduce_plane=str(
+                            cfg.get("reduce_plane", "auto") or "auto"
+                        ),
+                        hop_deadline_s=cfg.get("hop_deadline_s"),
                         failures_path=self.failures_path,
                         task_name=self.uid,
                         unsharded=lambda: gaec_parallel(

@@ -53,6 +53,7 @@ def hardened_sharded_solve(self, cfg, n_nodes, edges, costs, node_shard,
         node_shard=node_shard,
         solver_shards=int(cfg.get("solver_shards", 1) or 1),
         fanout=int(cfg.get("reduce_fanout", 2) or 2),
+        reduce_plane=str(cfg.get("reduce_plane", "auto") or "auto"),
         failures_path=self.failures_path,
         task_name=self.uid,
         unsharded=unsharded,

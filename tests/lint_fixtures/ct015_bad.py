@@ -1,0 +1,39 @@
+"""CT015 fixture: unbounded reduce-plane waits and a silent
+degraded:packet_plane fallback site."""
+
+import os
+import time
+
+from cluster_tools_tpu.parallel import multihost
+
+
+def _wait_npz(path, wait_s, deadline=None, owner_pid_path=None):
+    while not os.path.exists(path):
+        time.sleep(0.05)
+    return path
+
+
+class _Plane:
+    def solve_level(self, state, groups, level=0, deadline_s=None):
+        return [], 0
+
+
+def wait_forever(scratch):
+    # packet poll with no patience argument at all
+    return _wait_npz(os.path.join(scratch, "packet_0_0.npz"))
+
+
+def hop_without_deadline(plane, state, groups):
+    # collective dispatch without deadline_s: a dead sibling wedges us
+    return plane.solve_level(state, groups, level=0)
+
+
+def probe_without_deadline():
+    # the support probe itself can hang on a wedged coordinator
+    return multihost.collectives_supported()
+
+
+def silent_degrade(info):
+    # falls back without writing a failures record: unauditable
+    info["degraded_plane"] = "degraded:packet_plane"
+    return info

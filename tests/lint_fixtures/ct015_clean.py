@@ -1,0 +1,59 @@
+"""CT015 fixture: every reduce-plane wait bounded, every
+degraded:packet_plane site evidenced by a failures record (clean)."""
+
+import os
+import time
+
+from cluster_tools_tpu.parallel import multihost
+from cluster_tools_tpu.utils import function_utils as fu
+
+
+def _wait_npz(path, wait_s, deadline=None, owner_pid_path=None):
+    end = time.monotonic() + wait_s
+    while not os.path.exists(path):
+        if time.monotonic() >= end:
+            raise TimeoutError(path)
+        time.sleep(0.05)
+    return path
+
+
+class _Plane:
+    def solve_level(self, state, groups, level=0, deadline_s=None):
+        return [], 0
+
+
+def wait_with_patience(scratch, hop_wait_s):
+    # positional wait_s bounds the poll
+    return _wait_npz(os.path.join(scratch, "packet_0_0.npz"), hop_wait_s)
+
+
+def wait_with_deadline(scratch, level_deadline):
+    return _wait_npz(
+        os.path.join(scratch, "packet_0_0.npz"),
+        120.0,
+        deadline=level_deadline,
+    )
+
+
+def hop_with_deadline(plane, state, groups, hop_deadline_s):
+    return plane.solve_level(state, groups, level=0, deadline_s=hop_deadline_s)
+
+
+def probe_with_deadline(hop_deadline_s):
+    return multihost.collectives_supported(deadline_s=hop_deadline_s)
+
+
+def _record_packet_degrade(failures_path, task_name, err):
+    # the canonical helper: counter + failures record in one place
+    fu.record_failures(
+        failures_path,
+        task_name,
+        [{"sites": {"hop": 1}, "resolution": "degraded:packet_plane"}],
+    )
+
+
+def recorded_degrade(failures_path, info, err):
+    # fallback evidenced one level into the same-module helper
+    _record_packet_degrade(failures_path, "solve", err)
+    info["degraded_plane"] = "degraded:packet_plane"
+    return info

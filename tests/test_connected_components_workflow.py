@@ -217,6 +217,9 @@ def test_fused_segmentation_grid_decomposition(workspace, rng):
     assert_labels_equivalent(cc, want)
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~9 s of XLA compiles; resume
+# semantics stay tier-1 via test_cc_workflow_resume, and the fused task
+# itself via test_fused_segmentation_task_vs_scipy.
 def test_fused_segmentation_resume_noop(workspace, rng):
     """Rerunning a completed fused task is a no-op (success target)."""
     from cluster_tools_tpu.tasks.fused import FusedSegmentationLocal

@@ -711,6 +711,9 @@ def test_fused_segmentation_workflow_surfaces_inner_summary(tmp_path):
 # -- bench smoke (the <10 s twin of `make bench-fuse`) ------------------------
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~26 s of XLA compiles; bench
+# entry-point smoke — the fused workflow path stays tier-1 via
+# test_fused_segmentation_workflow_surfaces_inner_summary.
 def test_fuse_bench_smoke():
     import bench
 

@@ -352,6 +352,37 @@ def test_ct014_supervisor_surface_passes_unsuppressed():
         assert "ctlint: disable=CT014" not in open(path).read()
 
 
+def test_ct015_all_violation_classes():
+    """Reduce-plane discipline (docs/PERFORMANCE.md "Collective reduce
+    plane"): unbounded packet polls / collective hops / support probes,
+    and a degraded:packet_plane site with no failures record — each its
+    own violation class."""
+    findings, _ = lint_fixture("ct015_bad.py")
+    msgs = [f.message for f in findings if f.rule == "CT015"]
+    assert any("'_wait_npz'" in m for m in msgs)
+    assert any("'solve_level'" in m for m in msgs)
+    assert any("'collectives_supported'" in m for m in msgs)
+    assert any("'silent_degrade' degrades to the packet plane" in m
+               for m in msgs)
+
+
+def test_ct015_reduce_plane_surface_passes_unsuppressed():
+    """The real reduce-plane surface satisfies its own rule on merit:
+    every _wait_npz/solve_level/collectives_supported call carries
+    patience, and every degraded:packet_plane mention reaches
+    record_failures via _record_packet_degrade — no opt-outs."""
+    paths = [
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "parallel",
+                     "reduce_tree.py"),
+        os.path.join(REPO_ROOT, "cluster_tools_tpu", "parallel",
+                     "multihost.py"),
+    ]
+    for path in paths:
+        findings, _ = run_lint([path])
+        assert [f for f in findings if f.rule == "CT015"] == [], path
+        assert "ctlint: disable=CT015" not in open(path).read()
+
+
 # -- suppressions -------------------------------------------------------------
 
 

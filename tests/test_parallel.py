@@ -408,6 +408,9 @@ def test_distributed_edt_two_axis_decomposition(rng):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~18 s of XLA compiles; the
+# stitched path stays tier-1 via test_ws_ccl_step_stitched_with_compaction
+# and test_ws_ccl_step_two_axis_decomposition.
 def test_ws_ccl_step_stitched_fragments(rng):
     """stitch_ws_threshold: fragments facing each other across shard cuts
     with weak boundary evidence must merge — returned ws_labels are

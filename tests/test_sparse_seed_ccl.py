@@ -106,6 +106,9 @@ def test_sparse_overflow_flag(rng):
     assert bool(ovf)
 
 
+@pytest.mark.slow  # tier-2 (make tier2): ~20 s of XLA compiles; seed-mode
+# validation stays tier-1 via test_seed_mode_validation, and dt_watershed
+# itself via tests/test_tile_ws.py.
 def test_watershed_seed_mode_parity(rng, monkeypatch):
     from cluster_tools_tpu.ops.tile_ws import dt_watershed_tiled
 
